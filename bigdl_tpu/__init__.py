@@ -1,0 +1,27 @@
+"""bigdl_tpu — a TPU-native deep learning framework.
+
+A ground-up JAX/XLA re-design of the capability set of Intel BigDL
+(reference: /root/reference, Scala/Spark/MKL):
+
+- Torch-style stateful ``nn.Module`` layer library that lowers to pure
+  jittable functions (reference: ``nn/abstractnn/AbstractModule.scala``).
+- ``Optimizer`` builder API with Local (single host) and Distri (SPMD over a
+  ``jax.sharding.Mesh``) training loops (reference: ``optim/Optimizer.scala``,
+  ``optim/DistriOptimizer.scala``).
+- Data pipeline: ``Sample`` / ``MiniBatch`` / ``Transformer`` / ``DataSet``
+  (reference: ``dataset/``).
+- Distributed communication via XLA collectives over ICI/DCN instead of the
+  reference's Spark BlockManager parameter server (reference:
+  ``parameters/AllReduceParameter.scala``).
+
+Everything compute-side runs through jax.numpy / lax / pallas on TPU; the
+reference's MKL/MKL-DNN JNI layers are absorbed by XLA (SURVEY.md §2.12).
+"""
+
+from bigdl_tpu.version import __version__
+
+from bigdl_tpu.utils.table import Table, T
+from bigdl_tpu.utils import random as _random
+from bigdl_tpu.utils.random import RandomGenerator
+
+__all__ = ["__version__", "Table", "T", "RandomGenerator"]

@@ -1,0 +1,8 @@
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.minibatch import MiniBatch, PaddingParam
+from bigdl_tpu.dataset.transformer import (
+    Transformer, ChainedTransformer, FuncTransformer, SampleToMiniBatch, Normalizer,
+)
+from bigdl_tpu.dataset.dataset import (
+    AbstractDataSet, LocalDataSet, ShardedDataSet, TransformedDataSet, DataSet,
+)

@@ -1,0 +1,159 @@
+"""DataSet — the training data abstraction.
+
+Reference: dataset/DataSet.scala:49,113,167 (``DataSet``/``LocalDataSet``/
+``DistributedDataSet``) and the exact distributed-data semantics the TPU
+pipeline reproduces (SURVEY.md §2.4):
+
+- the training iterator is **infinite**: it walks a shuffled index array
+  modulo length from an offset (reference: dataset/DataSet.scala:258-292);
+- ``shuffle()`` re-permutes the index array only (:295-303);
+- data is sharded into ``num_shards`` in-memory partitions, one per host
+  (≙ one cached Array per Spark executor, :358-367); each iteration pulls
+  exactly one MiniBatch per shard (≙ optim/DistriOptimizer.scala:217).
+
+On TPU the "executor" is a JAX process (one per TPU host): a
+:class:`ShardedDataSet` owns only this host's shard, selected by
+``process_index``, and feeds device buffers.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import Transformer
+
+
+class AbstractDataSet:
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def shuffle(self) -> None:
+        raise NotImplementedError
+
+    def data(self, train: bool) -> Iterator:
+        raise NotImplementedError
+
+    def transform(self, transformer: Transformer) -> "TransformedDataSet":
+        return TransformedDataSet(self, transformer)
+
+    def __rshift__(self, transformer: Transformer) -> "TransformedDataSet":
+        return self.transform(transformer)
+
+
+class LocalDataSet(AbstractDataSet):
+    """In-memory dataset with the reference's infinite shuffled-index
+    training iterator (reference: dataset/DataSet.scala:113,258-292)."""
+
+    def __init__(self, records: Sequence, seed: int = 1):
+        self.records = list(records)
+        self._index = np.arange(len(self.records))
+        self._rng = np.random.RandomState(seed)
+
+    def size(self) -> int:
+        return len(self.records)
+
+    def shuffle(self) -> None:
+        self._rng.shuffle(self._index)
+
+    def data(self, train: bool = True) -> Iterator:
+        if train:
+            n = len(self.records)
+            offset = int(self._rng.randint(0, n)) if n else 0
+
+            def infinite():
+                i = offset
+                while True:
+                    yield self.records[self._index[i % n]]
+                    i += 1
+
+            return infinite()
+        return iter(self.records)
+
+
+class ShardedDataSet(AbstractDataSet):
+    """Distributed dataset: each process owns shard ``shard_id`` of
+    ``num_shards`` (reference: DistributedDataSet / CachedDistriDataSet,
+    dataset/DataSet.scala:167,243-306). All processes use the same seed so
+    shuffles stay aligned without communication (SPMD-friendly — unlike the
+    reference, no driver coordination is needed)."""
+
+    def __init__(self, records: Sequence, shard_id: int = None, num_shards: int = None,
+                 seed: int = 1):
+        import jax
+
+        self.num_shards = num_shards if num_shards is not None else jax.process_count()
+        self.shard_id = shard_id if shard_id is not None else jax.process_index()
+        all_records = list(records)
+        self._total_size = len(all_records)
+        # contiguous split, remainder spread over the first shards
+        # (≙ RDD coalesce to Engine.nodeNumber() partitions)
+        base = self._total_size // self.num_shards
+        rem = self._total_size % self.num_shards
+        start = self.shard_id * base + min(self.shard_id, rem)
+        length = base + (1 if self.shard_id < rem else 0)
+        self.records: List = all_records[start : start + length]
+        self._index = np.arange(len(self.records))
+        self._rng = np.random.RandomState(seed + self.shard_id)
+
+    def size(self) -> int:
+        """Global record count (matches the reference's dataset.size())."""
+        return self._total_size
+
+    def local_size(self) -> int:
+        return len(self.records)
+
+    def shuffle(self) -> None:
+        self._rng.shuffle(self._index)
+
+    def data(self, train: bool = True) -> Iterator:
+        if train:
+            n = len(self.records)
+            offset = int(self._rng.randint(0, n)) if n else 0
+
+            def infinite():
+                i = offset
+                while True:
+                    yield self.records[self._index[i % n]]
+                    i += 1
+
+            return infinite()
+        return iter(self.records)
+
+
+class TransformedDataSet(AbstractDataSet):
+    def __init__(self, base: AbstractDataSet, transformer: Transformer):
+        self.base = base
+        self.transformer = transformer
+
+    def size(self) -> int:
+        return self.base.size()
+
+    def local_size(self) -> int:
+        return getattr(self.base, "local_size", self.base.size)()
+
+    def shuffle(self) -> None:
+        self.base.shuffle()
+
+    def data(self, train: bool = True) -> Iterator:
+        return self.transformer(self.base.data(train))
+
+    @property
+    def num_shards(self):
+        return getattr(self.base, "num_shards", 1)
+
+
+class DataSet:
+    """Factory namespace (reference: dataset/DataSet.scala:322-567 object DataSet)."""
+
+    @staticmethod
+    def array(samples: Sequence, seed: int = 1) -> LocalDataSet:
+        return LocalDataSet(samples, seed=seed)
+
+    @staticmethod
+    def sharded(samples: Sequence, shard_id: int = None, num_shards: int = None,
+                seed: int = 1) -> ShardedDataSet:
+        """≙ DataSet.rdd — shard records across hosts."""
+        return ShardedDataSet(samples, shard_id=shard_id, num_shards=num_shards, seed=seed)

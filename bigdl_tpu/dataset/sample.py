@@ -1,0 +1,51 @@
+"""Sample — one training record of feature/label arrays.
+
+Reference: dataset/Sample.scala:32,138,446 (``ArraySample``/``TensorSample``).
+Features and labels are numpy arrays on host (device transfer happens at
+MiniBatch assembly, the analog of the reference keeping Samples on JVM heap
+until batching).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+ArrayLike = Union[np.ndarray, float, int, Sequence]
+
+
+class Sample:
+    __slots__ = ("features", "labels")
+
+    def __init__(self, features, labels=None):
+        if isinstance(features, np.ndarray) or not isinstance(features, (list, tuple)):
+            features = [np.asarray(features)]
+        else:
+            features = [np.asarray(f) for f in features]
+        self.features: List[np.ndarray] = features
+        if labels is None:
+            self.labels: List[np.ndarray] = []
+        else:
+            if isinstance(labels, np.ndarray) or not isinstance(labels, (list, tuple)):
+                labels = [np.asarray(labels)]
+            else:
+                labels = [np.asarray(l) for l in labels]
+            self.labels = labels
+
+    def feature(self, index: int = 0) -> np.ndarray:
+        return self.features[index]
+
+    def label(self, index: int = 0) -> Optional[np.ndarray]:
+        return self.labels[index] if self.labels else None
+
+    def num_feature(self) -> int:
+        return len(self.features)
+
+    def num_label(self) -> int:
+        return len(self.labels)
+
+    def __repr__(self):
+        f = ",".join(str(x.shape) for x in self.features)
+        l = ",".join(str(x.shape) for x in self.labels)
+        return f"Sample(features=[{f}], labels=[{l}])"

@@ -1,0 +1,337 @@
+"""Activation layers.
+
+Reference: the ~30 pointwise activation modules under nn/ (ReLU.scala,
+Tanh.scala, Sigmoid.scala, ELU.scala, …). All are stateless elementwise maps
+that XLA fuses into neighboring ops on the VPU; the reference's in-place
+(``ip``) flags are irrelevant under functional semantics and accepted for
+API compatibility only.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn import init as bt_init
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils import random as bt_random
+
+
+class ReLU(Module):
+    def __init__(self, ip: bool = False):
+        super().__init__()
+
+    def forward(self, input):
+        return jax.nn.relu(input)
+
+
+class ReLU6(Module):
+    def forward(self, input):
+        return jnp.clip(input, 0.0, 6.0)
+
+
+class Threshold(Module):
+    """x if x > th else v (reference: nn/Threshold.scala)."""
+
+    def __init__(self, th: float = 1e-6, v: float = 0.0, ip: bool = False):
+        super().__init__()
+        self.th, self.v = th, v
+
+    def forward(self, input):
+        return jnp.where(input > self.th, input, self.v)
+
+
+class BinaryThreshold(Module):
+    def __init__(self, th: float = 1e-6, ip: bool = False):
+        super().__init__()
+        self.th = th
+
+    def forward(self, input):
+        return (input > self.th).astype(input.dtype)
+
+
+class Tanh(Module):
+    def forward(self, input):
+        return jnp.tanh(input)
+
+
+class TanhShrink(Module):
+    def forward(self, input):
+        return input - jnp.tanh(input)
+
+
+class Sigmoid(Module):
+    def forward(self, input):
+        return jax.nn.sigmoid(input)
+
+
+class HardSigmoid(Module):
+    def forward(self, input):
+        return jnp.clip(0.2 * input + 0.5, 0.0, 1.0)
+
+
+class HardTanh(Module):
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0, ip: bool = False):
+        super().__init__()
+        self.min_value, self.max_value = min_value, max_value
+
+    def forward(self, input):
+        return jnp.clip(input, self.min_value, self.max_value)
+
+
+class Clamp(HardTanh):
+    def __init__(self, min_v: float, max_v: float):
+        super().__init__(min_v, max_v)
+
+
+class ELU(Module):
+    def __init__(self, alpha: float = 1.0, ip: bool = False):
+        super().__init__()
+        self.alpha = alpha
+
+    def forward(self, input):
+        return jax.nn.elu(input, alpha=self.alpha)
+
+
+class LeakyReLU(Module):
+    def __init__(self, negval: float = 0.01, ip: bool = False):
+        super().__init__()
+        self.negval = negval
+
+    def forward(self, input):
+        return jax.nn.leaky_relu(input, negative_slope=self.negval)
+
+
+class PReLU(Module):
+    """Learnable leaky slope per channel (reference: nn/PReLU.scala)."""
+
+    def __init__(self, n_output_plane: int = 0):
+        super().__init__()
+        self.n_output_plane = n_output_plane
+        n = max(1, n_output_plane)
+        self.register_parameter("weight", jnp.full((n,), 0.25))
+
+    def forward(self, input):
+        w = self.weight
+        if self.n_output_plane > 0:
+            # channel axis by rank (reference layout contract): 4D=NCHW -> 1,
+            # 3D=CHW unbatched -> 0, 2D=(batch, feat) -> 1, 1D -> 0.
+            ch_axis = 1 if input.ndim in (2, 4) else 0
+            shape = [1] * input.ndim
+            shape[ch_axis] = w.shape[0]
+            w = w.reshape(shape)
+        return jnp.where(input > 0, input, w * input)
+
+
+class RReLU(Module):
+    """Randomized leaky ReLU (reference: nn/RReLU.scala). In eval mode uses the
+    mean slope; in train mode samples slope U(lower, upper) per element."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3, ip: bool = False):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, input):
+        if self.training:
+            a = bt_random.RNG.uniform(input.shape, minval=self.lower, maxval=self.upper)
+        else:
+            a = (self.lower + self.upper) / 2.0
+        return jnp.where(input >= 0, input, a * input)
+
+
+class SReLU(Module):
+    """S-shaped ReLU with 4 learnable params per channel (reference: nn/SReLU.scala)."""
+
+    def __init__(self, shape):
+        super().__init__()
+        shape = tuple(shape)
+        self.register_parameter("t_left", jnp.zeros(shape))
+        self.register_parameter("a_left", jnp.ones(shape))
+        self.register_parameter("t_right", bt_init.Xavier()(shape, fan_in=1, fan_out=1) + 1.0)
+        self.register_parameter("a_right", jnp.ones(shape))
+
+    def forward(self, input):
+        y_left = self.t_left + self.a_left * (input - self.t_left)
+        y_right = self.t_right + self.a_right * (input - self.t_right)
+        return jnp.where(
+            input >= self.t_right, y_right, jnp.where(input > self.t_left, input, y_left)
+        )
+
+
+class SoftPlus(Module):
+    def __init__(self, beta: float = 1.0):
+        super().__init__()
+        self.beta = beta
+
+    def forward(self, input):
+        return jax.nn.softplus(self.beta * input) / self.beta
+
+
+class SoftSign(Module):
+    def forward(self, input):
+        return input / (1.0 + jnp.abs(input))
+
+
+class SoftShrink(Module):
+    def __init__(self, lambd: float = 0.5):
+        super().__init__()
+        self.lambd = lambd
+
+    def forward(self, input):
+        return jnp.sign(input) * jnp.maximum(jnp.abs(input) - self.lambd, 0.0)
+
+
+class HardShrink(Module):
+    def __init__(self, lambd: float = 0.5):
+        super().__init__()
+        self.lambd = lambd
+
+    def forward(self, input):
+        return jnp.where(jnp.abs(input) > self.lambd, input, 0.0)
+
+
+class SoftMax(Module):
+    """Softmax over the feature dim (last for 1-2D, dim 1 for 3-4D batched,
+    matching the reference's nn/SoftMax.scala)."""
+
+    def forward(self, input):
+        axis = -1 if input.ndim <= 2 else 1
+        return jax.nn.softmax(input, axis=axis)
+
+
+class SoftMin(Module):
+    def forward(self, input):
+        axis = -1 if input.ndim <= 2 else 1
+        return jax.nn.softmax(-input, axis=axis)
+
+
+class LogSoftMax(Module):
+    def forward(self, input):
+        return jax.nn.log_softmax(input, axis=-1)
+
+
+class LogSigmoid(Module):
+    def forward(self, input):
+        return jax.nn.log_sigmoid(input)
+
+
+class Exp(Module):
+    def forward(self, input):
+        return jnp.exp(input)
+
+
+class Log(Module):
+    def forward(self, input):
+        return jnp.log(input)
+
+
+class Log1p(Module):
+    def forward(self, input):
+        return jnp.log1p(input)
+
+
+class Sqrt(Module):
+    def forward(self, input):
+        return jnp.sqrt(input)
+
+
+class Square(Module):
+    def forward(self, input):
+        return input * input
+
+
+class Power(Module):
+    """(shift + scale * x)^power (reference: nn/Power.scala)."""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0):
+        super().__init__()
+        self.power, self.scale, self.shift = power, scale, shift
+
+    def forward(self, input):
+        return jnp.power(self.shift + self.scale * input, self.power)
+
+
+class Abs(Module):
+    def forward(self, input):
+        return jnp.abs(input)
+
+
+class Negative(Module):
+    def __init__(self, inplace: bool = False):
+        super().__init__()
+
+    def forward(self, input):
+        return -input
+
+
+class AddConstant(Module):
+    def __init__(self, constant_scalar: float, ip: bool = False):
+        super().__init__()
+        self.constant_scalar = constant_scalar
+
+    def forward(self, input):
+        return input + self.constant_scalar
+
+
+class MulConstant(Module):
+    def __init__(self, scalar: float, ip: bool = False):
+        super().__init__()
+        self.scalar = scalar
+
+    def forward(self, input):
+        return input * self.scalar
+
+
+class GradientReversal(Module):
+    """Identity forward, -lambda * grad backward (reference: nn/GradientReversal.scala)."""
+
+    def __init__(self, lambda_: float = 1.0):
+        super().__init__()
+        self.lambda_ = lambda_
+
+    def forward(self, input):
+        lam = self.lambda_
+
+        @jax.custom_vjp
+        def rev(x):
+            return x
+
+        def fwd(x):
+            return x, None
+
+        def bwd(_, g):
+            return (jax.tree.map(lambda t: -lam * t, g),)
+
+        rev.defvjp(fwd, bwd)
+        return rev(input)
+
+
+class Identity(Module):
+    def forward(self, input):
+        return input
+
+
+class Echo(Module):
+    """Identity that prints its input shape (reference: nn/Echo.scala)."""
+
+    def forward(self, input):
+        print(f"{self.get_name()}: {jax.tree.map(lambda x: x.shape, input)}")
+        return input
+
+
+class Maxout(Module):
+    """Linear to (maxout_number * output) then max over pieces (reference: nn/Maxout.scala)."""
+
+    def __init__(self, input_size: int, output_size: int, maxout_number: int,
+                 with_bias: bool = True):
+        super().__init__()
+        from bigdl_tpu.nn.linear import Linear
+
+        self.output_size = output_size
+        self.maxout_number = maxout_number
+        self.linear = Linear(input_size, output_size * maxout_number, with_bias=with_bias)
+
+    def forward(self, input):
+        out = self.linear(input)
+        out = out.reshape(out.shape[:-1] + (self.maxout_number, self.output_size))
+        return jnp.max(out, axis=-2)

@@ -1,0 +1,110 @@
+"""Containers.
+
+TPU-native analogs of the reference's containers (reference:
+nn/Container.scala:40, nn/Sequential.scala:31, nn/Concat.scala,
+nn/ConcatTable.scala, nn/ParallelTable.scala, nn/Bottle.scala,
+nn/MapTable.scala). Containers are ordinary Modules whose forward composes
+children; under ``pure_apply`` the whole composition traces into one XLA
+program (XLA fuses across layer boundaries — the role the reference's
+MklDnnContainer.compile played is subsumed by jit).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils.table import Table
+
+
+class Container(Module):
+    def __init__(self, *modules: Module):
+        super().__init__()
+        self._n_children = 0
+        for m in modules:
+            self.add(m)
+
+    def add(self, module: Module) -> "Container":
+        setattr(self, f"m{self._n_children}", module)
+        self._n_children += 1
+        return self
+
+    def __getitem__(self, index: int) -> Module:
+        return list(self._modules.values())[index]
+
+    def __len__(self):
+        return len(self._modules)
+
+    @property
+    def children(self):
+        return list(self._modules.values())
+
+
+class Sequential(Container):
+    """Feed-forward chain (reference: nn/Sequential.scala:31)."""
+
+    def forward(self, input):
+        x = input
+        for m in self._modules.values():
+            x = m(x)
+        return x
+
+
+class Concat(Container):
+    """Apply each child to the same input, concat outputs along ``dimension``
+    (1-based, reference: nn/Concat.scala)."""
+
+    def __init__(self, dimension: int, *modules: Module):
+        super().__init__(*modules)
+        self.dimension = dimension
+
+    def forward(self, input):
+        outs = [m(input) for m in self._modules.values()]
+        return jnp.concatenate(outs, axis=self.dimension - 1)
+
+
+class ConcatTable(Container):
+    """Apply each child to the same input, return a Table of outputs
+    (reference: nn/ConcatTable.scala)."""
+
+    def forward(self, input):
+        return Table(*[m(input) for m in self._modules.values()])
+
+
+class ParallelTable(Container):
+    """i-th child applied to i-th input element (reference: nn/ParallelTable.scala)."""
+
+    def forward(self, input):
+        mods = list(self._modules.values())
+        ins = list(input) if isinstance(input, (Table, list, tuple)) else [input]
+        return Table(*[m(x) for m, x in zip(mods, ins)])
+
+
+class MapTable(Container):
+    """Apply the single child to every element of the input table
+    (reference: nn/MapTable.scala). Functionally the child is shared (same
+    parameters applied to each element)."""
+
+    def __init__(self, module: Module):
+        super().__init__(module)
+
+    def forward(self, input):
+        m = self[0]
+        return Table(*[m(x) for x in input])
+
+
+class Bottle(Container):
+    """Reshape leading dims into one batch dim, apply child, restore
+    (reference: nn/Bottle.scala)."""
+
+    def __init__(self, module: Module, n_input_dim: int = 2, n_output_dim: int = None):
+        super().__init__(module)
+        self.n_input_dim = n_input_dim
+        self.n_output_dim = n_output_dim if n_output_dim is not None else n_input_dim
+
+    def forward(self, input):
+        shape = input.shape
+        lead = shape[: len(shape) - self.n_input_dim + 1]
+        flat = input.reshape((-1,) + shape[len(shape) - self.n_input_dim + 1 :])
+        out = self[0](flat)
+        return out.reshape(lead + out.shape[1:])

@@ -1,0 +1,305 @@
+"""Convolution layers.
+
+Reference: nn/SpatialConvolution.scala:54 (and Dilated/Full/Separable/
+Temporal/Volumetric variants). The reference lowers conv to im2col + MKL
+GEMM; here every variant is one ``lax.conv_general_dilated`` call, which XLA
+tiles directly onto the TPU MXU — no im2col, no layout reorder machinery
+(the role of nn/mkldnn/ReorderManager.scala is played by XLA layout
+assignment).
+
+API parity notes:
+- ctor argument order follows the reference: (kernelW, kernelH, strideW,
+  strideH, padW, padH) — W before H.
+- data layout is NCHW like the reference; XLA:TPU internally picks optimal
+  layouts, so this is a semantic choice only.
+- weight layout is (out_channels, in_channels/groups, kH, kW).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn import init as bt_init
+from bigdl_tpu.nn.module import Module
+
+
+def _pair_pad(pad_h, pad_w, in_h=None, in_w=None):
+    if pad_h == -1 or pad_w == -1:
+        # SAME padding (reference uses -1 to mean "same", SpatialConvolution.scala)
+        return "SAME"
+    return [(pad_h, pad_h), (pad_w, pad_w)]
+
+
+class SpatialConvolution(Module):
+    """2-D convolution over NCHW input (reference: nn/SpatialConvolution.scala:54)."""
+
+    def __init__(
+        self,
+        n_input_plane: int,
+        n_output_plane: int,
+        kernel_w: int,
+        kernel_h: int,
+        stride_w: int = 1,
+        stride_h: int = 1,
+        pad_w: int = 0,
+        pad_h: int = 0,
+        n_group: int = 1,
+        propagate_back: bool = True,
+        w_regularizer=None,
+        b_regularizer=None,
+        init_weight=None,
+        init_bias=None,
+        with_bias: bool = True,
+        init_method=None,
+    ):
+        super().__init__()
+        assert n_input_plane % n_group == 0 and n_output_plane % n_group == 0
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kernel_w, self.kernel_h = kernel_w, kernel_h
+        self.stride_w, self.stride_h = stride_w, stride_h
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.n_group = n_group
+        self.propagate_back = propagate_back
+        self.with_bias = with_bias
+        self._init_method = init_method or bt_init.Xavier()
+        wshape = (n_output_plane, n_input_plane // n_group, kernel_h, kernel_w)
+        fan_in = (n_input_plane // n_group) * kernel_h * kernel_w
+        fan_out = (n_output_plane // n_group) * kernel_h * kernel_w
+        w = (
+            jnp.asarray(init_weight)
+            if init_weight is not None
+            else self._init_method(wshape, fan_in=fan_in, fan_out=fan_out)
+        )
+        self.register_parameter("weight", w, regularizer=w_regularizer)
+        if with_bias:
+            b = jnp.asarray(init_bias) if init_bias is not None else jnp.zeros((n_output_plane,))
+            self.register_parameter("bias", b, regularizer=b_regularizer)
+
+    def reset(self):
+        fan_in = (self.n_input_plane // self.n_group) * self.kernel_h * self.kernel_w
+        fan_out = (self.n_output_plane // self.n_group) * self.kernel_h * self.kernel_w
+        self._set_param(
+            "weight",
+            self._init_method(self.weight.shape, fan_in=fan_in, fan_out=fan_out),
+        )
+        if self.with_bias:
+            self._set_param("bias", jnp.zeros((self.n_output_plane,)))
+
+    def _conv(self, x, w, dilation=(1, 1)):
+        return lax.conv_general_dilated(
+            x,
+            w,
+            window_strides=(self.stride_h, self.stride_w),
+            padding=_pair_pad(self.pad_h, self.pad_w),
+            rhs_dilation=dilation,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=self.n_group,
+        )
+
+    def forward(self, input):
+        squeeze = input.ndim == 3
+        x = input[None] if squeeze else input
+        out = self._conv(x, self.weight)
+        if self.with_bias:
+            out = out + self.bias[None, :, None, None]
+        return out[0] if squeeze else out
+
+    def _extra_repr(self):
+        return (
+            f"({self.n_input_plane} -> {self.n_output_plane}, "
+            f"{self.kernel_w}x{self.kernel_h}, {self.stride_w},{self.stride_h}, "
+            f"{self.pad_w},{self.pad_h})"
+        )
+
+
+class SpatialDilatedConvolution(SpatialConvolution):
+    """Atrous conv (reference: nn/SpatialDilatedConvolution.scala)."""
+
+    def __init__(self, n_input_plane, n_output_plane, kw, kh, dw=1, dh=1, pad_w=0, pad_h=0,
+                 dilation_w=1, dilation_h=1, **kwargs):
+        super().__init__(n_input_plane, n_output_plane, kw, kh, dw, dh, pad_w, pad_h, **kwargs)
+        self.dilation_w, self.dilation_h = dilation_w, dilation_h
+
+    def forward(self, input):
+        squeeze = input.ndim == 3
+        x = input[None] if squeeze else input
+        out = self._conv(x, self.weight, dilation=(self.dilation_h, self.dilation_w))
+        if self.with_bias:
+            out = out + self.bias[None, :, None, None]
+        return out[0] if squeeze else out
+
+
+class SpatialFullConvolution(Module):
+    """Transposed convolution (reference: nn/SpatialFullConvolution.scala)."""
+
+    def __init__(self, n_input_plane, n_output_plane, kw, kh, dw=1, dh=1,
+                 pad_w=0, pad_h=0, adj_w=0, adj_h=0, n_group=1, with_bias=True,
+                 w_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.kernel_w, self.kernel_h = kw, kh
+        self.stride_w, self.stride_h = dw, dh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.adj_w, self.adj_h = adj_w, adj_h
+        self.n_group = n_group
+        self.with_bias = with_bias
+        fan_in = n_output_plane * kh * kw
+        wshape = (n_input_plane, n_output_plane // n_group, kh, kw)
+        self.register_parameter(
+            "weight", bt_init.Xavier()(wshape, fan_in=fan_in, fan_out=n_input_plane * kh * kw),
+            regularizer=w_regularizer,
+        )
+        if with_bias:
+            self.register_parameter("bias", jnp.zeros((n_output_plane,)), regularizer=b_regularizer)
+
+    def forward(self, input):
+        squeeze = input.ndim == 3
+        x = input[None] if squeeze else input
+        kh, kw = self.kernel_h, self.kernel_w
+        g = self.n_group
+        pad = [
+            (kh - 1 - self.pad_h, kh - 1 - self.pad_h + self.adj_h),
+            (kw - 1 - self.pad_w, kw - 1 - self.pad_w + self.adj_w),
+        ]
+        # transposed conv = lhs-dilated conv with the spatially flipped kernel;
+        # weight (in, out/g, kh, kw) -> grouped OIHW (out, in/g, kh, kw)
+        w = jnp.flip(self.weight, axis=(-2, -1))
+        w = w.reshape(g, self.n_input_plane // g, self.n_output_plane // g, kh, kw)
+        w = jnp.swapaxes(w, 1, 2).reshape(
+            self.n_output_plane, self.n_input_plane // g, kh, kw
+        )
+        out = lax.conv_general_dilated(
+            x, w,
+            window_strides=(1, 1),
+            padding=pad,
+            lhs_dilation=(self.stride_h, self.stride_w),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=g,
+        )
+        if self.with_bias:
+            out = out + self.bias[None, :, None, None]
+        return out[0] if squeeze else out
+
+
+class SpatialSeparableConvolution(Module):
+    """Depthwise + pointwise conv (reference: nn/SpatialSeparableConvolution.scala)."""
+
+    def __init__(self, n_input_channel, n_output_channel, depth_multiplier,
+                 kw, kh, sw=1, sh=1, pw=0, ph=0, with_bias=True):
+        super().__init__()
+        self.depthwise = SpatialConvolution(
+            n_input_channel, n_input_channel * depth_multiplier, kw, kh, sw, sh, pw, ph,
+            n_group=n_input_channel, with_bias=False,
+        )
+        self.pointwise = SpatialConvolution(
+            n_input_channel * depth_multiplier, n_output_channel, 1, 1, 1, 1, 0, 0,
+            with_bias=with_bias,
+        )
+
+    def forward(self, input):
+        return self.pointwise(self.depthwise(input))
+
+
+class SpatialShareConvolution(SpatialConvolution):
+    """Same math as SpatialConvolution; the reference variant only shares
+    im2col buffers (nn/SpatialShareConvolution.scala) which is moot under XLA."""
+
+
+class LocallyConnected2D(Module):
+    """Unshared conv (reference: nn/LocallyConnected2D.scala). Implemented as
+    patch extraction + per-position einsum (maps to batched matmul on MXU)."""
+
+    def __init__(self, n_input_plane, input_w, input_h, n_output_plane,
+                 kw, kh, sw=1, sh=1, pw=0, ph=0, with_bias=True):
+        super().__init__()
+        self.args = (n_input_plane, input_w, input_h, n_output_plane, kw, kh, sw, sh, pw, ph)
+        self.with_bias = with_bias
+        out_h = (input_h + 2 * ph - kh) // sh + 1
+        out_w = (input_w + 2 * pw - kw) // sw + 1
+        self.out_h, self.out_w = out_h, out_w
+        fan_in = n_input_plane * kh * kw
+        self.register_parameter(
+            "weight",
+            bt_init.Xavier()((out_h * out_w, n_output_plane, n_input_plane * kh * kw),
+                             fan_in=fan_in, fan_out=n_output_plane * kh * kw),
+        )
+        if with_bias:
+            self.register_parameter("bias", jnp.zeros((out_h * out_w, n_output_plane)))
+
+    def forward(self, input):
+        n_in, in_w, in_h, n_out, kw, kh, sw, sh, pw, ph = self.args
+        x = input[None] if input.ndim == 3 else input
+        b = x.shape[0]
+        x = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        patches = lax.conv_general_dilated_patches(
+            x, (kh, kw), (sh, sw), padding="VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )  # (b, n_in*kh*kw, out_h, out_w)
+        patches = patches.reshape(b, -1, self.out_h * self.out_w).transpose(0, 2, 1)
+        out = jnp.einsum("bpk,pok->bpo", patches, self.weight)
+        if self.with_bias:
+            out = out + self.bias
+        out = out.transpose(0, 2, 1).reshape(b, n_out, self.out_h, self.out_w)
+        return out[0] if input.ndim == 3 else out
+
+
+class TemporalConvolution(Module):
+    """1-D conv over (batch, time, feat) (reference: nn/TemporalConvolution.scala)."""
+
+    def __init__(self, input_frame_size, output_frame_size, kernel_w, stride_w=1,
+                 w_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.input_frame_size = input_frame_size
+        self.output_frame_size = output_frame_size
+        self.kernel_w, self.stride_w = kernel_w, stride_w
+        fan_in = input_frame_size * kernel_w
+        self.register_parameter(
+            "weight",
+            bt_init.Xavier()((output_frame_size, input_frame_size, kernel_w),
+                             fan_in=fan_in, fan_out=output_frame_size * kernel_w),
+            regularizer=w_regularizer,
+        )
+        self.register_parameter("bias", jnp.zeros((output_frame_size,)), regularizer=b_regularizer)
+
+    def forward(self, input):
+        squeeze = input.ndim == 2
+        x = input[None] if squeeze else input  # (b, t, c)
+        x = jnp.swapaxes(x, 1, 2)  # (b, c, t)
+        out = lax.conv_general_dilated(
+            x, self.weight, window_strides=(self.stride_w,), padding="VALID",
+            dimension_numbers=("NCH", "OIH", "NCH"),
+        )
+        out = jnp.swapaxes(out, 1, 2) + self.bias
+        return out[0] if squeeze else out
+
+
+class VolumetricConvolution(Module):
+    """3-D conv over NCDHW (reference: nn/VolumetricConvolution.scala)."""
+
+    def __init__(self, n_input_plane, n_output_plane, kt, kw, kh,
+                 dt=1, dw=1, dh=1, pad_t=0, pad_w=0, pad_h=0, with_bias=True):
+        super().__init__()
+        self.strides = (dt, dh, dw)
+        self.pads = [(pad_t, pad_t), (pad_h, pad_h), (pad_w, pad_w)]
+        self.with_bias = with_bias
+        fan_in = n_input_plane * kt * kh * kw
+        self.register_parameter(
+            "weight",
+            bt_init.Xavier()((n_output_plane, n_input_plane, kt, kh, kw),
+                             fan_in=fan_in, fan_out=n_output_plane * kt * kh * kw),
+        )
+        if with_bias:
+            self.register_parameter("bias", jnp.zeros((n_output_plane,)))
+
+    def forward(self, input):
+        squeeze = input.ndim == 4
+        x = input[None] if squeeze else input
+        out = lax.conv_general_dilated(
+            x, self.weight, window_strides=self.strides, padding=self.pads,
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        )
+        if self.with_bias:
+            out = out + self.bias[None, :, None, None, None]
+        return out[0] if squeeze else out

@@ -1,0 +1,518 @@
+"""Loss functions (criterions).
+
+Reference: nn/abstractnn/AbstractCriterion.scala and the ~45 criterion
+modules (nn/ClassNLLCriterion.scala:242 etc.). Each criterion is
+``forward(input, target) -> scalar``; ``backward`` is jax.grad of forward
+w.r.t. input, replacing the hand-written updateGradInput implementations.
+
+Behavioral contract: class targets are **1-based** (SURVEY.md Appendix B.1) —
+ClassNLLCriterion expects labels in 1..nClasses.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.utils.table import Table
+
+
+class Criterion:
+    """Base (reference: nn/abstractnn/AbstractCriterion.scala)."""
+
+    def __init__(self):
+        self.output = None
+        self.grad_input = None
+        self.size_average = True
+
+    def forward(self, input, target):
+        raise NotImplementedError
+
+    def __call__(self, input, target):
+        self.output = self.forward(input, target)
+        return self.output
+
+    def backward(self, input, target):
+        self.grad_input = jax.grad(lambda x: jnp.sum(self.forward(x, target)))(input)
+        return self.grad_input
+
+
+def _reduce(x, size_average: bool):
+    return jnp.mean(x) if size_average else jnp.sum(x)
+
+
+class ClassNLLCriterion(Criterion):
+    """NLL over log-probabilities with 1-based integer targets
+    (reference: nn/ClassNLLCriterion.scala). ``logProbAsInput=True`` expects
+    log-softmax outputs (the default pairing with LogSoftMax)."""
+
+    def __init__(self, weights=None, size_average: bool = True,
+                 log_prob_as_input: bool = True, padding_value: int = -1):
+        super().__init__()
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+        self.log_prob_as_input = log_prob_as_input
+        self.padding_value = padding_value
+
+    def forward(self, input, target):
+        logp = input if self.log_prob_as_input else jnp.log(input + 1e-8)
+        if logp.ndim == 1:
+            logp = logp[None]
+            target = jnp.reshape(target, (1,))
+        t = jnp.reshape(target, (-1,)).astype(jnp.int32)
+        valid = t != self.padding_value
+        idx = jnp.clip(t - 1, 0, logp.shape[-1] - 1)
+        picked = jnp.take_along_axis(logp, idx[:, None], axis=-1)[:, 0]
+        w = jnp.ones_like(picked) if self.weights is None else self.weights[idx]
+        w = w * valid.astype(picked.dtype)
+        loss = -jnp.sum(w * picked)
+        if self.size_average:
+            loss = loss / jnp.maximum(jnp.sum(w), 1e-8)
+        return loss
+
+
+class CrossEntropyCriterion(Criterion):
+    """LogSoftMax + ClassNLL fused (reference: nn/CrossEntropyCriterion.scala)."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__()
+        self.nll = ClassNLLCriterion(weights, size_average, log_prob_as_input=True)
+
+    def forward(self, input, target):
+        return self.nll.forward(jax.nn.log_softmax(input, axis=-1), target)
+
+
+class CategoricalCrossEntropy(Criterion):
+    """Cross entropy with one-hot targets over probabilities
+    (reference: nn/CategoricalCrossEntropy.scala)."""
+
+    def forward(self, input, target):
+        logp = jnp.log(jnp.clip(input, 1e-8, 1.0))
+        return -jnp.mean(jnp.sum(target * logp, axis=-1))
+
+
+class MSECriterion(Criterion):
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        return _reduce((input - target) ** 2, self.size_average)
+
+
+class AbsCriterion(Criterion):
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        return _reduce(jnp.abs(input - target), self.size_average)
+
+
+class BCECriterion(Criterion):
+    """Binary cross entropy over probabilities (reference: nn/BCECriterion.scala)."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__()
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        eps = 1e-12
+        x = jnp.clip(input, eps, 1.0 - eps)
+        loss = -(target * jnp.log(x) + (1.0 - target) * jnp.log(1.0 - x))
+        if self.weights is not None:
+            loss = loss * self.weights
+        return _reduce(loss, self.size_average)
+
+
+class SmoothL1Criterion(Criterion):
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        d = jnp.abs(input - target)
+        loss = jnp.where(d < 1.0, 0.5 * d * d, d - 0.5)
+        return _reduce(loss, self.size_average)
+
+
+class DistKLDivCriterion(Criterion):
+    """KL(target || input) with input = log-probs (reference: nn/DistKLDivCriterion.scala)."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        loss = jnp.where(target > 0, target * (jnp.log(jnp.maximum(target, 1e-12)) - input), 0.0)
+        if self.size_average and input.ndim > 1:
+            return jnp.mean(jnp.sum(loss, axis=-1))  # mean over batch rows
+        return jnp.sum(loss)
+
+
+class KLDCriterion(Criterion):
+    """VAE KL(q(z|x) || N(0,1)); input Table(mean, log_var)
+    (reference: nn/KLDCriterion.scala)."""
+
+    def forward(self, input, target=None):
+        mean, log_var = input[1], input[2]
+        kl = 0.5 * jnp.sum(mean**2 + jnp.exp(log_var) - 1.0 - log_var, axis=-1)
+        return jnp.mean(kl)
+
+
+class GaussianCriterion(Criterion):
+    """-log N(target; mean, exp(log_var)) (reference: nn/GaussianCriterion.scala)."""
+
+    def forward(self, input, target):
+        mean, log_var = input[1], input[2]
+        nll = 0.5 * (log_var + (target - mean) ** 2 / jnp.exp(log_var)
+                     + jnp.log(2 * jnp.pi))
+        return jnp.sum(nll)
+
+
+class MarginCriterion(Criterion):
+    """Hinge loss, targets ±1 (reference: nn/MarginCriterion.scala);
+    squared=True gives L2-SVM."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True,
+                 squared: bool = False):
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+        self.squared = squared
+
+    def forward(self, input, target):
+        loss = jnp.maximum(0.0, self.margin - input * target)
+        if self.squared:
+            loss = loss * loss
+        return _reduce(loss, self.size_average)
+
+
+class HingeEmbeddingCriterion(Criterion):
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        loss = jnp.where(target > 0, input, jnp.maximum(0.0, self.margin - input))
+        return _reduce(loss, self.size_average)
+
+
+class L1HingeEmbeddingCriterion(Criterion):
+    """Pairwise L1 distance hinge (reference: nn/L1HingeEmbeddingCriterion.scala)."""
+
+    def __init__(self, margin: float = 1.0):
+        super().__init__()
+        self.margin = margin
+
+    def forward(self, input, target):
+        d = jnp.sum(jnp.abs(input[1] - input[2]))
+        return jnp.where(target > 0, d, jnp.maximum(0.0, self.margin - d))
+
+
+class CosineEmbeddingCriterion(Criterion):
+    def __init__(self, margin: float = 0.0, size_average: bool = True):
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        a, b = input[1], input[2]
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12
+        )
+        t = jnp.reshape(target, cos.shape) if hasattr(target, "shape") else target
+        loss = jnp.where(t > 0, 1.0 - cos, jnp.maximum(0.0, cos - self.margin))
+        return _reduce(loss, self.size_average)
+
+
+class MarginRankingCriterion(Criterion):
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        x1, x2 = input[1], input[2]
+        y = target[1] if isinstance(target, Table) else target
+        loss = jnp.maximum(0.0, -y * (x1 - x2) + self.margin)
+        return _reduce(loss, self.size_average)
+
+
+class MultiMarginCriterion(Criterion):
+    """Multiclass hinge (reference: nn/MultiMarginCriterion.scala). 1-based targets."""
+
+    def __init__(self, p: int = 1, weights=None, margin: float = 1.0,
+                 size_average: bool = True):
+        super().__init__()
+        self.p, self.margin = p, margin
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        x = input if input.ndim == 2 else input[None]
+        t = jnp.reshape(target, (-1,)).astype(jnp.int32) - 1
+        correct = jnp.take_along_axis(x, t[:, None], axis=-1)
+        loss = jnp.maximum(0.0, self.margin - correct + x) ** self.p
+        if self.weights is not None:
+            loss = loss * self.weights[t][:, None]
+        # exclude the correct class position
+        mask = jax.nn.one_hot(t, x.shape[-1], dtype=x.dtype)
+        loss = loss * (1.0 - mask)
+        per_sample = jnp.sum(loss, axis=-1) / x.shape[-1]
+        return _reduce(per_sample, self.size_average)
+
+
+class MultiLabelMarginCriterion(Criterion):
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        x = input if input.ndim == 2 else input[None]
+        t = (target if target.ndim == 2 else target[None]).astype(jnp.int32)
+        n, c = x.shape
+        is_label = jnp.zeros((n, c), dtype=bool)
+        # labels are 1-based, 0 marks end
+        for j in range(t.shape[1]):
+            idx = jnp.clip(t[:, j] - 1, 0, c - 1)
+            valid = t[:, j] > 0
+            is_label = is_label | (jax.nn.one_hot(idx, c, dtype=jnp.int32).astype(bool)
+                                   & valid[:, None])
+        pos = jnp.where(is_label, x, jnp.inf)[:, :, None]   # (n, c_pos, 1)
+        neg = jnp.where(is_label, -jnp.inf, x)[:, None, :]  # (n, 1, c_neg)
+        margin = jnp.maximum(0.0, 1.0 - (pos - neg))
+        margin = jnp.where(jnp.isfinite(margin), margin, 0.0)
+        per_sample = jnp.sum(margin, axis=(1, 2)) / c
+        return _reduce(per_sample, self.size_average)
+
+
+class MultiLabelSoftMarginCriterion(Criterion):
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__()
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        loss = jax.nn.softplus(-input) * target + jax.nn.softplus(input) * (1 - target)
+        if self.weights is not None:
+            loss = loss * self.weights
+        return _reduce(jnp.mean(loss, axis=-1), self.size_average)
+
+
+class SoftMarginCriterion(Criterion):
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        return _reduce(jax.nn.softplus(-input * target), self.size_average)
+
+
+class L1Cost(Criterion):
+    def forward(self, input, target=None):
+        return jnp.sum(jnp.abs(input))
+
+
+class DotProductCriterion(Criterion):
+    def __init__(self, size_average: bool = False):
+        super().__init__()
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        dot = jnp.sum(input * target)
+        if self.size_average and input.ndim > 1:
+            dot = dot / input.shape[0]
+        return dot
+
+
+class CosineDistanceCriterion(Criterion):
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        cos = jnp.sum(input * target, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(input, axis=-1) * jnp.linalg.norm(target, axis=-1), 1e-12
+        )
+        return _reduce(1.0 - cos, self.size_average)
+
+
+class CosineProximityCriterion(Criterion):
+    def forward(self, input, target):
+        xn = input / jnp.maximum(jnp.linalg.norm(input, axis=-1, keepdims=True), 1e-12)
+        tn = target / jnp.maximum(jnp.linalg.norm(target, axis=-1, keepdims=True), 1e-12)
+        return -jnp.mean(jnp.sum(xn * tn, axis=-1))
+
+
+class PoissonCriterion(Criterion):
+    def forward(self, input, target):
+        return jnp.mean(input - target * jnp.log(input + 1e-8))
+
+
+class MeanAbsolutePercentageCriterion(Criterion):
+    def forward(self, input, target):
+        diff = jnp.abs(target - input) / jnp.clip(jnp.abs(target), 1e-7, None)
+        return 100.0 * jnp.mean(diff)
+
+
+class MeanSquaredLogarithmicCriterion(Criterion):
+    def forward(self, input, target):
+        a = jnp.log(jnp.clip(input, 1e-7, None) + 1.0)
+        b = jnp.log(jnp.clip(target, 1e-7, None) + 1.0)
+        return jnp.mean((a - b) ** 2)
+
+
+class KullbackLeiblerDivergenceCriterion(Criterion):
+    def forward(self, input, target):
+        t = jnp.clip(target, 1e-7, 1.0)
+        x = jnp.clip(input, 1e-7, 1.0)
+        return jnp.mean(jnp.sum(t * jnp.log(t / x), axis=-1))
+
+
+class DiceCoefficientCriterion(Criterion):
+    def __init__(self, size_average: bool = True, epsilon: float = 1.0):
+        super().__init__()
+        self.epsilon = epsilon
+
+    def forward(self, input, target):
+        x = input.reshape(input.shape[0], -1)
+        t = target.reshape(target.shape[0], -1)
+        inter = jnp.sum(x * t, axis=-1)
+        union = jnp.sum(x, axis=-1) + jnp.sum(t, axis=-1)
+        return jnp.mean(1.0 - (2.0 * inter + self.epsilon) / (union + self.epsilon))
+
+
+class ClassSimplexCriterion(Criterion):
+    """MSE against simplex-embedded class targets (reference: nn/ClassSimplexCriterion.scala)."""
+
+    def __init__(self, n_classes: int):
+        super().__init__()
+        self.n_classes = n_classes
+        import numpy as np
+
+        # build simplex embedding via Gram-Schmidt as in the reference
+        a = np.zeros((n_classes, n_classes), dtype=np.float32)
+        for i in range(n_classes):
+            a[i, i] = 1.0
+        a = a * np.sqrt(n_classes / (n_classes - 1.0)) if n_classes > 1 else a
+        mean = a.mean(axis=0, keepdims=True)
+        self.simplex = jnp.asarray(a - mean + mean * 0)  # centered
+        self.mse = MSECriterion()
+
+    def forward(self, input, target):
+        t = jnp.reshape(target, (-1,)).astype(jnp.int32) - 1
+        return self.mse.forward(input, self.simplex[t])
+
+
+class ParallelCriterion(Criterion):
+    """Weighted sum of criterions over table input/target
+    (reference: nn/ParallelCriterion.scala)."""
+
+    def __init__(self, repeat_target: bool = False):
+        super().__init__()
+        self.criterions = []
+        self.weights = []
+        self.repeat_target = repeat_target
+
+    def add(self, criterion: Criterion, weight: float = 1.0) -> "ParallelCriterion":
+        self.criterions.append(criterion)
+        self.weights.append(weight)
+        return self
+
+    def forward(self, input, target):
+        ins = list(input)
+        tgts = [target] * len(ins) if self.repeat_target else list(target)
+        total = 0.0
+        for c, w, x, t in zip(self.criterions, self.weights, ins, tgts):
+            total = total + w * c.forward(x, t)
+        return total
+
+
+class MultiCriterion(Criterion):
+    """Weighted sum of criterions on the same input (reference: nn/MultiCriterion.scala)."""
+
+    def __init__(self):
+        super().__init__()
+        self.criterions = []
+        self.weights = []
+
+    def add(self, criterion: Criterion, weight: float = 1.0) -> "MultiCriterion":
+        self.criterions.append(criterion)
+        self.weights.append(weight)
+        return self
+
+    def forward(self, input, target):
+        total = 0.0
+        for c, w in zip(self.criterions, self.weights):
+            total = total + w * c.forward(input, target)
+        return total
+
+
+class TimeDistributedCriterion(Criterion):
+    """Apply a criterion at every timestep of (batch, time, ...)
+    (reference: nn/TimeDistributedCriterion.scala)."""
+
+    def __init__(self, critrn: Criterion, size_average: bool = False,
+                 dimension: int = 2):
+        super().__init__()
+        self.critrn = critrn
+        self.size_average = size_average
+        self.dimension = dimension
+
+    def forward(self, input, target):
+        ax = self.dimension - 1
+        steps = input.shape[ax]
+        total = 0.0
+        for i in range(steps):
+            x = jnp.take(input, i, axis=ax)
+            t = jnp.take(target, i, axis=ax) if hasattr(target, "ndim") and \
+                target.ndim > ax else target
+            total = total + self.critrn.forward(x, t)
+        return total / steps if self.size_average else total
+
+
+class PGCriterion(Criterion):
+    """Policy-gradient criterion (reference: nn/PGCriterion.scala):
+    loss = -sum(log(prob_of_taken_action) * reward)."""
+
+    def __init__(self, sizeAverage: bool = False):
+        super().__init__()
+
+    def forward(self, input, target):
+        logp = jnp.log(jnp.clip(input, 1e-8, 1.0))
+        return -jnp.sum(logp * target)
+
+
+class ActivityRegularization(Criterion):
+    def __init__(self, l1: float = 0.0, l2: float = 0.0):
+        super().__init__()
+        self.l1, self.l2 = l1, l2
+
+    def forward(self, input, target=None):
+        return self.l1 * jnp.sum(jnp.abs(input)) + self.l2 * jnp.sum(input * input)
+
+
+class SmoothL1CriterionWithWeights(Criterion):
+    """Smooth-L1 with inside/outside weights (Fast-RCNN bbox loss,
+    reference: nn/SmoothL1CriterionWithWeights.scala)."""
+
+    def __init__(self, sigma: float = 1.0, num: int = 0):
+        super().__init__()
+        self.sigma2 = sigma * sigma
+        self.num = num
+
+    def forward(self, input, target):
+        if isinstance(target, Table):
+            t, w_in, w_out = target[1], target[2], target[3]
+        else:
+            t, w_in, w_out = target, 1.0, 1.0
+        d = w_in * (input - t)
+        ad = jnp.abs(d)
+        loss = jnp.where(ad < 1.0 / self.sigma2,
+                         0.5 * d * d * self.sigma2,
+                         ad - 0.5 / self.sigma2)
+        loss = jnp.sum(w_out * loss)
+        return loss / self.num if self.num > 0 else loss

@@ -1,0 +1,133 @@
+"""Dropout / noise layers.
+
+Reference: nn/Dropout.scala, nn/GaussianDropout.scala, nn/GaussianNoise.scala,
+nn/SpatialDropout1D/2D/3D.scala, nn/GaussianSampler.scala. Randomness flows
+through the scoped RNG (bigdl_tpu.utils.random): eager calls draw from the
+global stream; under ``pure_apply`` the caller-supplied key makes the layer
+deterministic and jit-safe.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils import random as bt_random
+
+
+class Dropout(Module):
+    """Inverted dropout, scales by 1/(1-p) at train time when scale=True
+    (reference: nn/Dropout.scala)."""
+
+    def __init__(self, init_p: float = 0.5, inplace: bool = False, scale: bool = True):
+        super().__init__()
+        self.p = init_p
+        self.scale = scale
+
+    def set_p(self, p: float) -> "Dropout":
+        self.p = p
+        return self
+
+    def forward(self, input):
+        if not self.training or self.p <= 0.0:
+            return input
+        keep = bt_random.RNG.bernoulli(input.shape, 1.0 - self.p)
+        out = input * keep.astype(input.dtype)
+        if self.scale:
+            out = out / (1.0 - self.p)
+        return out
+
+
+class SpatialDropout2D(Module):
+    """Drops whole channels of NCHW (reference: nn/SpatialDropout2D.scala)."""
+
+    def __init__(self, init_p: float = 0.5, data_format: str = "NCHW"):
+        super().__init__()
+        self.p = init_p
+        self.data_format = data_format
+
+    def forward(self, input):
+        if not self.training or self.p <= 0.0:
+            return input
+        shape = list(input.shape)
+        if self.data_format == "NCHW":
+            for i in range(len(shape) - 2, len(shape)):
+                shape[i] = 1
+        else:
+            for i in range(1 if input.ndim == 4 else 0, len(shape) - 1):
+                shape[i] = 1
+        keep = bt_random.RNG.bernoulli(tuple(shape), 1.0 - self.p)
+        return input * keep.astype(input.dtype)
+
+
+class SpatialDropout1D(Module):
+    def __init__(self, init_p: float = 0.5):
+        super().__init__()
+        self.p = init_p
+
+    def forward(self, input):
+        if not self.training or self.p <= 0.0:
+            return input
+        shape = list(input.shape)
+        shape[-2] = 1  # drop whole feature maps across time
+        keep = bt_random.RNG.bernoulli(tuple(shape), 1.0 - self.p)
+        return input * keep.astype(input.dtype)
+
+
+class SpatialDropout3D(Module):
+    """Drops whole channels of NCDHW (or NDHWC) volumes
+    (reference: nn/SpatialDropout3D.scala)."""
+
+    def __init__(self, init_p: float = 0.5, data_format: str = "NCHW"):
+        super().__init__()
+        self.p = init_p
+        self.data_format = data_format
+
+    def forward(self, input):
+        if not self.training or self.p <= 0.0:
+            return input
+        shape = list(input.shape)
+        if self.data_format == "NCHW":  # channels-first: mask (b, c, 1, 1, 1)
+            shape[-1] = shape[-2] = shape[-3] = 1
+        else:  # channels-last: mask (b, 1, 1, 1, c)
+            shape[-2] = shape[-3] = shape[-4] = 1
+        keep = bt_random.RNG.bernoulli(tuple(shape), 1.0 - self.p)
+        return input * keep.astype(input.dtype)
+
+
+class GaussianDropout(Module):
+    """Multiplicative N(1, p/(1-p)) noise (reference: nn/GaussianDropout.scala)."""
+
+    def __init__(self, rate: float):
+        super().__init__()
+        self.rate = rate
+
+    def forward(self, input):
+        if not self.training:
+            return input
+        stddev = (self.rate / (1.0 - self.rate)) ** 0.5
+        noise = bt_random.RNG.normal(input.shape, mean=1.0, stdv=stddev)
+        return input * noise
+
+
+class GaussianNoise(Module):
+    """Additive N(0, stddev) noise at train time (reference: nn/GaussianNoise.scala)."""
+
+    def __init__(self, stddev: float):
+        super().__init__()
+        self.stddev = stddev
+
+    def forward(self, input):
+        if not self.training:
+            return input
+        return input + bt_random.RNG.normal(input.shape, stdv=self.stddev)
+
+
+class GaussianSampler(Module):
+    """VAE reparameterized sampler: input Table(mean, log_var)
+    (reference: nn/GaussianSampler.scala)."""
+
+    def forward(self, input):
+        mean, log_var = input[1], input[2]
+        eps = bt_random.RNG.normal(mean.shape)
+        return mean + jnp.exp(0.5 * log_var) * eps

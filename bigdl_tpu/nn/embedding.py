@@ -1,0 +1,45 @@
+"""Embedding layers.
+
+Reference: nn/LookupTable.scala (315 LoC), nn/LookupTableSparse.scala.
+Indices are 1-based (Torch legacy). A gather on TPU; max-norm renorm is
+applied functionally to the rows referenced by the current batch.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn import init as bt_init
+from bigdl_tpu.nn.module import Module
+
+
+class LookupTable(Module):
+    def __init__(self, n_index: int, n_output: int, padding_value: float = 0,
+                 max_norm: float = float("inf"), norm_type: float = 2.0,
+                 should_scale_grad_by_freq: bool = False, w_regularizer=None):
+        super().__init__()
+        self.n_index, self.n_output = n_index, n_output
+        self.padding_value = padding_value
+        self.max_norm = max_norm
+        self.norm_type = norm_type
+        w = bt_init.RandomNormal(0.0, 1.0)((n_index, n_output))
+        self.register_parameter("weight", w, regularizer=w_regularizer)
+
+    def reset(self):
+        self._set_param("weight", bt_init.RandomNormal(0.0, 1.0)((self.n_index, self.n_output)))
+
+    def forward(self, input):
+        idx = jnp.asarray(input).astype(jnp.int32) - 1  # 1-based -> 0-based
+        w = self.weight
+        if self.max_norm != float("inf"):
+            norms = jnp.linalg.norm(w, ord=self.norm_type, axis=1, keepdims=True)
+            scale = jnp.minimum(1.0, self.max_norm / jnp.maximum(norms, 1e-7))
+            w = w * scale
+        out = jnp.take(w, jnp.clip(idx, 0, self.n_index - 1), axis=0)
+        if self.padding_value != 0:
+            mask = (jnp.asarray(input) != self.padding_value).astype(out.dtype)
+            out = out * mask[..., None]
+        return out
+
+    def _extra_repr(self):
+        return f"(nIndex={self.n_index}, nOutput={self.n_output})"

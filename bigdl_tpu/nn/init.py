@@ -1,0 +1,108 @@
+"""Weight initialization methods.
+
+TPU-native analog of the reference's ``InitializationMethod`` hierarchy
+(reference: nn/InitializationMethod.scala). Each method is a callable
+``init(shape, fan_in, fan_out) -> jnp array`` drawing from the global RNG
+(deterministic under ``bigdl_tpu.utils.random.set_seed``).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from bigdl_tpu.utils import random as bt_random
+
+
+class InitializationMethod:
+    def __call__(self, shape, fan_in=None, fan_out=None):
+        raise NotImplementedError
+
+
+class Zeros(InitializationMethod):
+    def __call__(self, shape, fan_in=None, fan_out=None):
+        return jnp.zeros(shape, dtype=jnp.float32)
+
+
+class Ones(InitializationMethod):
+    def __call__(self, shape, fan_in=None, fan_out=None):
+        return jnp.ones(shape, dtype=jnp.float32)
+
+
+class ConstInitMethod(InitializationMethod):
+    def __init__(self, value: float):
+        self.value = value
+
+    def __call__(self, shape, fan_in=None, fan_out=None):
+        return jnp.full(shape, self.value, dtype=jnp.float32)
+
+
+class RandomUniform(InitializationMethod):
+    """U(lower, upper); defaults to Torch's U(-1/sqrt(fan_in), 1/sqrt(fan_in))."""
+
+    def __init__(self, lower=None, upper=None):
+        self.lower = lower
+        self.upper = upper
+
+    def __call__(self, shape, fan_in=None, fan_out=None):
+        if self.lower is None:
+            stdv = 1.0 / math.sqrt(max(1, fan_in or 1))
+            lo, hi = -stdv, stdv
+        else:
+            lo, hi = self.lower, self.upper
+        return bt_random.RNG.uniform(shape, minval=lo, maxval=hi)
+
+
+class RandomNormal(InitializationMethod):
+    def __init__(self, mean: float = 0.0, stdv: float = 1.0):
+        self.mean = mean
+        self.stdv = stdv
+
+    def __call__(self, shape, fan_in=None, fan_out=None):
+        return bt_random.RNG.normal(shape, mean=self.mean, stdv=self.stdv)
+
+
+class Xavier(InitializationMethod):
+    """Glorot uniform (the reference's default for Linear/Conv)."""
+
+    def __call__(self, shape, fan_in=None, fan_out=None):
+        fi = fan_in or shape[-1]
+        fo = fan_out or shape[0]
+        limit = math.sqrt(6.0 / (fi + fo))
+        return bt_random.RNG.uniform(shape, minval=-limit, maxval=limit)
+
+
+class MsraFiller(InitializationMethod):
+    """He initialization (reference: InitializationMethod.scala MsraFiller)."""
+
+    def __init__(self, variance_norm_average: bool = True):
+        self.variance_norm_average = variance_norm_average
+
+    def __call__(self, shape, fan_in=None, fan_out=None):
+        fi = fan_in or shape[-1]
+        fo = fan_out or shape[0]
+        n = (fi + fo) / 2.0 if self.variance_norm_average else fi
+        std = math.sqrt(2.0 / max(1.0, n))
+        return bt_random.RNG.normal(shape, mean=0.0, stdv=std)
+
+
+class BilinearFiller(InitializationMethod):
+    """Bilinear upsampling kernel init for full (transposed) convolutions."""
+
+    def __call__(self, shape, fan_in=None, fan_out=None):
+        # shape: (..., kh, kw)
+        kh, kw = shape[-2], shape[-1]
+        f_h, f_w = math.ceil(kh / 2.0), math.ceil(kw / 2.0)
+        c_h = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+        c_w = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        ys = jnp.arange(kh)[:, None]
+        xs = jnp.arange(kw)[None, :]
+        k = (1 - jnp.abs(ys / f_h - c_h)) * (1 - jnp.abs(xs / f_w - c_w))
+        return jnp.broadcast_to(k, shape).astype(jnp.float32)
+
+
+zeros = Zeros()
+ones = Ones()
+xavier = Xavier()
+msra = MsraFiller()

@@ -1,0 +1,174 @@
+"""Linear (fully-connected) layers.
+
+Reference: nn/Linear.scala:44. Weight layout (output_size, input_size), bias
+(output_size,), matching Torch. The matmul maps straight onto the TPU MXU;
+under jit XLA fuses the bias add.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn import init as bt_init
+from bigdl_tpu.nn.module import Module
+
+
+class Linear(Module):
+    def __init__(
+        self,
+        input_size: int,
+        output_size: int,
+        with_bias: bool = True,
+        w_regularizer=None,
+        b_regularizer=None,
+        init_weight=None,
+        init_bias=None,
+        init_method=None,
+    ):
+        super().__init__()
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_bias = with_bias
+        self._init_method = init_method or bt_init.Xavier()
+        if init_weight is not None:
+            w = jnp.asarray(init_weight)
+        else:
+            w = self._init_method((output_size, input_size), fan_in=input_size, fan_out=output_size)
+        self.register_parameter("weight", w, regularizer=w_regularizer)
+        if with_bias:
+            b = jnp.asarray(init_bias) if init_bias is not None else jnp.zeros((output_size,))
+            self.register_parameter("bias", b, regularizer=b_regularizer)
+
+    def reset(self):
+        self._set_param(
+            "weight",
+            self._init_method(
+                (self.output_size, self.input_size),
+                fan_in=self.input_size,
+                fan_out=self.output_size,
+            ),
+        )
+        if self.with_bias:
+            self._set_param("bias", jnp.zeros((self.output_size,)))
+
+    def forward(self, input):
+        out = jnp.matmul(input, self.weight.T)
+        if self.with_bias:
+            out = out + self.bias
+        return out
+
+    def _extra_repr(self):
+        return f"({self.input_size} -> {self.output_size})"
+
+
+class Bilinear(Module):
+    """out_k = x1ᵀ W_k x2 + b_k (reference: nn/Bilinear.scala)."""
+
+    def __init__(self, input_size1: int, input_size2: int, output_size: int, bias_res: bool = True):
+        super().__init__()
+        self.input_size1, self.input_size2, self.output_size = input_size1, input_size2, output_size
+        self.bias_res = bias_res
+        stdv = 1.0 / (input_size1**0.5)
+        self.register_parameter(
+            "weight",
+            bt_init.RandomUniform(-stdv, stdv)((output_size, input_size1, input_size2)),
+        )
+        if bias_res:
+            self.register_parameter("bias", bt_init.RandomUniform(-stdv, stdv)((output_size,)))
+
+    def forward(self, input):
+        x1, x2 = input[1], input[2]
+        out = jnp.einsum("bi,oij,bj->bo", x1, self.weight, x2)
+        if self.bias_res:
+            out = out + self.bias
+        return out
+
+
+class Add(Module):
+    """Learnable per-element bias add (reference: nn/Add.scala)."""
+
+    def __init__(self, input_size: int):
+        super().__init__()
+        self.input_size = input_size
+        self.register_parameter("bias", jnp.zeros((input_size,)))
+
+    def forward(self, input):
+        return input + self.bias
+
+
+class Mul(Module):
+    """Single learnable scalar gain (reference: nn/Mul.scala)."""
+
+    def __init__(self):
+        super().__init__()
+        self.register_parameter("weight", jnp.ones(()))
+
+    def forward(self, input):
+        return input * self.weight
+
+
+class CMul(Module):
+    """Learnable componentwise gain, broadcastable shape (reference: nn/CMul.scala)."""
+
+    def __init__(self, size):
+        super().__init__()
+        self.size = tuple(size)
+        self.register_parameter("weight", jnp.ones(self.size))
+
+    def forward(self, input):
+        return input * self.weight
+
+
+class CAdd(Module):
+    """Learnable componentwise bias, broadcastable shape (reference: nn/CAdd.scala)."""
+
+    def __init__(self, size):
+        super().__init__()
+        self.size = tuple(size)
+        self.register_parameter("bias", jnp.zeros(self.size))
+
+    def forward(self, input):
+        return input + self.bias
+
+
+class Scale(Module):
+    """CMul then CAdd (reference: nn/Scale.scala)."""
+
+    def __init__(self, size):
+        super().__init__()
+        self.cmul = CMul(size)
+        self.cadd = CAdd(size)
+
+    def forward(self, input):
+        return self.cadd(self.cmul(input))
+
+
+class Euclidean(Module):
+    """Pairwise euclidean distance to learnable centers (reference: nn/Euclidean.scala)."""
+
+    def __init__(self, input_size: int, output_size: int):
+        super().__init__()
+        stdv = 1.0 / (input_size**0.5)
+        self.register_parameter(
+            "weight", bt_init.RandomUniform(-stdv, stdv)((output_size, input_size))
+        )
+
+    def forward(self, input):
+        diff = input[:, None, :] - self.weight[None, :, :]
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-12)
+
+
+class Cosine(Module):
+    """Cosine similarity to learnable centers (reference: nn/Cosine.scala)."""
+
+    def __init__(self, input_size: int, output_size: int):
+        super().__init__()
+        stdv = 1.0 / (input_size**0.5)
+        self.register_parameter(
+            "weight", bt_init.RandomUniform(-stdv, stdv)((output_size, input_size))
+        )
+
+    def forward(self, input):
+        xn = input / (jnp.linalg.norm(input, axis=-1, keepdims=True) + 1e-12)
+        wn = self.weight / (jnp.linalg.norm(self.weight, axis=-1, keepdims=True) + 1e-12)
+        return xn @ wn.T

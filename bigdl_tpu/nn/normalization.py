@@ -1,0 +1,240 @@
+"""Normalization layers.
+
+Reference: nn/BatchNormalization.scala (446 LoC), nn/SpatialBatchNormalization.scala,
+nn/Normalize.scala, nn/SpatialCrossMapLRN.scala, nn/SpatialWithinChannelLRN.scala,
+nn/SpatialContrastive/Divisive/SubtractiveNormalization.scala, nn/NormalizeScale.scala.
+
+BatchNorm running stats are Module *buffers*: under ``pure_apply`` the updated
+stats come back as the new-buffers pytree (functional state threading), which
+is the jit-safe equivalent of the reference's in-place running-mean updates.
+The reference's sync-BN (thread-level ParameterSynchronizer,
+utils/ParameterSynchronizer.scala:29) maps to a ``psum`` over the batch axis
+when run under shard_map — exposed via ``global_stats_axis``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+
+
+class BatchNormalization(Module):
+    """BN over (batch, feat) (reference: nn/BatchNormalization.scala)."""
+
+    n_dim = 2
+
+    def __init__(self, n_output: int, eps: float = 1e-5, momentum: float = 0.1,
+                 affine: bool = True, init_weight=None, init_bias=None,
+                 global_stats_axis: str = None):
+        super().__init__()
+        self.n_output = n_output
+        self.eps = eps
+        self.momentum = momentum
+        self.affine = affine
+        self.global_stats_axis = global_stats_axis
+        if affine:
+            w = jnp.asarray(init_weight) if init_weight is not None else jnp.ones((n_output,))
+            b = jnp.asarray(init_bias) if init_bias is not None else jnp.zeros((n_output,))
+            self.register_parameter("weight", w)
+            self.register_parameter("bias", b)
+        self.register_buffer("running_mean", jnp.zeros((n_output,)))
+        self.register_buffer("running_var", jnp.ones((n_output,)))
+
+    def forward(self, input):
+        x = input
+        # batched input has n_dim dims (channel at 1); unbatched n_dim-1 (channel at 0)
+        ch_ax = 1 if x.ndim >= self.n_dim else 0
+        axes = tuple(i for i in range(x.ndim) if i != ch_ax)
+        if self.training:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            if self.global_stats_axis is not None:
+                mean = jax.lax.pmean(mean, self.global_stats_axis)
+                var = jax.lax.pmean(var, self.global_stats_axis)
+            n = x.size / x.shape[ch_ax]
+            unbiased = var * n / max(1.0, n - 1)
+            self._set_buffer(
+                "running_mean",
+                (1 - self.momentum) * self.running_mean + self.momentum * mean,
+            )
+            self._set_buffer(
+                "running_var",
+                (1 - self.momentum) * self.running_var + self.momentum * unbiased,
+            )
+        else:
+            mean, var = self.running_mean, self.running_var
+        shape = [1] * x.ndim
+        shape[ch_ax] = x.shape[ch_ax]
+        out = (x - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + self.eps)
+        if self.affine:
+            out = out * self.weight.reshape(shape) + self.bias.reshape(shape)
+        return out
+
+    def _extra_repr(self):
+        return f"({self.n_output}, eps={self.eps}, momentum={self.momentum})"
+
+
+class SpatialBatchNormalization(BatchNormalization):
+    """BN over NCHW per-channel (reference: nn/SpatialBatchNormalization.scala)."""
+
+    n_dim = 4
+
+
+class VolumetricBatchNormalization(BatchNormalization):
+    n_dim = 5
+
+
+class Normalize(Module):
+    """Lp-normalize along the feature dim (reference: nn/Normalize.scala)."""
+
+    def __init__(self, p: float = 2.0, eps: float = 1e-10):
+        super().__init__()
+        self.p = p
+        self.eps = eps
+
+    def forward(self, input):
+        if self.p == float("inf"):
+            norm = jnp.max(jnp.abs(input), axis=1 if input.ndim > 1 else 0, keepdims=True)
+        else:
+            norm = jnp.sum(jnp.abs(input) ** self.p, axis=1 if input.ndim > 1 else 0,
+                           keepdims=True) ** (1.0 / self.p)
+        return input / (norm + self.eps)
+
+
+class NormalizeScale(Module):
+    """L2-normalize channels then learnable per-channel scale
+    (reference: nn/NormalizeScale.scala, used by SSD)."""
+
+    def __init__(self, p: float = 2.0, eps: float = 1e-10, scale: float = 1.0,
+                 size=None, w_regularizer=None):
+        super().__init__()
+        self.p, self.eps = p, eps
+        size = tuple(size) if size is not None else (1,)
+        self.register_parameter("weight", jnp.full(size, scale), regularizer=w_regularizer)
+
+    def forward(self, input):
+        norm = jnp.sum(jnp.abs(input) ** self.p, axis=1, keepdims=True) ** (1.0 / self.p)
+        return input / (norm + self.eps) * self.weight
+
+
+class SpatialCrossMapLRN(Module):
+    """Local response normalization across channels
+    (reference: nn/SpatialCrossMapLRN.scala)."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75, k: float = 1.0):
+        super().__init__()
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+
+    def forward(self, input):
+        squeeze = input.ndim == 3
+        x = input[None] if squeeze else input
+        sq = x * x
+        half = (self.size - 1) // 2
+        # sum over a sliding channel window
+        padded = jnp.pad(sq, ((0, 0), (half, self.size - 1 - half), (0, 0), (0, 0)))
+        s = jax.lax.reduce_window(
+            padded, 0.0, jax.lax.add,
+            window_dimensions=(1, self.size, 1, 1),
+            window_strides=(1, 1, 1, 1),
+            padding="VALID",
+        )
+        denom = (self.k + self.alpha / self.size * s) ** self.beta
+        out = x / denom
+        return out[0] if squeeze else out
+
+
+class SpatialWithinChannelLRN(Module):
+    """LRN over a spatial window within each channel
+    (reference: nn/SpatialWithinChannelLRN.scala)."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75):
+        super().__init__()
+        self.size, self.alpha, self.beta = size, alpha, beta
+
+    def forward(self, input):
+        squeeze = input.ndim == 3
+        x = input[None] if squeeze else input
+        sq = x * x
+        half = (self.size - 1) // 2
+        s = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add,
+            window_dimensions=(1, 1, self.size, self.size),
+            window_strides=(1, 1, 1, 1),
+            padding=((0, 0), (0, 0), (half, self.size - 1 - half),
+                     (half, self.size - 1 - half)),
+        )
+        denom = (1.0 + self.alpha / (self.size * self.size) * s) ** self.beta
+        out = x / denom
+        return out[0] if squeeze else out
+
+
+class SpatialSubtractiveNormalization(Module):
+    """Subtract kernel-weighted local mean (reference:
+    nn/SpatialSubtractiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None):
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        if kernel is None:
+            kernel = jnp.ones((9, 9))
+        kernel = jnp.asarray(kernel, dtype=jnp.float32)
+        self.kernel = kernel / jnp.sum(kernel)
+
+    def _local_mean(self, x):
+        k = self.kernel
+        kh, kw = k.shape
+        w = jnp.broadcast_to(k, (1, self.n_input_plane, kh, kw)) / self.n_input_plane
+        pad = ((kh - 1) // 2, kh - 1 - (kh - 1) // 2), ((kw - 1) // 2, kw - 1 - (kw - 1) // 2)
+        mean = jax.lax.conv_general_dilated(
+            x, w, (1, 1), [pad[0], pad[1]], dimension_numbers=("NCHW", "OIHW", "NCHW")
+        )
+        # normalize by actual window coverage at borders
+        ones = jnp.ones_like(x[:, :1])
+        w1 = jnp.broadcast_to(k, (1, 1, kh, kw))
+        coef = jax.lax.conv_general_dilated(
+            ones, w1, (1, 1), [pad[0], pad[1]], dimension_numbers=("NCHW", "OIHW", "NCHW")
+        )
+        return mean / coef
+
+    def forward(self, input):
+        squeeze = input.ndim == 3
+        x = input[None] if squeeze else input
+        out = x - self._local_mean(x)
+        return out[0] if squeeze else out
+
+
+class SpatialDivisiveNormalization(Module):
+    """Divide by local std estimate (reference: nn/SpatialDivisiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None, threshold: float = 1e-4,
+                 thresval: float = 1e-4):
+        super().__init__()
+        self.sub = SpatialSubtractiveNormalization(n_input_plane, kernel)
+        self.threshold, self.thresval = threshold, thresval
+
+    def forward(self, input):
+        squeeze = input.ndim == 3
+        x = input[None] if squeeze else input
+        local_sq_mean = self.sub._local_mean(x * x)
+        std = jnp.sqrt(jnp.maximum(local_sq_mean, 0.0))
+        mean_std = jnp.mean(std, axis=(2, 3), keepdims=True)
+        denom = jnp.maximum(std, mean_std)
+        denom = jnp.where(denom > self.threshold, denom, self.thresval)
+        out = x / denom
+        return out[0] if squeeze else out
+
+
+class SpatialContrastiveNormalization(Module):
+    """Subtractive then divisive normalization
+    (reference: nn/SpatialContrastiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None, threshold: float = 1e-4,
+                 thresval: float = 1e-4):
+        super().__init__()
+        self.sub = SpatialSubtractiveNormalization(n_input_plane, kernel)
+        self.div = SpatialDivisiveNormalization(n_input_plane, kernel, threshold, thresval)
+
+    def forward(self, input):
+        return self.div(self.sub(input))

@@ -1,0 +1,193 @@
+"""Pooling layers.
+
+Reference: nn/SpatialMaxPooling.scala, nn/SpatialAveragePooling.scala and the
+Temporal/Volumetric variants. All lower to ``lax.reduce_window`` which XLA
+maps to the TPU VPU. Ceil-mode parity is handled by explicit asymmetric
+padding (the reference's ceil() output-size formula).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Module
+
+
+def _pool_out_size(in_size, k, stride, pad, ceil_mode):
+    if ceil_mode:
+        out = -(-(in_size + 2 * pad - k) // stride) + 1
+    else:
+        out = (in_size + 2 * pad - k) // stride + 1
+    if pad > 0 and (out - 1) * stride >= in_size + pad:
+        out -= 1
+    return out
+
+
+def _pool_padding(in_size, out_size, k, stride, pad):
+    """Explicit (lo, hi) padding realizing the requested output size."""
+    needed = (out_size - 1) * stride + k - in_size
+    hi = max(0, needed - pad)
+    return (pad, hi)
+
+
+class SpatialMaxPooling(Module):
+    """Max pooling over NCHW (reference: nn/SpatialMaxPooling.scala)."""
+
+    def __init__(self, kw: int, kh: int, dw: int = None, dh: int = None,
+                 pad_w: int = 0, pad_h: int = 0):
+        super().__init__()
+        self.kw, self.kh = kw, kh
+        self.dw = dw if dw is not None else kw
+        self.dh = dh if dh is not None else kh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.ceil_mode = False
+
+    def ceil(self) -> "SpatialMaxPooling":
+        self.ceil_mode = True
+        return self
+
+    def floor(self) -> "SpatialMaxPooling":
+        self.ceil_mode = False
+        return self
+
+    def forward(self, input):
+        squeeze = input.ndim == 3
+        x = input[None] if squeeze else input
+        h, w = x.shape[2], x.shape[3]
+        out_h = _pool_out_size(h, self.kh, self.dh, self.pad_h, self.ceil_mode)
+        out_w = _pool_out_size(w, self.kw, self.dw, self.pad_w, self.ceil_mode)
+        pad_h = _pool_padding(h, out_h, self.kh, self.dh, self.pad_h)
+        pad_w = _pool_padding(w, out_w, self.kw, self.dw, self.pad_w)
+        out = lax.reduce_window(
+            x, -jnp.inf, lax.max,
+            window_dimensions=(1, 1, self.kh, self.kw),
+            window_strides=(1, 1, self.dh, self.dw),
+            padding=((0, 0), (0, 0), pad_h, pad_w),
+        )
+        return out[0] if squeeze else out
+
+
+class SpatialAveragePooling(Module):
+    """Average pooling (reference: nn/SpatialAveragePooling.scala).
+
+    ``count_include_pad`` matches the reference's default True behavior;
+    ``global_pooling`` pools the whole plane.
+    """
+
+    def __init__(self, kw: int, kh: int, dw: int = None, dh: int = None,
+                 pad_w: int = 0, pad_h: int = 0, global_pooling: bool = False,
+                 ceil_mode: bool = False, count_include_pad: bool = True,
+                 divide: bool = True):
+        super().__init__()
+        self.kw, self.kh = kw, kh
+        self.dw = dw if dw is not None else kw
+        self.dh = dh if dh is not None else kh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.global_pooling = global_pooling
+        self.ceil_mode = ceil_mode
+        self.count_include_pad = count_include_pad
+        self.divide = divide
+
+    def ceil(self):
+        self.ceil_mode = True
+        return self
+
+    def forward(self, input):
+        squeeze = input.ndim == 3
+        x = input[None] if squeeze else input
+        h, w = x.shape[2], x.shape[3]
+        kh, kw = (h, w) if self.global_pooling else (self.kh, self.kw)
+        dh, dw = (1, 1) if self.global_pooling else (self.dh, self.dw)
+        out_h = _pool_out_size(h, kh, dh, self.pad_h, self.ceil_mode)
+        out_w = _pool_out_size(w, kw, dw, self.pad_w, self.ceil_mode)
+        pad_h = _pool_padding(h, out_h, kh, dh, self.pad_h)
+        pad_w = _pool_padding(w, out_w, kw, dw, self.pad_w)
+        padding = ((0, 0), (0, 0), pad_h, pad_w)
+        summed = lax.reduce_window(
+            x, 0.0, lax.add,
+            window_dimensions=(1, 1, kh, kw),
+            window_strides=(1, 1, dh, dw),
+            padding=padding,
+        )
+        if not self.divide:
+            out = summed
+        elif self.count_include_pad:
+            out = summed / (kh * kw)
+        else:
+            ones = jnp.ones_like(x)
+            counts = lax.reduce_window(
+                ones, 0.0, lax.add,
+                window_dimensions=(1, 1, kh, kw),
+                window_strides=(1, 1, dh, dw),
+                padding=padding,
+            )
+            out = summed / counts
+        return out[0] if squeeze else out
+
+
+class TemporalMaxPooling(Module):
+    """1-D max pooling over (batch, time, feat) (reference: nn/TemporalMaxPooling.scala)."""
+
+    def __init__(self, k_w: int, d_w: int = None):
+        super().__init__()
+        self.k_w = k_w
+        self.d_w = d_w if d_w is not None else k_w
+
+    def forward(self, input):
+        squeeze = input.ndim == 2
+        x = input[None] if squeeze else input
+        out = lax.reduce_window(
+            x, -jnp.inf, lax.max,
+            window_dimensions=(1, self.k_w, 1),
+            window_strides=(1, self.d_w, 1),
+            padding="VALID",
+        )
+        return out[0] if squeeze else out
+
+
+class VolumetricMaxPooling(Module):
+    """3-D max pooling over NCDHW (reference: nn/VolumetricMaxPooling.scala)."""
+
+    def __init__(self, kt, kw, kh, dt=None, dw=None, dh=None, pad_t=0, pad_w=0, pad_h=0):
+        super().__init__()
+        self.k = (kt, kh, kw)
+        self.d = (dt or kt, dh or kh, dw or kw)
+        self.pad = (pad_t, pad_h, pad_w)
+
+    def forward(self, input):
+        squeeze = input.ndim == 4
+        x = input[None] if squeeze else input
+        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in self.pad)
+        out = lax.reduce_window(
+            x, -jnp.inf, lax.max,
+            window_dimensions=(1, 1) + self.k,
+            window_strides=(1, 1) + self.d,
+            padding=pads,
+        )
+        return out[0] if squeeze else out
+
+
+class VolumetricAveragePooling(Module):
+    """3-D average pooling (reference: nn/VolumetricAveragePooling.scala)."""
+
+    def __init__(self, kt, kw, kh, dt=None, dw=None, dh=None, pad_t=0, pad_w=0, pad_h=0,
+                 count_include_pad: bool = True):
+        super().__init__()
+        self.k = (kt, kh, kw)
+        self.d = (dt or kt, dh or kh, dw or kw)
+        self.pad = (pad_t, pad_h, pad_w)
+        self.count_include_pad = count_include_pad
+
+    def forward(self, input):
+        squeeze = input.ndim == 4
+        x = input[None] if squeeze else input
+        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in self.pad)
+        summed = lax.reduce_window(
+            x, 0.0, lax.add,
+            window_dimensions=(1, 1) + self.k,
+            window_strides=(1, 1) + self.d,
+            padding=pads,
+        )
+        out = summed / (self.k[0] * self.k[1] * self.k[2])
+        return out[0] if squeeze else out

@@ -1,0 +1,365 @@
+"""Shape / indexing layers.
+
+Reference: nn/Reshape.scala, nn/View.scala, nn/Squeeze.scala, nn/Unsqueeze.scala,
+nn/Transpose.scala, nn/Select.scala, nn/Narrow.scala, nn/Replicate.scala,
+nn/Tile.scala, nn/Padding.scala, nn/Contiguous.scala, nn/Index.scala,
+nn/MaskedSelect.scala, nn/Masking.scala, nn/Reverse.scala, nn/SplitTable.scala,
+nn/JoinTable.scala is in table_ops. Dimensions are 1-based (Torch legacy,
+SURVEY.md Appendix B.1); negative dims count from the end.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils.table import Table
+
+
+def _axis(dim: int, ndim: int, batched: bool = False) -> int:
+    """1-based (possibly negative) reference dim -> 0-based numpy axis."""
+    if dim > 0:
+        return dim - 1 + (1 if batched else 0)
+    return ndim + dim
+
+
+class Reshape(Module):
+    """Reshape the non-batch dims (reference: nn/Reshape.scala). ``batch_mode``
+    None = infer: treat dim 0 as batch iff numel doesn't match."""
+
+    def __init__(self, size, batch_mode=None):
+        super().__init__()
+        self.size = tuple(size)
+        self.batch_mode = batch_mode
+
+    def forward(self, input):
+        numel = int(np.prod(self.size))
+        if self.batch_mode is True or (
+            self.batch_mode is None and input.size != numel
+        ):
+            return input.reshape((input.shape[0],) + self.size)
+        return input.reshape(self.size)
+
+
+class View(Module):
+    """Like Reshape with -1 support and batch passthrough (reference: nn/View.scala)."""
+
+    def __init__(self, *sizes):
+        super().__init__()
+        if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)):
+            sizes = tuple(sizes[0])
+        self.sizes = tuple(sizes)
+        self.num_input_dims = 0
+
+    def set_num_input_dims(self, n: int) -> "View":
+        self.num_input_dims = n
+        return self
+
+    def forward(self, input):
+        numel = 1
+        infer = False
+        for s in self.sizes:
+            if s == -1:
+                infer = True
+            else:
+                numel *= s
+        if input.size == numel or infer and input.size % max(1, numel) == 0 and \
+                input.ndim <= len(self.sizes):
+            return input.reshape(self.sizes)
+        return input.reshape((input.shape[0],) + self.sizes)
+
+
+class Squeeze(Module):
+    def __init__(self, dim=None, num_input_dims: int = 0):
+        super().__init__()
+        self.dim = dim
+        self.num_input_dims = num_input_dims
+
+    def forward(self, input):
+        if self.dim is None:
+            return jnp.squeeze(input)
+        batched = input.ndim == self.num_input_dims + 1 if self.num_input_dims else False
+        dims = self.dim if isinstance(self.dim, (tuple, list)) else (self.dim,)
+        axes = tuple(_axis(d, input.ndim, batched) for d in dims)
+        return jnp.squeeze(input, axis=axes)
+
+
+class Unsqueeze(Module):
+    def __init__(self, pos: int, num_input_dims: int = 0):
+        super().__init__()
+        self.pos = pos
+        self.num_input_dims = num_input_dims
+
+    def forward(self, input):
+        batched = input.ndim == self.num_input_dims + 1 if self.num_input_dims else False
+        return jnp.expand_dims(input, _axis(self.pos, input.ndim + 1, batched))
+
+
+class Transpose(Module):
+    """Sequence of pairwise dim swaps, 1-based (reference: nn/Transpose.scala)."""
+
+    def __init__(self, permutations):
+        super().__init__()
+        self.permutations = list(permutations)
+
+    def forward(self, input):
+        x = input
+        for d1, d2 in self.permutations:
+            x = jnp.swapaxes(x, _axis(d1, x.ndim), _axis(d2, x.ndim))
+        return x
+
+
+class Select(Module):
+    """Select index along dim, removing it (reference: nn/Select.scala). 1-based."""
+
+    def __init__(self, dim: int, index: int):
+        super().__init__()
+        self.dim, self.index = dim, index
+
+    def forward(self, input):
+        ax = _axis(self.dim, input.ndim)
+        idx = self.index - 1 if self.index > 0 else input.shape[ax] + self.index
+        return jnp.take(input, idx, axis=ax)
+
+
+class Narrow(Module):
+    """Slice [offset, offset+length) along dim (reference: nn/Narrow.scala). 1-based."""
+
+    def __init__(self, dimension: int, offset: int, length: int = 1):
+        super().__init__()
+        self.dimension, self.offset, self.length = dimension, offset, length
+
+    def forward(self, input):
+        ax = _axis(self.dimension, input.ndim)
+        size = input.shape[ax]
+        start = self.offset - 1 if self.offset > 0 else size + self.offset
+        length = self.length if self.length > 0 else size - start + self.length + 1
+        idx = [slice(None)] * input.ndim
+        idx[ax] = slice(start, start + length)
+        return input[tuple(idx)]
+
+
+class Replicate(Module):
+    """Insert new dim of size n_features at dim (reference: nn/Replicate.scala)."""
+
+    def __init__(self, n_features: int, dim: int = 1, n_dim: int = float("inf")):
+        super().__init__()
+        self.n_features, self.dim = n_features, dim
+
+    def forward(self, input):
+        x = jnp.expand_dims(input, self.dim - 1)
+        reps = [1] * x.ndim
+        reps[self.dim - 1] = self.n_features
+        return jnp.tile(x, reps)
+
+
+class Tile(Module):
+    """Repeat along one dim (reference: nn/Tile.scala)."""
+
+    def __init__(self, dim: int = 1, copies: int = 2):
+        super().__init__()
+        self.dim, self.copies = dim, copies
+
+    def forward(self, input):
+        reps = [1] * input.ndim
+        reps[_axis(self.dim, input.ndim)] = self.copies
+        return jnp.tile(input, reps)
+
+
+class Padding(Module):
+    """Pad ``pad`` entries (negative = front) with value along dim
+    (reference: nn/Padding.scala)."""
+
+    def __init__(self, dim: int, pad: int, n_input_dim: int, value: float = 0.0,
+                 n_index: int = 1):
+        super().__init__()
+        self.dim, self.pad, self.n_input_dim, self.value = dim, pad, n_input_dim, value
+
+    def forward(self, input):
+        batched = input.ndim == self.n_input_dim + 1
+        ax = self.dim - 1 + (1 if batched else 0)
+        pads = [(0, 0)] * input.ndim
+        pads[ax] = (-self.pad, 0) if self.pad < 0 else (0, self.pad)
+        return jnp.pad(input, pads, constant_values=self.value)
+
+
+class SpatialZeroPadding(Module):
+    """Zero-pad H/W of NCHW (reference: nn/SpatialZeroPadding.scala)."""
+
+    def __init__(self, pad_left: int, pad_right: int = None, pad_top: int = None,
+                 pad_bottom: int = None):
+        super().__init__()
+        self.pl = pad_left
+        self.pr = pad_right if pad_right is not None else pad_left
+        self.pt = pad_top if pad_top is not None else pad_left
+        self.pb = pad_bottom if pad_bottom is not None else pad_left
+
+    def forward(self, input):
+        pads = [(0, 0)] * (input.ndim - 2) + [(self.pt, self.pb), (self.pl, self.pr)]
+        return jnp.pad(input, pads)
+
+
+class Contiguous(Module):
+    """No-op under functional arrays (reference: nn/Contiguous.scala)."""
+
+    def forward(self, input):
+        return input
+
+
+class Index(Module):
+    """index_select along dim with 1-based index tensor (reference: nn/Index.scala)."""
+
+    def __init__(self, dimension: int):
+        super().__init__()
+        self.dimension = dimension
+
+    def forward(self, input):
+        t, idx = input[1], input[2]
+        return jnp.take(t, idx.astype(jnp.int32) - 1, axis=self.dimension - 1)
+
+
+class MaskedSelect(Module):
+    """Select elements where mask==1. NOTE: returns a dense masked-out copy
+    (data-dependent shapes are not XLA-compatible; documented divergence from
+    nn/MaskedSelect.scala)."""
+
+    def forward(self, input):
+        t, mask = input[1], input[2]
+        return jnp.where(mask.astype(bool), t, 0.0)
+
+
+class Masking(Module):
+    """Zero timesteps equal to mask_value (reference: nn/Masking.scala)."""
+
+    def __init__(self, mask_value: float = 0.0):
+        super().__init__()
+        self.mask_value = mask_value
+
+    def forward(self, input):
+        keep = jnp.any(input != self.mask_value, axis=-1, keepdims=True)
+        return input * keep.astype(input.dtype)
+
+
+class Reverse(Module):
+    """Flip along dim (reference: nn/Reverse.scala)."""
+
+    def __init__(self, dimension: int = 1, is_inplace: bool = False):
+        super().__init__()
+        self.dimension = dimension
+
+    def forward(self, input):
+        return jnp.flip(input, axis=_axis(self.dimension, input.ndim))
+
+
+class InferReshape(Module):
+    """Reshape with -1 (infer) and 0 (copy input dim) entries
+    (reference: nn/InferReshape.scala)."""
+
+    def __init__(self, size, batch_mode: bool = False):
+        super().__init__()
+        self.size = tuple(size)
+        self.batch_mode = batch_mode
+
+    def forward(self, input):
+        in_shape = input.shape[1:] if self.batch_mode else input.shape
+        out = []
+        for i, s in enumerate(self.size):
+            out.append(in_shape[i] if s == 0 else s)
+        if self.batch_mode:
+            return input.reshape((input.shape[0],) + tuple(out))
+        return input.reshape(tuple(out))
+
+
+class Cropping2D(Module):
+    """Crop H/W of NCHW (reference: nn/Cropping2D.scala)."""
+
+    def __init__(self, height_crop=(0, 0), width_crop=(0, 0), data_format: str = "NCHW"):
+        super().__init__()
+        self.hc, self.wc = tuple(height_crop), tuple(width_crop)
+        self.data_format = data_format
+
+    def forward(self, input):
+        h0, h1 = self.hc
+        w0, w1 = self.wc
+        if self.data_format == "NCHW":
+            return input[..., h0 : input.shape[-2] - h1, w0 : input.shape[-1] - w1]
+        return input[..., h0 : input.shape[-3] - h1, w0 : input.shape[-2] - w1, :]
+
+
+class Cropping3D(Module):
+    def __init__(self, dim1_crop=(0, 0), dim2_crop=(0, 0), dim3_crop=(0, 0)):
+        super().__init__()
+        self.crops = (tuple(dim1_crop), tuple(dim2_crop), tuple(dim3_crop))
+
+    def forward(self, input):
+        (a0, a1), (b0, b1), (c0, c1) = self.crops
+        return input[
+            ...,
+            a0 : input.shape[-3] - a1,
+            b0 : input.shape[-2] - b1,
+            c0 : input.shape[-1] - c1,
+        ]
+
+
+class UpSampling1D(Module):
+    def __init__(self, length: int):
+        super().__init__()
+        self.length = length
+
+    def forward(self, input):
+        return jnp.repeat(input, self.length, axis=1)
+
+
+class UpSampling2D(Module):
+    """Nearest-neighbor upsample NCHW (reference: nn/UpSampling2D.scala)."""
+
+    def __init__(self, size=(2, 2)):
+        super().__init__()
+        self.size = tuple(size)
+
+    def forward(self, input):
+        x = jnp.repeat(input, self.size[0], axis=-2)
+        return jnp.repeat(x, self.size[1], axis=-1)
+
+
+class UpSampling3D(Module):
+    def __init__(self, size=(2, 2, 2)):
+        super().__init__()
+        self.size = tuple(size)
+
+    def forward(self, input):
+        x = jnp.repeat(input, self.size[0], axis=-3)
+        x = jnp.repeat(x, self.size[1], axis=-2)
+        return jnp.repeat(x, self.size[2], axis=-1)
+
+
+class ResizeBilinear(Module):
+    """Bilinear resize of NCHW (reference: nn/ResizeBilinear.scala)."""
+
+    def __init__(self, output_height: int, output_width: int, align_corners: bool = False):
+        super().__init__()
+        self.oh, self.ow = output_height, output_width
+        self.align_corners = align_corners
+
+    def forward(self, input):
+        import jax.image
+
+        squeeze = input.ndim == 3
+        x = input[None] if squeeze else input
+        out = jax.image.resize(
+            x, (x.shape[0], x.shape[1], self.oh, self.ow), method="bilinear"
+        )
+        return out[0] if squeeze else out
+
+
+class Pack(Module):
+    """Stack a table of tensors along a new 1-based dim (reference: nn/Pack.scala)."""
+
+    def __init__(self, dimension: int):
+        super().__init__()
+        self.dimension = dimension
+
+    def forward(self, input):
+        ts = list(input) if isinstance(input, (Table, list, tuple)) else [input]
+        return jnp.stack(ts, axis=self.dimension - 1)

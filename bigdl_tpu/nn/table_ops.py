@@ -1,0 +1,307 @@
+"""Table-algebra layers — combine/split multiple tensors.
+
+Reference: nn/CAddTable.scala, nn/CMulTable.scala, nn/CSubTable.scala,
+nn/CDivTable.scala, nn/CMaxTable.scala, nn/CMinTable.scala, nn/CAveTable.scala,
+nn/JoinTable.scala, nn/SplitTable.scala, nn/MixtureTable.scala, nn/MM.scala,
+nn/MV.scala, nn/DotProduct.scala, nn/CosineDistance.scala,
+nn/PairwiseDistance.scala, nn/SelectTable.scala, nn/NarrowTable.scala,
+nn/FlattenTable.scala, nn/CrossProduct.scala, nn/Max.scala, nn/Min.scala,
+nn/Mean.scala, nn/Sum.scala.
+"""
+
+from __future__ import annotations
+
+from functools import reduce
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils.table import Table
+
+
+def _elems(input):
+    return list(input) if isinstance(input, (Table, list, tuple)) else [input]
+
+
+class CAddTable(Module):
+    def __init__(self, inplace: bool = False):
+        super().__init__()
+
+    def forward(self, input):
+        return reduce(jnp.add, _elems(input))
+
+
+class CMulTable(Module):
+    def forward(self, input):
+        return reduce(jnp.multiply, _elems(input))
+
+
+class CSubTable(Module):
+    def forward(self, input):
+        a, b = _elems(input)[:2]
+        return a - b
+
+
+class CDivTable(Module):
+    def forward(self, input):
+        a, b = _elems(input)[:2]
+        return a / b
+
+
+class CMaxTable(Module):
+    def forward(self, input):
+        return reduce(jnp.maximum, _elems(input))
+
+
+class CMinTable(Module):
+    def forward(self, input):
+        return reduce(jnp.minimum, _elems(input))
+
+
+class CAveTable(Module):
+    def __init__(self, inplace: bool = False):
+        super().__init__()
+
+    def forward(self, input):
+        es = _elems(input)
+        return reduce(jnp.add, es) / len(es)
+
+
+class JoinTable(Module):
+    """Concat table elements along 1-based dim (reference: nn/JoinTable.scala)."""
+
+    def __init__(self, dimension: int, n_input_dims: int = 0):
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+
+    def forward(self, input):
+        es = _elems(input)
+        ax = self.dimension - 1
+        if self.n_input_dims and es[0].ndim == self.n_input_dims + 1:
+            ax += 1
+        return jnp.concatenate(es, axis=ax)
+
+
+class SplitTable(Module):
+    """Split along 1-based dim into a table (reference: nn/SplitTable.scala)."""
+
+    def __init__(self, dimension: int, n_input_dims: int = 0):
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+
+    def forward(self, input):
+        ax = self.dimension - 1
+        if self.dimension < 0:
+            ax = input.ndim + self.dimension
+        elif self.n_input_dims and input.ndim == self.n_input_dims + 1:
+            ax += 1
+        parts = [jnp.squeeze(p, axis=ax) for p in jnp.split(input, input.shape[ax], axis=ax)]
+        return Table(*parts)
+
+
+class BifurcateSplitTable(Module):
+    """Split into two halves along dim (reference: nn/BifurcateSplitTable.scala)."""
+
+    def __init__(self, dimension: int):
+        super().__init__()
+        self.dimension = dimension
+
+    def forward(self, input):
+        ax = self.dimension - 1
+        half = input.shape[ax] // 2
+        idx1 = [slice(None)] * input.ndim
+        idx2 = [slice(None)] * input.ndim
+        idx1[ax] = slice(0, half)
+        idx2[ax] = slice(half, input.shape[ax])
+        return Table(input[tuple(idx1)], input[tuple(idx2)])
+
+
+class NarrowTable(Module):
+    def __init__(self, offset: int, length: int = 1):
+        super().__init__()
+        self.offset, self.length = offset, length
+
+    def forward(self, input):
+        es = _elems(input)
+        length = self.length if self.length > 0 else len(es) - self.offset + self.length + 2
+        return Table(*es[self.offset - 1 : self.offset - 1 + length])
+
+
+class SelectTable(Module):
+    def __init__(self, index: int):
+        super().__init__()
+        self.index = index
+
+    def forward(self, input):
+        es = _elems(input)
+        return es[self.index - 1 if self.index > 0 else self.index]
+
+
+class FlattenTable(Module):
+    def forward(self, input):
+        out = []
+
+        def rec(x):
+            if isinstance(x, (Table, list, tuple)):
+                for e in x:
+                    rec(e)
+            else:
+                out.append(x)
+
+        rec(input)
+        return Table(*out)
+
+
+class MixtureTable(Module):
+    """Gater-weighted mixture of experts (reference: nn/MixtureTable.scala).
+    input = Table(gater (b, n), experts Table of n tensors (b, ...))."""
+
+    def __init__(self, dim: int = None):
+        super().__init__()
+        self.dim = dim
+
+    def forward(self, input):
+        gater, experts = input[1], input[2]
+        es = _elems(experts)
+        stacked = jnp.stack(es, axis=1)  # (b, n, ...)
+        g = gater.reshape(gater.shape + (1,) * (stacked.ndim - 2))
+        return jnp.sum(stacked * g, axis=1)
+
+
+class MM(Module):
+    """Batch/plain matrix-matrix product of a 2-tensor table (reference: nn/MM.scala)."""
+
+    def __init__(self, trans_a: bool = False, trans_b: bool = False):
+        super().__init__()
+        self.trans_a, self.trans_b = trans_a, trans_b
+
+    def forward(self, input):
+        a, b = input[1], input[2]
+        if self.trans_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.trans_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+
+
+class MV(Module):
+    """Matrix-vector product (reference: nn/MV.scala)."""
+
+    def __init__(self, trans: bool = False):
+        super().__init__()
+        self.trans = trans
+
+    def forward(self, input):
+        m, v = input[1], input[2]
+        if self.trans:
+            m = jnp.swapaxes(m, -1, -2)
+        return jnp.einsum("...ij,...j->...i", m, v)
+
+
+class DotProduct(Module):
+    def forward(self, input):
+        a, b = input[1], input[2]
+        return jnp.sum(a * b, axis=-1)
+
+
+class CosineDistance(Module):
+    def forward(self, input):
+        a, b = input[1], input[2]
+        an = jnp.linalg.norm(a, axis=-1)
+        bn = jnp.linalg.norm(b, axis=-1)
+        return jnp.sum(a * b, axis=-1) / jnp.maximum(an * bn, 1e-12)
+
+
+class PairwiseDistance(Module):
+    def __init__(self, norm: int = 2):
+        super().__init__()
+        self.norm = norm
+
+    def forward(self, input):
+        a, b = input[1], input[2]
+        d = jnp.abs(a - b) ** self.norm
+        return jnp.sum(d, axis=-1) ** (1.0 / self.norm)
+
+
+class CrossProduct(Module):
+    """Pairwise dot products between all table elements (reference: nn/CrossProduct.scala)."""
+
+    def __init__(self, num_tensor: int = 0, embedding_size: int = 0):
+        super().__init__()
+
+    def forward(self, input):
+        es = _elems(input)
+        outs = []
+        for i in range(len(es)):
+            for j in range(i + 1, len(es)):
+                outs.append(jnp.sum(es[i] * es[j], axis=-1, keepdims=True))
+        return jnp.concatenate(outs, axis=-1)
+
+
+class Sum(Module):
+    """Sum along 1-based dim (reference: nn/Sum.scala)."""
+
+    def __init__(self, dimension: int = 1, n_input_dims: int = -1,
+                 size_average: bool = False, squeeze: bool = True):
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+        self.size_average = size_average
+        self.squeeze = squeeze
+
+    def _ax(self, input):
+        ax = self.dimension - 1
+        if self.n_input_dims > 0 and input.ndim == self.n_input_dims + 1:
+            ax += 1
+        return ax
+
+    def forward(self, input):
+        ax = self._ax(input)
+        out = jnp.sum(input, axis=ax, keepdims=not self.squeeze)
+        if self.size_average:
+            out = out / input.shape[ax]
+        return out
+
+
+class Mean(Module):
+    def __init__(self, dimension: int = 1, n_input_dims: int = -1, squeeze: bool = True):
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+        self.squeeze = squeeze
+
+    def forward(self, input):
+        ax = self.dimension - 1
+        if self.n_input_dims > 0 and input.ndim == self.n_input_dims + 1:
+            ax += 1
+        return jnp.mean(input, axis=ax, keepdims=not self.squeeze)
+
+
+class Max(Module):
+    """Max along dim, returns values (reference: nn/Max.scala)."""
+
+    def __init__(self, dim: int = 1, num_input_dims: int = 0):
+        super().__init__()
+        self.dim = dim
+        self.num_input_dims = num_input_dims
+
+    def forward(self, input):
+        ax = self.dim - 1
+        if self.num_input_dims and input.ndim == self.num_input_dims + 1:
+            ax += 1
+        return jnp.max(input, axis=ax)
+
+
+class Min(Module):
+    def __init__(self, dim: int = 1, num_input_dims: int = 0):
+        super().__init__()
+        self.dim = dim
+        self.num_input_dims = num_input_dims
+
+    def forward(self, input):
+        ax = self.dim - 1
+        if self.num_input_dims and input.ndim == self.num_input_dims + 1:
+            ax += 1
+        return jnp.min(input, axis=ax)

@@ -1,0 +1,4 @@
+from bigdl_tpu.utils.table import Table, T
+from bigdl_tpu.utils.random import RandomGenerator
+
+__all__ = ["Table", "T", "RandomGenerator"]

@@ -1,0 +1,72 @@
+"""Module save/load.
+
+Reference: utils/File.scala:68-176 (Java-serialization save/load of any
+module). The pickle-based path is the analog of the reference's
+``save``/``Module.load``; the structured protobuf-style format
+(``saveModule``/``loadModule``) lives in bigdl_tpu.utils.serializer.
+Device arrays are converted to numpy on save and restored with jnp.asarray
+on load, so checkpoints are host-portable.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _to_host(module):
+    for _, m in module.named_modules():
+        for k in list(m._parameters):
+            m._parameters[k] = np.asarray(m._parameters[k])
+            object.__setattr__(m, k, m._parameters[k])
+        for k in list(m._gradients):
+            m._gradients[k] = np.asarray(m._gradients[k])
+        for k in list(m._buffers):
+            m._buffers[k] = np.asarray(m._buffers[k])
+            object.__setattr__(m, k, m._buffers[k])
+
+
+def _to_device(module):
+    for _, m in module.named_modules():
+        for k in list(m._parameters):
+            m._set_param(k, jnp.asarray(m._parameters[k]))
+        for k in list(m._gradients):
+            m._gradients[k] = jnp.asarray(m._gradients[k])
+        for k in list(m._buffers):
+            m._set_buffer(k, jnp.asarray(m._buffers[k]))
+
+
+def save_module(module, path: str, overwrite: bool = False) -> None:
+    if os.path.exists(path) and not overwrite:
+        raise FileExistsError(f"{path} exists; pass overwrite=True")
+    clone = module.clone_module()
+    _to_host(clone)
+    clone._forward_key = None
+    with open(path, "wb") as f:
+        pickle.dump(clone, f)
+
+
+def load_module(path: str):
+    with open(path, "rb") as f:
+        module = pickle.load(f)
+    _to_device(module)
+    return module
+
+
+def save(obj, path: str, overwrite: bool = False) -> None:
+    """Generic save for optimizer state / tables (≙ File.save)."""
+    if os.path.exists(path) and not overwrite:
+        raise FileExistsError(f"{path} exists; pass overwrite=True")
+    import jax
+
+    host = jax.tree.map(lambda x: np.asarray(x) if hasattr(x, "shape") else x, obj)
+    with open(path, "wb") as f:
+        pickle.dump(host, f)
+
+
+def load(path: str):
+    with open(path, "rb") as f:
+        return pickle.load(f)

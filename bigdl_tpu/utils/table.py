@@ -1,0 +1,128 @@
+"""Torch-style activity Table.
+
+TPU-native analog of the reference's heterogeneous key->value container
+(reference: utils/Table.scala:34, factory ``T()`` at :318). Keys are 1-based
+integers (Torch legacy, SURVEY.md Appendix B.1) or strings. Registered as a
+JAX pytree so Tables flow through jit / grad / shard_map like any container.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class Table:
+    def __init__(self, *args, **kwargs):
+        self._state = {}
+        for i, v in enumerate(args):
+            self._state[i + 1] = v
+        self._state.update(kwargs)
+
+    # -- mapping protocol ----------------------------------------------------
+    def __getitem__(self, key):
+        return self._state[key]
+
+    def __setitem__(self, key, value):
+        self._state[key] = value
+
+    def __delitem__(self, key):
+        del self._state[key]
+
+    def __contains__(self, key):
+        return key in self._state
+
+    def __len__(self):
+        return len(self._state)
+
+    def __iter__(self):
+        return iter(self._state.values())
+
+    def keys(self):
+        return self._state.keys()
+
+    def values(self):
+        return self._state.values()
+
+    def items(self):
+        return self._state.items()
+
+    def get(self, key, default=None):
+        return self._state.get(key, default)
+
+    def update(self, other):
+        if isinstance(other, Table):
+            other = other._state
+        self._state.update(other)
+        return self
+
+    def insert(self, *args):
+        """``insert(value)`` appends at the next integer key; ``insert(pos, value)``."""
+        if len(args) == 1:
+            n = max([k for k in self._state if isinstance(k, int)] or [0])
+            self._state[n + 1] = args[0]
+        else:
+            pos, value = args
+            n = max([k for k in self._state if isinstance(k, int)] or [0])
+            for i in range(n, pos - 1, -1):
+                if i in self._state:
+                    self._state[i + 1] = self._state[i]
+            self._state[pos] = value
+        return self
+
+    def remove(self, pos=None):
+        ints = sorted(k for k in self._state if isinstance(k, int))
+        if not ints:
+            return None
+        if pos is None:
+            pos = ints[-1]
+        value = self._state.pop(pos, None)
+        n = ints[-1]
+        for i in range(pos + 1, n + 1):
+            if i in self._state:
+                self._state[i - 1] = self._state.pop(i)
+        return value
+
+    def __eq__(self, other):
+        if not isinstance(other, Table):
+            return NotImplemented
+        if set(self._state.keys()) != set(other._state.keys()):
+            return False
+        import numpy as np
+
+        for k, v in self._state.items():
+            ov = other._state[k]
+            if isinstance(v, Table) or isinstance(ov, Table):
+                if v != ov:
+                    return False
+            else:
+                try:
+                    if not np.array_equal(v, ov):
+                        return False
+                except Exception:
+                    if v != ov:
+                        return False
+        return True
+
+    def __repr__(self):
+        items = ", ".join(f"{k}: {type(v).__name__}" for k, v in self._state.items())
+        return f"Table({items})"
+
+
+def T(*args, **kwargs) -> Table:
+    """Factory mirroring the reference's ``T()`` (utils/Table.scala:318)."""
+    return Table(*args, **kwargs)
+
+
+def _table_flatten(t: Table):
+    keys = sorted(t._state.keys(), key=lambda k: (isinstance(k, str), k))
+    return [t._state[k] for k in keys], tuple(keys)
+
+
+def _table_unflatten(keys, children):
+    t = Table()
+    for k, v in zip(keys, children):
+        t._state[k] = v
+    return t
+
+
+jax.tree_util.register_pytree_node(Table, _table_flatten, _table_unflatten)

@@ -1,0 +1,23 @@
+"""Test harness config.
+
+Mirrors the reference's "distributed tested via in-process multi-device"
+strategy (SURVEY.md §4): Spark local-mode ≙ a virtual 8-device CPU platform
+(``xla_force_host_platform_device_count``). Must run before jax initializes.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fixed_seed():
+    from bigdl_tpu.utils import random as bt_random
+
+    bt_random.set_seed(42)
+    yield

@@ -1,0 +1,267 @@
+"""Layer unit tests — shapes and hand-computed values, mirroring the
+reference's nn spec style (SURVEY.md §4: deterministic seeds, hand-computed
+outputs, gradient checks)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils.table import T
+
+
+def test_linear_values():
+    m = nn.Linear(2, 2, init_weight=[[1.0, 2.0], [3.0, 4.0]], init_bias=[0.5, -0.5])
+    y = m(jnp.array([[1.0, 1.0]]))
+    np.testing.assert_allclose(np.asarray(y), [[3.5, 6.5]])
+
+
+def test_spatial_convolution_shape_and_value():
+    # 1 in-plane, 1 out-plane, 3x3 kernel of ones on a 5x5 ones image
+    m = nn.SpatialConvolution(1, 1, 3, 3, init_weight=np.ones((1, 1, 3, 3)),
+                              init_bias=np.zeros((1,)))
+    x = jnp.ones((1, 1, 5, 5))
+    y = m(x)
+    assert y.shape == (1, 1, 3, 3)
+    np.testing.assert_allclose(np.asarray(y), 9.0)
+
+
+def test_spatial_convolution_stride_pad():
+    m = nn.SpatialConvolution(3, 8, 3, 3, 2, 2, 1, 1)
+    y = m(jnp.ones((2, 3, 8, 8)))
+    assert y.shape == (2, 8, 4, 4)
+
+
+def test_conv_unbatched_3d_input():
+    m = nn.SpatialConvolution(3, 4, 3, 3)
+    y = m(jnp.ones((3, 7, 7)))
+    assert y.shape == (4, 5, 5)
+
+
+def test_grouped_conv():
+    m = nn.SpatialConvolution(4, 8, 3, 3, n_group=2)
+    y = m(jnp.ones((1, 4, 5, 5)))
+    assert y.shape == (1, 8, 3, 3)
+
+
+def test_dilated_conv():
+    m = nn.SpatialDilatedConvolution(1, 1, 3, 3, dilation_w=2, dilation_h=2)
+    y = m(jnp.ones((1, 1, 9, 9)))
+    assert y.shape == (1, 1, 5, 5)
+
+
+def test_full_convolution_shape():
+    m = nn.SpatialFullConvolution(2, 3, 4, 4, 2, 2, 1, 1)
+    y = m(jnp.ones((1, 2, 5, 5)))
+    # out = (in-1)*stride - 2*pad + kernel + adj = 4*2 - 2 + 4 = 10
+    assert y.shape == (1, 3, 10, 10)
+
+
+def test_temporal_convolution():
+    m = nn.TemporalConvolution(4, 6, 3)
+    y = m(jnp.ones((2, 10, 4)))
+    assert y.shape == (2, 8, 6)
+
+
+def test_volumetric_convolution():
+    m = nn.VolumetricConvolution(2, 4, 3, 3, 3)
+    y = m(jnp.ones((1, 2, 6, 6, 6)))
+    assert y.shape == (1, 4, 4, 4, 4)
+
+
+def test_max_pooling_values():
+    m = nn.SpatialMaxPooling(2, 2)
+    x = jnp.arange(16.0).reshape(1, 1, 4, 4)
+    y = m(x)
+    np.testing.assert_allclose(np.asarray(y)[0, 0], [[5, 7], [13, 15]])
+
+
+def test_max_pooling_ceil_mode():
+    m = nn.SpatialMaxPooling(3, 3, 2, 2).ceil()
+    y = m(jnp.ones((1, 1, 6, 6)))
+    assert y.shape == (1, 1, 3, 3)
+    m2 = nn.SpatialMaxPooling(3, 3, 2, 2)
+    assert m2(jnp.ones((1, 1, 6, 6))).shape == (1, 1, 2, 2)
+
+
+def test_avg_pooling():
+    m = nn.SpatialAveragePooling(2, 2)
+    x = jnp.arange(16.0).reshape(1, 1, 4, 4)
+    y = m(x)
+    np.testing.assert_allclose(np.asarray(y)[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_global_avg_pooling():
+    m = nn.SpatialAveragePooling(0, 0, global_pooling=True)
+    y = m(jnp.ones((2, 3, 5, 5)) * 2.0)
+    assert y.shape == (2, 3, 1, 1)
+    np.testing.assert_allclose(np.asarray(y), 2.0)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNormalization(3, eps=0.0)
+    x = jnp.array([[1.0, 2.0, 3.0], [3.0, 4.0, 5.0]])
+    y = bn(x)
+    np.testing.assert_allclose(np.asarray(y), [[-1, -1, -1], [1, 1, 1]], atol=1e-5)
+    bn.evaluate()
+    y2 = bn(x)
+    assert y2.shape == x.shape
+
+
+def test_spatial_batchnorm():
+    bn = nn.SpatialBatchNormalization(4)
+    y = bn(jnp.ones((2, 4, 3, 3)))
+    assert y.shape == (2, 4, 3, 3)
+
+
+def test_activations_values():
+    x = jnp.array([-2.0, -0.5, 0.0, 0.5, 2.0])
+    np.testing.assert_allclose(np.asarray(nn.ReLU()(x)), [0, 0, 0, 0.5, 2.0])
+    np.testing.assert_allclose(np.asarray(nn.ReLU6()(jnp.array([7.0]))), [6.0])
+    np.testing.assert_allclose(np.asarray(nn.HardTanh()(x)), [-1, -0.5, 0, 0.5, 1])
+    np.testing.assert_allclose(
+        np.asarray(nn.LeakyReLU(0.1)(x)), [-0.2, -0.05, 0, 0.5, 2.0], rtol=1e-6
+    )
+    np.testing.assert_allclose(np.asarray(nn.Square()(x)), np.asarray(x) ** 2)
+
+
+def test_logsoftmax_rows_sum_to_one():
+    y = nn.LogSoftMax()(jnp.ones((2, 5)))
+    np.testing.assert_allclose(np.exp(np.asarray(y)).sum(-1), 1.0, rtol=1e-4)
+
+
+def test_softmax_4d_channel_dim():
+    y = nn.SoftMax()(jnp.ones((2, 3, 4, 4)))
+    np.testing.assert_allclose(np.asarray(y).sum(axis=1), 1.0, rtol=1e-6)
+
+
+def test_prelu():
+    m = nn.PReLU()
+    y = m(jnp.array([-4.0, 4.0]))
+    np.testing.assert_allclose(np.asarray(y), [-1.0, 4.0])
+
+
+def test_reshape_view():
+    m = nn.Reshape([2, 8])
+    assert m(jnp.ones((4, 4))).shape == (2, 8)
+    m2 = nn.Reshape([4], batch_mode=True)
+    assert m2(jnp.ones((3, 2, 2))).shape == (3, 4)
+    v = nn.View(16)
+    assert v(jnp.ones((2, 4, 4))).shape == (2, 16)
+
+
+def test_narrow_select_1based():
+    x = jnp.arange(24.0).reshape(2, 3, 4)
+    n = nn.Narrow(2, 2, 2)
+    assert n(x).shape == (2, 2, 4)
+    np.testing.assert_allclose(np.asarray(n(x))[0, 0], [4, 5, 6, 7])
+    s = nn.Select(1, 2)
+    np.testing.assert_allclose(np.asarray(s(x)), np.asarray(x)[1])
+
+
+def test_transpose_squeeze_unsqueeze():
+    x = jnp.ones((2, 3, 4))
+    assert nn.Transpose([(1, 3)])(x).shape == (4, 3, 2)
+    assert nn.Unsqueeze(2)(x).shape == (2, 1, 3, 4)
+    assert nn.Squeeze(2)(jnp.ones((2, 1, 3))).shape == (2, 3)
+
+
+def test_concat_and_tables():
+    c = nn.Concat(2, nn.Identity(), nn.Identity())
+    y = c(jnp.ones((2, 3)))
+    assert y.shape == (2, 6)
+    ct = nn.ConcatTable(nn.Identity(), nn.MulConstant(2.0))
+    t = ct(jnp.ones((2, 2)))
+    np.testing.assert_allclose(np.asarray(t[2]), 2.0)
+    add = nn.CAddTable()
+    np.testing.assert_allclose(np.asarray(add(t)), 3.0)
+
+
+def test_parallel_table():
+    pt = nn.ParallelTable(nn.MulConstant(2.0), nn.MulConstant(3.0))
+    out = pt(T(jnp.ones((2,)), jnp.ones((2,))))
+    np.testing.assert_allclose(np.asarray(out[1]), 2.0)
+    np.testing.assert_allclose(np.asarray(out[2]), 3.0)
+
+
+def test_join_split_table():
+    j = nn.JoinTable(2)
+    y = j(T(jnp.ones((2, 3)), jnp.zeros((2, 2))))
+    assert y.shape == (2, 5)
+    s = nn.SplitTable(2)
+    parts = s(jnp.ones((2, 3)))
+    assert len(parts) == 3
+    assert parts[1].shape == (2,)
+
+
+def test_mm_mv():
+    mm = nn.MM()
+    y = mm(T(jnp.ones((2, 3)), jnp.ones((3, 4))))
+    np.testing.assert_allclose(np.asarray(y), 3.0)
+    mv = nn.MV()
+    y2 = mv(T(jnp.ones((2, 3)), jnp.ones((3,))))
+    np.testing.assert_allclose(np.asarray(y2), 3.0)
+
+
+def test_lookup_table_1based():
+    m = nn.LookupTable(5, 4)
+    y = m(jnp.array([[1, 5], [2, 3]]))
+    assert y.shape == (2, 2, 4)
+    np.testing.assert_allclose(np.asarray(y[0, 0]), np.asarray(m.weight[0]))
+    np.testing.assert_allclose(np.asarray(y[0, 1]), np.asarray(m.weight[4]))
+
+
+def test_lrn_shape():
+    m = nn.SpatialCrossMapLRN(5, 1.0, 0.75, 1.0)
+    y = m(jnp.ones((2, 8, 4, 4)))
+    assert y.shape == (2, 8, 4, 4)
+
+
+def test_upsampling():
+    m = nn.UpSampling2D((2, 2))
+    y = m(jnp.arange(4.0).reshape(1, 1, 2, 2))
+    assert y.shape == (1, 1, 4, 4)
+    np.testing.assert_allclose(np.asarray(y)[0, 0, :2, :2], [[0, 0], [0, 0]])
+    np.testing.assert_allclose(np.asarray(y)[0, 0, 2:, 2:], [[3, 3], [3, 3]])
+
+
+def test_cmul_cadd_scale():
+    m = nn.Scale((3,))
+    y = m(jnp.ones((2, 3)))
+    np.testing.assert_allclose(np.asarray(y), 1.0)
+
+
+def test_maxout():
+    m = nn.Maxout(4, 3, 2)
+    assert m(jnp.ones((5, 4))).shape == (5, 3)
+
+
+def test_locally_connected():
+    m = nn.LocallyConnected2D(2, 6, 6, 4, 3, 3)
+    y = m(jnp.ones((2, 2, 6, 6)))
+    assert y.shape == (2, 4, 4, 4)
+
+
+def test_full_convolution_grouped():
+    m = nn.SpatialFullConvolution(4, 4, 3, 3, 2, 2, 1, 1, n_group=2)
+    y = m(jnp.ones((1, 4, 5, 5)))
+    assert y.shape == (1, 4, 9, 9)
+
+
+def test_prelu_3d_channel_axis():
+    # 3D input is unbatched CHW: channel axis 0 even when sizes coincide
+    m = nn.PReLU(8)
+    y = m(-jnp.ones((8, 8, 4)))
+    np.testing.assert_allclose(np.asarray(y), -0.25)
+
+
+def test_save_load_roundtrip(tmp_path):
+    from bigdl_tpu.utils import file as bt_file
+
+    m = nn.Sequential(nn.Linear(4, 3), nn.ReLU())
+    x = jnp.ones((2, 4))
+    y = m(x)
+    p = str(tmp_path / "model.bin")
+    m.save(p)
+    m2 = bt_file.load_module(p)
+    np.testing.assert_allclose(np.asarray(m2(x)), np.asarray(y))

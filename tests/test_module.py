@@ -1,0 +1,170 @@
+"""Core Module contract tests (reference behavior: nn/abstractnn/AbstractModule.scala)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.module import pure_apply
+
+
+def test_parameter_registration_and_parameters():
+    m = nn.Linear(4, 3)
+    ws, gs = m.parameters()
+    assert len(ws) == 2
+    assert ws[0].shape == (3, 4)
+    assert ws[1].shape == (3,)
+    assert all(np.allclose(g, 0) for g in gs)
+
+
+def test_get_parameters_flat():
+    m = nn.Sequential(nn.Linear(4, 3), nn.ReLU(), nn.Linear(3, 2))
+    w, g = m.get_parameters()
+    assert w.shape == (4 * 3 + 3 + 3 * 2 + 2,)
+    assert g.shape == w.shape
+
+
+def test_sequential_forward():
+    m = nn.Sequential(nn.Linear(4, 3), nn.ReLU())
+    x = jnp.ones((2, 4))
+    y = m(x)
+    assert y.shape == (2, 3)
+    assert np.all(np.asarray(y) >= 0)
+
+
+def test_pure_apply_matches_eager_and_jits():
+    m = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    x = jnp.arange(8.0).reshape(2, 4)
+    eager = m(x)
+    fn = pure_apply(m)
+    params = m.params_dict()
+    out, _ = jax.jit(lambda p, x: fn(p, {}, x))(params, x)
+    np.testing.assert_allclose(np.asarray(eager), np.asarray(out), rtol=1e-6)
+
+
+def test_pure_apply_does_not_leak_tracers():
+    m = nn.Linear(4, 3)
+    fn = pure_apply(m)
+    jax.jit(lambda p, x: fn(p, {}, x))(m.params_dict(), jnp.ones((1, 4)))
+    # after trace the module's own weights must still be concrete
+    assert isinstance(np.asarray(m.weight), np.ndarray)
+
+
+def test_pure_apply_without_rng_keeps_global_rng_healthy():
+    # regression: tracing with rng=None must not split tracers into the
+    # global RNG key (UnexpectedTracerError on next eager use)
+    from bigdl_tpu.utils import random as bt_random
+
+    m = nn.Sequential(nn.Linear(4, 4), nn.ReLU())
+    fn = pure_apply(m)
+    jax.jit(lambda p, x: fn(p, {}, x)[0])(m.params_dict(), jnp.ones((2, 4)))
+    bt_random.next_key()  # must not raise
+    m(jnp.ones((2, 4)))  # eager call after trace must also work
+
+
+def test_backward_linear_matches_manual():
+    m = nn.Linear(4, 3, with_bias=True)
+    x = jnp.array([[1.0, 2.0, 3.0, 4.0], [0.5, -1.0, 2.0, 0.0]])
+    y = m(x)
+    grad_out = jnp.ones_like(y)
+    grad_in = m.backward(x, grad_out)
+    # dL/dx = grad_out @ W
+    np.testing.assert_allclose(
+        np.asarray(grad_in), np.asarray(grad_out @ m.weight), rtol=1e-5
+    )
+    # dL/dW = grad_out.T @ x accumulated
+    np.testing.assert_allclose(
+        np.asarray(m._gradients["weight"]), np.asarray(grad_out.T @ x), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(m._gradients["bias"]), np.asarray(grad_out.sum(0)), rtol=1e-5
+    )
+
+
+def test_zero_grad_and_update_parameters():
+    m = nn.Linear(2, 2)
+    x = jnp.ones((1, 2))
+    m.backward(x, jnp.ones((1, 2)))
+    w_before = np.asarray(m.weight).copy()
+    m.update_parameters(0.1)
+    assert not np.allclose(np.asarray(m.weight), w_before)
+    m.zero_grad_parameters()
+    _, gs = m.parameters()
+    assert all(np.allclose(np.asarray(g), 0) for g in gs)
+
+
+def test_training_evaluate_modes():
+    m = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+    m.evaluate()
+    assert not m[1].training
+    x = jnp.ones((2, 4))
+    y1, y2 = m(x), m(x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2))
+    m.training_mode()
+    assert m[1].training
+
+
+def test_dropout_backward_replays_forward_mask():
+    m = nn.Dropout(0.5)
+    x = jnp.ones((4, 8))
+    y = m(x)
+    gi = m.backward(x, jnp.ones_like(x))
+    # gradient passes exactly where forward kept values
+    mask_fwd = np.asarray(y) != 0
+    mask_bwd = np.asarray(gi) != 0
+    np.testing.assert_array_equal(mask_fwd, mask_bwd)
+
+
+def test_freeze_trainable_dict():
+    m = nn.Sequential(nn.Linear(2, 2), nn.Linear(2, 2))
+    m[0].freeze()
+    td = m.trainable_dict()
+    leaves0 = jax.tree.leaves(td["m0"])
+    leaves1 = jax.tree.leaves(td["m1"])
+    assert not any(leaves0)
+    assert all(leaves1)
+
+
+def test_get_times():
+    m = nn.Sequential(nn.Linear(4, 4), nn.ReLU())
+    m(jnp.ones((1, 4)))
+    times = m.get_times()
+    assert len(times) == 3  # container + 2 children
+    grouped = m.get_times_group_by_module_type()
+    assert "Linear" in grouped and "ReLU" in grouped
+
+
+def test_set_name_get_name():
+    m = nn.Linear(2, 2).set_name("fc1")
+    assert m.get_name() == "fc1"
+
+
+def test_buffers_roundtrip_batchnorm():
+    bn = nn.BatchNormalization(4)
+    x = jnp.arange(12.0).reshape(3, 4)
+    bn(x)
+    b = bn.buffers_dict()
+    assert not np.allclose(np.asarray(b["~buffers"]["running_mean"]), 0)
+
+
+def test_table_pytree():
+    from bigdl_tpu.utils.table import T
+
+    t = T(jnp.ones((2,)), jnp.zeros((3,)))
+    doubled = jax.tree.map(lambda x: x * 2, t)
+    np.testing.assert_allclose(np.asarray(doubled[1]), 2.0)
+    assert len(jax.tree.leaves(t)) == 2
+
+
+def test_child_backward_replays_parent_scoped_mask():
+    # regression: a stochastic child called inside a container must replay
+    # its own forward mask on direct child.backward()
+    m = nn.Sequential(nn.Dropout(0.5), nn.Identity())
+    x = jnp.ones((4, 16))
+    y = m(x)
+    drop = m[0]
+    gi = drop.backward(x, jnp.ones_like(x))
+    mask_fwd = np.asarray(y) != 0
+    mask_bwd = np.asarray(gi) != 0
+    np.testing.assert_array_equal(mask_fwd, mask_bwd)
