@@ -76,9 +76,10 @@ class LocalDataSet(AbstractDataSet):
 class ShardedDataSet(AbstractDataSet):
     """Distributed dataset: each process owns shard ``shard_id`` of
     ``num_shards`` (reference: DistributedDataSet / CachedDistriDataSet,
-    dataset/DataSet.scala:167,243-306). All processes use the same seed so
-    shuffles stay aligned without communication (SPMD-friendly — unlike the
-    reference, no driver coordination is needed)."""
+    dataset/DataSet.scala:167,243-306). Each shard shuffles its own disjoint
+    records with an independent per-shard RNG (seed + shard_id) — no
+    cross-process alignment is required because shards never exchange
+    records (≙ per-partition index-array shuffle, DataSet.scala:295-303)."""
 
     def __init__(self, records: Sequence, shard_id: int = None, num_shards: int = None,
                  seed: int = 1):

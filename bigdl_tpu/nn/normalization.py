@@ -50,11 +50,17 @@ class BatchNormalization(Module):
         if self.training:
             mean = jnp.mean(x, axis=axes)
             var = jnp.var(x, axis=axes)
-            if self.global_stats_axis is not None:
-                mean = jax.lax.pmean(mean, self.global_stats_axis)
-                var = jax.lax.pmean(var, self.global_stats_axis)
             n = x.size / x.shape[ch_ax]
-            unbiased = var * n / max(1.0, n - 1)
+            if self.global_stats_axis is not None:
+                # global var needs the variance OF the per-shard means too:
+                # var = E[x^2] - E[x]^2 across the whole global batch
+                mean_g = jax.lax.pmean(mean, self.global_stats_axis)
+                var = jax.lax.pmean(var + mean ** 2, self.global_stats_axis) - mean_g ** 2
+                mean = mean_g
+                n = n * jax.lax.psum(1, self.global_stats_axis)
+                unbiased = var * n / jnp.maximum(1.0, n - 1.0)
+            else:
+                unbiased = var * n / max(1.0, n - 1)
             self._set_buffer(
                 "running_mean",
                 (1 - self.momentum) * self.running_mean + self.momentum * mean,

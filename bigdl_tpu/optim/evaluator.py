@@ -1,0 +1,61 @@
+"""Model evaluation (reference: optim/Evaluator.scala:27-48,
+optim/LocalValidator — broadcast model + forward + ValidationResult merge).
+TPU-native: one jitted eval forward, batches streamed from the dataset,
+results merged host-side (≙ the reduce of mergeable ValidationResults)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.dataset.dataset import AbstractDataSet, LocalDataSet
+from bigdl_tpu.dataset.minibatch import MiniBatch
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+from bigdl_tpu.nn.module import Module, pure_apply
+from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
+
+
+class Evaluator:
+    def __init__(self, model: Module):
+        self.model = model
+        self._jitted = None
+
+    def _eval_fn(self):
+        if self._jitted is None:
+            apply_fn = pure_apply(self.model)
+            self._jitted = jax.jit(
+                lambda p, b, x: apply_fn(p, b, x, training=False)[0])
+        return self._jitted
+
+    def test(self, dataset, methods: Sequence[ValidationMethod],
+             batch_size: Optional[int] = 32) -> List[Tuple[ValidationMethod, ValidationResult]]:
+        if isinstance(dataset, (list, tuple)):
+            dataset = LocalDataSet(list(dataset))
+        params = self.model.params_dict()
+        buffers = self.model.buffers_dict()
+        fn = self._eval_fn()
+        results: List[Optional[ValidationResult]] = [None] * len(methods)
+        src = dataset.data(train=False)
+        first = next(iter(src), None)
+
+        def chain():
+            yield first
+            yield from src
+
+        if first is not None and isinstance(first, Sample):
+            it = SampleToMiniBatch(batch_size or 32, partial_batch=True)(chain())
+        elif first is not None:
+            it = chain()
+        else:
+            it = iter(())
+        for batch in it:
+            x = jnp.asarray(batch.get_input())
+            y = batch.get_target()
+            out = fn(params, buffers, x)
+            for i, m in enumerate(methods):
+                r = m(out, y)
+                results[i] = r if results[i] is None else results[i] + r
+        return [(m, r) for m, r in zip(methods, results) if r is not None]

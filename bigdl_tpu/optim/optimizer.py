@@ -1,0 +1,406 @@
+"""Optimizer builder API + the local (single-host) training loop.
+
+Reference: optim/Optimizer.scala:47 (builder: setValidation / setCheckpoint /
+setTrainSummary / setOptimMethod / setEndWhen / gradient clipping) and
+optim/LocalOptimizer.scala:45. The reference runs per-core model replicas
+over MKL threads; TPU-native, one jitted train step consumes the whole
+per-host batch — thread-level data parallelism is absorbed by XLA's own
+parallelism on device, and multi-chip data parallelism lives in
+bigdl_tpu.parallel.DistriOptimizer.
+
+The train step is a pure function
+    (params, buffers, slots, input, target, lr, rng) ->
+    (loss, new_params, new_buffers, new_slots)
+compiled once; the loop around it reproduces the reference's semantics:
+infinite shuffled iterator, approximate epoch boundary
+(recordsProcessedThisEpoch >= numSamples, Appendix B.6), state-table keys
+(Appendix B.7), trigger-driven validation/checkpoint/summary, per-iteration
+throughput log (optim/DistriOptimizer.scala:390-393 parity).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import AbstractDataSet, LocalDataSet
+from bigdl_tpu.dataset.minibatch import MiniBatch
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+from bigdl_tpu.nn.module import Module, pure_apply
+from bigdl_tpu.optim.metrics import Metrics
+from bigdl_tpu.optim.optim_method import OptimMethod, SGD
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.optim.validation import ValidationMethod
+from bigdl_tpu.utils import random as bt_random
+
+logger = logging.getLogger("bigdl_tpu.optim")
+
+
+def _clip_constant(grads, min_v, max_v):
+    return jax.tree.map(lambda g: jnp.clip(g, min_v, max_v), grads)
+
+
+def _clip_by_global_norm(grads, max_norm):
+    """≙ L2NormClippingProcessor (parameters/ParameterOperations.scala:71-124):
+    the reference computes the global grad norm across partitions; here the
+    grads pytree is already global under SPMD."""
+    sq = sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in jax.tree.leaves(grads))
+    norm = jnp.sqrt(sq)
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-12))
+    return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)
+
+
+def _mask_frozen(new_params, old_params, trainable):
+    def pick(new, old, t):
+        return new if t else old
+
+    return jax.tree.map(pick, new_params, old_params, trainable,
+                        is_leaf=lambda x: isinstance(x, bool))
+
+
+def _method_groups(model: Module, default_method: OptimMethod, sub_methods):
+    """Per-param-leaf optimizer assignment for setOptimMethods
+    (optim/Optimizer.scala:377): group 0 = default, one group per named
+    submodule. Returns (methods, leaf_group_ids) with ids aligned to
+    ``jax.tree.leaves(model.params_dict())`` order (same dict structure)."""
+    methods = [default_method]
+    name_to_gid = {}
+    for name, m in (sub_methods or {}).items():
+        name_to_gid[name] = len(methods)
+        methods.append(m)
+
+    from bigdl_tpu.nn.module import _PARAMS_KEY
+
+    def walk(module, gid):
+        g = name_to_gid.get(module.get_name(), gid)
+        d = {}
+        if module._parameters:
+            d[_PARAMS_KEY] = {k: g for k in module._parameters}
+        for child_name, child in module._modules.items():
+            sub = walk(child, g)
+            if sub:
+                d[child_name] = sub
+        return d
+
+    unmatched = set(name_to_gid) - {m.get_name() for _, m in model.named_modules()}
+    if unmatched:
+        raise ValueError(f"setOptimMethods names not found in model: {sorted(unmatched)}")
+    return methods, jax.tree.leaves(walk(model, 0))
+
+
+class TrainStep:
+    """The pure train step + grouped optimizer state (shared by Local and
+    Distri optimizers). ``step(params, buffers, slots, x, y, lrs, rng)`` is
+    jit/pjit-safe; ``lrs`` is one scalar per optimizer group (host-scheduled)."""
+
+    def __init__(self, model: Module, criterion, optim_method: OptimMethod,
+                 grad_clip: Optional[dict] = None, sub_methods=None):
+        apply_fn = pure_apply(model)
+        trainable = model.trainable_dict()
+        any_frozen = not all(
+            t for t in jax.tree.leaves(trainable, is_leaf=lambda x: isinstance(x, bool)))
+        self.methods, gids = _method_groups(model, optim_method, sub_methods)
+        n_groups = len(self.methods)
+        idxs_per_group = [[i for i, g in enumerate(gids) if g == k]
+                          for k in range(n_groups)]
+        self._idxs_per_group = idxs_per_group
+
+        def loss_fn(params, buffers, x, y, rng):
+            out, new_buffers = apply_fn(params, buffers, x, rng=rng, training=True)
+            loss = criterion.forward(out, y)
+            loss = loss + model.regularization_loss(params)
+            return loss, new_buffers
+
+        def step(params, buffers, slots, x, y, lrs, rng):
+            (loss, new_buffers), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, buffers, x, y, rng)
+            if grad_clip:
+                if "constant" in grad_clip:
+                    lo, hi = grad_clip["constant"]
+                    grads = _clip_constant(grads, lo, hi)
+                if "l2norm" in grad_clip:
+                    grads = _clip_by_global_norm(grads, grad_clip["l2norm"])
+            leaves, treedef = jax.tree.flatten(params)
+            g_leaves = jax.tree.leaves(grads)
+            new_leaves = list(leaves)
+            new_slots = []
+            for k, meth in enumerate(self.methods):
+                idxs = idxs_per_group[k]
+                if not idxs:
+                    new_slots.append(slots[k])
+                    continue
+                p_sub = [leaves[i] for i in idxs]
+                gr_sub = [g_leaves[i] for i in idxs]
+                np_sub, ns = meth.step(p_sub, gr_sub, slots[k], lrs[k])
+                for i, pv in zip(idxs, np_sub):
+                    new_leaves[i] = pv
+                new_slots.append(ns)
+            new_params = jax.tree.unflatten(treedef, new_leaves)
+            if any_frozen:
+                new_params = _mask_frozen(new_params, params, trainable)
+            return loss, new_params, new_buffers, tuple(new_slots)
+
+        self.step = step
+
+    def init_slots(self, params):
+        leaves = jax.tree.leaves(params)
+        return tuple(
+            m.init_slots([leaves[i] for i in idxs])
+            for m, idxs in zip(self.methods, self._idxs_per_group))
+
+    def current_lrs(self):
+        return jnp.asarray([m.get_current_rate() for m in self.methods], jnp.float32)
+
+    def update_states(self, **kv):
+        for m in self.methods:
+            m.state.update(kv)
+
+
+def make_train_step(model: Module, criterion, optim_method: OptimMethod,
+                    grad_clip: Optional[dict] = None, sub_methods=None) -> TrainStep:
+    return TrainStep(model, criterion, optim_method, grad_clip, sub_methods)
+
+
+class Optimizer:
+    """Builder façade (reference: optim/Optimizer.scala:47,655-676). The
+    factory picks the local loop for LocalDataSet and the distributed SPMD
+    loop for ShardedDataSet / device-sharded data."""
+
+    def __new__(cls, model: Module = None, dataset=None, criterion=None,
+                batch_size: Optional[int] = None, end_when: Optional[Trigger] = None,
+                training_set=None, **kw):
+        dataset = dataset if dataset is not None else training_set
+        if cls is Optimizer:
+            from bigdl_tpu.parallel.distri_optimizer import DistriOptimizer
+            from bigdl_tpu.dataset.dataset import ShardedDataSet
+
+            base = dataset
+            while hasattr(base, "base"):
+                base = base.base
+            if isinstance(base, ShardedDataSet):
+                inst = object.__new__(DistriOptimizer)
+            else:
+                inst = object.__new__(LocalOptimizer)
+            return inst
+        return object.__new__(cls)
+
+    def __init__(self, model: Module = None, dataset=None, criterion=None,
+                 batch_size: Optional[int] = None, end_when: Optional[Trigger] = None,
+                 training_set=None, **kw):
+        self.model = model
+        dataset = dataset if dataset is not None else training_set
+        if isinstance(dataset, (list, tuple)) and dataset and isinstance(dataset[0], Sample):
+            dataset = LocalDataSet(list(dataset))
+        self.dataset: AbstractDataSet = dataset
+        self.criterion = criterion
+        self.batch_size = batch_size
+        self.end_when = end_when or Trigger.max_epoch(1)
+        self.optim_method: OptimMethod = SGD()
+        self.sub_optim_methods: Dict[str, OptimMethod] = {}
+        self.validation_trigger: Optional[Trigger] = None
+        self.validation_dataset = None
+        self.validation_methods: Optional[Sequence[ValidationMethod]] = None
+        self.checkpoint_path: Optional[str] = None
+        self.checkpoint_trigger: Optional[Trigger] = None
+        self.train_summary = None
+        self.validation_summary = None
+        self.grad_clip: dict = {}
+        self.metrics = Metrics()
+        self._dropped_checkpoints = 0
+
+    # -------------------------------------------------------------- builder
+    def set_optim_method(self, method: OptimMethod) -> "Optimizer":
+        self.optim_method = method
+        return self
+
+    def set_optim_methods(self, methods: Dict[str, OptimMethod]) -> "Optimizer":
+        """Per-submodule optim methods (reference: optim/Optimizer.scala:377).
+        Keys are module names; parameters under that submodule use its method."""
+        self.sub_optim_methods = dict(methods)
+        return self
+
+    def set_end_when(self, trigger: Trigger) -> "Optimizer":
+        self.end_when = trigger
+        return self
+
+    def set_validation(self, trigger: Trigger, dataset, methods,
+                       batch_size: Optional[int] = None) -> "Optimizer":
+        self.validation_trigger = trigger
+        if isinstance(dataset, (list, tuple)) and dataset and isinstance(dataset[0], Sample):
+            dataset = LocalDataSet(list(dataset))
+        self.validation_dataset = dataset
+        self.validation_methods = list(methods)
+        self.validation_batch_size = batch_size or self.batch_size
+        return self
+
+    def set_checkpoint(self, path: str, trigger: Trigger,
+                       is_overwrite: bool = True) -> "Optimizer":
+        self.checkpoint_path = path
+        self.checkpoint_trigger = trigger
+        self.checkpoint_overwrite = is_overwrite
+        return self
+
+    def set_train_summary(self, summary) -> "Optimizer":
+        self.train_summary = summary
+        return self
+
+    def set_validation_summary(self, summary) -> "Optimizer":
+        self.validation_summary = summary
+        return self
+
+    def set_gradient_clipping_by_l2_norm(self, clip_norm: float) -> "Optimizer":
+        self.grad_clip["l2norm"] = float(clip_norm)
+        return self
+
+    def set_constant_gradient_clipping(self, min_v: float, max_v: float) -> "Optimizer":
+        self.grad_clip["constant"] = (float(min_v), float(max_v))
+        return self
+
+    def disable_gradient_clipping(self) -> "Optimizer":
+        self.grad_clip = {}
+        return self
+
+    # ------------------------------------------------------------- optimize
+    def optimize(self) -> Module:
+        raise NotImplementedError
+
+
+class LocalOptimizer(Optimizer):
+    """Single-host training loop (reference: optim/LocalOptimizer.scala:45)."""
+
+    def _minibatches(self, dataset, batch_size, train=True):
+        it = dataset.data(train=train)
+        first = None
+        for first in it:
+            break
+        if first is None:
+            return iter(())
+
+        def chain():
+            yield first
+            yield from it
+
+        if isinstance(first, MiniBatch):
+            return chain()
+        return SampleToMiniBatch(batch_size)(chain())
+
+    def optimize(self) -> Module:
+        model, criterion = self.model, self.criterion
+        method = self.optim_method
+        state = method.state
+        state.setdefault("epoch", 1)
+        state.setdefault("neval", 1)
+        state.setdefault("recordsProcessedThisEpoch", 0)
+
+        params = model.params_dict()
+        buffers = model.buffers_dict()
+        ts = make_train_step(model, criterion, method, self.grad_clip,
+                             self.sub_optim_methods)
+        slots = ts.init_slots(params)
+        train_step = jax.jit(ts.step)
+
+        num_samples = self.dataset.size()
+        data_iter = self._minibatches(self.dataset, self.batch_size)
+        wall_start = time.time()
+
+        while not self.end_when(state):
+            try:
+                batch = next(data_iter)
+            except StopIteration:
+                data_iter = self._minibatches(self.dataset, self.batch_size)
+                batch = next(data_iter)
+            x = jnp.asarray(batch.get_input())
+            y = jnp.asarray(batch.get_target())
+            lrs = ts.current_lrs()
+            lr = float(lrs[0])
+            rng = bt_random.next_key()
+            t0 = time.time()
+            loss, params, buffers, slots = train_step(params, buffers, slots, x, y, lrs, rng)
+            loss = float(loss)
+            dt = time.time() - t0
+            n = batch.size()
+            state["recordsProcessedThisEpoch"] += n
+            state["Loss"] = loss
+            state["LearningRate"] = float(lr)
+            self.metrics.add("computing time", dt * 1e9)
+            logger.info(
+                "[Epoch %d %d/%d][Iteration %d][Wall Clock %.3fs] "
+                "Trained %d records in %.4f seconds. Throughput is %.1f records/second. "
+                "Loss is %.4f.",
+                state["epoch"], state["recordsProcessedThisEpoch"], num_samples,
+                state["neval"], time.time() - wall_start, n, dt, n / max(dt, 1e-9), loss)
+
+            if self.train_summary is not None:
+                self.train_summary.add_scalar("Loss", loss, state["neval"])
+                self.train_summary.add_scalar("LearningRate", float(lr), state["neval"])
+                self.train_summary.add_scalar("Throughput", n / max(dt, 1e-9), state["neval"])
+
+            state["neval"] += 1
+            if state["recordsProcessedThisEpoch"] >= num_samples:
+                state["epoch"] += 1
+                state["recordsProcessedThisEpoch"] = 0
+                self.dataset.shuffle()
+                data_iter = self._minibatches(self.dataset, self.batch_size)
+            ts.update_states(neval=state["neval"], epoch=state["epoch"], Loss=loss)
+
+            # write updated weights back before validation/checkpoint
+            if self._should_fire_aux(state):
+                model.load_params_dict(params)
+                model.load_buffers_dict(buffers)
+                self._run_validation(state)
+                self._run_checkpoint(state)
+
+        model.load_params_dict(params)
+        model.load_buffers_dict(buffers)
+        return model
+
+    # ------------------------------------------------------------- aux steps
+    def _should_fire_aux(self, state) -> bool:
+        fire = False
+        if self.validation_trigger is not None:
+            self._val_now = self.validation_trigger(state)
+            fire = fire or self._val_now
+        else:
+            self._val_now = False
+        if self.checkpoint_trigger is not None:
+            self._ckpt_now = self.checkpoint_trigger(state)
+            fire = fire or self._ckpt_now
+        else:
+            self._ckpt_now = False
+        return fire
+
+    def _run_validation(self, state):
+        if not self._val_now or self.validation_dataset is None:
+            return
+        from bigdl_tpu.optim.evaluator import Evaluator
+
+        results = Evaluator(self.model).test(
+            self.validation_dataset, self.validation_methods,
+            batch_size=getattr(self, "validation_batch_size", None) or self.batch_size)
+        for method, res in results:
+            value, _ = res.result()
+            logger.info("%s is %s", method.name(), res)
+            if method.name() in ("Top1Accuracy", "Top5Accuracy"):
+                state["score"] = value
+            if self.validation_summary is not None:
+                self.validation_summary.add_scalar(method.name(), value, state["neval"] - 1)
+
+    def _run_checkpoint(self, state):
+        if not self._ckpt_now or self.checkpoint_path is None:
+            return
+        os.makedirs(self.checkpoint_path, exist_ok=True)
+        tag = f"{state['neval'] - 1}"
+        from bigdl_tpu.utils import file as bt_file
+
+        bt_file.save_module(
+            self.model, os.path.join(self.checkpoint_path, f"model.{tag}"),
+            overwrite=True)
+        self.optim_method.save(
+            os.path.join(self.checkpoint_path, f"optimMethod.{tag}"), overwrite=True)

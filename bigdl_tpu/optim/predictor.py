@@ -1,0 +1,84 @@
+"""Inference façades.
+
+Reference: optim/Predictor.scala:31-234 (distributed RDD predict),
+optim/LocalPredictor.scala:50-188 (thread-parallel local predict),
+optim/PredictionService.scala:56-157 (concurrent serving). TPU-native: one
+jitted forward per shape; batching via SampleToMiniBatch; ``predict_class``
+returns 1-based indices (Appendix B.1)."""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import LocalDataSet
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+from bigdl_tpu.nn.module import Module, pure_apply
+
+
+class LocalPredictor:
+    def __init__(self, model: Module, batch_size: int = 32):
+        self.model = model
+        self.batch_size = batch_size
+        apply_fn = pure_apply(model)
+        self._fn = jax.jit(lambda p, b, x: apply_fn(p, b, x, training=False)[0])
+
+    def _batches(self, dataset):
+        if isinstance(dataset, (list, tuple)):
+            dataset = LocalDataSet(list(dataset))
+        it = dataset.data(train=False)
+        first = next(iter(it), None)
+        if first is None:
+            return
+        def chain():
+            yield first
+            yield from it
+        if isinstance(first, Sample):
+            yield from SampleToMiniBatch(self.batch_size, partial_batch=True)(chain())
+        else:
+            yield from chain()
+
+    def predict(self, dataset) -> List[np.ndarray]:
+        params = self.model.params_dict()
+        buffers = self.model.buffers_dict()
+        outs: List[np.ndarray] = []
+        for batch in self._batches(dataset):
+            x = jnp.asarray(batch.get_input())
+            out = np.asarray(self._fn(params, buffers, x))
+            outs.extend(out[i] for i in range(out.shape[0]))
+        return outs
+
+    def predict_class(self, dataset) -> np.ndarray:
+        preds = self.predict(dataset)
+        return np.asarray([int(np.argmax(p)) + 1 for p in preds])
+
+
+class PredictionService:
+    """Thread-safe concurrent serving (reference: optim/PredictionService.scala:56):
+    a blocking pool of model instances; under JAX the compiled function is
+    already thread-safe, so the pool bounds concurrency, not correctness."""
+
+    def __init__(self, model: Module, num_instances: int = 2, batch_size: int = 32):
+        self._pool: "queue.Queue[LocalPredictor]" = queue.Queue()
+        for _ in range(max(1, num_instances)):
+            self._pool.put(LocalPredictor(model, batch_size=batch_size))
+
+    def predict(self, input_activity):
+        """Predict one batched Activity. Inputs must carry a leading batch
+        dimension (single-sample callers add it: ``x[None]``)."""
+        predictor = self._pool.get()
+        try:
+            x = jnp.asarray(input_activity)
+            if x.ndim == 0:
+                raise ValueError("scalar input")
+            params = predictor.model.params_dict()
+            buffers = predictor.model.buffers_dict()
+            return np.asarray(predictor._fn(params, buffers, x))
+        finally:
+            self._pool.put(predictor)

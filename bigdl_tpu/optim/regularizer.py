@@ -1,0 +1,43 @@
+"""Weight regularizers.
+
+Reference: optim/Regularizer.scala — L1/L2/L1L2 penalties the reference
+applies inside each layer's ``accGradParameters``. TPU-native design: a
+regularizer is a pure penalty function ``reg(w) -> scalar`` added to the loss
+(Module.regularization_loss), so the gradient contribution falls out of
+autodiff instead of being hand-fused into layer backward code.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Regularizer:
+    def __call__(self, w) -> jnp.ndarray:
+        raise NotImplementedError
+
+
+class L1L2Regularizer(Regularizer):
+    """l1 * |w|_1 + l2/2 * |w|_2^2 (reference: optim/Regularizer.scala L1L2Regularizer)."""
+
+    def __init__(self, l1: float = 0.0, l2: float = 0.0):
+        self.l1 = float(l1)
+        self.l2 = float(l2)
+
+    def __call__(self, w):
+        out = 0.0
+        if self.l1:
+            out = out + self.l1 * jnp.sum(jnp.abs(w))
+        if self.l2:
+            out = out + 0.5 * self.l2 * jnp.sum(w * w)
+        return out
+
+
+class L1Regularizer(L1L2Regularizer):
+    def __init__(self, l1: float):
+        super().__init__(l1=l1, l2=0.0)
+
+
+class L2Regularizer(L1L2Regularizer):
+    def __init__(self, l2: float):
+        super().__init__(l1=0.0, l2=l2)

@@ -1,0 +1,114 @@
+"""Validation methods with mergeable results.
+
+Reference: optim/ValidationMethod.scala:72-332 — ``Top1Accuracy``,
+``Top5Accuracy``, ``Loss``, ``MAE`` etc., each producing a
+``ValidationResult`` that merges across partitions (here: across batches and
+device shards). Class predictions are 1-based (SURVEY.md Appendix B.1).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class ValidationResult:
+    def result(self):
+        """(value, count)."""
+        raise NotImplementedError
+
+    def __add__(self, other: "ValidationResult") -> "ValidationResult":
+        raise NotImplementedError
+
+
+class AccuracyResult(ValidationResult):
+    def __init__(self, correct: int, count: int):
+        self.correct = int(correct)
+        self.count = int(count)
+
+    def result(self):
+        return (self.correct / max(self.count, 1), self.count)
+
+    def __add__(self, other):
+        return AccuracyResult(self.correct + other.correct, self.count + other.count)
+
+    def __repr__(self):
+        acc, n = self.result()
+        return f"Accuracy(correct: {self.correct}, count: {n}, accuracy: {acc})"
+
+
+class LossResult(ValidationResult):
+    def __init__(self, loss: float, count: int):
+        self.loss = float(loss)
+        self.count = int(count)
+
+    def result(self):
+        return (self.loss / max(self.count, 1), self.count)
+
+    def __add__(self, other):
+        return LossResult(self.loss + other.loss, self.count + other.count)
+
+    def __repr__(self):
+        avg, n = self.result()
+        return f"Loss(loss: {self.loss}, count: {n}, average: {avg})"
+
+
+class ValidationMethod:
+    def __call__(self, output, target) -> ValidationResult:
+        raise NotImplementedError
+
+    def name(self) -> str:
+        return type(self).__name__
+
+
+def _class_targets(target) -> np.ndarray:
+    t = np.asarray(target).reshape(-1)
+    return t.astype(np.int64)
+
+
+class Top1Accuracy(ValidationMethod):
+    """Reference: optim/ValidationMethod.scala Top1Accuracy."""
+
+    def __call__(self, output, target):
+        out = np.asarray(output)
+        t = _class_targets(target)
+        if out.ndim == 1:
+            out = out[None]
+        pred = np.argmax(out, axis=-1) + 1  # 1-based
+        return AccuracyResult(int(np.sum(pred == t)), t.shape[0])
+
+
+class Top5Accuracy(ValidationMethod):
+    def __call__(self, output, target):
+        out = np.asarray(output)
+        t = _class_targets(target)
+        if out.ndim == 1:
+            out = out[None]
+        top5 = np.argsort(out, axis=-1)[:, -5:] + 1
+        correct = int(np.sum(np.any(top5 == t[:, None], axis=-1)))
+        return AccuracyResult(correct, t.shape[0])
+
+
+class Loss(ValidationMethod):
+    """Criterion loss on the validation set (reference: ValidationMethod.scala Loss)."""
+
+    def __init__(self, criterion=None):
+        from bigdl_tpu.nn.criterion import ClassNLLCriterion
+
+        self.criterion = criterion if criterion is not None else ClassNLLCriterion()
+
+    def __call__(self, output, target):
+        n = int(np.asarray(target).reshape(-1).shape[0]) if np.asarray(target).ndim else 1
+        loss = float(self.criterion.forward(jnp.asarray(output), jnp.asarray(target)))
+        return LossResult(loss * n, n)
+
+    def name(self):
+        return "Loss"
+
+
+class MAE(ValidationMethod):
+    def __call__(self, output, target):
+        out = np.asarray(output)
+        t = np.asarray(target)
+        n = out.shape[0] if out.ndim else 1
+        return LossResult(float(np.sum(np.abs(out - t)) / max(out[0].size, 1)), n)

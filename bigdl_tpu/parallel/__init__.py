@@ -1,0 +1,10 @@
+"""bigdl_tpu.parallel — distributed engine (reference: parameters/ +
+optim/DistriOptimizer + utils/Engine, SURVEY.md §2.5): device mesh discovery,
+flat-parameter collectives over ICI, and the SPMD training loop."""
+
+from bigdl_tpu.parallel.engine import Engine, EngineType
+from bigdl_tpu.parallel.all_reduce import (
+    AllReduceParameter, flatten_params, unflatten_params, pad_to_multiple,
+    compress, decompress,
+)
+from bigdl_tpu.parallel.distri_optimizer import DistriOptimizer

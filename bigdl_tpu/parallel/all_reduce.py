@@ -1,0 +1,92 @@
+"""Flat-parameter collectives — the TPU-native AllReduceParameter.
+
+Reference: parameters/AllReduceParameter.scala:84 — the model's flat
+parameter vector is sliced into one chunk per executor; each iteration does
+putGradients (FP16-compressed scatter) → aggregateGradientPartition (sum/N)
+→ optimizer update on the owned slice → sendWeightPartition / getWeights
+(all-gather). That algorithm IS reduce_scatter + shard-update + all_gather,
+so here it is expressed directly with XLA collectives over ICI inside
+``shard_map`` (SURVEY.md §2.5 "TPU-native equivalent").
+
+The reference's "FP16" wire format keeps the upper 16 bits of the float32
+pattern (parameters/FP16CompressedTensor.scala:270-278) — i.e. bfloat16
+truncation, TPU's native dtype — reproduced by ``compress_dtype=bfloat16``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def flatten_params(params) -> Tuple[jnp.ndarray, Any]:
+    """Pytree → (flat 1-D vector, spec) (≙ getParameters flattening,
+    nn/abstractnn/AbstractModule.scala:963)."""
+    leaves, treedef = jax.tree.flatten(params)
+    shapes = [l.shape for l in leaves]
+    dtypes = [l.dtype for l in leaves]
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+    flat = jnp.concatenate([l.astype(jnp.float32).reshape(-1) for l in leaves]) \
+        if leaves else jnp.zeros((0,), jnp.float32)
+    return flat, (treedef, shapes, dtypes, sizes)
+
+
+def unflatten_params(flat: jnp.ndarray, spec) -> Any:
+    treedef, shapes, dtypes, sizes = spec
+    leaves = []
+    off = 0
+    for shape, dtype, size in zip(shapes, dtypes, sizes):
+        leaves.append(flat[off:off + size].reshape(shape).astype(dtype))
+        off += size
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def pad_to_multiple(flat: jnp.ndarray, n: int) -> Tuple[jnp.ndarray, int]:
+    """Pad so the vector splits evenly into n slices (the reference instead
+    gives the last partition the remainder, AllReduceParameter.scala:84)."""
+    size = flat.shape[0]
+    padded = (size + n - 1) // n * n
+    if padded != size:
+        flat = jnp.concatenate([flat, jnp.zeros((padded - size,), flat.dtype)])
+    return flat, padded
+
+
+def compress(t: jnp.ndarray, dtype=jnp.bfloat16) -> jnp.ndarray:
+    """≙ FP16CompressedTensor.compress — bf16 truncation of f32."""
+    return t.astype(dtype)
+
+
+def decompress(t: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
+    return t.astype(dtype)
+
+
+class AllReduceParameter:
+    """Sharded flat-parameter update executed inside ``shard_map`` over a
+    mesh axis. Each device owns flat_size/axis_size contiguous elements
+    (≙ one executor's weightPartition)."""
+
+    def __init__(self, axis_name: str = "data", compress_dtype=jnp.bfloat16):
+        self.axis_name = axis_name
+        self.compress_dtype = compress_dtype
+
+    def aggregate(self, local_grad_flat: jnp.ndarray) -> jnp.ndarray:
+        """putGradients + aggregateGradientPartition: reduce_scatter of the
+        (compressed) gradient; returns this device's owned slice, already
+        averaged over the axis (÷N, AllReduceParameter.scala:269)."""
+        n = jax.lax.psum(1, self.axis_name)
+        g = compress(local_grad_flat, self.compress_dtype) \
+            if self.compress_dtype is not None else local_grad_flat
+        owned = jax.lax.psum_scatter(g, self.axis_name, tiled=True)
+        return decompress(owned) / n
+
+    def all_gather_weights(self, owned_slice: jnp.ndarray) -> jnp.ndarray:
+        """sendWeightPartition + getWeights: republish the updated owned
+        slice and gather the full vector (AllReduceParameter.scala:193-220,
+        307-320)."""
+        w = compress(owned_slice, self.compress_dtype) \
+            if self.compress_dtype is not None else owned_slice
+        full = jax.lax.all_gather(w, self.axis_name, tiled=True)
+        return decompress(full)

@@ -1,0 +1,123 @@
+"""Runtime engine: device/mesh discovery and global config.
+
+Reference: utils/Engine.scala:41 — detects executor count/cores from
+SparkConf for every cluster manager (Engine.scala:460-541), owns thread
+pools, checks required conf, and switches engine type. TPU-native redesign:
+
+- "executors" ≙ JAX processes (one per TPU host, ``jax.process_count()``),
+  "cores per executor" ≙ local devices (``jax.local_device_count()``);
+- the thread pools are absorbed by XLA's async dispatch + the host input
+  pipeline (bigdl_tpu.dataset prefetch);
+- the engine-type switch (MklBlas/MklDnn) maps to dtype/backend policy
+  (float32 vs bfloat16 compute on the MXU);
+- ``Engine.init`` ≙ jax.distributed.initialize for multi-host pods
+  (SURVEY.md §2.5 "control plane"), a no-op single-host.
+
+Config tiers mirror the reference's ``bigdl.*`` system properties
+(SURVEY.md §5 "Config / flag system") as ``BIGDL_TPU_*`` env vars.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+logger = logging.getLogger("bigdl_tpu.engine")
+
+
+class EngineType:
+    """≙ MklBlas / MklDnn switch (utils/Engine.scala:35-47): on TPU the
+    analogous choice is the compute dtype policy fed to the MXU."""
+
+    FLOAT32 = "float32"
+    BFLOAT16 = "bfloat16"
+
+
+class Engine:
+    _initialized = False
+    _mesh: Optional[Mesh] = None
+    _engine_type = os.environ.get("BIGDL_TPU_ENGINE_TYPE", EngineType.FLOAT32)
+
+    @classmethod
+    def init(cls, coordinator_address: Optional[str] = None,
+             num_processes: Optional[int] = None,
+             process_id: Optional[int] = None) -> None:
+        """≙ Engine.init (utils/Engine.scala:105-118). Multi-host: wires the
+        JAX distributed runtime (one controller per TPU host ≙ one executor
+        JVM per Spark node); single-host: records devices."""
+        if cls._initialized:
+            return  # singleton-per-process (≙ Engine.checkSingleton, Engine.scala:248)
+        if coordinator_address is not None:
+            jax.distributed.initialize(coordinator_address, num_processes, process_id)
+        cls._initialized = True
+        logger.info(
+            "Engine.init: %d process(es), %d local device(s), platform=%s",
+            cls.node_number(), jax.local_device_count(),
+            jax.devices()[0].platform)
+
+    @classmethod
+    def node_number(cls) -> int:
+        """≙ Engine.nodeNumber (executor count)."""
+        return jax.process_count()
+
+    @classmethod
+    def core_number(cls) -> int:
+        """≙ Engine.coreNumber (cores per executor → local chips per host)."""
+        return jax.local_device_count()
+
+    @classmethod
+    def total_devices(cls) -> int:
+        return jax.device_count()
+
+    @classmethod
+    def get_engine_type(cls) -> str:
+        return cls._engine_type
+
+    @classmethod
+    def set_engine_type(cls, t: str) -> None:
+        cls._engine_type = t
+
+    @classmethod
+    def compute_dtype(cls):
+        import jax.numpy as jnp
+
+        return jnp.bfloat16 if cls._engine_type == EngineType.BFLOAT16 else jnp.float32
+
+    # ------------------------------------------------------------------ mesh
+    @classmethod
+    def create_mesh(cls, axes: Optional[Sequence[Tuple[str, int]]] = None,
+                    devices=None) -> Mesh:
+        """Build the device mesh that replaces cluster topology discovery
+        (utils/Engine.scala:460-541). Default: all devices on one ``data``
+        axis (the reference's only parallelism is data parallel, SURVEY.md
+        §2.5). Pass axes like [("data", 4), ("model", 2)] for dp×tp."""
+        devices = devices if devices is not None else jax.devices()
+        if axes is None:
+            axes = [("data", len(devices))]
+        names = [a for a, _ in axes]
+        sizes = [s for _, s in axes]
+        if int(np.prod(sizes)) != len(devices):
+            raise ValueError(
+                f"mesh axes {axes} do not cover {len(devices)} devices")
+        dev_array = np.asarray(devices).reshape(sizes)
+        return Mesh(dev_array, names)
+
+    @classmethod
+    def default_mesh(cls) -> Mesh:
+        if cls._mesh is None:
+            cls._mesh = cls.create_mesh()
+        return cls._mesh
+
+    @classmethod
+    def set_default_mesh(cls, mesh: Mesh) -> None:
+        cls._mesh = mesh
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._initialized = False
+        cls._mesh = None

@@ -2,15 +2,24 @@
 
 Mirrors the reference's "distributed tested via in-process multi-device"
 strategy (SURVEY.md §4): Spark local-mode ≙ a virtual 8-device CPU platform
-(``xla_force_host_platform_device_count``). Must run before jax initializes.
+(``xla_force_host_platform_device_count``).
+
+NOTE on env ordering: this image registers the axon TPU PJRT plugin from
+sitecustomize at interpreter start; setting JAX_PLATFORMS=cpu in the
+environment *before* startup deadlocks that registration. So instead we
+switch platform post-import via ``jax.config.update`` — XLA_FLAGS is read at
+backend-creation time, which happens on first device use, after this file.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

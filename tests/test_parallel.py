@@ -1,0 +1,136 @@
+"""Distributed engine tests on a virtual 8-device CPU mesh.
+
+Mirrors the reference's strategy of testing "distributed" in-process
+(Spark local-mode ≙ xla_force_host_platform_device_count, SURVEY.md §4):
+AllReduceParameterSpec / FP16ParameterSpec / DistriOptimizerSpec analogs."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.dataset.dataset import DataSet
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.optim import SGD, Adam, Trigger, Top1Accuracy, Optimizer
+from bigdl_tpu.parallel import (
+    Engine, AllReduceParameter, DistriOptimizer,
+    flatten_params, unflatten_params, pad_to_multiple, compress, decompress,
+)
+
+
+@pytest.fixture
+def mesh():
+    return Engine.create_mesh([("data", 8)])
+
+
+class TestFlatParams:
+    def test_round_trip(self):
+        tree = {"a": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+                "b": {"c": jnp.ones(5, jnp.float32)}}
+        flat, spec = flatten_params(tree)
+        assert flat.shape == (11,)
+        back = unflatten_params(flat, spec)
+        np.testing.assert_allclose(np.asarray(back["a"]), np.arange(6).reshape(2, 3))
+        np.testing.assert_allclose(np.asarray(back["b"]["c"]), np.ones(5))
+
+    def test_pad_to_multiple(self):
+        flat = jnp.ones(10)
+        padded, size = pad_to_multiple(flat, 8)
+        assert size == 16 and padded.shape == (16,)
+        np.testing.assert_allclose(np.asarray(padded[10:]), 0.0)
+
+    def test_bf16_compress_is_truncation(self):
+        """≙ FP16CompressedTensor: upper 16 bits of the f32 pattern
+        (parameters/FP16CompressedTensor.scala:270-278) == bfloat16."""
+        x = jnp.asarray([1.2345678, -3.1415926, 1e-8], jnp.float32)
+        c = decompress(compress(x))
+        np.testing.assert_allclose(np.asarray(c), np.asarray(x), rtol=1e-2)
+
+
+class TestAllReduceParameter:
+    def test_reduce_scatter_then_gather_matches_mean(self, mesh):
+        arp = AllReduceParameter("data", compress_dtype=None)
+        n = 8
+
+        def body(g):
+            owned = arp.aggregate(g)
+            return arp.all_gather_weights(owned)
+
+        grads = jnp.arange(n * 16, dtype=jnp.float32).reshape(n, 16)
+        out = jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+            check_vma=False))(grads.reshape(-1))
+        # every device's gathered copy equals mean over devices
+        expect = np.mean(np.asarray(grads), axis=0)
+        got = np.asarray(out).reshape(n, 16)
+        for d in range(n):
+            np.testing.assert_allclose(got[d], expect, rtol=1e-5)
+
+
+def _xor_samples(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 2).astype(np.float32)
+    labels = ((x[:, 0] > 0.5) ^ (x[:, 1] > 0.5)).astype(np.float32) + 1.0
+    return [Sample(x[i], np.array([labels[i]])) for i in range(n)]
+
+
+def _mlp():
+    model = nn.Sequential()
+    model.add(nn.Linear(2, 32))
+    model.add(nn.Tanh())
+    model.add(nn.Linear(32, 2))
+    model.add(nn.LogSoftMax())
+    return model
+
+
+class TestDistriOptimizer:
+    @pytest.mark.parametrize("sync", ["sharded", "allreduce"])
+    def test_trains_xor_on_8_devices(self, mesh, sync):
+        samples = _xor_samples(256)
+        model = _mlp()
+        opt = DistriOptimizer(
+            model=model, dataset=DataSet.array(samples),
+            criterion=nn.ClassNLLCriterion(), batch_size=64,
+            end_when=Trigger.max_epoch(60),
+            mesh=mesh, parameter_sync=sync)
+        opt.set_optim_method(Adam(learning_rate=0.05))
+        trained = opt.optimize()
+        results = trained.evaluate_on(_xor_samples(64, seed=1), [Top1Accuracy()],
+                                      batch_size=64)
+        acc, _ = results[0][1].result()
+        assert acc > 0.85, f"{sync}: accuracy {acc}"
+
+    def test_sharded_matches_local_single_step(self, mesh):
+        """Semantic oracle à la RefDistriOptimizer (optim/RefDistriOptimizer.scala):
+        one distributed step == one local step on the same global batch."""
+        samples = _xor_samples(64, seed=3)
+        model_a = _mlp()
+        model_b = model_a.clone_module()
+
+        opt_a = Optimizer(model=model_a, dataset=samples,
+                          criterion=nn.ClassNLLCriterion(), batch_size=64,
+                          end_when=Trigger.max_iteration(1))
+        opt_a.set_optim_method(SGD(learning_rate=0.1))
+        opt_a.optimize()
+
+        opt_b = DistriOptimizer(model=model_b, dataset=DataSet.array(samples),
+                                criterion=nn.ClassNLLCriterion(), batch_size=64,
+                                end_when=Trigger.max_iteration(1),
+                                mesh=mesh, parameter_sync="sharded",
+                                compress_dtype=None)
+        opt_b.set_optim_method(SGD(learning_rate=0.1))
+        opt_b.optimize()
+
+        wa, _ = model_a.get_parameters()
+        wb, _ = model_b.get_parameters()
+        np.testing.assert_allclose(np.asarray(wa), np.asarray(wb), atol=2e-5)
+
+    def test_batch_divisibility_enforced(self, mesh):
+        samples = _xor_samples(30)
+        opt = DistriOptimizer(model=_mlp(), dataset=DataSet.array(samples),
+                              criterion=nn.ClassNLLCriterion(), batch_size=30,
+                              end_when=Trigger.max_iteration(1), mesh=mesh)
+        with pytest.raises(ValueError, match="divide"):
+            opt.optimize()
