@@ -52,6 +52,11 @@ from bigdl_tpu.nn.normalization import (
     SpatialContrastiveNormalization,
 )
 from bigdl_tpu.nn.embedding import LookupTable
+from bigdl_tpu.nn.graph import Graph, StaticGraph, DynamicGraph, Node, Input
+from bigdl_tpu.nn.recurrent import (
+    Cell, RnnCell, LSTM, LSTMPeephole, GRU, ConvLSTMPeephole, MultiRNNCell,
+    Recurrent, BiRecurrent, RecurrentDecoder, TimeDistributed,
+)
 from bigdl_tpu.nn.criterion import (
     Criterion, ClassNLLCriterion, CrossEntropyCriterion, CategoricalCrossEntropy,
     MSECriterion, AbsCriterion, BCECriterion, SmoothL1Criterion,

@@ -1,0 +1,179 @@
+"""Functional graph containers.
+
+TPU-native redesign of the reference's graph engine (reference:
+nn/Graph.scala:72, nn/StaticGraph.scala, nn/DynamicGraph.scala,
+node wiring via ``AbstractModule.inputs(...)`` at
+nn/abstractnn/AbstractModule.scala:785-816).
+
+The reference builds an explicit *backward* graph mirroring the forward one
+(Graph.scala:196) and walks it with hand-written updateGradInput chains;
+``stopGradient`` prunes backward edges (Graph.scala:247-273). Here the graph
+only describes the forward dataflow — autodiff derives the backward — and
+``stop_gradient`` lowers to ``jax.lax.stop_gradient`` on the named nodes'
+outputs, which prunes exactly the same backward paths inside the XLA
+program. Topological execution order is computed once at construction
+(≙ StaticGraph's sorted node array, Graph.scala:390-407); under
+``pure_apply`` the whole walk traces into one fused jit program, so
+"static" vs "dynamic" scheduling (nn/Scheduler.scala:36) collapses to
+trace-time evaluation order. Control-flow graphs (TF while loops) are
+handled by the ops layer with ``lax.while_loop`` / ``lax.cond`` instead of
+the reference's Scheduler/FrameManager.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax
+
+from bigdl_tpu.nn.activation import Identity
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils.table import Table
+
+
+class Node:
+    """A module instance wired into a dataflow graph (≙ ModuleNode[T]).
+
+    ``linear.inputs(a, b)`` (or ``Node(linear)(a, b)``) records ``a`` and
+    ``b`` as this node's predecessors and returns the node, mirroring the
+    reference's functional wiring API (AbstractModule.scala:785-816).
+    """
+
+    _counter = 0
+
+    def __init__(self, module: Module):
+        self.module = module
+        self.prev: List["Node"] = []
+        Node._counter += 1
+        self._uid = Node._counter
+
+    def inputs(self, *nodes: "Node") -> "Node":
+        for n in nodes:
+            if not isinstance(n, Node):
+                raise TypeError(f"graph inputs must be Nodes, got {type(n)}")
+        self.prev.extend(nodes)
+        return self
+
+    __call__ = inputs
+
+    @property
+    def name(self) -> str:
+        return self.module.get_name()
+
+    def __repr__(self):
+        return f"Node({self.name})"
+
+
+def Input() -> Node:
+    """Placeholder input node (reference: nn/Input.scala — an Identity node)."""
+    return Node(Identity())
+
+
+class Graph(Module):
+    """Directed acyclic module graph (reference: nn/Graph.scala:72).
+
+    ``inputs`` / ``outputs`` are Nodes (or lists). Forward feeds the i-th
+    element of the input activity to the i-th input node, walks the
+    topological order, and returns the single output or a Table of outputs.
+    """
+
+    def __init__(self,
+                 inputs: Union[Node, Sequence[Node]],
+                 outputs: Union[Node, Sequence[Node]]):
+        super().__init__()
+        self.input_nodes = [inputs] if isinstance(inputs, Node) else list(inputs)
+        self.output_nodes = [outputs] if isinstance(outputs, Node) else list(outputs)
+        self._stop_gradient_names: set = set()
+        self._topo = self._topo_sort()
+        # Register every distinct module once so params/buffers pytrees and
+        # named_modules see the graph's weights (shared modules share slots).
+        seen = {}
+        for i, node in enumerate(self._topo):
+            if id(node.module) not in seen:
+                seen[id(node.module)] = True
+                setattr(self, f"n{i}_{type(node.module).__name__}", node.module)
+
+    # ------------------------------------------------------------- structure
+    def _topo_sort(self) -> List[Node]:
+        order: List[Node] = []
+        state: Dict[int, int] = {}  # 0=visiting, 1=done
+
+        def visit(node: Node):
+            s = state.get(node._uid)
+            if s == 1:
+                return
+            if s == 0:
+                raise ValueError("graph contains a cycle; use the ops layer's "
+                                 "lax.while_loop lowering for control flow")
+            state[node._uid] = 0
+            for p in node.prev:
+                visit(p)
+            state[node._uid] = 1
+            order.append(node)
+
+        for out in self.output_nodes:
+            visit(out)
+        for inp in self.input_nodes:
+            if state.get(inp._uid) != 1:
+                raise ValueError(
+                    f"input node {inp.name} is not connected to any output")
+        return order
+
+    def node(self, name: str) -> Node:
+        """Look up a node by module name (≙ Graph.node(name))."""
+        for n in self._topo:
+            if n.name == name:
+                return n
+        raise KeyError(name)
+
+    def stop_gradient(self, names: Sequence[str]) -> "Graph":
+        """Stop backprop at the named nodes (reference: Graph.setStopGradient,
+        nn/Graph.scala:247-273) — their outputs become ``lax.stop_gradient``
+        leaves so no gradient flows to them or their ancestors."""
+        known = {n.name for n in self._topo}
+        for name in names:
+            if name not in known:
+                raise KeyError(f"no node named {name}")
+        self._stop_gradient_names.update(names)
+        return self
+
+    # ------------------------------------------------------------- execution
+    def forward(self, input):
+        if len(self.input_nodes) == 1:
+            feeds = [input]
+        else:
+            feeds = list(input)
+            if len(feeds) != len(self.input_nodes):
+                raise ValueError(
+                    f"graph expects {len(self.input_nodes)} inputs, got {len(feeds)}")
+        cache: Dict[int, object] = {}
+        for node, x in zip(self.input_nodes, feeds):
+            cache[node._uid] = node.module(x)
+            if node.name in self._stop_gradient_names:
+                cache[node._uid] = jax.lax.stop_gradient(cache[node._uid])
+        for node in self._topo:
+            if node._uid in cache:
+                continue
+            if not node.prev:
+                raise ValueError(
+                    f"node {node.name} has no inputs and is not an input node")
+            ins = [cache[p._uid] for p in node.prev]
+            act = ins[0] if len(ins) == 1 else Table(*ins)
+            out = node.module(act)
+            if node.name in self._stop_gradient_names:
+                out = jax.lax.stop_gradient(out)
+            cache[node._uid] = out
+        outs = [cache[n._uid] for n in self.output_nodes]
+        return outs[0] if len(outs) == 1 else Table(*outs)
+
+
+class StaticGraph(Graph):
+    """Alias with the reference's name: execution order is fixed at build
+    time (nn/StaticGraph.scala). Graph already executes statically."""
+
+
+class DynamicGraph(Graph):
+    """Lazily-scheduled graph (reference: nn/DynamicGraph.scala +
+    nn/Scheduler.scala:36). Under jit, lazy scheduling and static order
+    trace to the same XLA program, so this shares Graph's execution; it
+    exists for API parity with imported TF graphs."""

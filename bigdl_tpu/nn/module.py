@@ -50,6 +50,12 @@ _BUFFERS_KEY = "~buffers"
 _PURE_BIND_DEPTH = 0
 
 
+def in_pure_bind() -> bool:
+    """True while tracing under ``pure_apply`` — layers must then avoid
+    stashing per-call values (they would be leaked tracers)."""
+    return _PURE_BIND_DEPTH > 0
+
+
 class Module:
     """Base class of all layers (reference: nn/abstractnn/AbstractModule.scala:58)."""
 
@@ -407,6 +413,13 @@ class Module:
         return Evaluator(self).test(dataset, methods, batch_size=batch_size)
 
     # ------------------------------------------------------------- utilities
+    def inputs(self, *nodes):
+        """Wire this module into a dataflow graph; returns its Node
+        (≙ AbstractModule.inputs, AbstractModule.scala:785-816)."""
+        from bigdl_tpu.nn.graph import Node
+
+        return Node(self).inputs(*nodes)
+
     def clone_module(self) -> "Module":
         import copy
 

@@ -1,0 +1,528 @@
+"""Recurrent stack.
+
+TPU-native redesign of the reference's recurrent machinery (reference:
+nn/Recurrent.scala:47 — an 855-LoC container that clones the cell per time
+step, manages hidden state tensors in place, and loops in Scala; cells in
+nn/Cell.scala, nn/RNN.scala (RnnCell), nn/LSTM.scala, nn/LSTMPeephole.scala,
+nn/GRU.scala, nn/ConvLSTMPeephole.scala, nn/MultiRNNCell.scala,
+nn/BiRecurrent.scala, nn/RecurrentDecoder.scala, nn/TimeDistributed.scala).
+
+Instead of a per-step Scala loop over cloned cells, the time dimension is a
+single ``jax.lax.scan``: one cell ``step`` traced once, compiled once, and
+rolled by XLA — the idiomatic TPU form (static shapes, fused gate matmuls
+sized for the MXU; SURVEY.md §7 step 8). Gate projections are fused into one
+``(in, 4*hidden)`` matmul per step rather than four separate ones.
+
+Batch-first layout ``(batch, time, ...)`` matches the reference's
+``batchNormParams``-free default (Recurrent expects [batch, time, feature]).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn import init as bt_init
+from bigdl_tpu.nn.module import Module, in_pure_bind
+from bigdl_tpu.nn.table_ops import CAddTable
+from bigdl_tpu.utils.table import Table
+
+
+class Cell(Module):
+    """Base recurrent cell (reference: nn/Cell.scala).
+
+    Contract: ``step(x_t, state, rng=None) -> (out_t, new_state)`` is pure
+    jax over the cell's registered parameters; ``init_state(batch, dtype)``
+    builds the zero state pytree (cells whose state depends on the input
+    shape override ``state_for(x_t)`` instead). ``forward`` runs one step on
+    ``Table(x, state)`` for parity with the reference's Cell forward on
+    T(input, hidden).
+    """
+
+    def __init__(self):
+        super().__init__()
+        self._param_inits = {}
+
+    def register_random_parameter(self, name, init_fn, regularizer=None):
+        """Register a parameter together with its init thunk so ``reset``
+        re-draws it from the exact construction-time distribution."""
+        self._param_inits[name] = init_fn
+        self.register_parameter(name, init_fn(), regularizer=regularizer)
+
+    def init_state(self, batch: int, dtype=jnp.float32):
+        raise NotImplementedError
+
+    def state_for(self, x_t):
+        """Zero state derived from one step's input (overridden by conv
+        cells which need the spatial shape)."""
+        return self.init_state(x_t.shape[0], x_t.dtype)
+
+    def step(self, x, state, rng=None):
+        raise NotImplementedError
+
+    def reset(self):
+        for name, fn in self._param_inits.items():
+            self._set_param(name, fn())
+        for _, child in self._modules.items():
+            child.reset()
+
+    def forward(self, input):
+        if isinstance(input, (Table, tuple, list)):
+            seq = list(input)
+            x, state = seq[0], seq[1]
+        else:
+            x, state = input, self.state_for(input)
+        out, new_state = self.step(x, state)
+        return Table(out, new_state)
+
+
+def _uniform_stdv(shape, hidden_size):
+    stdv = 1.0 / (hidden_size ** 0.5)
+    return bt_init.RandomUniform(-stdv, stdv)(shape)
+
+
+def _cell_uses_rng(cell: "Cell") -> bool:
+    """True when any (sub)cell will draw dropout masks this pass — the
+    unroll then threads a split PRNG key through the scan carry so every
+    time step gets an independent mask (≙ the reference's per-step cell
+    clones each owning a Dropout instance)."""
+    if getattr(cell, "training", False) and getattr(cell, "p", 0.0) > 0.0:
+        return True
+    return any(_cell_uses_rng(c) for c in getattr(cell, "cells", ()))
+
+
+def _gate_dropout(x, p, n_gates, training, rng):
+    """Per-gate inverted dropout on the step input (≙ the reference wiring a
+    separate Dropout(p) into each gate's input projection, nn/LSTM.scala).
+    Returns (batch, n_gates, in_features); pair with a weight reshaped to
+    (in, n_gates, h) so the gate matmuls stay one fused contraction."""
+    xg = jnp.broadcast_to(x[:, None, :], (x.shape[0], n_gates) + x.shape[1:])
+    if not training or p <= 0.0:
+        return xg
+    if rng is None:
+        from bigdl_tpu.utils import random as bt_random
+
+        rng = bt_random.next_key()
+    keep = jax.random.bernoulli(rng, 1.0 - p, xg.shape)
+    return jnp.where(keep, xg / (1.0 - p), 0.0)
+
+
+class RnnCell(Cell):
+    """Vanilla RNN cell: h' = act(W x + U h + b) (reference: nn/RNN.scala)."""
+
+    def __init__(self, input_size: int, hidden_size: int, activation: Optional[Module] = None,
+                 is_input_with_bias: bool = True, is_hidden_with_bias: bool = True,
+                 w_regularizer=None, u_regularizer=None, b_regularizer=None):
+        super().__init__()
+        from bigdl_tpu.nn.activation import Tanh
+
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation if activation is not None else Tanh()
+        self.register_random_parameter(
+            "i2h", lambda: _uniform_stdv((input_size, hidden_size), hidden_size),
+            regularizer=w_regularizer)
+        self.register_random_parameter(
+            "h2h", lambda: _uniform_stdv((hidden_size, hidden_size), hidden_size),
+            regularizer=u_regularizer)
+        if is_input_with_bias or is_hidden_with_bias:
+            self.register_parameter("bias", jnp.zeros((hidden_size,)),
+                                    regularizer=b_regularizer)
+        self.with_bias = is_input_with_bias or is_hidden_with_bias
+
+    def init_state(self, batch, dtype=jnp.float32):
+        return jnp.zeros((batch, self.hidden_size), dtype)
+
+    def step(self, x, h, rng=None):
+        z = x @ self.i2h + h @ self.h2h
+        if self.with_bias:
+            z = z + self.bias
+        h_new = self.activation.forward(z)
+        return h_new, h_new
+
+
+class LSTM(Cell):
+    """Standard LSTM (reference: nn/LSTM.scala). Gate order i, f, g, o; the
+    four projections are fused into single (in, 4h)/(h, 4h) matmuls for one
+    big MXU-friendly GEMM per step."""
+
+    def __init__(self, input_size: int, hidden_size: int, p: float = 0.0,
+                 activation: Optional[Module] = None,
+                 inner_activation: Optional[Module] = None,
+                 w_regularizer=None, u_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.p = p
+        self._act = activation
+        self._inner = inner_activation
+        h = hidden_size
+        self.register_random_parameter(
+            "i2g", lambda: _uniform_stdv((input_size, 4 * h), h),
+            regularizer=w_regularizer)
+        self.register_random_parameter(
+            "h2g", lambda: _uniform_stdv((h, 4 * h), h),
+            regularizer=u_regularizer)
+        # forget-gate bias 1.0 — standard trick, matches reference init of
+        # the f-gate bias in nn/LSTM.scala's initial bias tensor
+        bias = jnp.zeros((4 * h,)).at[h:2 * h].set(1.0)
+        self.register_parameter("bias", bias, regularizer=b_regularizer)
+
+    def _activate(self, z):
+        return self._act.forward(z) if self._act is not None else jnp.tanh(z)
+
+    def _inner_activate(self, z):
+        return self._inner.forward(z) if self._inner is not None else jax.nn.sigmoid(z)
+
+    def init_state(self, batch, dtype=jnp.float32):
+        h = jnp.zeros((batch, self.hidden_size), dtype)
+        c = jnp.zeros((batch, self.hidden_size), dtype)
+        return (h, c)
+
+    def step(self, x, state, rng=None):
+        h, c = state
+        hs = self.hidden_size
+        if self.training and self.p > 0.0:
+            xg = _gate_dropout(x, self.p, 4, self.training, rng)
+            w = self.i2g.reshape(self.input_size, 4, hs)
+            zi = jnp.einsum("bgi,igh->bgh", xg, w).reshape(x.shape[0], 4 * hs)
+        else:
+            zi = x @ self.i2g
+        z = zi + h @ self.h2g + self.bias
+        i = self._inner_activate(z[:, 0 * hs:1 * hs])
+        f = self._inner_activate(z[:, 1 * hs:2 * hs])
+        g = self._activate(z[:, 2 * hs:3 * hs])
+        o = self._inner_activate(z[:, 3 * hs:4 * hs])
+        c_new = f * c + i * g
+        h_new = o * self._activate(c_new)
+        return h_new, (h_new, c_new)
+
+
+class LSTMPeephole(Cell):
+    """LSTM with peephole connections from the cell state into the gates
+    (reference: nn/LSTMPeephole.scala)."""
+
+    def __init__(self, input_size: int, hidden_size: int, p: float = 0.0,
+                 w_regularizer=None, u_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.p = p
+        h = hidden_size
+        self.register_random_parameter(
+            "i2g", lambda: _uniform_stdv((input_size, 4 * h), h),
+            regularizer=w_regularizer)
+        self.register_random_parameter(
+            "h2g", lambda: _uniform_stdv((h, 4 * h), h),
+            regularizer=u_regularizer)
+        self.register_parameter("bias", jnp.zeros((4 * h,)).at[h:2 * h].set(1.0),
+                                regularizer=b_regularizer)
+        self.register_random_parameter("w_ci", lambda: _uniform_stdv((h,), h))
+        self.register_random_parameter("w_cf", lambda: _uniform_stdv((h,), h))
+        self.register_random_parameter("w_co", lambda: _uniform_stdv((h,), h))
+
+    def init_state(self, batch, dtype=jnp.float32):
+        return (jnp.zeros((batch, self.hidden_size), dtype),
+                jnp.zeros((batch, self.hidden_size), dtype))
+
+    def step(self, x, state, rng=None):
+        h, c = state
+        hs = self.hidden_size
+        if self.training and self.p > 0.0:
+            xg = _gate_dropout(x, self.p, 4, self.training, rng)
+            w = self.i2g.reshape(self.input_size, 4, hs)
+            zi = jnp.einsum("bgi,igh->bgh", xg, w).reshape(x.shape[0], 4 * hs)
+        else:
+            zi = x @ self.i2g
+        z = zi + h @ self.h2g + self.bias
+        i = jax.nn.sigmoid(z[:, 0 * hs:1 * hs] + self.w_ci * c)
+        f = jax.nn.sigmoid(z[:, 1 * hs:2 * hs] + self.w_cf * c)
+        g = jnp.tanh(z[:, 2 * hs:3 * hs])
+        c_new = f * c + i * g
+        o = jax.nn.sigmoid(z[:, 3 * hs:4 * hs] + self.w_co * c_new)
+        h_new = o * jnp.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+
+class GRU(Cell):
+    """Gated recurrent unit (reference: nn/GRU.scala). r/z gates fused into
+    one (in, 2h) matmul; candidate uses the reset-gated hidden state."""
+
+    def __init__(self, input_size: int, hidden_size: int, p: float = 0.0,
+                 w_regularizer=None, u_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.p = p
+        h = hidden_size
+        self.register_random_parameter(
+            "i2g", lambda: _uniform_stdv((input_size, 2 * h), h),
+            regularizer=w_regularizer)
+        self.register_random_parameter(
+            "h2g", lambda: _uniform_stdv((h, 2 * h), h),
+            regularizer=u_regularizer)
+        self.register_parameter("gate_bias", jnp.zeros((2 * h,)), regularizer=b_regularizer)
+        self.register_random_parameter(
+            "i2c", lambda: _uniform_stdv((input_size, h), h),
+            regularizer=w_regularizer)
+        self.register_random_parameter(
+            "h2c", lambda: _uniform_stdv((h, h), h),
+            regularizer=u_regularizer)
+        self.register_parameter("cand_bias", jnp.zeros((h,)), regularizer=b_regularizer)
+
+    def init_state(self, batch, dtype=jnp.float32):
+        return jnp.zeros((batch, self.hidden_size), dtype)
+
+    def step(self, x, h, rng=None):
+        hs = self.hidden_size
+        if self.training and self.p > 0.0:
+            # 3 dropped copies of x: one per gate (r, z) + one for the candidate
+            xg = _gate_dropout(x, self.p, 3, self.training, rng)
+            wg = self.i2g.reshape(self.input_size, 2, hs)
+            zg = jnp.einsum("bgi,igh->bgh", xg[:, :2], wg).reshape(x.shape[0], 2 * hs) \
+                + h @ self.h2g + self.gate_bias
+            x_cand = xg[:, 2]
+        else:
+            zg = x @ self.i2g + h @ self.h2g + self.gate_bias
+            x_cand = x
+        r = jax.nn.sigmoid(zg[:, :hs])
+        z = jax.nn.sigmoid(zg[:, hs:])
+        cand = jnp.tanh(x_cand @ self.i2c + (r * h) @ self.h2c + self.cand_bias)
+        h_new = (1 - z) * cand + z * h
+        return h_new, h_new
+
+
+class ConvLSTMPeephole(Cell):
+    """Convolutional LSTM with peepholes over (C, H, W) feature maps
+    (reference: nn/ConvLSTMPeephole.scala). Gate convs are SAME-padded so the
+    spatial shape is preserved; all four input/hidden convs are fused into
+    single 4*nOutput-channel convolutions (one MXU conv per step)."""
+
+    def __init__(self, input_size: int, output_size: int, kernel_i: int = 3,
+                 kernel_c: int = 3, stride: int = 1, with_peephole: bool = True):
+        super().__init__()
+        self.input_size = input_size
+        self.output_size = output_size
+        self.kernel_i = kernel_i
+        self.kernel_c = kernel_c
+        self.with_peephole = with_peephole
+        fan = input_size * kernel_i * kernel_i
+        self.register_random_parameter(
+            "w_in", lambda: bt_init.RandomNormal(0.0, (2.0 / fan) ** 0.5)(
+                (4 * output_size, input_size, kernel_i, kernel_i)))
+        fanh = output_size * kernel_c * kernel_c
+        self.register_random_parameter(
+            "w_hid", lambda: bt_init.RandomNormal(0.0, (2.0 / fanh) ** 0.5)(
+                (4 * output_size, output_size, kernel_c, kernel_c)))
+        self.register_parameter("bias", jnp.zeros((4 * output_size,)))
+        if with_peephole:
+            self.register_parameter("w_ci", jnp.zeros((output_size, 1, 1)))
+            self.register_parameter("w_cf", jnp.zeros((output_size, 1, 1)))
+            self.register_parameter("w_co", jnp.zeros((output_size, 1, 1)))
+
+    def _conv(self, x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=(1, 1), padding="SAME",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+    def init_state(self, batch, dtype=jnp.float32, spatial=None):
+        if spatial is None:
+            raise ValueError("ConvLSTMPeephole state needs the spatial shape; "
+                             "use Recurrent which passes it from the input")
+        h = jnp.zeros((batch, self.output_size) + tuple(spatial), dtype)
+        return (h, h)
+
+    def state_for(self, x_t):
+        return self.init_state(x_t.shape[0], x_t.dtype, spatial=x_t.shape[2:])
+
+    def step(self, x, state, rng=None):
+        h, c = state
+        z = self._conv(x, self.w_in) + self._conv(h, self.w_hid) \
+            + self.bias[None, :, None, None]
+        n = self.output_size
+        zi, zf, zg, zo = (z[:, 0 * n:1 * n], z[:, 1 * n:2 * n],
+                          z[:, 2 * n:3 * n], z[:, 3 * n:4 * n])
+        if self.with_peephole:
+            zi = zi + self.w_ci * c
+            zf = zf + self.w_cf * c
+        i = jax.nn.sigmoid(zi)
+        f = jax.nn.sigmoid(zf)
+        g = jnp.tanh(zg)
+        c_new = f * c + i * g
+        if self.with_peephole:
+            zo = zo + self.w_co * c_new
+        o = jax.nn.sigmoid(zo)
+        h_new = o * jnp.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+
+class MultiRNNCell(Cell):
+    """Stack of cells applied in sequence at each step (reference:
+    nn/MultiRNNCell.scala); state is the tuple of per-cell states."""
+
+    def __init__(self, cells: Sequence[Cell]):
+        super().__init__()
+        for i, c in enumerate(cells):
+            setattr(self, f"cell{i}", c)
+        self.cells = list(cells)
+
+    def init_state(self, batch, dtype=jnp.float32):
+        return tuple(c.init_state(batch, dtype) for c in self.cells)
+
+    def state_for(self, x_t):
+        # later cells see the previous cell's output; for the standard dense
+        # cells zero-state only needs batch/dtype which x_t already carries
+        return tuple(c.state_for(x_t) for c in self.cells)
+
+    def step(self, x, state, rng=None):
+        new_states = []
+        out = x
+        for i, (c, s) in enumerate(zip(self.cells, state)):
+            sub = jax.random.fold_in(rng, i) if rng is not None else None
+            out, ns = c.step(out, s, rng=sub)
+            new_states.append(ns)
+        return out, tuple(new_states)
+
+
+class Recurrent(Module):
+    """Unroll a cell over the time axis with ``lax.scan`` (reference:
+    nn/Recurrent.scala:47). Input (batch, time, ...), output (batch, time,
+    hidden...). The per-step Scala loop + cell clones become one compiled
+    scan body; hidden state is carried functionally."""
+
+    def __init__(self, cell: Optional[Cell] = None):
+        super().__init__()
+        self.cell: Optional[Cell] = None
+        self._init_state_override = None
+        if cell is not None:
+            self.add(cell)
+
+    def add(self, cell: Cell) -> "Recurrent":
+        self.cell = cell
+        return self
+
+    def set_hidden_state(self, state) -> "Recurrent":
+        """≙ Recurrent.setHiddenState — use ``state`` instead of zeros."""
+        self._init_state_override = state
+        return self
+
+    def get_hidden_state(self):
+        return getattr(self, "_last_state", None)
+
+    def _initial_state(self, x0):
+        if self._init_state_override is not None:
+            return self._init_state_override
+        return self.cell.state_for(x0)
+
+    def forward(self, input):
+        cell = self.cell
+        xs = jnp.moveaxis(input, 1, 0)  # (time, batch, ...)
+        state0 = self._initial_state(xs[0])
+
+        if _cell_uses_rng(cell):
+            from bigdl_tpu.utils import random as bt_random
+
+            def body(carry, x_t):
+                state, key = carry
+                key, sub = jax.random.split(key)
+                out, new_state = cell.step(x_t, state, rng=sub)
+                return (new_state, key), out
+
+            (final_state, _), outs = jax.lax.scan(
+                body, (state0, bt_random.next_key()), xs)
+        else:
+            def body(state, x_t):
+                out, new_state = cell.step(x_t, state)
+                return new_state, out
+
+            final_state, outs = jax.lax.scan(body, state0, xs)
+        if not in_pure_bind():
+            self._last_state = final_state
+        return jnp.moveaxis(outs, 0, 1)
+
+
+class BiRecurrent(Module):
+    """Bidirectional recurrence (reference: nn/BiRecurrent.scala): the cell
+    is cloned for the reverse direction (independent weights, as in the
+    reference's layer clone + re-init) and outputs are merged — default
+    elementwise add."""
+
+    def __init__(self, merge: Optional[Module] = None, cell: Optional[Cell] = None):
+        super().__init__()
+        self.merge = merge if merge is not None else CAddTable()
+        self.fwd: Optional[Recurrent] = None
+        self.bwd: Optional[Recurrent] = None
+        if cell is not None:
+            self.add(cell)
+
+    def add(self, cell: Cell) -> "BiRecurrent":
+        rev = cell.clone_module()
+        rev.reset()
+        self.fwd = Recurrent(cell)
+        self.bwd = Recurrent(rev)
+        return self
+
+    def forward(self, input):
+        out_f = self.fwd(input)
+        out_b = jnp.flip(self.bwd(jnp.flip(input, axis=1)), axis=1)
+        return self.merge(Table(out_f, out_b))
+
+
+class RecurrentDecoder(Module):
+    """Autoregressive unroll: the input is the first step's input and each
+    step's output feeds the next step (reference: nn/RecurrentDecoder.scala).
+    Output (batch, seq_length, ...)."""
+
+    def __init__(self, seq_length: int, cell: Optional[Cell] = None):
+        super().__init__()
+        self.seq_length = seq_length
+        self.cell: Optional[Cell] = None
+        if cell is not None:
+            self.add(cell)
+
+    def add(self, cell: Cell) -> "RecurrentDecoder":
+        self.cell = cell
+        return self
+
+    def forward(self, input):
+        cell = self.cell
+        state0 = cell.state_for(input)
+
+        if _cell_uses_rng(cell):
+            from bigdl_tpu.utils import random as bt_random
+
+            def body(carry, _):
+                x, state, key = carry
+                key, sub = jax.random.split(key)
+                out, new_state = cell.step(x, state, rng=sub)
+                return (out, new_state, key), out
+
+            _, outs = jax.lax.scan(body, (input, state0, bt_random.next_key()),
+                                   None, length=self.seq_length)
+        else:
+            def body(carry, _):
+                x, state = carry
+                out, new_state = cell.step(x, state)
+                return (out, new_state), out
+
+            _, outs = jax.lax.scan(body, (input, state0), None,
+                                   length=self.seq_length)
+        return jnp.moveaxis(outs, 0, 1)
+
+
+class TimeDistributed(Module):
+    """Apply a layer to every time step by folding time into batch
+    (reference: nn/TimeDistributed.scala) — one big batched op on the MXU
+    instead of a time loop."""
+
+    def __init__(self, layer: Module):
+        super().__init__()
+        self.layer = layer
+
+    def forward(self, input):
+        b, t = input.shape[0], input.shape[1]
+        flat = input.reshape((b * t,) + input.shape[2:])
+        out = self.layer(flat)
+        return out.reshape((b, t) + out.shape[1:])

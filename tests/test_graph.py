@@ -1,0 +1,121 @@
+"""Graph container tests (reference analog: spark/dl test GraphSpec)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.module import pure_apply
+from bigdl_tpu.utils.table import Table
+
+
+def test_linear_chain_matches_sequential():
+    l1 = nn.Linear(4, 8)
+    l2 = nn.Linear(8, 3)
+    seq = nn.Sequential(l1, l2)
+    x = jnp.asarray(np.random.RandomState(0).randn(5, 4), jnp.float32)
+    want = seq(x)
+
+    inp = nn.Input()
+    n1 = l1.inputs(inp)
+    n2 = l2.inputs(n1)
+    g = nn.Graph(inp, n2)
+    got = g(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+
+def test_two_branch_merge():
+    inp = nn.Input()
+    a = nn.Linear(4, 6).inputs(inp)
+    b = nn.Linear(4, 6).inputs(inp)
+    add = nn.CAddTable().inputs(a, b)
+    out = nn.ReLU().inputs(add)
+    g = nn.Graph(inp, out)
+    x = jnp.ones((2, 4))
+    y = g(x)
+    assert y.shape == (2, 6)
+    la = g.node(a.name).module
+    lb = g.node(b.name).module
+    want = jax.nn.relu(la(x) + lb(x))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want), rtol=1e-6)
+
+
+def test_multi_input_multi_output():
+    i1, i2 = nn.Input(), nn.Input()
+    a = nn.Linear(3, 5).inputs(i1)
+    b = nn.Linear(2, 5).inputs(i2)
+    s = nn.CAddTable().inputs(a, b)
+    t = nn.Tanh().inputs(s)
+    g = nn.Graph([i1, i2], [s, t])
+    out = g(Table(jnp.ones((4, 3)), jnp.ones((4, 2))))
+    assert isinstance(out, Table)
+    assert out[1].shape == (4, 5) and out[2].shape == (4, 5)
+    np.testing.assert_allclose(np.asarray(out[2]), np.tanh(np.asarray(out[1])), rtol=1e-6)
+
+
+def test_shared_module_registered_once():
+    shared = nn.Linear(4, 4)
+    inp = nn.Input()
+    n1 = shared.inputs(inp)
+    n2 = shared.inputs(n1)  # applied twice, same weights
+    g = nn.Graph(inp, n2)
+    ws, _ = g.parameters()
+    assert len(ws) == 2  # weight + bias, once
+    x = jnp.ones((1, 4))
+    np.testing.assert_allclose(np.asarray(g(x)), np.asarray(shared(shared(x))), rtol=1e-6)
+
+
+def test_stop_gradient_prunes_backward():
+    inp = nn.Input()
+    l1 = nn.Linear(4, 4).set_name("frozen_branch")
+    l2 = nn.Linear(4, 4)
+    n1 = l1.inputs(inp)
+    n2 = l2.inputs(n1)
+    g = nn.Graph(inp, n2)
+    g.stop_gradient(["frozen_branch"])
+
+    apply_fn = pure_apply(g)
+    params = g.params_dict()
+    buffers = g.buffers_dict()
+    x = jnp.ones((2, 4))
+
+    def loss(p):
+        out, _ = apply_fn(p, buffers, x)
+        return jnp.sum(out ** 2)
+
+    grads = jax.grad(loss)(params)
+    for k in grads:
+        mod = getattr(g, k, None)
+        if mod is l1:
+            for arr in jax.tree.leaves(grads[k]):
+                np.testing.assert_allclose(np.asarray(arr), 0.0)
+        if mod is l2:
+            assert any(np.abs(np.asarray(a)).sum() > 0 for a in jax.tree.leaves(grads[k]))
+
+
+def test_cycle_detection():
+    inp = nn.Input()
+    a = nn.Node(nn.Linear(2, 2))
+    b = nn.Node(nn.Linear(2, 2))
+    a.inputs(inp, b)
+    b.inputs(a)
+    with pytest.raises(ValueError, match="cycle"):
+        nn.Graph(inp, b)
+
+
+def test_disconnected_input_rejected():
+    i1, i2 = nn.Input(), nn.Input()
+    out = nn.Linear(2, 2).inputs(i1)
+    with pytest.raises(ValueError, match="not connected"):
+        nn.Graph([i1, i2], out)
+
+
+def test_graph_jits():
+    inp = nn.Input()
+    out = nn.Linear(4, 2).inputs(inp)
+    g = nn.Graph(inp, out)
+    apply_fn = jax.jit(lambda p, b, x: pure_apply(g)(p, b, x)[0])
+    x = jnp.ones((3, 4))
+    y = apply_fn(g.params_dict(), g.buffers_dict(), x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(g(x)), rtol=1e-6)

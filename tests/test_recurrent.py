@@ -1,0 +1,181 @@
+"""Recurrent stack tests (reference analogs: nn/RecurrentSpec, LSTMSpec,
+GRUSpec, BiRecurrentSpec, RecurrentDecoderSpec, TimeDistributedSpec)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.module import pure_apply
+
+
+B, T, I, H = 3, 5, 4, 6
+
+
+def _x(seed=0, shape=(B, T, I)):
+    return jnp.asarray(np.random.RandomState(seed).randn(*shape), jnp.float32)
+
+
+def _manual_unroll(cell, x):
+    """Python-loop oracle for the lax.scan path."""
+    state = (cell.state_for(x[:, 0]) if hasattr(cell, "state_for")
+             else cell.init_state(x.shape[0], x.dtype))
+    outs = []
+    for t in range(x.shape[1]):
+        out, state = cell.step(x[:, t], state)
+        outs.append(out)
+    return jnp.stack(outs, axis=1), state
+
+
+@pytest.mark.parametrize("cell_fn", [
+    lambda: nn.RnnCell(I, H),
+    lambda: nn.LSTM(I, H),
+    lambda: nn.LSTMPeephole(I, H),
+    lambda: nn.GRU(I, H),
+])
+def test_scan_matches_python_loop(cell_fn):
+    cell = cell_fn()
+    rec = nn.Recurrent(cell)
+    x = _x()
+    want, _ = _manual_unroll(cell, x)
+    got = rec(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6)
+    assert got.shape == (B, T, H)
+
+
+def test_lstm_gradients_flow():
+    rec = nn.Recurrent(nn.LSTM(I, H))
+    x = _x()
+    apply_fn = pure_apply(rec)
+    params = rec.params_dict()
+
+    def loss(p):
+        out, _ = apply_fn(p, rec.buffers_dict(), x)
+        return jnp.sum(out ** 2)
+
+    grads = jax.grad(loss)(params)
+    assert all(np.isfinite(np.asarray(g)).all() for g in jax.tree.leaves(grads))
+    assert any(np.abs(np.asarray(g)).sum() > 0 for g in jax.tree.leaves(grads))
+
+
+def test_multi_rnn_cell_stacks():
+    cell = nn.MultiRNNCell([nn.LSTM(I, H), nn.GRU(H, H)])
+    rec = nn.Recurrent(cell)
+    out = rec(_x())
+    assert out.shape == (B, T, H)
+    want, _ = _manual_unroll(cell, _x())
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), rtol=1e-5, atol=1e-6)
+
+
+def test_birecurrent_merges_directions():
+    bi = nn.BiRecurrent(cell=nn.RnnCell(I, H))
+    x = _x()
+    out = bi(x)
+    assert out.shape == (B, T, H)
+    f = bi.fwd(x)
+    b = jnp.flip(bi.bwd(jnp.flip(x, axis=1)), axis=1)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(f + b), rtol=1e-5)
+    # reverse cell has its own (different) weights
+    assert not np.allclose(np.asarray(bi.fwd.cell.i2h), np.asarray(bi.bwd.cell.i2h))
+
+
+def test_recurrent_decoder_feeds_back():
+    # cell input/output sizes must match for feedback
+    cell = nn.LSTM(H, H)
+    dec = nn.RecurrentDecoder(seq_length=4, cell=cell)
+    x0 = jnp.asarray(np.random.RandomState(1).randn(B, H), jnp.float32)
+    out = dec(x0)
+    assert out.shape == (B, 4, H)
+    # oracle
+    state = cell.init_state(B, x0.dtype)
+    cur, outs = x0, []
+    for _ in range(4):
+        cur, state = cell.step(cur, state)
+        outs.append(cur)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(jnp.stack(outs, 1)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_conv_lstm():
+    cell = nn.ConvLSTMPeephole(2, 3, kernel_i=3, kernel_c=3)
+    rec = nn.Recurrent(cell)
+    x = _x(shape=(B, T, 2, 8, 8))
+    out = rec(x)
+    assert out.shape == (B, T, 3, 8, 8)
+    want, _ = _manual_unroll(cell, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), rtol=1e-4, atol=1e-5)
+
+
+def test_time_distributed():
+    td = nn.TimeDistributed(nn.Linear(I, 2))
+    x = _x()
+    out = td(x)
+    assert out.shape == (B, T, 2)
+    np.testing.assert_allclose(
+        np.asarray(out[:, 2]), np.asarray(td.layer(x[:, 2])), rtol=1e-6)
+
+
+def test_recurrent_under_jit():
+    rec = nn.Recurrent(nn.GRU(I, H))
+    x = _x()
+    eager = rec(x)
+    apply_fn = jax.jit(lambda p, b, xx: pure_apply(rec)(p, b, xx)[0])
+    jitted = apply_fn(rec.params_dict(), rec.buffers_dict(), x)
+    np.testing.assert_allclose(np.asarray(jitted), np.asarray(eager), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("cell_fn", [
+    lambda: nn.LSTM(I, H, p=0.5),
+    lambda: nn.LSTMPeephole(I, H, p=0.5),
+    lambda: nn.GRU(I, H, p=0.5),
+])
+def test_dropout_active_in_training_only(cell_fn):
+    cell = cell_fn()
+    rec = nn.Recurrent(cell)
+    x = _x()
+    a = rec(x)
+    b = rec(x)
+    assert not np.allclose(np.asarray(a), np.asarray(b))  # fresh masks per pass
+    rec.evaluate()
+    c = rec(x)
+    d = rec(x)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(d))
+    rec.training_mode()
+
+
+def test_birecurrent_works_with_multirnncell():
+    bi = nn.BiRecurrent(cell=nn.MultiRNNCell([nn.LSTM(I, H), nn.GRU(H, H)]))
+    assert bi(_x()).shape == (B, T, H)
+
+
+def test_cell_reset_redraws_same_distribution():
+    cell = nn.ConvLSTMPeephole(2, 3)
+    w0 = np.asarray(cell.w_in)
+    cell.reset()
+    w1 = np.asarray(cell.w_in)
+    assert not np.allclose(w0, w1)
+    assert abs(w0.std() - w1.std()) < 0.1 * w0.std()  # same init family
+
+
+def test_conv_cell_single_step_forward():
+    cell = nn.ConvLSTMPeephole(2, 3)
+    out = cell(jnp.ones((2, 2, 8, 8)))
+    assert out[1].shape == (2, 3, 8, 8)
+
+
+def test_set_hidden_state():
+    cell = nn.RnnCell(I, H)
+    rec = nn.Recurrent(cell)
+    h0 = jnp.ones((B, H))
+    rec.set_hidden_state(h0)
+    x = _x()
+    out = rec(x)
+    state = h0
+    outs = []
+    for t in range(T):
+        o, state = cell.step(x[:, t], state)
+        outs.append(o)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(jnp.stack(outs, 1)),
+                               rtol=1e-5, atol=1e-6)
+    assert rec.get_hidden_state() is not None
