@@ -1,0 +1,17 @@
+"""bigdl_tpu.models — the model zoo (reference: models/, SURVEY.md §2.10).
+
+Every reference model family is re-built with the TPU-native nn API:
+LeNet-5 (models/lenet/LeNet5.scala), VGG-16 for CIFAR-10
+(models/vgg/VggForCifar10.scala), ResNet for CIFAR/ImageNet
+(models/resnet/ResNet.scala), Inception v1 (models/inception/Inception_v1.scala),
+SimpleRNN char LM (models/rnn/SimpleRNN.scala), Autoencoder
+(models/autoencoder/Autoencoder.scala), plus the synthetic-data perf
+harness (models/utils/DistriOptimizerPerf.scala).
+"""
+
+from bigdl_tpu.models.lenet import LeNet5
+from bigdl_tpu.models.vgg import VggForCifar10, Vgg16
+from bigdl_tpu.models.resnet import ResNet, ShortcutType, DatasetType
+from bigdl_tpu.models.inception import InceptionV1, InceptionV1NoAuxClassifier
+from bigdl_tpu.models.rnn import SimpleRNN
+from bigdl_tpu.models.autoencoder import Autoencoder

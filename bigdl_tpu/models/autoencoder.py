@@ -1,0 +1,28 @@
+"""MNIST autoencoder (reference: models/autoencoder/Autoencoder.scala:28-37)."""
+
+from bigdl_tpu import nn
+
+ROW_N = 28
+COL_N = 28
+FEATURE_SIZE = ROW_N * COL_N
+
+
+class Autoencoder:
+    def __new__(cls, class_num: int = 32) -> nn.Module:
+        model = nn.Sequential()
+        model.add(nn.Reshape((FEATURE_SIZE,)))
+        model.add(nn.Linear(FEATURE_SIZE, class_num))
+        model.add(nn.ReLU())
+        model.add(nn.Linear(class_num, FEATURE_SIZE))
+        model.add(nn.Sigmoid())
+        return model
+
+    @staticmethod
+    def graph(class_num: int = 32) -> nn.Module:
+        inp = nn.Input()
+        flat = nn.Reshape((FEATURE_SIZE,)).inputs(inp)
+        linear1 = nn.Linear(FEATURE_SIZE, class_num).inputs(flat)
+        relu = nn.ReLU().inputs(linear1)
+        linear2 = nn.Linear(class_num, FEATURE_SIZE).inputs(relu)
+        out = nn.Sigmoid().inputs(linear2)
+        return nn.Graph(inp, out)

@@ -1,0 +1,174 @@
+"""Inception v1 (GoogLeNet) for ImageNet (BASELINE config 4 predict target).
+
+Reference: models/inception/Inception_v1.scala — `Inception_Layer_v1`
+four-branch concat blocks (:27-96), full model with two auxiliary
+classifier heads (:182-265) and the no-aux variant (:98-132).
+"""
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn import init
+from bigdl_tpu.utils.table import Table
+
+
+def inception_layer_v1(input_size: int, config, name_prefix: str = "") -> nn.Module:
+    """Four parallel branches concatenated on channels
+    (reference: Inception_v1.scala:27-62). ``config`` is
+    ((c1x1,), (c3x3_reduce, c3x3), (c5x5_reduce, c5x5), (pool_proj,))."""
+    concat = nn.Concat(2)
+    conv1 = (nn.Sequential()
+             .add(nn.SpatialConvolution(input_size, config[0][0], 1, 1, 1, 1,
+                                        init_method=init.Xavier())
+                  .set_name(name_prefix + "1x1"))
+             .add(nn.ReLU().set_name(name_prefix + "relu_1x1")))
+    concat.add(conv1)
+    conv3 = (nn.Sequential()
+             .add(nn.SpatialConvolution(input_size, config[1][0], 1, 1, 1, 1,
+                                        init_method=init.Xavier())
+                  .set_name(name_prefix + "3x3_reduce"))
+             .add(nn.ReLU().set_name(name_prefix + "relu_3x3_reduce"))
+             .add(nn.SpatialConvolution(config[1][0], config[1][1], 3, 3, 1, 1, 1, 1,
+                                        init_method=init.Xavier())
+                  .set_name(name_prefix + "3x3"))
+             .add(nn.ReLU().set_name(name_prefix + "relu_3x3")))
+    concat.add(conv3)
+    conv5 = (nn.Sequential()
+             .add(nn.SpatialConvolution(input_size, config[2][0], 1, 1, 1, 1,
+                                        init_method=init.Xavier())
+                  .set_name(name_prefix + "5x5_reduce"))
+             .add(nn.ReLU().set_name(name_prefix + "relu_5x5_reduce"))
+             .add(nn.SpatialConvolution(config[2][0], config[2][1], 5, 5, 1, 1, 2, 2,
+                                        init_method=init.Xavier())
+                  .set_name(name_prefix + "5x5"))
+             .add(nn.ReLU().set_name(name_prefix + "relu_5x5")))
+    concat.add(conv5)
+    pool = (nn.Sequential()
+            .add(nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil().set_name(name_prefix + "pool"))
+            .add(nn.SpatialConvolution(input_size, config[3][0], 1, 1, 1, 1,
+                                       init_method=init.Xavier())
+                 .set_name(name_prefix + "pool_proj"))
+            .add(nn.ReLU().set_name(name_prefix + "relu_pool_proj")))
+    concat.add(pool)
+    return concat.set_name(name_prefix + "output")
+
+
+def _stem() -> nn.Sequential:
+    """conv1 → pool1 → LRN → conv2 reduce/3x3 → LRN → pool2 (Inception_v1.scala:183-199)."""
+    s = nn.Sequential()
+    (s.add(nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3, propagate_back=False,
+                                 init_method=init.Xavier())
+           .set_name("conv1/7x7_s2"))
+      .add(nn.ReLU().set_name("conv1/relu_7x7"))
+      .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool1/3x3_s2"))
+      .add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("pool1/norm1"))
+      .add(nn.SpatialConvolution(64, 64, 1, 1, 1, 1, init_method=init.Xavier())
+           .set_name("conv2/3x3_reduce"))
+      .add(nn.ReLU().set_name("conv2/relu_3x3_reduce"))
+      .add(nn.SpatialConvolution(64, 192, 3, 3, 1, 1, 1, 1, init_method=init.Xavier())
+           .set_name("conv2/3x3"))
+      .add(nn.ReLU().set_name("conv2/relu_3x3"))
+      .add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("conv2/norm2"))
+      .add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool2/3x3_s2")))
+    return s
+
+
+class InceptionV1NoAuxClassifier:
+    """Single-head GoogLeNet (reference: Inception_v1.scala:98-132)."""
+
+    def __new__(cls, class_num: int = 1000, has_dropout: bool = True) -> nn.Module:
+        m = _stem()
+        m.add(inception_layer_v1(192, ((64,), (96, 128), (16, 32), (32,)), "inception_3a/"))
+        m.add(inception_layer_v1(256, ((128,), (128, 192), (32, 96), (64,)), "inception_3b/"))
+        m.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool3/3x3_s2"))
+        m.add(inception_layer_v1(480, ((192,), (96, 208), (16, 48), (64,)), "inception_4a/"))
+        m.add(inception_layer_v1(512, ((160,), (112, 224), (24, 64), (64,)), "inception_4b/"))
+        m.add(inception_layer_v1(512, ((128,), (128, 256), (24, 64), (64,)), "inception_4c/"))
+        m.add(inception_layer_v1(512, ((112,), (144, 288), (32, 64), (64,)), "inception_4d/"))
+        m.add(inception_layer_v1(528, ((256,), (160, 320), (32, 128), (128,)), "inception_4e/"))
+        m.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool4/3x3_s2"))
+        m.add(inception_layer_v1(832, ((256,), (160, 320), (32, 128), (128,)), "inception_5a/"))
+        m.add(inception_layer_v1(832, ((384,), (192, 384), (48, 128), (128,)), "inception_5b/"))
+        m.add(nn.SpatialAveragePooling(7, 7, 1, 1).set_name("pool5/7x7_s1"))
+        if has_dropout:
+            m.add(nn.Dropout(0.4).set_name("pool5/drop_7x7_s1"))
+        m.add(nn.View(1024))
+        m.add(nn.Linear(1024, class_num, init_method=init.Xavier()).set_name("loss3/classifier"))
+        m.add(nn.LogSoftMax().set_name("loss3/loss3"))
+        return m
+
+
+class InceptionV1:
+    """Training GoogLeNet with the two auxiliary heads; output is a Table of
+    (main, aux2, aux1) log-probs — aux2 taps after inception_4d, aux1 after
+    inception_4a, mirroring the reference's nested ConcatTable order
+    (Inception_v1.scala:182-265). Train with ParallelCriterion weighting
+    both aux losses 0.3 as in the paper."""
+
+    def __new__(cls, class_num: int = 1000, has_dropout: bool = True) -> nn.Module:
+        feature1 = _stem()
+        feature1.add(inception_layer_v1(192, ((64,), (96, 128), (16, 32), (32,)), "inception_3a/"))
+        feature1.add(inception_layer_v1(256, ((128,), (128, 192), (32, 96), (64,)), "inception_3b/"))
+        feature1.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool3/3x3_s2"))
+        feature1.add(inception_layer_v1(480, ((192,), (96, 208), (16, 48), (64,)), "inception_4a/"))
+
+        output1 = (nn.Sequential()
+                   .add(nn.SpatialAveragePooling(5, 5, 3, 3).ceil().set_name("loss1/ave_pool"))
+                   .add(nn.SpatialConvolution(512, 128, 1, 1, 1, 1).set_name("loss1/conv"))
+                   .add(nn.ReLU().set_name("loss1/relu_conv"))
+                   .add(nn.View(128 * 4 * 4))
+                   .add(nn.Linear(128 * 4 * 4, 1024).set_name("loss1/fc"))
+                   .add(nn.ReLU().set_name("loss1/relu_fc")))
+        if has_dropout:
+            output1.add(nn.Dropout(0.7).set_name("loss1/drop_fc"))
+        output1.add(nn.Linear(1024, class_num).set_name("loss1/classifier"))
+        output1.add(nn.LogSoftMax().set_name("loss1/loss"))
+
+        feature2 = nn.Sequential()
+        feature2.add(inception_layer_v1(512, ((160,), (112, 224), (24, 64), (64,)), "inception_4b/"))
+        feature2.add(inception_layer_v1(512, ((128,), (128, 256), (24, 64), (64,)), "inception_4c/"))
+        feature2.add(inception_layer_v1(512, ((112,), (144, 288), (32, 64), (64,)), "inception_4d/"))
+
+        output2 = (nn.Sequential()
+                   .add(nn.SpatialAveragePooling(5, 5, 3, 3).set_name("loss2/ave_pool"))
+                   .add(nn.SpatialConvolution(528, 128, 1, 1, 1, 1).set_name("loss2/conv"))
+                   .add(nn.ReLU().set_name("loss2/relu_conv"))
+                   .add(nn.View(128 * 4 * 4))
+                   .add(nn.Linear(128 * 4 * 4, 1024).set_name("loss2/fc"))
+                   .add(nn.ReLU().set_name("loss2/relu_fc")))
+        if has_dropout:
+            output2.add(nn.Dropout(0.7).set_name("loss2/drop_fc"))
+        output2.add(nn.Linear(1024, class_num).set_name("loss2/classifier"))
+        output2.add(nn.LogSoftMax().set_name("loss2/loss"))
+
+        output3 = nn.Sequential()
+        output3.add(inception_layer_v1(528, ((256,), (160, 320), (32, 128), (128,)), "inception_4e/"))
+        output3.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool4/3x3_s2"))
+        output3.add(inception_layer_v1(832, ((256,), (160, 320), (32, 128), (128,)), "inception_5a/"))
+        output3.add(inception_layer_v1(832, ((384,), (192, 384), (48, 128), (128,)), "inception_5b/"))
+        output3.add(nn.SpatialAveragePooling(7, 7, 1, 1).set_name("pool5/7x7_s1"))
+        if has_dropout:
+            output3.add(nn.Dropout(0.4).set_name("pool5/drop_7x7_s1"))
+        output3.add(nn.View(1024))
+        output3.add(nn.Linear(1024, class_num, init_method=init.Xavier())
+                    .set_name("loss3/classifier"))
+        output3.add(nn.LogSoftMax().set_name("loss3/loss3"))
+
+        split2 = nn.ConcatTable().add(output3).add(output2)
+        mainBranch = nn.Sequential().add(feature2).add(split2)
+        split1 = nn.ConcatTable().add(mainBranch).add(output1)
+
+        model = nn.Sequential().add(feature1).add(split1)
+        return _FlattenHeads(model)
+
+
+class _FlattenHeads(nn.Module):
+    """Flatten the nested ((main, aux2), aux1) table into (main, aux2, aux1)."""
+
+    def __init__(self, inner: nn.Module):
+        super().__init__()
+        self.inner = inner
+
+    def forward(self, input):
+        out = self.inner(input)
+        nested, aux1 = out[1], out[2]  # Table is 1-based (Appendix B.1)
+        main, aux2 = nested[1], nested[2]
+        return Table(main, aux2, aux1)

@@ -1,0 +1,49 @@
+"""LeNet-5 for MNIST — the canonical smoke model.
+
+Reference: models/lenet/LeNet5.scala:23-40 (Sequential and graph variants).
+Same architecture, built on the TPU-native module system; under jit the
+whole stack compiles to one fused XLA program.
+"""
+
+from bigdl_tpu import nn
+
+
+class LeNet5:
+    """Factory matching the reference object's ``apply``/``graph``."""
+
+    def __new__(cls, class_num: int = 10) -> nn.Module:
+        return cls.build(class_num)
+
+    @staticmethod
+    def build(class_num: int = 10) -> nn.Module:
+        model = nn.Sequential()
+        (model.add(nn.Reshape((1, 28, 28)))
+              .add(nn.SpatialConvolution(1, 6, 5, 5).set_name("conv1_5x5"))
+              .add(nn.Tanh())
+              .add(nn.SpatialMaxPooling(2, 2, 2, 2))
+              .add(nn.SpatialConvolution(6, 12, 5, 5).set_name("conv2_5x5"))
+              .add(nn.Tanh())
+              .add(nn.SpatialMaxPooling(2, 2, 2, 2))
+              .add(nn.Reshape((12 * 4 * 4,)))
+              .add(nn.Linear(12 * 4 * 4, 100).set_name("fc1"))
+              .add(nn.Tanh())
+              .add(nn.Linear(100, class_num).set_name("fc2"))
+              .add(nn.LogSoftMax()))
+        return model
+
+    @staticmethod
+    def graph(class_num: int = 10) -> nn.Module:
+        inp = nn.Input()
+        reshape = nn.Reshape((1, 28, 28)).inputs(inp)
+        conv1 = nn.SpatialConvolution(1, 6, 5, 5).set_name("conv1_5x5").inputs(reshape)
+        tanh1 = nn.Tanh().inputs(conv1)
+        pool1 = nn.SpatialMaxPooling(2, 2, 2, 2).inputs(tanh1)
+        conv2 = nn.SpatialConvolution(6, 12, 5, 5).set_name("conv2_5x5").inputs(pool1)
+        tanh2 = nn.Tanh().inputs(conv2)
+        pool2 = nn.SpatialMaxPooling(2, 2, 2, 2).inputs(tanh2)
+        flat = nn.Reshape((12 * 4 * 4,)).inputs(pool2)
+        fc1 = nn.Linear(12 * 4 * 4, 100).set_name("fc1").inputs(flat)
+        tanh3 = nn.Tanh().inputs(fc1)
+        fc2 = nn.Linear(100, class_num).set_name("fc2").inputs(tanh3)
+        out = nn.LogSoftMax().inputs(fc2)
+        return nn.Graph(inp, out)

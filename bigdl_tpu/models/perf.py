@@ -1,0 +1,127 @@
+"""Synthetic-data training throughput harness.
+
+Reference: models/utils/DistriOptimizerPerf.scala:32-140 and
+LocalOptimizerPerf.scala — feed ImageNet-shaped random batches through a
+model by name and report records/sec. TPU-native: one jitted train step,
+device-resident synthetic batch (no host↔HBM transfer in the timed loop),
+`block_until_ready` fencing around the timed region.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.optim.optim_method import SGD
+from bigdl_tpu.optim.optimizer import make_train_step
+from bigdl_tpu.utils import random as bt_random
+
+
+def build_model(name: str, class_num: int = 1000):
+    """Model + (input shape sans batch, target kind) by name
+    (≙ DistriOptimizerPerf's --model flag)."""
+    from bigdl_tpu.models.inception import InceptionV1NoAuxClassifier
+    from bigdl_tpu.models.lenet import LeNet5
+    from bigdl_tpu.models.resnet import DatasetType, ResNet
+    from bigdl_tpu.models.vgg import Vgg16, VggForCifar10
+
+    name = name.lower()
+    if name == "lenet5":
+        return LeNet5(10), (28, 28), 10
+    if name == "vgg16":
+        return Vgg16(class_num), (3, 224, 224), class_num
+    if name == "vggcifar":
+        return VggForCifar10(10), (3, 32, 32), 10
+    if name in ("inception_v1", "inception"):
+        return InceptionV1NoAuxClassifier(class_num), (3, 224, 224), class_num
+    if name.startswith("resnet"):
+        depth = int(name[len("resnet"):] or 50)
+        return (ResNet(class_num, {"depth": depth, "dataSet": DatasetType.ImageNet}),
+                (3, 224, 224), class_num)
+    raise ValueError(f"unknown perf model {name!r}")
+
+
+def run_perf(model_name: str = "resnet50", batch_size: int = 32,
+             iterations: int = 20, warmup: int = 3,
+             dtype=jnp.float32, criterion=None,
+             model: Optional[Module] = None, input_shape=None,
+             class_num: int = 1000, log=print) -> dict:
+    """Time a jitted train step on synthetic data; returns a summary dict
+    with records/sec (the reference's per-iteration Throughput line,
+    optim/DistriOptimizer.scala:387-393)."""
+    if model is None:
+        model, input_shape, class_num = build_model(model_name, class_num)
+    elif input_shape is None:
+        raise ValueError("input_shape is required when passing a custom model")
+    else:
+        model_name = model_name if model_name else "custom"
+    criterion = criterion or nn.ClassNLLCriterion()
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (batch_size,) + tuple(input_shape), dtype)
+    y = jnp.ones((batch_size,), jnp.int32)  # 1-based labels (Appendix B.1)
+
+    def to_dtype(t):
+        return jax.tree.map(
+            lambda a: a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a, t)
+
+    method = SGD(learning_rate=0.01)
+    ts = make_train_step(model, criterion, method)
+    # copy params out of the module before donation — step() donates its
+    # buffers, which must not invalidate the caller's live model arrays
+    params = to_dtype(jax.tree.map(jnp.copy, model.params_dict()))
+    buffers = to_dtype(jax.tree.map(jnp.copy, model.buffers_dict()))
+    slots = ts.init_slots(params)
+    lrs = ts.current_lrs()
+    step = jax.jit(ts.step, donate_argnums=(0, 1, 2))
+
+    t0 = time.perf_counter()
+    for _ in range(max(1, warmup)):
+        loss, params, buffers, slots = step(params, buffers, slots, x, y, lrs,
+                                            bt_random.next_key())
+    jax.block_until_ready(loss)
+    compile_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(iterations):
+        loss, params, buffers, slots = step(params, buffers, slots, x, y, lrs,
+                                            bt_random.next_key())
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - t0
+
+    rec_per_sec = batch_size * iterations / elapsed
+    summary = {
+        "model": model_name,
+        "batch_size": batch_size,
+        "iterations": iterations,
+        "warmup_s": round(compile_s, 3),
+        "time_s": round(elapsed, 4),
+        "records_per_sec": round(rec_per_sec, 2),
+        "ms_per_iter": round(1000.0 * elapsed / iterations, 3),
+        "loss": float(loss),
+    }
+    log(f"[perf] {model_name} batch={batch_size}: "
+        f"{rec_per_sec:.1f} records/s ({summary['ms_per_iter']:.1f} ms/iter)")
+    return summary
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(description="bigdl_tpu training perf (≙ DistriOptimizerPerf)")
+    p.add_argument("--model", default="resnet50")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--iterations", type=int, default=20)
+    p.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"])
+    args = p.parse_args(argv)
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    run_perf(args.model, args.batch_size, args.iterations, dtype=dtype)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,159 @@
+"""ResNet for CIFAR-10 and ImageNet (BASELINE config 3 flagship).
+
+Reference: models/resnet/ResNet.scala:150-282 — basicBlock/bottleneck
+residual stacks, shortcut types A (pad) / B (conv on dim change) / C
+(always conv), CIFAR (depth = 6n+2) and ImageNet (18/34/50/101/152/200)
+variants. The reference's `optnet` memory-sharing conv
+(SpatialShareConvolution) is a JVM allocation trick with no TPU analog —
+XLA's buffer assignment already shares activation memory.
+
+Residual adds ride the MXU-friendly NCHW conv stack; the zero-padded
+type-A shortcut is concat with a zero tensor, exactly the reference's
+Concat(Identity, MulConstant(0)).
+"""
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn import init
+from bigdl_tpu.optim.regularizer import L2Regularizer
+
+
+def _conv(n_in: int, n_out: int, kw: int, kh: int, dw: int = 1, dh: int = 1,
+          pw: int = 0, ph: int = 0, propagate_back: bool = True) -> nn.Module:
+    """≙ the reference's Convolution helper (ResNet.scala:35-62): MSRA init
+    and L2(1e-4) weight decay on every conv."""
+    return nn.SpatialConvolution(
+        n_in, n_out, kw, kh, dw, dh, pw, ph,
+        propagate_back=propagate_back,
+        w_regularizer=L2Regularizer(1e-4), b_regularizer=L2Regularizer(1e-4),
+        init_method=init.MsraFiller(False))
+
+
+def _sbn(n_out: int) -> nn.Module:
+    """≙ Sbn (ResNet.scala:64-73): BN with eps 1e-3, gamma=1, beta=0."""
+    return nn.SpatialBatchNormalization(n_out, 1e-3)
+
+
+class ShortcutType:
+    A = "A"  # identity + zero-pad on channel increase (CIFAR classic)
+    B = "B"  # 1x1 conv projection only when shape changes (default)
+    C = "C"  # always 1x1 conv projection
+
+
+class DatasetType:
+    CIFAR10 = "CIFAR10"
+    ImageNet = "ImageNet"
+
+
+def _shortcut(n_in: int, n_out: int, stride: int, shortcut_type: str) -> nn.Module:
+    use_conv = shortcut_type == ShortcutType.C or (
+        shortcut_type == ShortcutType.B and n_in != n_out)
+    if use_conv:
+        return (nn.Sequential()
+                .add(_conv(n_in, n_out, 1, 1, stride, stride))
+                .add(_sbn(n_out)))
+    if n_in != n_out:
+        return (nn.Sequential()
+                .add(nn.SpatialAveragePooling(1, 1, stride, stride))
+                .add(nn.Concat(2)
+                     .add(nn.Identity())
+                     .add(nn.MulConstant(0.0))))
+    return nn.Identity()
+
+
+class ResNet:
+    """Factory: ``ResNet(class_num, {"depth": 50, "dataSet": DatasetType.ImageNet})``."""
+
+    def __new__(cls, class_num: int, opt: dict = None) -> nn.Module:
+        return cls.build(class_num, opt)
+
+    @staticmethod
+    def build(class_num: int, opt: dict = None) -> nn.Module:
+        opt = opt or {}
+        depth = opt.get("depth", 18)
+        shortcut_type = opt.get("shortcutType", ShortcutType.B)
+        dataset = opt.get("dataSet", DatasetType.CIFAR10)
+
+        state = {"ichannels": 0}
+
+        def basic_block(n: int, stride: int) -> nn.Module:
+            n_in = state["ichannels"]
+            state["ichannels"] = n
+            s = (nn.Sequential()
+                 .add(_conv(n_in, n, 3, 3, stride, stride, 1, 1))
+                 .add(_sbn(n))
+                 .add(nn.ReLU())
+                 .add(_conv(n, n, 3, 3, 1, 1, 1, 1))
+                 .add(_sbn(n)))
+            return (nn.Sequential()
+                    .add(nn.ConcatTable().add(s).add(_shortcut(n_in, n, stride, shortcut_type)))
+                    .add(nn.CAddTable())
+                    .add(nn.ReLU()))
+
+        def bottleneck(n: int, stride: int) -> nn.Module:
+            n_in = state["ichannels"]
+            state["ichannels"] = n * 4
+            s = (nn.Sequential()
+                 .add(_conv(n_in, n, 1, 1, 1, 1, 0, 0))
+                 .add(_sbn(n))
+                 .add(nn.ReLU())
+                 .add(_conv(n, n, 3, 3, stride, stride, 1, 1))
+                 .add(_sbn(n))
+                 .add(nn.ReLU())
+                 .add(_conv(n, n * 4, 1, 1, 1, 1, 0, 0))
+                 .add(_sbn(n * 4)))
+            return (nn.Sequential()
+                    .add(nn.ConcatTable().add(s).add(_shortcut(n_in, n * 4, stride, shortcut_type)))
+                    .add(nn.CAddTable())
+                    .add(nn.ReLU()))
+
+        def layer(block, features: int, count: int, stride: int = 1) -> nn.Module:
+            s = nn.Sequential()
+            for i in range(count):
+                s.add(block(features, stride if i == 0 else 1))
+            return s
+
+        model = nn.Sequential()
+        if dataset == DatasetType.ImageNet:
+            cfg = {
+                18: ((2, 2, 2, 2), 512, basic_block),
+                34: ((3, 4, 6, 3), 512, basic_block),
+                50: ((3, 4, 6, 3), 2048, bottleneck),
+                101: ((3, 4, 23, 3), 2048, bottleneck),
+                152: ((3, 8, 36, 3), 2048, bottleneck),
+                200: ((3, 24, 36, 3), 2048, bottleneck),
+            }
+            if depth not in cfg:
+                raise ValueError(f"Invalid depth {depth}")
+            loop, n_features, block = cfg[depth]
+            state["ichannels"] = 64
+            (model.add(_conv(3, 64, 7, 7, 2, 2, 3, 3, propagate_back=False))
+                  .add(_sbn(64))
+                  .add(nn.ReLU())
+                  .add(nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1))
+                  .add(layer(block, 64, loop[0]))
+                  .add(layer(block, 128, loop[1], 2))
+                  .add(layer(block, 256, loop[2], 2))
+                  .add(layer(block, 512, loop[3], 2))
+                  .add(nn.SpatialAveragePooling(7, 7, 1, 1))
+                  .add(nn.View(n_features))
+                  .add(nn.Linear(n_features, class_num,
+                                 w_regularizer=L2Regularizer(1e-4),
+                                 b_regularizer=L2Regularizer(1e-4),
+                                 init_method=init.RandomNormal(0.0, 0.01))))
+        elif dataset == DatasetType.CIFAR10:
+            if (depth - 2) % 6 != 0:
+                raise ValueError("depth should be one of 20, 32, 44, 56, 110, 1202")
+            n = (depth - 2) // 6
+            state["ichannels"] = 16
+            (model.add(_conv(3, 16, 3, 3, 1, 1, 1, 1, propagate_back=False))
+                  .add(_sbn(16))
+                  .add(nn.ReLU())
+                  .add(layer(basic_block, 16, n))
+                  .add(layer(basic_block, 32, n, 2))
+                  .add(layer(basic_block, 64, n, 2))
+                  .add(nn.SpatialAveragePooling(8, 8, 1, 1))
+                  .add(nn.View(64))
+                  .add(nn.Linear(64, class_num)))
+        else:
+            raise ValueError(f"Invalid dataset {dataset}")
+        return model

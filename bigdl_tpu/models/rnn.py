@@ -1,0 +1,16 @@
+"""SimpleRNN character language model (BASELINE config 5 family).
+
+Reference: models/rnn/SimpleRNN.scala:37-47 — Recurrent(RnnCell(tanh)) +
+TimeDistributed(Linear). The time loop is one ``lax.scan``; the
+TimeDistributed head is a single batched GEMM over (batch*time, hidden).
+"""
+
+from bigdl_tpu import nn
+
+
+class SimpleRNN:
+    def __new__(cls, input_size: int, hidden_size: int, output_size: int) -> nn.Module:
+        model = nn.Sequential()
+        model.add(nn.Recurrent().add(nn.RnnCell(input_size, hidden_size, nn.Tanh())))
+        model.add(nn.TimeDistributed(nn.Linear(hidden_size, output_size)))
+        return model

@@ -1,0 +1,79 @@
+"""VGG-16 for CIFAR-10 (BASELINE config 2).
+
+Reference: models/vgg/VggForCifar10.scala:24-76 — conv/BN/ReLU stacks with
+dropout, 512-wide classifier head, LogSoftMax output.
+"""
+
+from bigdl_tpu import nn
+
+
+def _conv_bn_relu(seq: nn.Sequential, n_in: int, n_out: int) -> None:
+    seq.add(nn.SpatialConvolution(n_in, n_out, 3, 3, 1, 1, 1, 1))
+    seq.add(nn.SpatialBatchNormalization(n_out, 1e-3))
+    seq.add(nn.ReLU())
+
+
+class VggForCifar10:
+    def __new__(cls, class_num: int = 10, has_dropout: bool = True) -> nn.Module:
+        return cls.build(class_num, has_dropout)
+
+    @staticmethod
+    def build(class_num: int = 10, has_dropout: bool = True) -> nn.Module:
+        m = nn.Sequential()
+        plan = [
+            (3, 64, 0.3), (64, 64, None),          # block 1
+            (64, 128, 0.4), (128, 128, None),      # block 2
+            (128, 256, 0.4), (256, 256, 0.4), (256, 256, None),   # block 3
+            (256, 512, 0.4), (512, 512, 0.4), (512, 512, None),   # block 4
+            (512, 512, 0.4), (512, 512, 0.4), (512, 512, None),   # block 5
+        ]
+        for n_in, n_out, drop in plan:
+            _conv_bn_relu(m, n_in, n_out)
+            if drop is not None and has_dropout:
+                m.add(nn.Dropout(drop))
+            elif drop is None:
+                m.add(nn.SpatialMaxPooling(2, 2, 2, 2).ceil())
+        m.add(nn.View(512))
+
+        classifier = nn.Sequential()
+        if has_dropout:
+            classifier.add(nn.Dropout(0.5))
+        classifier.add(nn.Linear(512, 512))
+        classifier.add(nn.BatchNormalization(512))
+        classifier.add(nn.ReLU())
+        if has_dropout:
+            classifier.add(nn.Dropout(0.5))
+        classifier.add(nn.Linear(512, class_num))
+        classifier.add(nn.LogSoftMax())
+        m.add(classifier)
+        return m
+
+
+class Vgg16:
+    """ImageNet-shaped VGG-16 (reference: models/vgg/Vgg_16.scala analog):
+    plain conv/ReLU (no BN) + 4096-wide FC head."""
+
+    def __new__(cls, class_num: int = 1000, has_dropout: bool = True) -> nn.Module:
+        m = nn.Sequential()
+        cfg = [(3, 64), (64, 64), "M",
+               (64, 128), (128, 128), "M",
+               (128, 256), (256, 256), (256, 256), "M",
+               (256, 512), (512, 512), (512, 512), "M",
+               (512, 512), (512, 512), (512, 512), "M"]
+        for item in cfg:
+            if item == "M":
+                m.add(nn.SpatialMaxPooling(2, 2, 2, 2))
+            else:
+                n_in, n_out = item
+                m.add(nn.SpatialConvolution(n_in, n_out, 3, 3, 1, 1, 1, 1))
+                m.add(nn.ReLU())
+        m.add(nn.View(512 * 7 * 7))
+        m.add(nn.Linear(512 * 7 * 7, 4096)).add(nn.ReLU())
+        if has_dropout:
+            m.add(nn.Dropout(0.5))
+        m.add(nn.Linear(4096, 4096)).add(nn.ReLU())
+        if has_dropout:
+            m.add(nn.Dropout(0.5))
+        m.add(nn.Linear(4096, class_num))
+        m.add(nn.LogSoftMax())
+        return m

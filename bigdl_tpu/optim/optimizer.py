@@ -139,8 +139,11 @@ class TrainStep:
                 p_sub = [leaves[i] for i in idxs]
                 gr_sub = [g_leaves[i] for i in idxs]
                 np_sub, ns = meth.step(p_sub, gr_sub, slots[k], lrs[k])
-                for i, pv in zip(idxs, np_sub):
-                    new_leaves[i] = pv
+                # optimizer math may promote (f32 lr × bf16 param); store
+                # back at the parameter's dtype so the step stays stable
+                # under jit across iterations
+                for i, pv, old in zip(idxs, np_sub, p_sub):
+                    new_leaves[i] = pv.astype(old.dtype)
                 new_slots.append(ns)
             new_params = jax.tree.unflatten(treedef, new_leaves)
             if any_frozen:

@@ -1,0 +1,88 @@
+"""Model zoo tests (reference strategy: models/*/README + LocalOptimizerPerf
+smoke; SURVEY.md §2.10)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import models, nn
+from bigdl_tpu.optim import SGD, Trigger
+from bigdl_tpu.optim.optimizer import make_train_step
+
+
+def test_lenet_forward_and_graph_agree_shapes():
+    m = models.LeNet5(10)
+    g = models.LeNet5.graph(10)
+    x = jnp.ones((4, 28, 28))
+    assert m(x).shape == (4, 10)
+    assert g(x).shape == (4, 10)
+
+
+def test_vgg_cifar_forward():
+    m = models.VggForCifar10(10, has_dropout=False)
+    m.evaluate()
+    out = m(jnp.ones((2, 3, 32, 32)))
+    assert out.shape == (2, 10)
+    # LogSoftMax output: rows are log-probs
+    np.testing.assert_allclose(np.exp(np.asarray(out)).sum(-1), 1.0, rtol=1e-4)
+
+
+@pytest.mark.parametrize("depth", [20, 32])
+def test_resnet_cifar_forward(depth):
+    m = models.ResNet(10, {"depth": depth, "dataSet": models.DatasetType.CIFAR10})
+    assert m(jnp.ones((2, 3, 32, 32))).shape == (2, 10)
+
+
+def test_resnet_shortcut_type_a_pads_channels():
+    m = models.ResNet(10, {"depth": 20, "dataSet": models.DatasetType.CIFAR10,
+                           "shortcutType": models.ShortcutType.A})
+    assert m(jnp.ones((2, 3, 32, 32))).shape == (2, 10)
+
+
+def test_resnet50_parameter_count():
+    m = models.ResNet(1000, {"depth": 50, "dataSet": models.DatasetType.ImageNet})
+    n = sum(x.size for x in jax.tree.leaves(m.params_dict()))
+    # torchvision resnet50: 25,557,032; ours matches within BN buffer bookkeeping
+    assert 25_000_000 < n < 26_000_000
+
+
+def test_simple_rnn_forward():
+    m = models.SimpleRNN(input_size=12, hidden_size=24, output_size=12)
+    out = m(jnp.ones((3, 7, 12)))
+    assert out.shape == (3, 7, 12)
+
+
+def test_autoencoder_reconstruction_shape():
+    m = models.Autoencoder(32)
+    out = m(jnp.ones((5, 28, 28)))
+    assert out.shape == (5, 28 * 28)
+    g = models.Autoencoder.graph(32)
+    assert g(jnp.ones((5, 28, 28))).shape == (5, 28 * 28)
+
+
+def test_inception_aux_heads():
+    m = models.InceptionV1(12, has_dropout=False)
+    outs = m(jnp.ones((2, 3, 224, 224)))
+    assert [o.shape for o in outs] == [(2, 12)] * 3
+
+
+def test_lenet_learns_tiny_problem():
+    """Convergence-to-threshold assert (reference test idiom, SURVEY.md §4)."""
+    m = models.LeNet5(2)
+    crit = nn.ClassNLLCriterion()
+    rng = np.random.RandomState(0)
+    x0 = rng.randn(16, 28, 28).astype(np.float32) - 1.0
+    x1 = rng.randn(16, 28, 28).astype(np.float32) + 1.0
+    x = jnp.asarray(np.concatenate([x0, x1]))
+    y = jnp.asarray(np.array([1] * 16 + [2] * 16), jnp.int32)
+
+    ts = make_train_step(m, crit, SGD(learning_rate=0.1))
+    params, buffers = m.params_dict(), m.buffers_dict()
+    slots = ts.init_slots(params)
+    step = jax.jit(ts.step)
+    loss = None
+    for i in range(60):
+        loss, params, buffers, slots = step(params, buffers, slots, x, y,
+                                            ts.current_lrs(), None)
+    assert float(loss) < 0.1
