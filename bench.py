@@ -1,0 +1,91 @@
+"""Headline benchmark: ResNet-50 synthetic-ImageNet training throughput.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "imgs/sec/chip", "vs_baseline": N}
+
+The reference publishes no imgs/sec table (BASELINE.md) — its north-star
+target is ResNet-50 data-parallel at >70% of reference-JAX MFU. We
+therefore report measured imgs/sec/chip and normalize ``vs_baseline``
+against that target expressed in MFU: assuming the reference JAX ResNet-50
+implementation reaches ~50% MFU, the target is 0.35 absolute MFU;
+vs_baseline = measured_MFU / 0.35 (>1.0 beats the north star).
+
+Run: python bench.py [--batch N] [--iters N] [--model resnet50]
+"""
+
+import argparse
+import json
+import sys
+import time
+
+RESNET50_FWD_FLOPS_PER_IMG = 4.09e9  # 224x224, standard bottleneck count
+TRAIN_FLOPS_MULT = 3.0               # fwd + bwd ≈ 3x fwd
+TARGET_MFU = 0.35                    # 70% of an assumed 50%-MFU reference JAX impl
+
+PEAK_FLOPS = {                       # bf16 peak per chip
+    "TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v5": 459e12,
+    "TPU v4": 275e12, "TPU v6 lite": 918e12, "TPU v6e": 918e12,
+    "cpu": 5e11,
+}
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu")
+    for k, v in PEAK_FLOPS.items():
+        if k.lower() in str(kind).lower():
+            return v
+    return PEAK_FLOPS["cpu"]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=None)
+    p.add_argument("--iters", type=int, default=None)
+    p.add_argument("--model", default="resnet50")
+    args = p.parse_args(argv)
+
+    import jax
+
+    dev = jax.devices()[0]
+    on_tpu = "tpu" in dev.platform.lower()
+    batch = args.batch or (64 if on_tpu else 4)
+    iters = args.iters or (20 if on_tpu else 2)
+    model = args.model if on_tpu else "lenet5"
+    if args.model != "resnet50":
+        model = args.model
+
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models.perf import run_perf
+
+    s = run_perf(model, batch_size=batch, iterations=iters,
+                 dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+                 log=lambda *a, **k: print(*a, file=sys.stderr, **k))
+
+    imgs_per_sec = s["records_per_sec"]
+    if model == "resnet50":
+        achieved = imgs_per_sec * RESNET50_FWD_FLOPS_PER_IMG * TRAIN_FLOPS_MULT
+        mfu = achieved / peak_flops(dev)
+        vs_baseline = mfu / TARGET_MFU
+        metric = "resnet50_synthetic_imagenet_train_throughput"
+    else:
+        mfu = 0.0
+        vs_baseline = 1.0
+        metric = f"{model}_synthetic_train_throughput"
+
+    print(json.dumps({
+        "metric": metric,
+        "value": round(imgs_per_sec, 2),
+        "unit": "imgs/sec/chip",
+        "vs_baseline": round(vs_baseline, 4),
+        "detail": {
+            "device": str(getattr(dev, "device_kind", dev.platform)),
+            "batch": batch, "iters": iters, "dtype": "bf16" if on_tpu else "f32",
+            "ms_per_iter": s["ms_per_iter"], "mfu": round(mfu, 4),
+            "target_mfu": TARGET_MFU,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

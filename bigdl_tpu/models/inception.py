@@ -7,7 +7,6 @@ classifier heads (:182-265) and the no-aux variant (:98-132).
 
 from bigdl_tpu import nn
 from bigdl_tpu.nn import init
-from bigdl_tpu.utils.table import Table
 
 
 def inception_layer_v1(input_size: int, config, name_prefix: str = "") -> nn.Module:
@@ -97,11 +96,10 @@ class InceptionV1NoAuxClassifier:
 
 
 class InceptionV1:
-    """Training GoogLeNet with the two auxiliary heads; output is a Table of
-    (main, aux2, aux1) log-probs — aux2 taps after inception_4d, aux1 after
-    inception_4a, mirroring the reference's nested ConcatTable order
-    (Inception_v1.scala:182-265). Train with ParallelCriterion weighting
-    both aux losses 0.3 as in the paper."""
+    """Training GoogLeNet with the two auxiliary heads. Matching the
+    reference (Inception_v1.scala:182-265, Concat(2) at :247-257), the
+    output is ONE tensor of shape (batch, 3*class_num): columns are
+    [main(loss3), aux2(loss2, after 4d), aux1(loss1, after 4a)] log-probs."""
 
     def __new__(cls, class_num: int = 1000, has_dropout: bool = True) -> nn.Module:
         feature1 = _stem()
@@ -152,23 +150,8 @@ class InceptionV1:
                     .set_name("loss3/classifier"))
         output3.add(nn.LogSoftMax().set_name("loss3/loss3"))
 
-        split2 = nn.ConcatTable().add(output3).add(output2)
+        split2 = nn.Concat(2).add(output3).add(output2)
         mainBranch = nn.Sequential().add(feature2).add(split2)
-        split1 = nn.ConcatTable().add(mainBranch).add(output1)
+        split1 = nn.Concat(2).add(mainBranch).add(output1)
 
-        model = nn.Sequential().add(feature1).add(split1)
-        return _FlattenHeads(model)
-
-
-class _FlattenHeads(nn.Module):
-    """Flatten the nested ((main, aux2), aux1) table into (main, aux2, aux1)."""
-
-    def __init__(self, inner: nn.Module):
-        super().__init__()
-        self.inner = inner
-
-    def forward(self, input):
-        out = self.inner(input)
-        nested, aux1 = out[1], out[2]  # Table is 1-based (Appendix B.1)
-        main, aux2 = nested[1], nested[2]
-        return Table(main, aux2, aux1)
+        return nn.Sequential().add(feature1).add(split1)

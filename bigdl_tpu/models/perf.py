@@ -46,7 +46,7 @@ def build_model(name: str, class_num: int = 1000):
     raise ValueError(f"unknown perf model {name!r}")
 
 
-def run_perf(model_name: str = "resnet50", batch_size: int = 32,
+def run_perf(model_name: str = None, batch_size: int = 32,
              iterations: int = 20, warmup: int = 3,
              dtype=jnp.float32, criterion=None,
              model: Optional[Module] = None, input_shape=None,
@@ -55,12 +55,20 @@ def run_perf(model_name: str = "resnet50", batch_size: int = 32,
     with records/sec (the reference's per-iteration Throughput line,
     optim/DistriOptimizer.scala:387-393)."""
     if model is None:
+        model_name = model_name or "resnet50"
         model, input_shape, class_num = build_model(model_name, class_num)
     elif input_shape is None:
         raise ValueError("input_shape is required when passing a custom model")
     else:
-        model_name = model_name if model_name else "custom"
-    criterion = criterion or nn.ClassNLLCriterion()
+        model_name = model_name or "custom"
+    if criterion is None:
+        # ResNet's ImageNet head emits raw logits (trained with
+        # CrossEntropyCriterion in the reference, models/resnet/TrainImageNet.scala);
+        # the other zoo models end in LogSoftMax → ClassNLL.
+        if model_name.startswith("resnet"):
+            criterion = nn.CrossEntropyCriterion()
+        else:
+            criterion = nn.ClassNLLCriterion()
 
     key = jax.random.PRNGKey(0)
     x = jax.random.normal(key, (batch_size,) + tuple(input_shape), dtype)
@@ -84,14 +92,14 @@ def run_perf(model_name: str = "resnet50", batch_size: int = 32,
     for _ in range(max(1, warmup)):
         loss, params, buffers, slots = step(params, buffers, slots, x, y, lrs,
                                             bt_random.next_key())
-    jax.block_until_ready(loss)
+    float(loss)  # value fetch: block_until_ready is unreliable over the axon tunnel
     compile_s = time.perf_counter() - t0
 
     t0 = time.perf_counter()
     for _ in range(iterations):
         loss, params, buffers, slots = step(params, buffers, slots, x, y, lrs,
                                             bt_random.next_key())
-    jax.block_until_ready(loss)
+    loss_v = float(loss)
     elapsed = time.perf_counter() - t0
 
     rec_per_sec = batch_size * iterations / elapsed
@@ -103,7 +111,7 @@ def run_perf(model_name: str = "resnet50", batch_size: int = 32,
         "time_s": round(elapsed, 4),
         "records_per_sec": round(rec_per_sec, 2),
         "ms_per_iter": round(1000.0 * elapsed / iterations, 3),
-        "loss": float(loss),
+        "loss": loss_v,
     }
     log(f"[perf] {model_name} batch={batch_size}: "
         f"{rec_per_sec:.1f} records/s ({summary['ms_per_iter']:.1f} ms/iter)")

@@ -12,6 +12,8 @@ type-A shortcut is concat with a zero tensor, exactly the reference's
 Concat(Identity, MulConstant(0)).
 """
 
+import jax.numpy as jnp
+
 from bigdl_tpu import nn
 from bigdl_tpu.nn import init
 from bigdl_tpu.optim.regularizer import L2Regularizer
@@ -100,7 +102,11 @@ class ResNet:
                  .add(_sbn(n))
                  .add(nn.ReLU())
                  .add(_conv(n, n * 4, 1, 1, 1, 1, 0, 0))
-                 .add(_sbn(n * 4)))
+                 # zero-gamma on the block's last BN so the residual branch
+                 # starts as identity (≙ Sbn(n*4).setInitMethod(Zeros, Zeros),
+                 # ResNet.scala:208)
+                 .add(nn.SpatialBatchNormalization(
+                     n * 4, 1e-3, init_weight=jnp.zeros((n * 4,)))))
             return (nn.Sequential()
                     .add(nn.ConcatTable().add(s).add(_shortcut(n_in, n * 4, stride, shortcut_type)))
                     .add(nn.CAddTable())

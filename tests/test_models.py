@@ -62,9 +62,11 @@ def test_autoencoder_reconstruction_shape():
 
 
 def test_inception_aux_heads():
+    # reference emits ONE (batch, 3*classNum) tensor: [main, aux2, aux1]
+    # (Inception_v1.scala:247-257 Concat(2))
     m = models.InceptionV1(12, has_dropout=False)
-    outs = m(jnp.ones((2, 3, 224, 224)))
-    assert [o.shape for o in outs] == [(2, 12)] * 3
+    out = m(jnp.ones((2, 3, 224, 224)))
+    assert out.shape == (2, 36)
 
 
 def test_lenet_learns_tiny_problem():
