@@ -15,9 +15,10 @@ import jax
 import jax.numpy as jnp
 
 from bigdl_tpu.utils.table import Table
+from bigdl_tpu.utils.config_capture import ConfigCaptured
 
 
-class Criterion:
+class Criterion(ConfigCaptured):
     """Base (reference: nn/abstractnn/AbstractCriterion.scala)."""
 
     def __init__(self):

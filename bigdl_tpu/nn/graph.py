@@ -93,6 +93,34 @@ class Graph(Module):
                 seen[id(node.module)] = True
                 setattr(self, f"n{i}_{type(node.module).__name__}", node.module)
 
+    # --------------------------------------------------------- serialization
+    def __serialize_spec__(self, ser_module, ser_tensor):
+        """Topology for the structured serializer: nodes in topo order with
+        module record ids + predecessor indices (≙ bigdl.proto's subModules
+        + node edges)."""
+        idx = {n._uid: i for i, n in enumerate(self._topo)}
+        return {
+            "nodes": [{"module": ser_module(n.module),
+                       "prev": [idx[p._uid] for p in n.prev]}
+                      for n in self._topo],
+            "inputs": [idx[n._uid] for n in self.input_nodes],
+            "outputs": [idx[n._uid] for n in self.output_nodes],
+            "stop_gradient": sorted(self._stop_gradient_names),
+        }
+
+    @classmethod
+    def __deserialize_spec__(cls, spec, get_module, get_tensor):
+        nodes: List[Node] = []
+        for nrec in spec["nodes"]:
+            node = Node(get_module(nrec["module"]))
+            node.prev = [nodes[i] for i in nrec["prev"]]
+            nodes.append(node)
+        g = cls([nodes[i] for i in spec["inputs"]],
+                [nodes[i] for i in spec["outputs"]])
+        if spec.get("stop_gradient"):
+            g.stop_gradient(spec["stop_gradient"])
+        return g
+
     # ------------------------------------------------------------- structure
     def _topo_sort(self) -> List[Node]:
         order: List[Node] = []

@@ -13,9 +13,10 @@ import math
 import jax.numpy as jnp
 
 from bigdl_tpu.utils import random as bt_random
+from bigdl_tpu.utils.config_capture import ConfigCaptured
 
 
-class InitializationMethod:
+class InitializationMethod(ConfigCaptured):
     def __call__(self, shape, fan_in=None, fan_out=None):
         raise NotImplementedError
 

@@ -61,6 +61,14 @@ class Module:
 
     _instance_counters: Dict[str, int] = {}
 
+    def __init_subclass__(cls, **kw):
+        # record constructor args for the structured serializer
+        # (≙ ModuleSerializer's case-class reflection, SURVEY.md §2.7)
+        super().__init_subclass__(**kw)
+        from bigdl_tpu.utils.config_capture import capture_init
+
+        capture_init(cls)
+
     def __init__(self):
         object.__setattr__(self, "_parameters", OrderedDict())
         object.__setattr__(self, "_gradients", OrderedDict())
@@ -153,12 +161,16 @@ class Module:
             self._forward_key = bt_random.RNG.peek_key()
         t0 = time.perf_counter()
         try:
-            self.output = self.forward(input)
+            out = self.forward(input)
+            # record eagerly only — under a pure bind `out` is a tracer that
+            # must not outlive the trace (it would poison clone/checkpoint)
+            if _PURE_BIND_DEPTH == 0:
+                self.output = out
         finally:
             if not scoped:
                 bt_random.RNG.pop_key()
         self._forward_time += time.perf_counter() - t0
-        return self.output
+        return out
 
     def backward(self, input: Activity, grad_output: Activity) -> Activity:
         """Module-local backward: gradInput + grad accumulation via jax.vjp.
@@ -429,10 +441,34 @@ class Module:
         return bool(self._modules)
 
     def save(self, path: str, overwrite: bool = False) -> "Module":
+        """Pickle save (≙ the reference's Java-serialization ``save``,
+        AbstractModule.scala:523)."""
         from bigdl_tpu.utils import file as bt_file
 
         bt_file.save_module(self, path, overwrite=overwrite)
         return self
+
+    def save_module(self, path: str, overwrite: bool = False) -> "Module":
+        """Structured save (≙ ``saveModule`` protobuf path,
+        AbstractModule.scala:543; format: utils/serializer)."""
+        from bigdl_tpu.utils import serializer
+
+        serializer.save_module(self, path, overwrite=overwrite)
+        return self
+
+    @staticmethod
+    def load(path: str) -> "Module":
+        """≙ Module.load (nn/Module.scala:44)."""
+        from bigdl_tpu.utils import file as bt_file
+
+        return bt_file.load_module(path)
+
+    @staticmethod
+    def load_module(path: str) -> "Module":
+        """≙ Module.loadModule (nn/Module.scala:54)."""
+        from bigdl_tpu.utils import serializer
+
+        return serializer.load_module(path)
 
 
 # --------------------------------------------------------------------------

@@ -27,6 +27,7 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+from bigdl_tpu.utils.config_capture import ConfigCaptured
 
 
 def _tree_map(f, *trees):
@@ -36,7 +37,7 @@ def _tree_map(f, *trees):
 # ---------------------------------------------------------------------------
 # Learning-rate schedules (reference: optim/SGD.scala:200-435)
 # ---------------------------------------------------------------------------
-class LearningRateSchedule:
+class LearningRateSchedule(ConfigCaptured):
     def rate(self, method: "OptimMethod", state: Dict[str, Any]) -> float:
         raise NotImplementedError
 
@@ -231,7 +232,7 @@ class NaturalExp(LearningRateSchedule):
 # ---------------------------------------------------------------------------
 # OptimMethod base
 # ---------------------------------------------------------------------------
-class OptimMethod:
+class OptimMethod(ConfigCaptured):
     """Reference: optim/OptimMethod.scala:28. State-table keys are API
     (epoch/neval/Loss/score/recordsProcessedThisEpoch, Appendix B.7)."""
 

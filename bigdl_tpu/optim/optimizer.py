@@ -171,6 +171,31 @@ def make_train_step(model: Module, criterion, optim_method: OptimMethod,
     return TrainStep(model, criterion, optim_method, grad_clip, sub_methods)
 
 
+def load_latest_checkpoint(path: str):
+    """Scan a checkpoint dir for the newest (model, optim_method) snapshot
+    (≙ DistriOptimizer.getLatestFile recovery scan,
+    optim/DistriOptimizer.scala:1072-1089). Returns (model, method, tag)
+    or (None, None, None) when the dir holds no snapshots."""
+    from bigdl_tpu.utils import file as bt_file
+    from bigdl_tpu.optim.optim_method import OptimMethod
+
+    if not os.path.isdir(path):
+        return None, None, None
+    tags = []
+    for fname in os.listdir(path):
+        if fname.startswith("model."):
+            suffix = fname[len("model."):]
+            if suffix.isdigit() and os.path.exists(
+                    os.path.join(path, f"optimMethod.{suffix}")):
+                tags.append(int(suffix))
+    if not tags:
+        return None, None, None
+    tag = max(tags)
+    model = bt_file.load_module(os.path.join(path, f"model.{tag}"))
+    method = OptimMethod.load(os.path.join(path, f"optimMethod.{tag}"))
+    return model, method, tag
+
+
 class Optimizer:
     """Builder façade (reference: optim/Optimizer.scala:47,655-676). The
     factory picks the local loop for LocalDataSet and the distributed SPMD

@@ -10,9 +10,10 @@ autodiff instead of being hand-fused into layer backward code.
 from __future__ import annotations
 
 import jax.numpy as jnp
+from bigdl_tpu.utils.config_capture import ConfigCaptured
 
 
-class Regularizer:
+class Regularizer(ConfigCaptured):
     def __call__(self, w) -> jnp.ndarray:
         raise NotImplementedError
 

@@ -42,9 +42,14 @@ def _to_device(module):
 def save_module(module, path: str, overwrite: bool = False) -> None:
     if os.path.exists(path) and not overwrite:
         raise FileExistsError(f"{path} exists; pass overwrite=True")
+    for _, m in module.named_modules():
+        # drop recorded activations before deepcopy — they may be large or
+        # (if a trace misbehaved) tracers that cannot be copied/pickled
+        m.output = None
+        m.grad_input = None
+        m._forward_key = None
     clone = module.clone_module()
     _to_host(clone)
-    clone._forward_key = None
     with open(path, "wb") as f:
         pickle.dump(clone, f)
 

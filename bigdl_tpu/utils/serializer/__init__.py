@@ -1,0 +1,13 @@
+"""Structured module serialization (≙ the reference's protobuf format).
+
+Reference: utils/serializer/ModuleSerializer.scala:34-118 + bigdl.proto —
+reflection-driven save/load of any registered layer with typed attribute
+converters and tensor-storage management. TPU-native analog: a zip archive
+holding ``module.json`` (the module tree: class path, constructor config,
+child links, graph topology) and ``tensors.npz`` (all parameters/buffers
+as numpy arrays), written/read by :mod:`bigdl_tpu.utils.serializer.serializer`.
+"""
+
+from bigdl_tpu.utils.serializer.serializer import (
+    save_module, load_module, module_to_spec, module_from_spec,
+)
