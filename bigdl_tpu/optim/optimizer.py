@@ -171,6 +171,15 @@ def make_train_step(model: Module, criterion, optim_method: OptimMethod,
     return TrainStep(model, criterion, optim_method, grad_clip, sub_methods)
 
 
+def _named_param_leaves(params, prefix=""):
+    """Flatten a params pytree into (dotted-name, leaf) pairs."""
+    if isinstance(params, dict):
+        for k, v in params.items():
+            yield from _named_param_leaves(v, f"{prefix}.{k}" if prefix else str(k))
+    else:
+        yield prefix, params
+
+
 def load_latest_checkpoint(path: str):
     """Scan a checkpoint dir for the newest (model, optim_method) snapshot
     (≙ DistriOptimizer.getLatestFile recovery scan,
@@ -369,6 +378,14 @@ class LocalOptimizer(Optimizer):
                 self.train_summary.add_scalar("Loss", loss, state["neval"])
                 self.train_summary.add_scalar("LearningRate", float(lr), state["neval"])
                 self.train_summary.add_scalar("Throughput", n / max(dt, 1e-9), state["neval"])
+                # optional parameter histograms, gated on a trigger
+                # (≙ TrainSummary "Parameters" tag, TrainSummary.scala:32)
+                ptrig = getattr(self.train_summary, "get_summary_trigger",
+                                lambda _n: None)("Parameters")
+                if ptrig is not None and ptrig(state):
+                    for pname, leaf in _named_param_leaves(params):
+                        self.train_summary.add_histogram(
+                            pname, np.asarray(leaf), state["neval"])
 
             state["neval"] += 1
             if state["recordsProcessedThisEpoch"] >= num_samples:

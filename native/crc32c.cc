@@ -1,0 +1,104 @@
+// CRC32C (Castagnoli) + TFRecord framing — the native codec the JVM
+// reference kept in java/netty/Crc32c.java and
+// visualization/tensorboard/RecordWriter.scala (SURVEY.md §2.12.5).
+// Slicing-by-8 table implementation; exposed with C linkage for ctypes.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+uint32_t kTable[8][256];
+bool kInit = false;
+
+void init_tables() {
+  if (kInit) return;
+  const uint32_t poly = 0x82f63b78u;  // reflected CRC-32C polynomial
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t crc = i;
+    for (int j = 0; j < 8; ++j)
+      crc = (crc >> 1) ^ ((crc & 1) ? poly : 0);
+    kTable[0][i] = crc;
+  }
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t crc = kTable[0][i];
+    for (int s = 1; s < 8; ++s) {
+      crc = (crc >> 8) ^ kTable[0][crc & 0xff];
+      kTable[s][i] = crc;
+    }
+  }
+  kInit = true;
+}
+
+inline uint32_t crc_update(uint32_t crc, const uint8_t* p, size_t n) {
+  while (n && (reinterpret_cast<uintptr_t>(p) & 7)) {
+    crc = (crc >> 8) ^ kTable[0][(crc ^ *p++) & 0xff];
+    --n;
+  }
+  while (n >= 8) {
+    uint64_t w;
+    std::memcpy(&w, p, 8);
+    w ^= crc;
+    crc = kTable[7][w & 0xff] ^ kTable[6][(w >> 8) & 0xff] ^
+          kTable[5][(w >> 16) & 0xff] ^ kTable[4][(w >> 24) & 0xff] ^
+          kTable[3][(w >> 32) & 0xff] ^ kTable[2][(w >> 40) & 0xff] ^
+          kTable[1][(w >> 48) & 0xff] ^ kTable[0][(w >> 56) & 0xff];
+    p += 8;
+    n -= 8;
+  }
+  while (n--) crc = (crc >> 8) ^ kTable[0][(crc ^ *p++) & 0xff];
+  return crc;
+}
+
+}  // namespace
+
+extern "C" {
+
+uint32_t bigdl_crc32c(const uint8_t* data, uint64_t n) {
+  init_tables();
+  return crc_update(0xffffffffu, data, n) ^ 0xffffffffu;
+}
+
+// TFRecord "masked" crc: rotate right 15 and add a constant.
+uint32_t bigdl_masked_crc32c(const uint8_t* data, uint64_t n) {
+  uint32_t crc = bigdl_crc32c(data, n);
+  return ((crc >> 15) | (crc << 17)) + 0xa282ead8u;
+}
+
+// Frame one record into out (caller allocates n + 16 bytes):
+// uint64 length LE | uint32 masked_crc(length) | data | uint32 masked_crc(data)
+// Returns total bytes written.
+uint64_t bigdl_tfrecord_frame(const uint8_t* data, uint64_t n, uint8_t* out) {
+  init_tables();
+  uint64_t len_le = n;  // assume little-endian host (x86/ARM TPU VMs)
+  std::memcpy(out, &len_le, 8);
+  uint32_t lc = bigdl_masked_crc32c(out, 8);
+  std::memcpy(out + 8, &lc, 4);
+  std::memcpy(out + 12, data, n);
+  uint32_t dc = bigdl_masked_crc32c(data, n);
+  std::memcpy(out + 12 + n, &dc, 4);
+  return n + 16;
+}
+
+// Parse a framed record at buf (of avail bytes). On success writes the
+// payload offset and length; returns 0. Returns -1 if truncated, -2 on
+// CRC mismatch.
+int bigdl_tfrecord_parse(const uint8_t* buf, uint64_t avail,
+                         uint64_t* payload_off, uint64_t* payload_len) {
+  init_tables();
+  if (avail < 12) return -1;
+  uint64_t n;
+  std::memcpy(&n, buf, 8);
+  uint32_t lc;
+  std::memcpy(&lc, buf + 8, 4);
+  if (bigdl_masked_crc32c(buf, 8) != lc) return -2;
+  if (avail < 16 + n) return -1;
+  uint32_t dc;
+  std::memcpy(&dc, buf + 12 + n, 4);
+  if (bigdl_masked_crc32c(buf + 12, n) != dc) return -2;
+  *payload_off = 12;
+  *payload_len = n;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,159 @@
+// Multithreaded prefetching file reader — the native IO staging shim for
+// input pipelines (SURVEY.md §2.12.5: the reference's "io" thread pool +
+// MTLabeledBGRImgToBatch multithreaded reader, utils/Engine.scala:218-355,
+// absorbed here into a C++ reader ahead of host→HBM transfer).
+//
+// Jobs are (path, offset, length) byte-range reads executed by a worker
+// pool; completions are handed back IN SUBMISSION ORDER so the Python
+// pipeline stays deterministic regardless of IO reordering.
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Job {
+  uint64_t id;
+  std::string path;
+  uint64_t offset;
+  uint64_t length;  // 0 = read to EOF
+};
+
+struct Done {
+  std::vector<uint8_t> data;
+  int err;  // 0 ok, nonzero errno-style
+};
+
+struct Loader {
+  std::mutex mu;
+  std::condition_variable cv_submit, cv_done;
+  std::deque<Job> queue;
+  std::map<uint64_t, Done> done;        // completed, keyed by job id
+  std::map<uint64_t, Done> handed_out;  // owned by caller until freed
+  uint64_t next_submit = 0;
+  uint64_t next_deliver = 0;
+  size_t capacity;
+  bool shutdown = false;
+  std::vector<std::thread> workers;
+
+  explicit Loader(int n_threads, size_t cap) : capacity(cap) {
+    for (int i = 0; i < n_threads; ++i)
+      workers.emplace_back([this] { run(); });
+  }
+
+  void run() {
+    for (;;) {
+      Job job;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_submit.wait(lk, [&] { return shutdown || !queue.empty(); });
+        if (shutdown && queue.empty()) return;
+        job = std::move(queue.front());
+        queue.pop_front();
+      }
+      Done d;
+      d.err = read_file(job, &d.data);
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        done.emplace(job.id, std::move(d));
+      }
+      cv_done.notify_all();
+    }
+  }
+
+  static int read_file(const Job& job, std::vector<uint8_t>* out) {
+    FILE* f = std::fopen(job.path.c_str(), "rb");
+    if (!f) return 1;
+    if (job.offset && std::fseek(f, (long)job.offset, SEEK_SET) != 0) {
+      std::fclose(f);
+      return 2;
+    }
+    uint64_t want = job.length;
+    if (want == 0) {
+      long cur = std::ftell(f);
+      std::fseek(f, 0, SEEK_END);
+      long end = std::ftell(f);
+      std::fseek(f, cur, SEEK_SET);
+      want = (uint64_t)(end - cur);
+    }
+    out->resize(want);
+    size_t got = want ? std::fread(out->data(), 1, want, f) : 0;
+    std::fclose(f);
+    out->resize(got);
+    return 0;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* bigdl_loader_create(int n_threads, int capacity) {
+  if (n_threads < 1) n_threads = 1;
+  if (capacity < 1) capacity = 16;
+  return new Loader(n_threads, (size_t)capacity);
+}
+
+// Returns the job id (>=0), or -1 when the loader is shut down. Blocks when
+// `capacity` jobs are already in flight (backpressure).
+int64_t bigdl_loader_submit(void* h, const char* path, uint64_t offset,
+                            uint64_t length) {
+  auto* L = static_cast<Loader*>(h);
+  std::unique_lock<std::mutex> lk(L->mu);
+  L->cv_done.wait(lk, [&] {
+    return L->shutdown ||
+           (L->next_submit - L->next_deliver) < L->capacity;
+  });
+  if (L->shutdown) return -1;
+  uint64_t id = L->next_submit++;
+  L->queue.push_back(Job{id, path, offset, length});
+  L->cv_submit.notify_one();
+  return (int64_t)id;
+}
+
+// Blocks for the next completion in submission order. Returns job id, or -1
+// if no jobs are outstanding. *data stays valid until bigdl_loader_free.
+int64_t bigdl_loader_next(void* h, const uint8_t** data, uint64_t* len,
+                          int* err) {
+  auto* L = static_cast<Loader*>(h);
+  std::unique_lock<std::mutex> lk(L->mu);
+  if (L->next_deliver == L->next_submit) return -1;
+  uint64_t id = L->next_deliver;
+  L->cv_done.wait(lk, [&] { return L->done.count(id) > 0; });
+  auto node = L->done.extract(id);
+  auto& d = L->handed_out.emplace(id, std::move(node.mapped())).first->second;
+  *data = d.data.data();
+  *len = d.data.size();
+  *err = d.err;
+  L->next_deliver++;
+  L->cv_done.notify_all();  // wake submitters waiting on backpressure
+  return (int64_t)id;
+}
+
+void bigdl_loader_free(void* h, int64_t job_id) {
+  auto* L = static_cast<Loader*>(h);
+  std::unique_lock<std::mutex> lk(L->mu);
+  L->handed_out.erase((uint64_t)job_id);
+}
+
+void bigdl_loader_destroy(void* h) {
+  auto* L = static_cast<Loader*>(h);
+  {
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->shutdown = true;
+  }
+  L->cv_submit.notify_all();
+  L->cv_done.notify_all();
+  for (auto& t : L->workers) t.join();
+  delete L;
+}
+
+}  // extern "C"

@@ -1,0 +1,112 @@
+"""Visualization + native codec tests (reference: visualization specs +
+Crc32c/RecordWriter behavior, SURVEY.md §2.11)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import native, nn
+from bigdl_tpu.visualization import TrainSummary, ValidationSummary, read_scalar
+from bigdl_tpu.visualization import proto
+
+
+class TestNativeCodec:
+    def test_crc32c_known_vector(self):
+        assert native.crc32c(b"123456789") == 0xE3069283
+        assert native.crc32c(b"") == 0x0
+
+    def test_python_fallback_matches_native(self):
+        if not native.native_available():
+            pytest.skip("native lib unavailable")
+        lib, native._lib, native._tried = native._lib, None, True
+        try:
+            py = [native.crc32c(b"abc"), native.masked_crc32c(b"abc"),
+                  native.tfrecord_frame(b"xyz")]
+        finally:
+            native._lib = lib
+        assert py == [native.crc32c(b"abc"), native.masked_crc32c(b"abc"),
+                      native.tfrecord_frame(b"xyz")]
+
+    def test_tfrecord_roundtrip(self):
+        recs = [b"a", b"payload-two", b"", b"\x00\xff" * 100]
+        blob = b"".join(native.tfrecord_frame(r) for r in recs)
+        assert list(native.tfrecord_iter(blob)) == recs
+
+    def test_tfrecord_detects_corruption(self):
+        blob = bytearray(native.tfrecord_frame(b"hello world"))
+        blob[14] ^= 0xFF
+        with pytest.raises(ValueError, match="crc"):
+            list(native.tfrecord_iter(bytes(blob)))
+
+    def test_prefetch_reader_ordered(self, tmp_path):
+        paths = []
+        for i in range(8):
+            p = tmp_path / f"f{i}.bin"
+            p.write_bytes(bytes([i]) * (i + 1))
+            paths.append(str(p))
+        with native.PrefetchReader(n_threads=4) as r:
+            for p in paths:
+                r.submit(p)
+            for i in range(8):
+                assert r.next() == bytes([i]) * (i + 1)
+
+
+class TestEventProto:
+    def test_event_roundtrip(self):
+        s = proto.summary([proto.scalar_value("Loss", 1.5)])
+        ev = proto.event(123.25, step=7, summary_bytes=s)
+        parsed = proto.parse_event(ev)
+        assert parsed["wall_time"] == 123.25
+        assert parsed["step"] == 7
+        assert parsed["values"] == [("Loss", 1.5)]
+
+
+class TestSummaries:
+    def test_write_read_scalars(self, tmp_path):
+        ts = TrainSummary(str(tmp_path), "app")
+        for i in range(5):
+            ts.add_scalar("Loss", 2.0 / (i + 1), i + 1)
+        rows = ts.read_scalar("Loss")
+        ts.close()
+        assert [r[0] for r in rows] == [1, 2, 3, 4, 5]
+        np.testing.assert_allclose([r[2] for r in rows],
+                                   [2.0, 1.0, 2 / 3, 0.5, 0.4], rtol=1e-6)
+
+    def test_histogram_write(self, tmp_path):
+        ts = TrainSummary(str(tmp_path), "app")
+        ts.add_histogram("weights", np.random.RandomState(0).randn(100), 1)
+        ts.flush()
+        files = os.listdir(os.path.join(str(tmp_path), "app", "train"))
+        assert any(".tfevents." in f for f in files)
+        ts.close()
+
+    def test_optimizer_writes_summaries(self, tmp_path):
+        from bigdl_tpu.dataset.sample import Sample
+        from bigdl_tpu.optim import SGD, Top1Accuracy, Trigger
+        from bigdl_tpu.optim.optimizer import Optimizer
+
+        rng = np.random.RandomState(0)
+        samples = [Sample(rng.randn(4).astype(np.float32),
+                          np.array([1.0 + (i % 2)], np.float32)) for i in range(32)]
+        model = nn.Sequential(nn.Linear(4, 4), nn.Tanh(),
+                              nn.Linear(4, 2), nn.LogSoftMax())
+        ts = TrainSummary(str(tmp_path), "job")
+        ts.set_summary_trigger("Parameters", Trigger.several_iteration(2))
+        vs = ValidationSummary(str(tmp_path), "job")
+        opt = Optimizer(model=model, dataset=samples,
+                        criterion=nn.ClassNLLCriterion(), batch_size=16,
+                        end_when=Trigger.max_iteration(4))
+        opt.set_optim_method(SGD(learning_rate=0.1))
+        opt.set_train_summary(ts)
+        opt.set_validation_summary(vs)
+        opt.set_validation(Trigger.several_iteration(2), samples,
+                           [Top1Accuracy()], batch_size=16)
+        opt.optimize()
+        loss_rows = ts.read_scalar("Loss")
+        tp_rows = ts.read_scalar("Throughput")
+        acc_rows = vs.read_scalar("Top1Accuracy")
+        ts.close()
+        vs.close()
+        assert len(loss_rows) == 4 and len(tp_rows) == 4
+        assert len(acc_rows) >= 1
