@@ -1,0 +1,69 @@
+"""Transformer language model — the long-context flagship.
+
+Beyond-parity model (the reference's sequence stack is RNN-only,
+models/rnn/SimpleRNN.scala); this is the workload that exercises ring
+attention / Ulysses sequence parallelism and tensor parallelism on the
+mesh. Decoder-only, pre-norm, GELU MLP, learned positions, weight-tied head.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.attention import LayerNorm, TransformerBlock
+from bigdl_tpu.nn.module import Module
+
+
+class TransformerLM(Module):
+    """Decoder-only LM. Input: (batch, time) int32 token ids (0-based).
+    Output: (batch, time, vocab) logits."""
+
+    def __init__(self, vocab_size: int, embed_dim: int = 256,
+                 num_heads: int = 8, num_layers: int = 4,
+                 max_len: int = 1024, mlp_ratio: int = 4,
+                 dropout: float = 0.0, causal: bool = True,
+                 sequence_parallel: Optional[str] = None,
+                 tie_embeddings: bool = True):
+        super().__init__()
+        self.vocab_size = vocab_size
+        self.embed_dim = embed_dim
+        self.sequence_parallel = sequence_parallel
+        self.tie_embeddings = tie_embeddings
+        self.register_parameter(
+            "tok_embed", nn.init.RandomNormal(0.0, 0.02)((vocab_size, embed_dim)))
+        self.register_parameter(
+            "pos_embed", nn.init.RandomNormal(0.0, 0.02)((max_len, embed_dim)))
+        for i in range(num_layers):
+            setattr(self, f"block{i}",
+                    TransformerBlock(embed_dim, num_heads, mlp_ratio=mlp_ratio,
+                                     dropout=dropout, causal=causal,
+                                     sequence_parallel=sequence_parallel))
+        self.ln_f = LayerNorm(embed_dim)
+        if not tie_embeddings:
+            self.head = nn.Linear(embed_dim, vocab_size, with_bias=False)
+        self.num_layers = num_layers
+
+    def forward(self, input):
+        ids = input.astype(jnp.int32)
+        b, t = ids.shape
+        x = jnp.take(self.tok_embed, ids, axis=0)
+        if self.sequence_parallel is not None:
+            # each device holds sequence block `axis_index`: offset positions
+            idx = jax.lax.axis_index(self.sequence_parallel)
+            pos0 = idx * t
+        else:
+            pos0 = 0
+        pos = jax.lax.dynamic_slice_in_dim(self.pos_embed, pos0, t, axis=0)
+        x = x + pos[None]
+        for i in range(self.num_layers):
+            x = getattr(self, f"block{i}")(x)
+        x = self.ln_f(x)
+        if self.tie_embeddings:
+            logits = jnp.einsum("btc,vc->btv", x, self.tok_embed)
+        else:
+            logits = self.head(x.reshape(b * t, -1)).reshape(b, t, -1)
+        return logits

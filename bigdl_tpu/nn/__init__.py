@@ -57,6 +57,9 @@ from bigdl_tpu.nn.recurrent import (
     Cell, RnnCell, LSTM, LSTMPeephole, GRU, ConvLSTMPeephole, MultiRNNCell,
     Recurrent, BiRecurrent, RecurrentDecoder, TimeDistributed,
 )
+from bigdl_tpu.nn.attention import (
+    LayerNorm, MultiHeadAttention, TransformerBlock, dot_product_attention,
+)
 from bigdl_tpu.nn.criterion import (
     Criterion, ClassNLLCriterion, CrossEntropyCriterion, CategoricalCrossEntropy,
     MSECriterion, AbsCriterion, BCECriterion, SmoothL1Criterion,

@@ -1,0 +1,140 @@
+"""Attention / transformer layers — beyond-parity, TPU-first.
+
+The reference has no attention stack (SURVEY.md §5 "Long-context /
+sequence parallelism: Absent"); its sequence workloads are RNNs. This
+module supplies the modern long-context path the north star requires:
+fused-QKV multi-head attention whose math lives in one MXU-friendly
+einsum chain, with optional **ring attention** sequence parallelism
+(bigdl_tpu.parallel.ring_attention) when the sequence axis is sharded
+over the mesh.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn import init as bt_init
+from bigdl_tpu.nn.module import Module, in_pure_bind
+from bigdl_tpu.nn.linear import Linear
+from bigdl_tpu.nn.dropout import Dropout
+
+
+class LayerNorm(Module):
+    """Layer normalization over the last dim (no reference analog; required
+    by the transformer stack)."""
+
+    def __init__(self, n_output: int, eps: float = 1e-5, affine: bool = True):
+        super().__init__()
+        self.n_output = n_output
+        self.eps = eps
+        self.affine = affine
+        if affine:
+            self.register_parameter("weight", jnp.ones((n_output,)))
+            self.register_parameter("bias", jnp.zeros((n_output,)))
+
+    def forward(self, input):
+        x = input.astype(jnp.float32)
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + self.eps)
+        y = y.astype(input.dtype)
+        if self.affine:
+            y = y * self.weight + self.bias
+        return y
+
+
+def dot_product_attention(q, k, v, causal: bool = False, mask=None,
+                          scale: Optional[float] = None):
+    """(B, H, T, D) attention; softmax statistics in f32 for bf16 inputs."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        tq, tk = scores.shape[-2], scores.shape[-1]
+        cm = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        scores = jnp.where(cm, scores, -jnp.inf)
+    if mask is not None:
+        scores = jnp.where(mask, scores, -jnp.inf)
+    w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v)
+
+
+class MultiHeadAttention(Module):
+    """Fused-QKV multi-head self/cross attention.
+
+    ``sequence_parallel`` names a mesh axis: inside a shard_map over that
+    axis the layer switches to ring attention (each device holds a sequence
+    block; K/V blocks rotate over ICI via ppermute)."""
+
+    def __init__(self, embed_dim: int, num_heads: int, dropout: float = 0.0,
+                 with_bias: bool = True, causal: bool = False,
+                 sequence_parallel: Optional[str] = None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.causal = causal
+        self.dropout_p = dropout
+        self.sequence_parallel = sequence_parallel
+        self.qkv = Linear(embed_dim, 3 * embed_dim, with_bias=with_bias)
+        self.out_proj = Linear(embed_dim, embed_dim, with_bias=with_bias)
+        if dropout > 0:
+            self.drop = Dropout(dropout)
+
+    def _split_heads(self, x):
+        b, t, _ = x.shape
+        return x.reshape(b, t, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+
+    def forward(self, input):
+        b, t, _ = input.shape
+        qkv = self.qkv(input.reshape(b * t, self.embed_dim)).reshape(b, t, -1)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = map(self._split_heads, (q, k, v))
+        if self.sequence_parallel is not None:
+            from bigdl_tpu.parallel.ring_attention import ring_attention
+
+            o = ring_attention(q, k, v, axis_name=self.sequence_parallel,
+                               causal=self.causal)
+        else:
+            o = dot_product_attention(q, k, v, causal=self.causal)
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, self.embed_dim)
+        o = self.out_proj(o.reshape(b * t, self.embed_dim)).reshape(b, t, -1)
+        if self.dropout_p > 0:
+            o = self.drop(o)
+        return o
+
+
+class TransformerBlock(Module):
+    """Pre-norm block: x + MHA(LN(x)); x + MLP(LN(x)). GELU MLP sized
+    ``mlp_ratio``× embed."""
+
+    def __init__(self, embed_dim: int, num_heads: int, mlp_ratio: int = 4,
+                 dropout: float = 0.0, causal: bool = True,
+                 sequence_parallel: Optional[str] = None):
+        super().__init__()
+        self.ln1 = LayerNorm(embed_dim)
+        self.attn = MultiHeadAttention(embed_dim, num_heads, dropout=dropout,
+                                       causal=causal,
+                                       sequence_parallel=sequence_parallel)
+        self.ln2 = LayerNorm(embed_dim)
+        self.fc1 = Linear(embed_dim, mlp_ratio * embed_dim)
+        self.fc2 = Linear(mlp_ratio * embed_dim, embed_dim)
+        if dropout > 0:
+            self.drop = Dropout(dropout)
+        self.dropout_p = dropout
+
+    def forward(self, input):
+        x = input + self.attn(self.ln1(input))
+        b, t, c = x.shape
+        h = self.fc1(self.ln2(x).reshape(b * t, c))
+        h = jax.nn.gelu(h)
+        h = self.fc2(h).reshape(b, t, c)
+        if self.dropout_p > 0:
+            h = self.drop(h)
+        return x + h

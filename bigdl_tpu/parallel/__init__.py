@@ -8,3 +8,7 @@ from bigdl_tpu.parallel.all_reduce import (
     compress, decompress,
 )
 from bigdl_tpu.parallel.distri_optimizer import DistriOptimizer
+from bigdl_tpu.parallel.ring_attention import ring_attention, ulysses_attention
+from bigdl_tpu.parallel.tp import (
+    spec_for_params, transformer_tp_rules, shard_params,
+)

@@ -1,0 +1,135 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+No reference analog (SURVEY.md §5: sequence parallelism is absent in the
+reference) — this is the long-context capability the north star adds.
+Design follows the public Ring Attention recipe (blockwise attention with
+flash-style running softmax statistics; K/V blocks rotate around the ICI
+ring via ``lax.ppermute``) and DeepSpeed-Ulysses (all-to-all swaps the
+sharded axis from sequence to heads so each device runs full-sequence
+attention on a head subset).
+
+Both run inside ``shard_map`` over a mesh axis whose size divides the
+sequence (ring) or heads (ulysses). Softmax statistics accumulate in f32
+regardless of input dtype (bf16-safe).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_attend(q, k, v, scale, mask):
+    """Unnormalized block attention: returns (o_block, row_sum, row_max)
+    with f32 statistics. q:(B,H,Tq,D) k,v:(B,H,Tk,D) mask:(Tq,Tk) or None."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -jnp.inf)
+    m = jnp.max(s, axis=-1)                      # (B,H,Tq)
+    # guard fully-masked rows: exp(-inf - -inf) would be nan
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m_safe[..., None])           # (B,H,Tq,Tk) f32
+    l = jnp.sum(p, axis=-1)                      # (B,H,Tq)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+    return o.astype(jnp.float32), l, m_safe, jnp.isfinite(m)
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   scale: Optional[float] = None):
+    """Blockwise ring attention inside shard_map.
+
+    Each device holds one sequence block of Q/K/V (B, H, T/n, D). K/V
+    rotate n-1 times around the ring; output accumulates with running
+    (max, denom) flash statistics so the result equals full softmax
+    attention over the whole sequence.
+    """
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    tq = q.shape[2]
+
+    def local_mask(src_block):
+        """(Tq, Tk) mask for attending my Q block to K block ``src_block``."""
+        if not causal:
+            return None
+        # global positions: my block rows my*tq + i, source cols src*tk + j
+        rows = my * tq + jnp.arange(tq)[:, None]
+        cols = src_block * k.shape[2] + jnp.arange(k.shape[2])[None, :]
+        return rows >= cols
+
+    # accumulators (f32)
+    o_acc = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32)
+    l_acc = jnp.zeros(q.shape[:3], jnp.float32)
+    m_acc = jnp.full(q.shape[:3], -jnp.inf, jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def merge(carry, block):
+        o_acc, l_acc, m_acc = carry
+        o_b, l_b, m_b, valid = block
+        # rows with no valid cols in this block contribute nothing
+        m_b = jnp.where(valid, m_b, -jnp.inf)
+        m_new = jnp.maximum(m_acc, m_b)
+        m_new_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        c_old = jnp.where(jnp.isfinite(m_acc), jnp.exp(m_acc - m_new_safe), 0.0)
+        c_b = jnp.where(valid, jnp.exp(m_b - m_new_safe), 0.0)
+        o_new = o_acc * c_old[..., None] + o_b * c_b[..., None]
+        l_new = l_acc * c_old + l_b * c_b
+        return o_new, l_new, m_new
+
+    def step(t, carry):
+        o_acc, l_acc, m_acc, k_cur, v_cur = carry
+        src = (my - t) % n  # block id currently held after t rotations
+        if causal:
+            # skip blocks strictly in the future (mask everything out)
+            mask = local_mask(src)
+        else:
+            mask = None
+        o_b, l_b, m_b, valid = _block_attend(q, k_cur, v_cur, scale, mask)
+        o_acc, l_acc, m_acc = merge((o_acc, l_acc, m_acc),
+                                    (o_b, l_b, m_b, valid))
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return o_acc, l_acc, m_acc, k_nxt, v_nxt
+
+    carry = (o_acc, l_acc, m_acc, k, v)
+    # static python loop: n is a trace-time constant; XLA overlaps the
+    # ppermute of step t+1 with the matmuls of step t
+    for t in range(n):
+        carry = step(t, carry)
+    o_acc, l_acc, m_acc, _, _ = carry
+    denom = jnp.where(l_acc > 0, l_acc, 1.0)
+    return (o_acc / denom[..., None]).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
+                      scale: Optional[float] = None):
+    """DeepSpeed-Ulysses style: all_to_all converts the sequence shard into
+    a head shard, runs full-sequence attention locally, converts back.
+    Requires num_heads % axis_size == 0."""
+    n = lax.psum(1, axis_name)
+
+    def seq_to_heads(x):
+        # (B, H, T/n, D) -> (B, H/n, T, D): device i keeps head-group i,
+        # gathers every device's sequence block along time (source order ==
+        # global order). tiled=True splits/concats in place.
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        # (B, H/n, T, D) -> (B, H, T/n, D): inverse
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    from bigdl_tpu.nn.attention import dot_product_attention
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    oh = dot_product_attention(qh, kh, vh, causal=causal, scale=scale)
+    return heads_to_seq(oh)

@@ -1,0 +1,77 @@
+"""Tensor-parallel sharding rules (GSPMD).
+
+No reference analog — the reference's only parallelism is data parallel
+(SURVEY.md §2.5). TPU-native TP is expressed as NamedSharding annotations
+on the params pytree: jit/GSPMD then inserts the all-gathers/reduce-
+scatters over ICI (scaling-book recipe: pick a mesh, annotate shardings,
+let XLA place collectives).
+
+``spec_for_params(params, rules)`` maps dotted param paths to
+PartitionSpecs by first-match regex; ``transformer_tp_rules`` implements
+the Megatron-style column/row split for the transformer stack:
+  qkv / fc1  (out, in)  -> shard dim 0 (column parallel)
+  out_proj / fc2        -> shard dim 1 (row parallel)
+  tok_embed  (vocab, d) -> shard dim 0
+  everything else       -> replicated
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Tuple
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def tree_paths(params, prefix=""):
+    if isinstance(params, dict):
+        for k, v in params.items():
+            yield from tree_paths(v, f"{prefix}/{k}")
+    else:
+        yield prefix, params
+
+
+def spec_for_params(params, rules: List[Tuple[str, P]], default: P = P()):
+    """Pytree of PartitionSpec matching ``params``; first regex match wins."""
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def build(sub, prefix):
+        if isinstance(sub, dict):
+            return {k: build(v, f"{prefix}/{k}") for k, v in sub.items()}
+        for pat, spec in compiled:
+            if pat.search(prefix):
+                return spec
+        return default
+
+    return build(params, "")
+
+
+def transformer_tp_rules(model_axis: str = "model", data_axis: str = None):
+    """Megatron-style rules for TransformerLM param paths. Pass ``data_axis``
+    to additionally FSDP-shard the replicated leaves' first dim (zero-style)."""
+    mp = model_axis
+    rules = [
+        (r"attn/qkv/~params/weight$", P(mp, None)),
+        (r"attn/qkv/~params/bias$", P(mp)),
+        (r"fc1/~params/weight$", P(mp, None)),
+        (r"fc1/~params/bias$", P(mp)),
+        (r"attn/out_proj/~params/weight$", P(None, mp)),
+        (r"fc2/~params/weight$", P(None, mp)),
+        (r"~params/tok_embed$", P(mp, None)),
+        (r"head/~params/weight$", P(mp, None)),
+    ]
+    return rules
+
+
+def shard_params(params, mesh, rules, default=P()):
+    """device_put every leaf with its NamedSharding. (Manual walk:
+    PartitionSpec is itself a pytree, so jax.tree.map would descend into it.)"""
+    specs = spec_for_params(params, rules, default)
+
+    def walk(p, s):
+        if isinstance(p, dict):
+            return {k: walk(v, s[k]) for k, v in p.items()}
+        return jax.device_put(p, NamedSharding(mesh, s))
+
+    return walk(params, specs)
