@@ -1,0 +1,27 @@
+"""bigdl_tpu.keras — Keras-1.2.2-style API (SURVEY.md §2.3 nn/keras/).
+
+Reference: nn/keras/Topology.scala:35-262 (KerasModel with
+compile/fit/evaluate/predict as sugar over the Optimizer, Appendix B.11)
+and the 71 shape-inferred layer wrappers. TPU-native design: each
+``KerasLayer`` lazily builds the underlying nn module once the input shape
+is known; shape inference is generic via ``jax.eval_shape`` on the built
+module (no per-layer shape math to drift out of sync).
+"""
+
+from bigdl_tpu.keras.engine import KerasLayer, InputLayer
+from bigdl_tpu.keras.topology import Sequential, Model
+from bigdl_tpu.keras.layers import (
+    Dense, Activation, Dropout, Flatten, Reshape, Permute, RepeatVector,
+    Masking, Highway, MaxoutDense,
+    Convolution1D, Convolution2D, SeparableConvolution2D, Deconvolution2D,
+    AtrousConvolution2D, LocallyConnected2D,
+    MaxPooling1D, MaxPooling2D, AveragePooling1D, AveragePooling2D,
+    GlobalAveragePooling1D, GlobalAveragePooling2D, GlobalMaxPooling1D,
+    GlobalMaxPooling2D,
+    BatchNormalization, Embedding, GaussianNoise, GaussianDropout,
+    SpatialDropout1D, SpatialDropout2D,
+    LSTM, GRU, SimpleRNN, Bidirectional, TimeDistributed,
+    Merge, ZeroPadding1D, ZeroPadding2D, Cropping1D, Cropping2D,
+    UpSampling1D, UpSampling2D, LeakyReLU, ELU, PReLU, SReLU,
+    ThresholdedReLU,
+)

@@ -1,0 +1,95 @@
+"""Keras layer adapter: lazy build + generic shape inference.
+
+Reference: nn/keras/KerasLayer.scala (adapter holding a bigdl layer with
+an InputSpec) + nn/abstractnn/InferShape.scala. Here ``build(input_shape)``
+constructs the wrapped nn module, and output shapes come from
+``jax.eval_shape`` over the module's forward — exact by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.module import Module
+
+
+def _infer_output_shape(module: Module, input_shape: Tuple[int, ...],
+                        dtype=jnp.float32) -> Tuple[int, ...]:
+    """Shape after ``module`` for a (batch,)+input_shape input; batch dim
+    reported back as None."""
+    spec = jax.ShapeDtypeStruct((2,) + tuple(input_shape), dtype)
+
+    def run(x):
+        from bigdl_tpu.nn.module import pure_trace
+        from bigdl_tpu.utils import random as bt_random
+
+        # scope a throwaway key: module __call__s split the ACTIVE stream,
+        # and splitting the global key under this trace would leak tracers
+        # into it (poisoning later eager calls); pure_trace() keeps modules
+        # from recording abstract outputs
+        bt_random.RNG.push_key(jax.random.PRNGKey(0))
+        module.evaluate()
+        try:
+            with pure_trace():
+                return module.forward(x)
+        finally:
+            module.training = True
+            bt_random.RNG.pop_key()
+
+    out = jax.eval_shape(run, spec)
+    return tuple(out.shape[1:])
+
+
+class KerasLayer(Module):
+    """Base wrapper: subclasses implement ``build_module(input_shape)``.
+
+    The wrapped module is created on first call / when the preceding
+    layer's output shape becomes known (Sequential drives this)."""
+
+    #: dtype used for shape inference (int layers e.g. Embedding override)
+    _infer_dtype = jnp.float32
+
+    def __init__(self, input_shape: Optional[Tuple[int, ...]] = None):
+        super().__init__()
+        self.input_shape = tuple(input_shape) if input_shape else None
+        self.output_shape: Optional[Tuple[int, ...]] = None
+        self.built = False
+
+    # ---- subclass contract -------------------------------------------------
+    def build_module(self, input_shape: Tuple[int, ...]) -> Module:
+        raise NotImplementedError
+
+    # ---- lifecycle ---------------------------------------------------------
+    def build(self, input_shape: Tuple[int, ...]) -> Tuple[int, ...]:
+        if self.built:
+            return self.output_shape
+        self.input_shape = tuple(input_shape)
+        self.layer = self.build_module(self.input_shape)  # registers child
+        self.output_shape = _infer_output_shape(
+            self.layer, self.input_shape, self._infer_dtype)
+        self.built = True
+        return self.output_shape
+
+    def get_output_shape(self):
+        return self.output_shape
+
+    def forward(self, input):
+        if not self.built:
+            self.build(tuple(np.shape(input))[1:])
+        return self.layer(input)
+
+
+class InputLayer(KerasLayer):
+    """≙ nn/keras/Input.scala — fixes the input shape of a Sequential."""
+
+    def __init__(self, input_shape=None):
+        super().__init__(input_shape=input_shape)
+
+    def build_module(self, input_shape):
+        from bigdl_tpu.nn.activation import Identity
+
+        return Identity()

@@ -1,0 +1,665 @@
+"""Keras-1.2.2-style layers (reference: nn/keras/ — Appendix A.4 list).
+
+Each wrapper lazily builds the underlying bigdl_tpu.nn module(s) from the
+inferred input shape ('th' channel-first ordering, as the reference's
+keras API uses). ``activation=`` strings map to nn activations.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.keras.engine import KerasLayer
+from bigdl_tpu.nn.module import Module
+
+_ACTIVATIONS = {
+    "relu": nn.ReLU, "tanh": nn.Tanh, "sigmoid": nn.Sigmoid,
+    "hard_sigmoid": nn.HardSigmoid, "softmax": nn.SoftMax,
+    "softplus": nn.SoftPlus, "softsign": nn.SoftSign,
+    "log_softmax": nn.LogSoftMax, "linear": nn.Identity,
+}
+
+
+def get_activation(name):
+    if name is None:
+        return None
+    if isinstance(name, Module):
+        return name
+    if name not in _ACTIVATIONS:
+        raise ValueError(f"unknown activation {name!r}")
+    return _ACTIVATIONS[name]()
+
+
+def _with_activation(module: Module, activation) -> Module:
+    act = get_activation(activation)
+    if act is None:
+        return module
+    return nn.Sequential(module, act)
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+class Dense(KerasLayer):
+    """≙ nn/keras/Dense.scala. Applies to the last dim of N-D input."""
+
+    def __init__(self, output_dim: int, activation=None, bias: bool = True,
+                 W_regularizer=None, b_regularizer=None, input_shape=None,
+                 input_dim=None):
+        if input_dim is not None and input_shape is None:
+            input_shape = (input_dim,)
+        super().__init__(input_shape=input_shape)
+        self.output_dim = output_dim
+        self.activation = activation
+        self.bias = bias
+        self.W_regularizer, self.b_regularizer = W_regularizer, b_regularizer
+
+    def build_module(self, input_shape):
+        linear = nn.Linear(input_shape[-1], self.output_dim,
+                           with_bias=self.bias,
+                           w_regularizer=self.W_regularizer,
+                           b_regularizer=self.b_regularizer)
+        if len(input_shape) > 1:
+            linear = nn.Bottle(linear, n_input_dim=2)
+        return _with_activation(linear, self.activation)
+
+
+class Activation(KerasLayer):
+    def __init__(self, activation, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.activation = activation
+
+    def build_module(self, input_shape):
+        return get_activation(self.activation)
+
+
+class Dropout(KerasLayer):
+    def __init__(self, p: float, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.p = p
+
+    def build_module(self, input_shape):
+        return nn.Dropout(self.p)
+
+
+class Flatten(KerasLayer):
+    def build_module(self, input_shape):
+        n = 1
+        for s in input_shape:
+            n *= s
+        return nn.Reshape((n,))
+
+
+class Reshape(KerasLayer):
+    def __init__(self, target_shape, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.target_shape = tuple(target_shape)
+
+    def build_module(self, input_shape):
+        return nn.Reshape(self.target_shape)
+
+
+class Permute(KerasLayer):
+    def __init__(self, dims, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.dims = tuple(dims)
+
+    def build_module(self, input_shape):
+        # keras dims are 1-based over non-batch dims; nn.Transpose swaps —
+        # use a tiny custom module for a general permutation
+        dims = self.dims
+
+        class _Permute(Module):
+            def forward(self, x):
+                return jnp.transpose(x, (0,) + tuple(d for d in dims))
+
+        return _Permute()
+
+
+class RepeatVector(KerasLayer):
+    def __init__(self, n: int, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.n = n
+
+    def build_module(self, input_shape):
+        return nn.Replicate(self.n, dim=2)
+
+
+class Masking(KerasLayer):
+    def __init__(self, mask_value: float = 0.0, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.mask_value = mask_value
+
+    def build_module(self, input_shape):
+        return nn.Masking(self.mask_value)
+
+
+class _HighwayModule(Module):
+    """y = T(x)*H(x) + (1-T(x))*x (reference: nn/Highway.scala)."""
+
+    def __init__(self, size: int, activation=None, with_bias: bool = True):
+        super().__init__()
+        self.proj = nn.Linear(size, size, with_bias=with_bias)
+        self.gate = nn.Linear(size, size, with_bias=with_bias)
+        self.act = get_activation(activation) or nn.Tanh()
+
+    def forward(self, x):
+        t = 1.0 / (1.0 + jnp.exp(-self.gate(x)))
+        h = self.act(self.proj(x))
+        return t * h + (1 - t) * x
+
+
+class Highway(KerasLayer):
+    def __init__(self, activation=None, bias: bool = True, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.activation, self.bias_flag = activation, bias
+
+    def build_module(self, input_shape):
+        return _HighwayModule(input_shape[-1], self.activation, self.bias_flag)
+
+
+class MaxoutDense(KerasLayer):
+    def __init__(self, output_dim: int, nb_feature: int = 4, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.output_dim, self.nb_feature = output_dim, nb_feature
+
+    def build_module(self, input_shape):
+        return nn.Maxout(input_shape[-1], self.output_dim, self.nb_feature)
+
+
+# ------------------------------------------------------------ convolution
+class Convolution2D(KerasLayer):
+    """≙ nn/keras/Convolution2D.scala — th ordering (C, H, W)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, border_mode: str = "valid",
+                 subsample=(1, 1), bias: bool = True,
+                 W_regularizer=None, b_regularizer=None, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.nb_filter, self.nb_row, self.nb_col = nb_filter, nb_row, nb_col
+        self.activation = activation
+        self.border_mode = border_mode
+        self.subsample = _pair(subsample)
+        self.bias = bias
+        self.W_regularizer, self.b_regularizer = W_regularizer, b_regularizer
+
+    def build_module(self, input_shape):
+        c = input_shape[0]
+        if self.border_mode == "same":
+            pw, ph = (self.nb_col - 1) // 2, (self.nb_row - 1) // 2
+        else:
+            pw = ph = 0
+        conv = nn.SpatialConvolution(
+            c, self.nb_filter, self.nb_col, self.nb_row,
+            self.subsample[1], self.subsample[0], pw, ph,
+            with_bias=self.bias, w_regularizer=self.W_regularizer,
+            b_regularizer=self.b_regularizer)
+        return _with_activation(conv, self.activation)
+
+
+class Convolution1D(KerasLayer):
+    """(B, T, F) temporal conv (≙ nn/keras/Convolution1D.scala)."""
+
+    def __init__(self, nb_filter: int, filter_length: int, activation=None,
+                 subsample_length: int = 1, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.nb_filter, self.filter_length = nb_filter, filter_length
+        self.activation = activation
+        self.subsample_length = subsample_length
+
+    def build_module(self, input_shape):
+        conv = nn.TemporalConvolution(input_shape[-1], self.nb_filter,
+                                      self.filter_length, self.subsample_length)
+        return _with_activation(conv, self.activation)
+
+
+class SeparableConvolution2D(KerasLayer):
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 depth_multiplier: int = 1, activation=None,
+                 subsample=(1, 1), bias: bool = True, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.nb_filter, self.nb_row, self.nb_col = nb_filter, nb_row, nb_col
+        self.depth_multiplier = depth_multiplier
+        self.activation = activation
+        self.subsample = _pair(subsample)
+        self.bias = bias
+
+    def build_module(self, input_shape):
+        conv = nn.SpatialSeparableConvolution(
+            input_shape[0], self.nb_filter, self.depth_multiplier,
+            self.nb_col, self.nb_row, self.subsample[1], self.subsample[0],
+            with_bias=self.bias)
+        return _with_activation(conv, self.activation)
+
+
+class Deconvolution2D(KerasLayer):
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, subsample=(1, 1), input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.nb_filter, self.nb_row, self.nb_col = nb_filter, nb_row, nb_col
+        self.activation = activation
+        self.subsample = _pair(subsample)
+
+    def build_module(self, input_shape):
+        conv = nn.SpatialFullConvolution(
+            input_shape[0], self.nb_filter, self.nb_col, self.nb_row,
+            self.subsample[1], self.subsample[0])
+        return _with_activation(conv, self.activation)
+
+
+class AtrousConvolution2D(KerasLayer):
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 atrous_rate=(1, 1), activation=None, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.nb_filter, self.nb_row, self.nb_col = nb_filter, nb_row, nb_col
+        self.atrous_rate = _pair(atrous_rate)
+        self.activation = activation
+
+    def build_module(self, input_shape):
+        conv = nn.SpatialDilatedConvolution(
+            input_shape[0], self.nb_filter, self.nb_col, self.nb_row,
+            dilation_w=self.atrous_rate[1], dilation_h=self.atrous_rate[0])
+        return _with_activation(conv, self.activation)
+
+
+class LocallyConnected2D(KerasLayer):
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.nb_filter, self.nb_row, self.nb_col = nb_filter, nb_row, nb_col
+        self.activation = activation
+
+    def build_module(self, input_shape):
+        c, h, w = input_shape
+        conv = nn.LocallyConnected2D(c, w, h, self.nb_filter,
+                                     self.nb_col, self.nb_row)
+        return _with_activation(conv, self.activation)
+
+
+# ---------------------------------------------------------------- pooling
+class MaxPooling2D(KerasLayer):
+    def __init__(self, pool_size=(2, 2), strides=None, border_mode="valid",
+                 input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.pool_size = _pair(pool_size)
+        self.strides = _pair(strides) if strides is not None else self.pool_size
+        self.border_mode = border_mode
+
+    def build_module(self, input_shape):
+        p = nn.SpatialMaxPooling(self.pool_size[1], self.pool_size[0],
+                                 self.strides[1], self.strides[0])
+        if self.border_mode == "same":
+            p.ceil()
+        return p
+
+
+class AveragePooling2D(KerasLayer):
+    def __init__(self, pool_size=(2, 2), strides=None, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.pool_size = _pair(pool_size)
+        self.strides = _pair(strides) if strides is not None else self.pool_size
+
+    def build_module(self, input_shape):
+        return nn.SpatialAveragePooling(self.pool_size[1], self.pool_size[0],
+                                        self.strides[1], self.strides[0])
+
+
+class MaxPooling1D(KerasLayer):
+    def __init__(self, pool_length: int = 2, stride=None, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.pool_length = pool_length
+        self.stride = stride if stride is not None else pool_length
+
+    def build_module(self, input_shape):
+        return nn.TemporalMaxPooling(self.pool_length, self.stride)
+
+
+class AveragePooling1D(KerasLayer):
+    def __init__(self, pool_length: int = 2, stride=None, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.pool_length = pool_length
+        self.stride = stride if stride is not None else pool_length
+
+    def build_module(self, input_shape):
+        pl, st = self.pool_length, self.stride
+
+        class _AvgPool1D(Module):
+            def forward(self, x):  # (B, T, F)
+                y = x.transpose(0, 2, 1)[:, :, None, :]  # (B, F, 1, T)
+                p = nn.SpatialAveragePooling(pl, 1, st, 1)(y)
+                return p[:, :, 0, :].transpose(0, 2, 1)
+
+        return _AvgPool1D()
+
+
+class GlobalMaxPooling2D(KerasLayer):
+    def build_module(self, input_shape):
+        c = input_shape[0]
+
+        class _GMax(Module):
+            def forward(self, x):
+                return jnp.max(x, axis=(2, 3))
+
+        return _GMax()
+
+
+class GlobalAveragePooling2D(KerasLayer):
+    def build_module(self, input_shape):
+        class _GAvg(Module):
+            def forward(self, x):
+                return jnp.mean(x, axis=(2, 3))
+
+        return _GAvg()
+
+
+class GlobalMaxPooling1D(KerasLayer):
+    def build_module(self, input_shape):
+        class _GMax1(Module):
+            def forward(self, x):
+                return jnp.max(x, axis=1)
+
+        return _GMax1()
+
+
+class GlobalAveragePooling1D(KerasLayer):
+    def build_module(self, input_shape):
+        class _GAvg1(Module):
+            def forward(self, x):
+                return jnp.mean(x, axis=1)
+
+        return _GAvg1()
+
+
+# ---------------------------------------------------------- normalization
+class BatchNormalization(KerasLayer):
+    def __init__(self, epsilon: float = 1e-3, momentum: float = 0.99,
+                 input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.epsilon, self.momentum = epsilon, momentum
+
+    def build_module(self, input_shape):
+        if len(input_shape) == 3:
+            return nn.SpatialBatchNormalization(input_shape[0], self.epsilon,
+                                                1.0 - self.momentum)
+        return nn.BatchNormalization(input_shape[-1], self.epsilon,
+                                     1.0 - self.momentum)
+
+
+# -------------------------------------------------------------- embedding
+class Embedding(KerasLayer):
+    """0-based int ids -> dense vectors (≙ nn/keras/Embedding.scala,
+    which shifts to the 1-based LookupTable)."""
+
+    _infer_dtype = jnp.int32
+
+    def __init__(self, input_dim: int, output_dim: int, input_shape=None,
+                 input_length=None):
+        if input_length is not None and input_shape is None:
+            input_shape = (input_length,)
+        super().__init__(input_shape=input_shape)
+        self.input_dim, self.output_dim = input_dim, output_dim
+
+    def build_module(self, input_shape):
+        return nn.Sequential(nn.AddConstant(1.0), nn.LookupTable(
+            self.input_dim, self.output_dim))
+
+
+# ------------------------------------------------------------------ noise
+class GaussianNoise(KerasLayer):
+    def __init__(self, sigma: float, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.sigma = sigma
+
+    def build_module(self, input_shape):
+        return nn.GaussianNoise(self.sigma)
+
+
+class GaussianDropout(KerasLayer):
+    def __init__(self, p: float, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.p = p
+
+    def build_module(self, input_shape):
+        return nn.GaussianDropout(self.p)
+
+
+class SpatialDropout1D(KerasLayer):
+    def __init__(self, p: float = 0.5, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.p = p
+
+    def build_module(self, input_shape):
+        return nn.SpatialDropout1D(self.p)
+
+
+class SpatialDropout2D(KerasLayer):
+    def __init__(self, p: float = 0.5, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.p = p
+
+    def build_module(self, input_shape):
+        return nn.SpatialDropout2D(self.p)
+
+
+# -------------------------------------------------------------- recurrent
+class _KerasRecurrent(KerasLayer):
+    cell_cls = None
+
+    def __init__(self, output_dim: int, activation=None,
+                 inner_activation=None, return_sequences: bool = False,
+                 go_backwards: bool = False, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.output_dim = output_dim
+        self.activation = activation
+        self.inner_activation = inner_activation
+        self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
+
+    def make_cell(self, input_size):
+        raise NotImplementedError
+
+    def build_module(self, input_shape):
+        seq = nn.Sequential()
+        if self.go_backwards:
+            seq.add(nn.Reverse(2))
+        seq.add(nn.Recurrent().add(self.make_cell(input_shape[-1])))
+        if not self.return_sequences:
+            seq.add(nn.Select(2, -1))
+        return seq
+
+
+class SimpleRNN(_KerasRecurrent):
+    def make_cell(self, input_size):
+        act = get_activation(self.activation) or nn.Tanh()
+        return nn.RnnCell(input_size, self.output_dim, act)
+
+
+class LSTM(_KerasRecurrent):
+    def make_cell(self, input_size):
+        act = get_activation(self.activation) or nn.Tanh()
+        inner = get_activation(self.inner_activation) or nn.Sigmoid()
+        return nn.LSTM(input_size, self.output_dim, activation=act,
+                       inner_activation=inner)
+
+
+class GRU(_KerasRecurrent):
+    def make_cell(self, input_size):
+        act = get_activation(self.activation) or nn.Tanh()
+        inner = get_activation(self.inner_activation) or nn.Sigmoid()
+        return nn.GRU(input_size, self.output_dim, activation=act,
+                      inner_activation=inner)
+
+
+class Bidirectional(KerasLayer):
+    """≙ nn/keras/Bidirectional.scala: wraps a keras recurrent layer."""
+
+    def __init__(self, layer: _KerasRecurrent, merge_mode: str = "concat",
+                 input_shape=None):
+        super().__init__(input_shape=input_shape or layer.input_shape)
+        self.inner = layer
+        self.merge_mode = merge_mode
+
+    def build_module(self, input_shape):
+        merge = nn.JoinTable(3) if self.merge_mode == "concat" else nn.CAddTable()
+        bi = nn.BiRecurrent(merge=merge, cell=self.inner.make_cell(input_shape[-1]))
+        if self.inner.return_sequences:
+            return bi
+        return nn.Sequential(bi, nn.Select(2, -1))
+
+
+class TimeDistributed(KerasLayer):
+    def __init__(self, layer: KerasLayer, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.inner = layer
+
+    def build_module(self, input_shape):
+        inner_module_shape = tuple(input_shape[1:])
+        self.inner.build(inner_module_shape)
+        return nn.TimeDistributed(self.inner.layer)
+
+
+# ------------------------------------------------------------------ merge
+class Merge(KerasLayer):
+    """Merge branch outputs (≙ nn/keras/Merge.scala). Input is a Table of
+    branch inputs; each branch is applied to its element, then merged."""
+
+    def __init__(self, layers: Sequence, mode: str = "sum", concat_axis: int = -1,
+                 input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.branches = list(layers)
+        self.mode = mode
+        self.concat_axis = concat_axis
+
+    def build_module(self, input_shape):
+        par = nn.ParallelTable()
+        for b in self.branches:
+            par.add(b)
+        mode = self.mode
+        if mode == "sum":
+            merge = nn.CAddTable()
+        elif mode == "mul":
+            merge = nn.CMulTable()
+        elif mode == "max":
+            merge = nn.CMaxTable()
+        elif mode == "ave":
+            merge = nn.CAveTable()
+        elif mode == "concat":
+            axis = self.concat_axis
+            merge = nn.JoinTable(axis if axis > 0 else 2)
+        elif mode == "dot":
+            merge = nn.DotProduct()
+        else:
+            raise ValueError(f"unsupported merge mode {mode!r}")
+        return nn.Sequential(par, merge)
+
+
+# ---------------------------------------------------------------- padding
+class ZeroPadding2D(KerasLayer):
+    def __init__(self, padding=(1, 1), input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.padding = _pair(padding)
+
+    def build_module(self, input_shape):
+        return nn.SpatialZeroPadding(self.padding[1], self.padding[1],
+                                     self.padding[0], self.padding[0])
+
+
+class ZeroPadding1D(KerasLayer):
+    def __init__(self, padding: int = 1, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.padding = padding
+
+    def build_module(self, input_shape):
+        pad = self.padding
+
+        class _Pad1D(Module):
+            def forward(self, x):
+                return jnp.pad(x, ((0, 0), (pad, pad), (0, 0)))
+
+        return _Pad1D()
+
+
+class Cropping2D(KerasLayer):
+    def __init__(self, cropping=((0, 0), (0, 0)), input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.cropping = cropping
+
+    def build_module(self, input_shape):
+        (t, b), (l, r) = self.cropping
+        return nn.Cropping2D((t, b), (l, r))
+
+
+class Cropping1D(KerasLayer):
+    def __init__(self, cropping=(1, 1), input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.cropping = _pair(cropping)
+
+    def build_module(self, input_shape):
+        a, b = self.cropping
+
+        class _Crop1D(Module):
+            def forward(self, x):
+                end = x.shape[1] - b
+                return x[:, a:end]
+
+        return _Crop1D()
+
+
+class UpSampling2D(KerasLayer):
+    def __init__(self, size=(2, 2), input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.size = _pair(size)
+
+    def build_module(self, input_shape):
+        return nn.UpSampling2D(self.size)
+
+
+class UpSampling1D(KerasLayer):
+    def __init__(self, length: int = 2, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.length = length
+
+    def build_module(self, input_shape):
+        return nn.UpSampling1D(self.length)
+
+
+# ----------------------------------------------------- advanced activations
+class LeakyReLU(KerasLayer):
+    def __init__(self, alpha: float = 0.3, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.alpha = alpha
+
+    def build_module(self, input_shape):
+        return nn.LeakyReLU(self.alpha)
+
+
+class ELU(KerasLayer):
+    def __init__(self, alpha: float = 1.0, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.alpha = alpha
+
+    def build_module(self, input_shape):
+        return nn.ELU(self.alpha)
+
+
+class PReLU(KerasLayer):
+    def build_module(self, input_shape):
+        return nn.PReLU(input_shape[0] if len(input_shape) > 1 else input_shape[-1])
+
+
+class SReLU(KerasLayer):
+    def build_module(self, input_shape):
+        return nn.SReLU(input_shape)
+
+
+class ThresholdedReLU(KerasLayer):
+    def __init__(self, theta: float = 1.0, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.theta = theta
+
+    def build_module(self, input_shape):
+        return nn.Threshold(self.theta, 0.0)

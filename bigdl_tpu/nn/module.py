@@ -56,6 +56,18 @@ def in_pure_bind() -> bool:
     return _PURE_BIND_DEPTH > 0
 
 
+@contextmanager
+def pure_trace():
+    """Mark a region as trace-only without binding params (used by shape
+    inference): module __call__s skip recording outputs/forward keys."""
+    global _PURE_BIND_DEPTH
+    _PURE_BIND_DEPTH += 1
+    try:
+        yield
+    finally:
+        _PURE_BIND_DEPTH -= 1
+
+
 class Module:
     """Base class of all layers (reference: nn/abstractnn/AbstractModule.scala:58)."""
 

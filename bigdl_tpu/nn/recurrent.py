@@ -251,11 +251,15 @@ class GRU(Cell):
     one (in, 2h) matmul; candidate uses the reset-gated hidden state."""
 
     def __init__(self, input_size: int, hidden_size: int, p: float = 0.0,
+                 activation: Optional[Module] = None,
+                 inner_activation: Optional[Module] = None,
                  w_regularizer=None, u_regularizer=None, b_regularizer=None):
         super().__init__()
         self.input_size = input_size
         self.hidden_size = hidden_size
         self.p = p
+        self._act = activation
+        self._inner = inner_activation
         h = hidden_size
         self.register_random_parameter(
             "i2g", lambda: _uniform_stdv((input_size, 2 * h), h),
@@ -287,9 +291,11 @@ class GRU(Cell):
         else:
             zg = x @ self.i2g + h @ self.h2g + self.gate_bias
             x_cand = x
-        r = jax.nn.sigmoid(zg[:, :hs])
-        z = jax.nn.sigmoid(zg[:, hs:])
-        cand = jnp.tanh(x_cand @ self.i2c + (r * h) @ self.h2c + self.cand_bias)
+        inner = self._inner if self._inner is not None else jax.nn.sigmoid
+        act = self._act if self._act is not None else jnp.tanh
+        r = inner(zg[:, :hs])
+        z = inner(zg[:, hs:])
+        cand = act(x_cand @ self.i2c + (r * h) @ self.h2c + self.cand_bias)
         h_new = (1 - z) * cand + z * h
         return h_new, h_new
 
