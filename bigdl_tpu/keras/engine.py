@@ -32,12 +32,14 @@ def _infer_output_shape(module: Module, input_shape: Tuple[int, ...],
         # into it (poisoning later eager calls); pure_trace() keeps modules
         # from recording abstract outputs
         bt_random.RNG.push_key(jax.random.PRNGKey(0))
+        modes = [(m, m.training) for _, m in module.named_modules()]
         module.evaluate()
         try:
             with pure_trace():
                 return module.forward(x)
         finally:
-            module.training = True
+            for m, was_training in modes:
+                m.training = was_training
             bt_random.RNG.pop_key()
 
     out = jax.eval_shape(run, spec)

@@ -548,8 +548,13 @@ class Merge(KerasLayer):
         elif mode == "ave":
             merge = nn.CAveTable()
         elif mode == "concat":
-            axis = self.concat_axis
-            merge = nn.JoinTable(axis if axis > 0 else 2)
+            axis = self.concat_axis  # keras semantics: full-tensor axis, -1 = last
+
+            class _ConcatMerge(Module):
+                def forward(self, table):
+                    return jnp.concatenate(list(table), axis=axis)
+
+            merge = _ConcatMerge()
         elif mode == "dot":
             merge = nn.DotProduct()
         else:

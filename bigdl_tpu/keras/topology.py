@@ -138,14 +138,19 @@ class Sequential(KerasModel):
         self._next_shape = None
         self._n = 0
 
-    def add(self, layer: KerasLayer) -> "Sequential":
+    def add(self, layer) -> "Sequential":
         if not self._layers:
-            shape = layer.input_shape
+            shape = getattr(layer, "input_shape", None)
             if shape is None:
                 raise ValueError("first layer needs input_shape=...")
             self._next_shape = shape
         if isinstance(layer, KerasLayer):
             self._next_shape = layer.build(self._next_shape)
+        else:
+            # plain nn.Module: advance the inferred shape chain generically
+            from bigdl_tpu.keras.engine import _infer_output_shape
+
+            self._next_shape = _infer_output_shape(layer, self._next_shape)
         self._layers.append(layer)
         setattr(self, f"layer{self._n}", layer)
         self._n += 1

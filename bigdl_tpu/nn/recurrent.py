@@ -291,8 +291,12 @@ class GRU(Cell):
         else:
             zg = x @ self.i2g + h @ self.h2g + self.gate_bias
             x_cand = x
-        inner = self._inner if self._inner is not None else jax.nn.sigmoid
-        act = self._act if self._act is not None else jnp.tanh
+        # call Module activations via .forward — __call__ would record scan
+        # tracers into Module.output (breaking later clone/save)
+        inner = (self._inner.forward if isinstance(self._inner, Module)
+                 else self._inner) if self._inner is not None else jax.nn.sigmoid
+        act = (self._act.forward if isinstance(self._act, Module)
+               else self._act) if self._act is not None else jnp.tanh
         r = inner(zg[:, :hs])
         z = inner(zg[:, hs:])
         cand = act(x_cand @ self.i2c + (r * h) @ self.h2c + self.cand_bias)

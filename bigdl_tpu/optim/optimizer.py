@@ -171,13 +171,12 @@ def make_train_step(model: Module, criterion, optim_method: OptimMethod,
     return TrainStep(model, criterion, optim_method, grad_clip, sub_methods)
 
 
-def _named_param_leaves(params, prefix=""):
-    """Flatten a params pytree into (dotted-name, leaf) pairs."""
-    if isinstance(params, dict):
-        for k, v in params.items():
-            yield from _named_param_leaves(v, f"{prefix}.{k}" if prefix else str(k))
-    else:
-        yield prefix, params
+def _named_param_leaves(params):
+    """(dotted-name, leaf) pairs over the params pytree."""
+    from bigdl_tpu.parallel.tp import tree_paths
+
+    for path, leaf in tree_paths(params):
+        yield path.strip("/").replace("/", "."), leaf
 
 
 def load_latest_checkpoint(path: str):
