@@ -482,6 +482,13 @@ class Module:
 
         return serializer.load_module(path)
 
+    def quantize(self) -> "Module":
+        """Int8-quantized clone for inference (≙ AbstractModule.quantize,
+        AbstractModule.scala:895)."""
+        from bigdl_tpu.nn.quantized import Quantizer
+
+        return Quantizer.quantize(self)
+
 
 # --------------------------------------------------------------------------
 # Pure (functional) application — the TPU execution path.

@@ -1,0 +1,98 @@
+"""Int8 quantization tests (reference: nn/quantized specs + the
+whitepaper's <0.1%-accuracy-drop claim tested as closeness thresholds)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import models, nn
+from bigdl_tpu.nn import quantized
+from bigdl_tpu.optim import SGD
+from bigdl_tpu.optim.optimizer import make_train_step
+
+
+def test_linear_quantized_close():
+    rng = np.random.RandomState(0)
+    m = nn.Linear(32, 16)
+    x = jnp.asarray(rng.randn(8, 32), jnp.float32)
+    want = m(x)
+    q = quantized.Linear.from_float(m)
+    got = q(x)
+    # int8 dynamic quantization: ~1% relative error budget
+    err = np.abs(np.asarray(got - want)).max() / (np.abs(np.asarray(want)).max() + 1e-9)
+    assert err < 0.02, err
+    assert q.weight_q.dtype == jnp.int8
+
+
+def test_conv_quantized_close():
+    rng = np.random.RandomState(1)
+    m = nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1)
+    x = jnp.asarray(rng.randn(2, 3, 12, 12), jnp.float32)
+    want = m(x)
+    q = quantized.SpatialConvolution.from_float(m)
+    got = q(x)
+    err = np.abs(np.asarray(got - want)).max() / (np.abs(np.asarray(want)).max() + 1e-9)
+    assert err < 0.02, err
+
+
+def test_quantizer_walks_and_swaps():
+    m = models.LeNet5(10)
+    q = quantized.Quantizer.quantize(m)
+    kinds = [type(mm).__name__ for _, mm in q.named_modules()]
+    assert "Linear" not in [type(mm).__module__ + "." + type(mm).__name__
+                            for _, mm in q.named_modules()
+                            if type(mm).__module__.endswith("nn.linear")]
+    n_q = sum(1 for _, mm in q.named_modules()
+              if isinstance(mm, (quantized.Linear, quantized.SpatialConvolution)))
+    assert n_q == 4  # 2 convs + 2 linears
+    # original model unchanged
+    n_orig = sum(1 for _, mm in m.named_modules()
+                 if isinstance(mm, (quantized.Linear, quantized.SpatialConvolution)))
+    assert n_orig == 0
+
+
+def test_quantized_model_accuracy_preserved():
+    """Train a tiny model, quantize, assert prediction agreement
+    (≙ integration/Quantization.scala e2e idea)."""
+    rng = np.random.RandomState(0)
+    x0 = rng.randn(64, 28, 28).astype(np.float32) - 1.0
+    x1 = rng.randn(64, 28, 28).astype(np.float32) + 1.0
+    x = jnp.asarray(np.concatenate([x0, x1]))
+    y = jnp.asarray(np.array([1] * 64 + [2] * 64), jnp.int32)
+
+    m = models.LeNet5(2)
+    ts = make_train_step(m, nn.ClassNLLCriterion(), SGD(learning_rate=0.1))
+    params, buffers = m.params_dict(), m.buffers_dict()
+    slots = ts.init_slots(params)
+    step = jax.jit(ts.step)
+    for _ in range(40):
+        loss, params, buffers, slots = step(params, buffers, slots, x, y,
+                                            ts.current_lrs(), None)
+    m.load_params_dict(params)
+    m.evaluate()
+
+    float_pred = np.asarray(m(x)).argmax(-1)
+    q = quantized.Quantizer.quantize(m)
+    q.evaluate()
+    q_pred = np.asarray(q(x)).argmax(-1)
+    agreement = (float_pred == q_pred).mean()
+    assert agreement >= 0.99, agreement
+
+
+def test_quantized_size_reduction():
+    m = nn.Linear(256, 256)
+    q = quantized.Linear.from_float(m)
+    float_bytes = np.asarray(m.weight).nbytes
+    q_bytes = np.asarray(q.weight_q).nbytes + np.asarray(q.w_scale).nbytes
+    assert q_bytes * 3.5 < float_bytes  # ~4x smaller
+
+
+def test_quantized_jit_compatible():
+    from bigdl_tpu.nn.module import pure_apply
+
+    m = quantized.Quantizer.quantize(models.LeNet5(10))
+    m.evaluate()
+    fn = pure_apply(m)
+    x = jnp.ones((2, 28, 28))
+    out = jax.jit(lambda b, x: fn({}, b, x)[0])(m.buffers_dict(), x)
+    assert out.shape == (2, 10)
