@@ -1,0 +1,306 @@
+"""TensorFlow GraphDef import (inference subset).
+
+Reference: utils/tf/TensorflowLoader.scala:55 + the 159 per-op loaders in
+utils/tf/loaders/ — parse a frozen graph.pb, convert nodes to modules,
+build a Graph between user-named inputs and outputs. Here the GraphDef is
+decoded with utils/protowire against the public tensorflow .proto field
+numbers; constants fold into their consumers (weights), and the supported
+op set covers frozen feed-forward inference graphs: Placeholder, Const,
+Identity, MatMul, BiasAdd, Add/AddV2, Relu, Relu6, Tanh, Sigmoid, Softmax,
+Conv2D (NHWC), DepthwiseConv2dNative, MaxPool, AvgPool, Mean, Reshape,
+Squeeze, Pad, ConcatV2.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils import protowire as pw
+
+# tensorflow dtype enum (subset)
+_DT = {1: np.float32, 2: np.float64, 3: np.int32, 4: np.uint8, 6: np.int8,
+       9: np.int64, 10: bool}
+
+
+def _parse_tensor(tensor_bytes: bytes) -> np.ndarray:
+    msg = pw.decode(tensor_bytes)
+    dtype = _DT.get(msg.get(1, [1])[0], np.float32)
+    shape = []
+    if 2 in msg:
+        shape_msg = pw.decode(msg[2][0])
+        for dim in shape_msg.get(2, []):
+            shape.append(pw.as_signed(pw.decode(dim).get(1, [0])[0]))
+    if 4 in msg and msg[4][0]:  # tensor_content: raw bytes
+        arr = np.frombuffer(msg[4][0], dtype=dtype).copy()
+    elif 5 in msg:  # float_val
+        vals = []
+        for v in msg[5]:
+            vals.extend(pw.packed_floats(v) if isinstance(v, bytes)
+                        else [struct.unpack("<f", struct.pack("<I", v))[0]])
+        arr = np.asarray(vals, np.float32)
+    elif 6 in msg:  # int_val
+        arr = np.asarray(pw.repeated_varints(msg[6]), np.int32)
+    elif 9 in msg:  # int64_val
+        arr = np.asarray([pw.as_signed(v) for v in pw.repeated_varints(msg[9])],
+                         np.int64)
+    else:
+        arr = np.zeros(shape or (0,), dtype)
+    if shape:
+        if arr.size == 1 and int(np.prod(shape)) > 1:
+            arr = np.full(shape, arr.reshape(-1)[0])
+        arr = arr.reshape(shape)
+    return arr
+
+
+class _TFNode:
+    def __init__(self, node_bytes: bytes):
+        msg = pw.decode(node_bytes)
+        self.name = pw.as_string(msg.get(1, [b""])[0])
+        self.op = pw.as_string(msg.get(2, [b""])[0])
+        self.inputs = [pw.as_string(v) for v in msg.get(3, [])]
+        self.attr: Dict[str, dict] = {}
+        for entry in msg.get(5, []):
+            em = pw.decode(entry)
+            key = pw.as_string(em.get(1, [b""])[0])
+            self.attr[key] = pw.decode(em[2][0]) if 2 in em else {}
+
+    def attr_ints(self, key: str) -> List[int]:
+        a = self.attr.get(key, {})
+        if 1 not in a:
+            return []
+        lst = pw.decode(a[1][0])
+        return [pw.as_signed(v) for v in pw.repeated_varints(lst.get(3, []))]
+
+    def attr_s(self, key: str) -> Optional[str]:
+        a = self.attr.get(key, {})
+        return pw.as_string(a[2][0]) if 2 in a else None
+
+    def attr_b(self, key: str, default=False) -> bool:
+        a = self.attr.get(key, {})
+        return bool(a[5][0]) if 5 in a else default
+
+    def attr_tensor(self) -> Optional[np.ndarray]:
+        a = self.attr.get("value", {})
+        return _parse_tensor(a[8][0]) if 8 in a else None
+
+
+def parse_graphdef(data: bytes) -> List[_TFNode]:
+    return [_TFNode(nb) for nb in pw.decode(data).get(1, [])]
+
+
+def _clean(name: str) -> str:
+    name = name.lstrip("^")
+    return name.split(":")[0]
+
+
+# ------------------------------------------------------ NHWC math modules
+class _Fn(Module):
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, x):
+        from bigdl_tpu.utils.table import Table
+
+        if isinstance(x, Table):
+            return self._fn(*list(x))
+        return self._fn(x)
+
+
+class _Conv2D(Module):
+    def __init__(self, w_hwio, strides, padding, depthwise=False):
+        super().__init__()
+        self.register_parameter("weight", jnp.asarray(w_hwio))
+        self.strides = strides
+        self.padding = padding
+        self.depthwise = depthwise
+
+    def forward(self, x):
+        w = self.weight
+        groups = 1
+        if self.depthwise:
+            h, wd, c, m = w.shape
+            w = w.reshape(h, wd, 1, c * m)
+            groups = c
+        return lax.conv_general_dilated(
+            x, w, window_strides=tuple(self.strides[1:3]),
+            padding=self.padding, feature_group_count=groups,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+class _Pool(Module):
+    def __init__(self, ksize, strides, padding, kind):
+        super().__init__()
+        self.ksize, self.strides, self.pad, self.kind = ksize, strides, padding, kind
+
+    def forward(self, x):
+        k = tuple(self.ksize)
+        s = tuple(self.strides)
+        if self.kind == "max":
+            return lax.reduce_window(x, -jnp.inf, lax.max, k, s, self.pad)
+        summed = lax.reduce_window(x, 0.0, lax.add, k, s, self.pad)
+        if self.pad == "VALID":
+            return summed / np.prod(self.ksize)
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, 0.0, lax.add, k, s, self.pad)
+        return summed / counts
+
+
+class _MatMul(Module):
+    def __init__(self, w=None, transpose_a=False, transpose_b=False):
+        super().__init__()
+        if w is not None:
+            self.register_parameter("weight", jnp.asarray(w))
+        self.has_w = w is not None
+        self.ta, self.tb = transpose_a, transpose_b
+
+    def forward(self, input):
+        if self.has_w:
+            a, b = input, self.weight
+        else:
+            a, b = input[1], input[2]
+        if self.ta:
+            a = a.T
+        if self.tb:
+            b = b.T
+        return a @ b
+
+
+class _BiasAdd(Module):
+    def __init__(self, b):
+        super().__init__()
+        self.register_parameter("bias", jnp.asarray(b))
+
+    def forward(self, x):
+        return x + self.bias
+
+
+class TensorflowLoader:
+    """≙ TensorflowLoader.load (utils/tf/TensorflowLoader.scala:55)."""
+
+    def __init__(self, graph_pb_path: str):
+        with open(graph_pb_path, "rb") as f:
+            self.nodes = {n.name: n for n in parse_graphdef(f.read())}
+
+    def load(self, inputs: List[str], outputs: List[str]):
+        consts: Dict[str, np.ndarray] = {}
+        for n in self.nodes.values():
+            if n.op == "Const":
+                consts[n.name] = n.attr_tensor()
+
+        def const_of(name: str) -> Optional[np.ndarray]:
+            name = _clean(name)
+            if name in consts:
+                return consts[name]
+            n = self.nodes.get(name)
+            if n is not None and n.op == "Identity":
+                return const_of(n.inputs[0])
+            return None
+
+        graph_nodes: Dict[str, nn.Node] = {}
+        input_nodes = []
+        for name in inputs:
+            node = nn.Input()
+            graph_nodes[_clean(name)] = node
+            input_nodes.append(node)
+
+        def build(name: str) -> nn.Node:
+            name = _clean(name)
+            if name in graph_nodes:
+                return graph_nodes[name]
+            n = self.nodes[name]
+            node = self._convert(n, build, const_of)
+            graph_nodes[name] = node
+            return node
+
+        output_nodes = [build(o) for o in outputs]
+        model = nn.Graph(input_nodes, output_nodes)
+        return model
+
+    def _convert(self, n: _TFNode, build, const_of) -> nn.Node:
+        op = n.op
+        data_inputs = [i for i in n.inputs if not i.startswith("^")]
+
+        def prev(i=0):
+            return build(data_inputs[i])
+
+        if op in ("Identity", "StopGradient", "Cast", "CheckNumerics"):
+            return prev()
+        if op == "Placeholder":
+            raise ValueError(
+                f"placeholder {n.name!r} reached but not listed in inputs")
+        if op == "Const":
+            raise ValueError(
+                f"const {n.name!r} must fold into a consumer; unsupported use")
+        if op == "MatMul":
+            w = const_of(data_inputs[1])
+            m = _MatMul(w, n.attr_b("transpose_a"), n.attr_b("transpose_b"))
+            m.set_name(n.name)
+            return m.inputs(prev(0))
+        if op == "BiasAdd" or (op in ("Add", "AddV2")
+                               and const_of(data_inputs[1]) is not None):
+            return _BiasAdd(const_of(data_inputs[1])).set_name(n.name).inputs(prev(0))
+        if op in ("Add", "AddV2"):
+            return nn.CAddTable().set_name(n.name).inputs(prev(0), prev(1))
+        if op == "Conv2D":
+            w = const_of(data_inputs[1])
+            return _Conv2D(w, n.attr_ints("strides"), n.attr_s("padding")
+                           ).set_name(n.name).inputs(prev(0))
+        if op == "DepthwiseConv2dNative":
+            w = const_of(data_inputs[1])
+            return _Conv2D(w, n.attr_ints("strides"), n.attr_s("padding"),
+                           depthwise=True).set_name(n.name).inputs(prev(0))
+        if op == "MaxPool":
+            return _Pool(n.attr_ints("ksize"), n.attr_ints("strides"),
+                         n.attr_s("padding"), "max").set_name(n.name).inputs(prev(0))
+        if op == "AvgPool":
+            return _Pool(n.attr_ints("ksize"), n.attr_ints("strides"),
+                         n.attr_s("padding"), "avg").set_name(n.name).inputs(prev(0))
+        if op == "Relu":
+            return nn.ReLU().set_name(n.name).inputs(prev(0))
+        if op == "Relu6":
+            return nn.ReLU6().set_name(n.name).inputs(prev(0))
+        if op == "Tanh":
+            return nn.Tanh().set_name(n.name).inputs(prev(0))
+        if op == "Sigmoid":
+            return nn.Sigmoid().set_name(n.name).inputs(prev(0))
+        if op == "Softmax":
+            return nn.SoftMax().set_name(n.name).inputs(prev(0))
+        if op == "Reshape":
+            shape = const_of(data_inputs[1])
+            tgt = tuple(int(s) for s in np.asarray(shape).reshape(-1))
+            return _Fn(lambda x, t=tgt: x.reshape(
+                tuple(x.shape[0] if d == -1 else d for d in t))
+            ).set_name(n.name).inputs(prev(0))
+        if op == "Squeeze":
+            dims = n.attr_ints("squeeze_dims")
+            return _Fn(lambda x, d=tuple(dims): jnp.squeeze(x, axis=d or None)
+                       ).set_name(n.name).inputs(prev(0))
+        if op == "Mean":
+            axes = const_of(data_inputs[1])
+            keep = n.attr_b("keep_dims")
+            ax = tuple(int(a) for a in np.asarray(axes).reshape(-1))
+            return _Fn(lambda x, a=ax, k=keep: jnp.mean(x, axis=a, keepdims=k)
+                       ).set_name(n.name).inputs(prev(0))
+        if op == "Pad":
+            pads = const_of(data_inputs[1])
+            p = tuple((int(a), int(b)) for a, b in np.asarray(pads))
+            return _Fn(lambda x, pp=p: jnp.pad(x, pp)).set_name(n.name).inputs(prev(0))
+        if op == "ConcatV2":
+            axis = int(np.asarray(const_of(data_inputs[-1])).reshape(())[()])
+            prevs = [build(i) for i in data_inputs[:-1]]
+            return _Fn(lambda *xs, a=axis: jnp.concatenate(xs, axis=a)
+                       ).set_name(n.name).inputs(*prevs)
+        raise ValueError(f"unsupported tf op {op!r} ({n.name})")
+
+
+def load_tf(graph_pb_path: str, inputs: List[str], outputs: List[str]):
+    """≙ Module.loadTF (nn/Module.scala:94)."""
+    return TensorflowLoader(graph_pb_path).load(inputs, outputs)

@@ -1,0 +1,161 @@
+"""Torch .t7 and TF GraphDef import tests (reference: torch/ TH-oracle
+specs and TensorflowLoaderSpec — here fixtures are generated with our own
+spec-conformant encoders and results checked against hand-built models)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils import protowire as pw
+from bigdl_tpu.utils import torchfile
+from bigdl_tpu.utils.tf_import import load_tf
+
+
+class TestTorchFile:
+    def test_raw_roundtrip(self, tmp_path):
+        obj = {"a": 1.5, "b": "hello", "t": np.arange(12, dtype=np.float32).reshape(3, 4),
+               "nested": {"x": True, "y": None}}
+        p = str(tmp_path / "o.t7")
+        torchfile.save(p, obj)
+        back = torchfile.load(p)
+        assert back["a"] == 1.5 and back["b"] == "hello"
+        np.testing.assert_allclose(back["t"], obj["t"])
+        assert back["nested"]["x"] is True
+
+    def test_load_torch_mlp(self, tmp_path):
+        rng = np.random.RandomState(0)
+        w1, b1 = rng.randn(6, 4).astype(np.float32), rng.randn(6).astype(np.float32)
+        w2, b2 = rng.randn(2, 6).astype(np.float32), rng.randn(2).astype(np.float32)
+        seq = torchfile.TorchObject("nn.Sequential", {"modules": {
+            1: torchfile.TorchObject("nn.Linear", {"weight": w1, "bias": b1}),
+            2: torchfile.TorchObject("nn.Tanh", {}),
+            3: torchfile.TorchObject("nn.Linear", {"weight": w2, "bias": b2}),
+            4: torchfile.TorchObject("nn.LogSoftMax", {}),
+        }})
+        p = str(tmp_path / "mlp.t7")
+        torchfile.save(p, seq)
+        m = torchfile.load_torch(p)
+        x = jnp.asarray(rng.randn(3, 4), jnp.float32)
+        got = np.asarray(m(x))
+        h = np.tanh(np.asarray(x) @ w1.T + b1)
+        logits = h @ w2.T + b2
+        want = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_load_torch_convnet(self, tmp_path):
+        rng = np.random.RandomState(1)
+        w = rng.randn(4, 3, 3, 3).astype(np.float32) * 0.2
+        b = rng.randn(4).astype(np.float32)
+        seq = torchfile.TorchObject("nn.Sequential", {"modules": {
+            1: torchfile.TorchObject("nn.SpatialConvolution", {
+                "weight": w, "bias": b, "nInputPlane": 3, "nOutputPlane": 4,
+                "kW": 3, "kH": 3, "dW": 1, "dH": 1, "padW": 1, "padH": 1}),
+            2: torchfile.TorchObject("nn.ReLU", {}),
+            3: torchfile.TorchObject("nn.SpatialMaxPooling", {
+                "kW": 2, "kH": 2, "dW": 2, "dH": 2, "padW": 0, "padH": 0}),
+        }})
+        p = str(tmp_path / "conv.t7")
+        torchfile.save(p, seq)
+        m = torchfile.load_torch(p)
+        out = m(jnp.ones((2, 3, 8, 8)))
+        assert out.shape == (2, 4, 4, 4)
+
+
+# ------------------------------------------------------------- TF fixtures
+def _attr(key: str, value_bytes: bytes) -> bytes:
+    return pw.enc_bytes(5, pw.enc_string(1, key) + pw.enc_bytes(2, value_bytes))
+
+
+def _attr_tensor(key: str, arr: np.ndarray) -> bytes:
+    shape = b"".join(pw.enc_bytes(2, pw.enc_varint(1, s)) for s in arr.shape)
+    tp = (pw.enc_varint(1, 1) + pw.enc_bytes(2, shape) +
+          pw.enc_bytes(4, arr.astype(np.float32).tobytes()))
+    return _attr(key, pw.enc_bytes(8, tp))
+
+
+def _attr_ints(key: str, vals) -> bytes:
+    lst = b"".join(pw.enc_varint(3, v) for v in vals)
+    return _attr(key, pw.enc_bytes(1, lst))
+
+
+def _attr_s(key: str, s: str) -> bytes:
+    return _attr(key, pw.enc_string(2, s))
+
+
+def _node(name: str, op: str, inputs=(), attrs=b"") -> bytes:
+    out = pw.enc_string(1, name) + pw.enc_string(2, op)
+    for i in inputs:
+        out += pw.enc_string(3, i)
+    return pw.enc_bytes(1, out + attrs)
+
+
+class TestTFImport:
+    def test_mlp_graph(self, tmp_path):
+        rng = np.random.RandomState(0)
+        w1 = rng.randn(4, 6).astype(np.float32)
+        b1 = rng.randn(6).astype(np.float32)
+        w2 = rng.randn(6, 3).astype(np.float32)
+        gd = b"".join([
+            _node("x", "Placeholder"),
+            _node("w1", "Const", attrs=_attr_tensor("value", w1)),
+            _node("b1", "Const", attrs=_attr_tensor("value", b1)),
+            _node("w2", "Const", attrs=_attr_tensor("value", w2)),
+            _node("mm1", "MatMul", ["x", "w1"]),
+            _node("add1", "BiasAdd", ["mm1", "b1"]),
+            _node("relu1", "Relu", ["add1"]),
+            _node("mm2", "MatMul", ["relu1", "w2"]),
+            _node("prob", "Softmax", ["mm2"]),
+        ])
+        p = tmp_path / "mlp.pb"
+        p.write_bytes(gd)
+        m = load_tf(str(p), ["x"], ["prob"])
+        x = rng.randn(5, 4).astype(np.float32)
+        got = np.asarray(m(jnp.asarray(x)))
+        h = np.maximum(x @ w1 + b1, 0.0)
+        logits = h @ w2
+        want = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_conv_graph_nhwc(self, tmp_path):
+        rng = np.random.RandomState(1)
+        w = rng.randn(3, 3, 2, 5).astype(np.float32) * 0.3  # HWIO
+        b = rng.randn(5).astype(np.float32)
+        gd = b"".join([
+            _node("img", "Placeholder"),
+            _node("w", "Const", attrs=_attr_tensor("value", w)),
+            _node("b", "Const", attrs=_attr_tensor("value", b)),
+            _node("conv", "Conv2D", ["img", "w"],
+                  attrs=_attr_ints("strides", [1, 1, 1, 1]) + _attr_s("padding", "SAME")),
+            _node("bias", "BiasAdd", ["conv", "b"]),
+            _node("relu", "Relu", ["bias"]),
+            _node("pool", "MaxPool", ["relu"],
+                  attrs=_attr_ints("ksize", [1, 2, 2, 1]) +
+                  _attr_ints("strides", [1, 2, 2, 1]) + _attr_s("padding", "VALID")),
+            _node("mean", "Mean", ["pool", "axes"]),
+            _node("axes", "Const", attrs=_attr_tensor("value",
+                                                      np.asarray([1., 2.], np.float32))),
+        ])
+        p = tmp_path / "conv.pb"
+        p.write_bytes(gd)
+        m = load_tf(str(p), ["img"], ["mean"])
+        x = rng.randn(2, 8, 8, 2).astype(np.float32)
+        out = np.asarray(m(jnp.asarray(x)))
+        assert out.shape == (2, 5)
+        # oracle via jax NHWC conv directly
+        from jax import lax
+        ref = lax.conv_general_dilated(jnp.asarray(x), jnp.asarray(w), (1, 1),
+                                       "SAME",
+                                       dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        ref = jnp.maximum(ref + b, 0.0)
+        ref = lax.reduce_window(ref, -jnp.inf, lax.max, (1, 2, 2, 1), (1, 2, 2, 1),
+                                "VALID")
+        ref = jnp.mean(ref, axis=(1, 2))
+        np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-5, atol=1e-6)
+
+    def test_unsupported_op_raises(self, tmp_path):
+        gd = _node("x", "Placeholder") + _node("y", "FancyOp", ["x"])
+        p = tmp_path / "bad.pb"
+        p.write_bytes(gd)
+        with pytest.raises(ValueError, match="unsupported tf op"):
+            load_tf(str(p), ["x"], ["y"])
