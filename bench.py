@@ -37,6 +37,46 @@ def peak_flops(device) -> float:
     return PEAK_FLOPS["cpu"]
 
 
+def _cpu_subprocess_fallback(args):
+    """Re-exec this bench on the CPU platform in a clean subprocess.
+
+    Necessary because a committed (or error-cached) backend can't be swapped
+    in-process, and the env must skip the axon sitecustomize (PYTHONPATH="")
+    so the wedged tunnel isn't dialed again."""
+    import os
+    import subprocess
+
+    env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, os.path.abspath(__file__), "--model", "lenet5"]
+    if args.batch:
+        cmd += ["--batch", str(args.batch)]
+    if args.iters:
+        cmd += ["--iters", str(args.iters)]
+    proc = subprocess.run(cmd, env=env, stdout=subprocess.PIPE,
+                          cwd=os.path.dirname(os.path.abspath(__file__)))
+    sys.stdout.buffer.write(proc.stdout)
+    sys.exit(proc.returncode)
+
+
+def init_backend(args, retries=3, backoff_s=10.0):
+    """Backend discovery that survives a flaky axon/TPU tunnel (round-1
+    failure mode: one transient UNAVAILABLE at jax.devices() cost the whole
+    round's evidence).  Retry with backoff, then degrade to the virtual CPU
+    platform via a clean subprocess (exits this process)."""
+    import jax
+
+    for attempt in range(1, retries + 1):
+        try:
+            return jax.devices()[0]
+        except Exception as e:  # jax.errors.JaxRuntimeError etc.
+            print(f"[bench] backend init attempt {attempt}/{retries} failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            if attempt < retries:
+                time.sleep(backoff_s * attempt)
+    print("[bench] falling back to CPU platform (subprocess)", file=sys.stderr)
+    _cpu_subprocess_fallback(args)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=None)
@@ -44,9 +84,7 @@ def main(argv=None):
     p.add_argument("--model", default="resnet50")
     args = p.parse_args(argv)
 
-    import jax
-
-    dev = jax.devices()[0]
+    dev = init_backend(args)
     on_tpu = "tpu" in dev.platform.lower()
     batch = args.batch or (64 if on_tpu else 4)
     iters = args.iters or (20 if on_tpu else 2)
@@ -58,9 +96,22 @@ def main(argv=None):
 
     from bigdl_tpu.models.perf import run_perf
 
-    s = run_perf(model, batch_size=batch, iterations=iters,
-                 dtype=jnp.bfloat16 if on_tpu else jnp.float32,
-                 log=lambda *a, **k: print(*a, file=sys.stderr, **k))
+    try:
+        s = run_perf(model, batch_size=batch, iterations=iters,
+                     dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+                     log=lambda *a, **k: print(*a, file=sys.stderr, **k))
+    except Exception as e:
+        if not on_tpu:
+            raise
+        # TPU run died mid-bench (tunnel wedge): salvage the round with a
+        # CPU fallback number rather than emitting nothing.  The TPU backend
+        # is already committed in this process (jax_platforms is only
+        # consulted at first backend init), so the CPU run MUST happen in a
+        # clean subprocess — with PYTHONPATH cleared so the axon
+        # sitecustomize doesn't dial the wedged tunnel again.
+        print(f"[bench] TPU run failed ({type(e).__name__}: {e}); "
+              "retrying on CPU in a subprocess", file=sys.stderr)
+        _cpu_subprocess_fallback(args)
 
     imgs_per_sec = s["records_per_sec"]
     if model == "resnet50":
@@ -69,15 +120,17 @@ def main(argv=None):
         vs_baseline = mfu / TARGET_MFU
         metric = "resnet50_synthetic_imagenet_train_throughput"
     else:
+        # No MFU north-star applies to fallback models — report an honest
+        # null rather than an unmeasured 1.0 (advisor finding, round 1).
         mfu = 0.0
-        vs_baseline = 1.0
+        vs_baseline = None
         metric = f"{model}_synthetic_train_throughput"
 
     print(json.dumps({
         "metric": metric,
         "value": round(imgs_per_sec, 2),
         "unit": "imgs/sec/chip",
-        "vs_baseline": round(vs_baseline, 4),
+        "vs_baseline": round(vs_baseline, 4) if vs_baseline is not None else None,
         "detail": {
             "device": str(getattr(dev, "device_kind", dev.platform)),
             "batch": batch, "iters": iters, "dtype": "bf16" if on_tpu else "f32",

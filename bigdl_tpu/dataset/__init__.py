@@ -6,3 +6,8 @@ from bigdl_tpu.dataset.transformer import (
 from bigdl_tpu.dataset.dataset import (
     AbstractDataSet, LocalDataSet, ShardedDataSet, TransformedDataSet, DataSet,
 )
+from bigdl_tpu.dataset.records import (
+    RecordFileDataSet, write_record_shards, encode_sample, decode_sample,
+)
+from bigdl_tpu.dataset.prefetch import prefetch, device_prefetch
+from bigdl_tpu.dataset import mnist, cifar, image
