@@ -37,55 +37,84 @@ def peak_flops(device) -> float:
     return PEAK_FLOPS["cpu"]
 
 
-def _cpu_subprocess_fallback(args):
-    """Re-exec this bench on the CPU platform in a clean subprocess.
+def main(argv=None):
+    """Watchdog orchestrator (imports NO jax itself).
 
-    Necessary because a committed (or error-cached) backend can't be swapped
-    in-process, and the env must skip the axon sitecustomize (PYTHONPATH="")
-    so the wedged tunnel isn't dialed again."""
+    The axon tunnel's observed failure modes are (a) an UNAVAILABLE error at
+    backend init (round-1 BENCH rc=1) and (b) a **hang inside `import jax` /
+    first device op** when the tunnel is wedged — a hang no in-process retry
+    can survive.  So the real bench runs in a child process under a
+    deadline; on timeout or failure the child is killed and a clean CPU
+    child (PYTHONPATH="" skips the axon sitecustomize, JAX_PLATFORMS=cpu)
+    produces a fallback metric line.  One JSON line is emitted in every
+    outcome.
+    """
     import os
     import subprocess
 
-    env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu")
-    cmd = [sys.executable, os.path.abspath(__file__), "--model", "lenet5"]
-    if args.batch:
-        cmd += ["--batch", str(args.batch)]
-    if args.iters:
-        cmd += ["--iters", str(args.iters)]
-    proc = subprocess.run(cmd, env=env, stdout=subprocess.PIPE,
-                          cwd=os.path.dirname(os.path.abspath(__file__)))
+    if os.environ.get("BIGDL_BENCH_CHILD"):
+        return bench_main(argv)
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    here = os.path.dirname(os.path.abspath(__file__))
+    me = os.path.abspath(__file__)
+    tpu_timeout = float(os.environ.get("BIGDL_BENCH_TPU_TIMEOUT", "420"))
+
+    env = dict(os.environ, BIGDL_BENCH_CHILD="1")
+    try:
+        proc = subprocess.run([sys.executable, me] + argv, env=env, cwd=here,
+                              stdout=subprocess.PIPE, timeout=tpu_timeout)
+        if proc.returncode == 0 and proc.stdout.strip():
+            sys.stdout.buffer.write(proc.stdout)
+            return
+        print(f"[bench] primary attempt rc={proc.returncode}; "
+              "falling back to CPU", file=sys.stderr)
+    except subprocess.TimeoutExpired:
+        print(f"[bench] primary attempt exceeded {tpu_timeout}s "
+              "(wedged tunnel?); falling back to CPU", file=sys.stderr)
+
+    env = dict(os.environ, BIGDL_BENCH_CHILD="1", PYTHONPATH="",
+               JAX_PLATFORMS="cpu")
+    fallback = []
+    skip = False
+    for a in argv:  # strip any --model/-m flag (+value); fallback is lenet5
+        if skip:
+            skip = False
+            continue
+        if a in ("--model", "-m"):
+            skip = True
+            continue
+        if a.startswith("--model="):
+            continue
+        fallback.append(a)
+    proc = subprocess.run(
+        [sys.executable, me, "--model", "lenet5"] + fallback, env=env,
+        cwd=here, stdout=subprocess.PIPE, timeout=600)
     sys.stdout.buffer.write(proc.stdout)
     sys.exit(proc.returncode)
 
 
-def init_backend(args, retries=3, backoff_s=10.0):
-    """Backend discovery that survives a flaky axon/TPU tunnel (round-1
-    failure mode: one transient UNAVAILABLE at jax.devices() cost the whole
-    round's evidence).  Retry with backoff, then degrade to the virtual CPU
-    platform via a clean subprocess (exits this process)."""
-    import jax
-
-    for attempt in range(1, retries + 1):
-        try:
-            return jax.devices()[0]
-        except Exception as e:  # jax.errors.JaxRuntimeError etc.
-            print(f"[bench] backend init attempt {attempt}/{retries} failed: "
-                  f"{type(e).__name__}: {e}", file=sys.stderr)
-            if attempt < retries:
-                time.sleep(backoff_s * attempt)
-    print("[bench] falling back to CPU platform (subprocess)", file=sys.stderr)
-    _cpu_subprocess_fallback(args)
-
-
-def main(argv=None):
+def bench_main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=None)
     p.add_argument("--iters", type=int, default=None)
     p.add_argument("--model", default="resnet50")
     args = p.parse_args(argv)
 
-    dev = init_backend(args)
-    on_tpu = "tpu" in dev.platform.lower()
+    import jax
+
+    dev = None
+    for attempt in range(1, 4):
+        try:
+            dev = jax.devices()[0]
+            break
+        except Exception as e:  # transient UNAVAILABLE from the tunnel
+            print(f"[bench] backend init attempt {attempt}/3 failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            if attempt == 3:
+                raise
+            time.sleep(10.0 * attempt)
+    on_tpu = "tpu" in dev.platform.lower() or dev.platform == "axon"
     batch = args.batch or (64 if on_tpu else 4)
     iters = args.iters or (20 if on_tpu else 2)
     model = args.model if on_tpu else "lenet5"
@@ -96,22 +125,9 @@ def main(argv=None):
 
     from bigdl_tpu.models.perf import run_perf
 
-    try:
-        s = run_perf(model, batch_size=batch, iterations=iters,
-                     dtype=jnp.bfloat16 if on_tpu else jnp.float32,
-                     log=lambda *a, **k: print(*a, file=sys.stderr, **k))
-    except Exception as e:
-        if not on_tpu:
-            raise
-        # TPU run died mid-bench (tunnel wedge): salvage the round with a
-        # CPU fallback number rather than emitting nothing.  The TPU backend
-        # is already committed in this process (jax_platforms is only
-        # consulted at first backend init), so the CPU run MUST happen in a
-        # clean subprocess — with PYTHONPATH cleared so the axon
-        # sitecustomize doesn't dial the wedged tunnel again.
-        print(f"[bench] TPU run failed ({type(e).__name__}: {e}); "
-              "retrying on CPU in a subprocess", file=sys.stderr)
-        _cpu_subprocess_fallback(args)
+    s = run_perf(model, batch_size=batch, iterations=iters,
+                 dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+                 log=lambda *a, **k: print(*a, file=sys.stderr, **k))
 
     imgs_per_sec = s["records_per_sec"]
     if model == "resnet50":

@@ -10,4 +10,4 @@ from bigdl_tpu.dataset.records import (
     RecordFileDataSet, write_record_shards, encode_sample, decode_sample,
 )
 from bigdl_tpu.dataset.prefetch import prefetch, device_prefetch
-from bigdl_tpu.dataset import mnist, cifar, image
+from bigdl_tpu.dataset import mnist, cifar, image, text

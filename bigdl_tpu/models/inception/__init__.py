@@ -1,0 +1,3 @@
+from bigdl_tpu.models.inception.model import (
+    InceptionV1, InceptionV1NoAuxClassifier,
+)

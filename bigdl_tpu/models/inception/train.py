@@ -1,0 +1,63 @@
+"""Inception-v1 ImageNet training main.
+
+Reference: models/inception/Train.scala — seq-file ImageNet pipeline,
+SGD with Poly(0.5) decay, optional warmup, checkpoint/resume via
+--model/--state.  Data here is the sharded-TFRecord layout written by
+``bigdl_tpu.models.utils.imagenet_record_generator``.
+
+Run: ``python -m bigdl_tpu.models.inception.train -f <records_dir>``.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset import RecordFileDataSet, image
+from bigdl_tpu.models import train_utils
+from bigdl_tpu.models.inception.model import InceptionV1NoAuxClassifier
+from bigdl_tpu.optim import SGD, Poly, Top1Accuracy, Top5Accuracy
+from bigdl_tpu.parallel import Engine
+
+
+def inception_train_pipeline(seed: int = 1):
+    """224 random crop + HFlip + normalize (≙ models/inception/ImageNet2012.scala
+    train transformer chain)."""
+    return (image.BytesToImg()
+            >> image.RandomResizedCrop(224, 224, seed=seed)
+            >> image.HFlip(0.5, seed=seed + 1)
+            >> image.ChannelNormalize((123.0, 117.0, 104.0), (1.0, 1.0, 1.0))
+            >> image.ImgToSample())
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    p = train_utils.train_parser(
+        "Inception-v1 on ImageNet records (≙ models/inception/Train.scala)",
+        default_batch=128, default_epochs=70, default_lr=0.065)
+    p.add_argument("--classes", type=int, default=1000)
+    args = p.parse_args(argv)
+    Engine.init()
+
+    records = RecordFileDataSet(args.folder)
+    train_ds = records.transform(inception_train_pipeline())
+    iters_per_epoch = max(1, records.size() // args.batch_size)
+
+    model, method = train_utils.resume(
+        args, lambda: InceptionV1NoAuxClassifier(args.classes),
+        lambda: SGD(learning_rate=args.learning_rate,
+                    learning_rate_decay=args.learning_rate_decay,
+                    weight_decay=args.weight_decay, momentum=args.momentum,
+                    learning_rate_schedule=Poly(
+                        0.5, args.max_epoch * iters_per_epoch)))
+
+    optimizer = train_utils.build_optimizer(
+        args, model, train_ds, nn.ClassNLLCriterion())
+    optimizer.set_optim_method(method)
+    train_utils.wire_common(optimizer, args, None,
+                            [Top1Accuracy(), Top5Accuracy()])
+    return optimizer.optimize()
+
+
+if __name__ == "__main__":
+    main()

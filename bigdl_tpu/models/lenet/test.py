@@ -1,0 +1,41 @@
+"""LeNet-5 MNIST evaluation main (≙ models/lenet/Test.scala).
+
+Run: ``python -m bigdl_tpu.models.lenet.test -f <mnist_dir> --model <snapshot>``.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from bigdl_tpu.dataset import mnist
+from bigdl_tpu.models import train_utils
+from bigdl_tpu.optim import Evaluator, Top1Accuracy
+from bigdl_tpu.parallel import Engine
+from bigdl_tpu.utils import file as bt_file
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    args = train_utils.test_parser(
+        "Evaluate LeNet-5 on MNIST (≙ models/lenet/Test.scala)").parse_args(argv)
+    Engine.init()
+
+    vi = mnist.load_images(_resolve(args.folder, "t10k-images-idx3-ubyte"))
+    vl = mnist.load_labels(_resolve(args.folder, "t10k-labels-idx1-ubyte"))
+    samples = mnist.to_samples(vi, vl, mnist.TEST_MEAN, mnist.TEST_STD)
+
+    model = bt_file.load_module(args.model)
+    results = Evaluator(model).test(samples, [Top1Accuracy()],
+                                    batch_size=args.batch_size)
+    for method, result in results:
+        print(f"{result} is {method}")
+    return results
+
+
+def _resolve(folder, name):
+    from bigdl_tpu.dataset.mnist import _resolve as r
+    return r(folder, name)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""LeNet-5 MNIST training main.
+
+Reference: models/lenet/Train.scala:23-80 — load idx files, build LeNet5 or
+resume snapshots, SGD with CLI hyperparams, everyEpoch validation +
+checkpointing.  Run: ``python -m bigdl_tpu.models.lenet.train -f <mnist_dir>``.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset import DataSet, mnist
+from bigdl_tpu.models.lenet.model import LeNet5
+from bigdl_tpu.models import train_utils
+from bigdl_tpu.optim import SGD, Top1Accuracy
+from bigdl_tpu.parallel import Engine
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    args = train_utils.train_parser(
+        "LeNet-5 on MNIST (≙ models/lenet/Train.scala)",
+        default_batch=128, default_epochs=5, default_lr=0.05).parse_args(argv)
+    Engine.init()
+
+    ti, tl, vi, vl = mnist.read_data_sets(args.folder)
+    train_samples = mnist.to_samples(ti, tl, mnist.TRAIN_MEAN, mnist.TRAIN_STD)
+    val_samples = mnist.to_samples(vi, vl, mnist.TEST_MEAN, mnist.TEST_STD)
+
+    model, method = train_utils.resume(
+        args, lambda: LeNet5(10),
+        lambda: SGD(learning_rate=args.learning_rate,
+                    learning_rate_decay=args.learning_rate_decay,
+                    weight_decay=args.weight_decay, momentum=args.momentum))
+
+    optimizer = train_utils.build_optimizer(
+        args, model, DataSet.array(train_samples), nn.ClassNLLCriterion())
+    optimizer.set_optim_method(method)
+    train_utils.wire_common(optimizer, args, val_samples, [Top1Accuracy()])
+    return optimizer.optimize()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+from bigdl_tpu.models.resnet.model import DatasetType, ResNet, ShortcutType

@@ -1,0 +1,37 @@
+"""ResNet evaluation main (≙ models/resnet/TestCIFAR10.scala / Test)."""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import numpy as np
+
+from bigdl_tpu.dataset import Sample, cifar, image
+from bigdl_tpu.models import train_utils
+from bigdl_tpu.models.resnet.train import CIFAR_MEAN, CIFAR_STD
+from bigdl_tpu.optim import Evaluator, Top1Accuracy
+from bigdl_tpu.parallel import Engine
+from bigdl_tpu.utils import file as bt_file
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    args = train_utils.test_parser("Evaluate ResNet on CIFAR-10").parse_args(argv)
+    Engine.init()
+    vi, vl = cifar.load_batch(os.path.join(args.folder, "test_batch.bin"))
+    pipe = (image.BytesToImg()
+            >> image.ChannelNormalize(CIFAR_MEAN, CIFAR_STD)
+            >> image.ImgToSample())
+    samples = list(pipe(iter([Sample(vi[i], np.array([vl[i] + 1.0], np.float32))
+                              for i in range(vi.shape[0])])))
+    model = bt_file.load_module(args.model)
+    results = Evaluator(model).test(samples, [Top1Accuracy()],
+                                    batch_size=args.batch_size)
+    for method, result in results:
+        print(f"{result} is {method}")
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,136 @@
+"""ResNet training main — CIFAR-10 (TrainCIFAR10.scala) and ImageNet
+record-file (TrainImageNet.scala) modes.
+
+Reference hyperparams: CIFAR — depth 20, SGD momentum 0.9 wd 1e-4, nesterov;
+ImageNet — warmup 5 epochs → maxLr, batch 8192 recipe
+(models/resnet/README.md:131-149).  ImageNet data is the sharded-TFRecord
+layout produced by ``bigdl_tpu.models.utils.imagenet_record_generator``
+(≙ ImageNetSeqFileGenerator.scala).
+
+Run: ``python -m bigdl_tpu.models.resnet.train -f <dir> --dataset cifar10``.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset import DataSet, RecordFileDataSet, Sample, cifar, image
+from bigdl_tpu.models import train_utils
+from bigdl_tpu.models.resnet.model import DatasetType, ResNet, ShortcutType
+from bigdl_tpu.optim import (
+    SGD, EpochSchedule, SequentialSchedule, Top1Accuracy, Top5Accuracy, Warmup,
+)
+from bigdl_tpu.parallel import Engine
+
+CIFAR_MEAN = (125.3, 123.0, 113.9)
+CIFAR_STD = (63.0, 62.1, 66.7)
+
+
+def imagenet_train_pipeline(seed: int = 1):
+    """RandomResizedCrop(224) + HFlip + ColorJitter + Lighting + normalize —
+    the reference's ImageNet train chain (models/resnet/TrainImageNet.scala
+    ImageNetDataSet: RandomAlterAspect/Crop/HFlip/ColorJitter/Lighting)."""
+    return (image.BytesToImg()
+            >> image.RandomResizedCrop(224, 224, seed=seed)
+            >> image.HFlip(0.5, seed=seed + 1)
+            >> image.ColorJitter(seed=seed + 2)
+            >> image.Lighting(seed=seed + 3)
+            >> image.ChannelNormalize((0.485 * 255, 0.456 * 255, 0.406 * 255),
+                                      (0.229 * 255, 0.224 * 255, 0.225 * 255))
+            >> image.ImgToSample())
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    p = train_utils.train_parser(
+        "ResNet (≙ models/resnet/TrainCIFAR10.scala / TrainImageNet.scala)",
+        default_batch=128, default_epochs=165, default_lr=0.1)
+    p.add_argument("--dataset", choices=["cifar10", "imagenet"], default="cifar10")
+    p.add_argument("--depth", type=int, default=None)
+    p.add_argument("--classes", type=int, default=None)
+    p.add_argument("--warmup-epochs", type=int, default=0,
+                   help="linear LR warmup epochs (ImageNet recipe)")
+    p.add_argument("--max-lr", type=float, default=None,
+                   help="peak LR after warmup (≙ TrainImageNet maxLr; "
+                        "--learning-rate is the warmup start)")
+    args = p.parse_args(argv)
+    if args.momentum == 0.0:
+        args.momentum = 0.9
+    if args.weight_decay == 0.0:
+        args.weight_decay = 1e-4
+    Engine.init()
+
+    if args.dataset == "cifar10":
+        depth = args.depth or 20
+        classes = args.classes or 10
+        ti, tl, vi, vl = cifar.read_data_sets(args.folder)
+        raw = [Sample(ti[i], np.array([tl[i] + 1.0], np.float32))
+               for i in range(ti.shape[0])]
+        pipe = (image.BytesToImg()
+                >> image.RandomCrop(32, 32, padding=4, seed=1)
+                >> image.HFlip(0.5, seed=2)
+                >> image.ChannelNormalize(CIFAR_MEAN, CIFAR_STD)
+                >> image.ImgToSample())
+        train_ds = DataSet.array(raw).transform(pipe)
+        eval_pipe = (image.BytesToImg()
+                     >> image.ChannelNormalize(CIFAR_MEAN, CIFAR_STD)
+                     >> image.ImgToSample())
+        val_samples = list(eval_pipe(iter(
+            [Sample(vi[i], np.array([vl[i] + 1.0], np.float32))
+             for i in range(vi.shape[0])])))
+        fresh = lambda: ResNet(classes, {
+            "depth": depth, "shortcutType": ShortcutType.A,
+            "dataSet": DatasetType.CIFAR10, "optnet": False})
+        criterion = nn.ClassNLLCriterion()
+        val_methods = [Top1Accuracy()]
+    else:
+        depth = args.depth or 50
+        classes = args.classes or 1000
+        records = RecordFileDataSet(args.folder)
+        train_ds = records.transform(imagenet_train_pipeline())
+        val_samples = None
+        fresh = lambda: ResNet(classes, {
+            "depth": depth, "shortcutType": ShortcutType.B,
+            "dataSet": DatasetType.ImageNet, "optnet": False})
+        # ImageNet head emits raw logits (TrainImageNet.scala uses
+        # CrossEntropyCriterion)
+        criterion = nn.CrossEntropyCriterion()
+        val_methods = [Top1Accuracy(), Top5Accuracy()]
+
+    schedule = None
+    if args.warmup_epochs:
+        # ≙ TrainImageNet.scala:106-124 EpochDecayWithWarmUp: ramp
+        # baseLr→maxLr over warmup iterations, then step-decay 0.1x at
+        # epochs 30/60/80 from maxLr (imageNetDecay)
+        iters_per_epoch = max(1, train_ds.size() // args.batch_size)
+        warmup_iters = args.warmup_epochs * iters_per_epoch
+        max_lr = args.max_lr or args.learning_rate
+        delta = (max_lr - args.learning_rate) / max(1, warmup_iters)
+        w = args.warmup_epochs
+        schedule = (SequentialSchedule(iters_per_epoch)
+                    .add(Warmup(delta), warmup_iters)
+                    .add(EpochSchedule([
+                        (1, 30 - w, max_lr),
+                        (31 - w, 60 - w, max_lr * 0.1),
+                        (61 - w, 80 - w, max_lr * 0.01),
+                        (81 - w, 10 ** 9, max_lr * 1e-3)]), 10 ** 9))
+
+    model, method = train_utils.resume(
+        args, fresh,
+        lambda: SGD(learning_rate=args.learning_rate,
+                    learning_rate_decay=args.learning_rate_decay,
+                    weight_decay=args.weight_decay, momentum=args.momentum,
+                    dampening=0.0, nesterov=True,
+                    learning_rate_schedule=schedule))
+
+    optimizer = train_utils.build_optimizer(args, model, train_ds, criterion)
+    optimizer.set_optim_method(method)
+    train_utils.wire_common(optimizer, args, val_samples, val_methods)
+    return optimizer.optimize()
+
+
+if __name__ == "__main__":
+    main()

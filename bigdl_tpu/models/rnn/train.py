@@ -1,0 +1,75 @@
+"""SimpleRNN word-level language-model training main.
+
+Reference: models/rnn/Train.scala — read ``train.txt``, tokenize with
+sentence markers, build a Dictionary(vocab_size), train SimpleRNN on
+one-hot sequences with TimeDistributedCriterion(CrossEntropy), per-sentence
+padding.  Run: ``python -m bigdl_tpu.models.rnn.train -f <dir_with_train.txt>``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset import DataSet
+from bigdl_tpu.dataset.text import (
+    Dictionary, LabeledSentenceToSample, SentenceSplitter, SentenceTokenizer,
+    TextToLabeledSentence,
+)
+from bigdl_tpu.models import train_utils
+from bigdl_tpu.models.rnn.model import SimpleRNN
+from bigdl_tpu.optim import SGD, Loss
+from bigdl_tpu.parallel import Engine
+
+
+def build_samples(folder: str, vocab_size: int, seq_len: int,
+                  filename: str = "train.txt"):
+    """File → tokenized sentences → Dictionary → padded one-hot Samples."""
+    path = os.path.join(folder, filename)
+    with open(path) as f:
+        text = f.read()
+    tok = SentenceTokenizer()
+    sentences = list(tok(SentenceSplitter()(iter([text]))))
+    dictionary = Dictionary(sentences, vocab_size)
+    pipe = (TextToLabeledSentence(dictionary)
+            >> LabeledSentenceToSample(dictionary.vocab_size(),
+                                       fixed_length=seq_len))
+    return list(pipe(iter(sentences))), dictionary
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    p = train_utils.train_parser(
+        "SimpleRNN word LM (≙ models/rnn/Train.scala)",
+        default_batch=8, default_epochs=30, default_lr=0.1)
+    p.add_argument("--vocab-size", type=int, default=4000)
+    p.add_argument("--hidden-size", type=int, default=40)
+    p.add_argument("--seq-len", type=int, default=32,
+                   help="static padded sentence length (XLA single shape)")
+    args = p.parse_args(argv)
+    Engine.init()
+
+    samples, dictionary = build_samples(args.folder, args.vocab_size, args.seq_len)
+    if args.checkpoint:
+        dictionary.save(args.checkpoint)
+    vocab = dictionary.vocab_size()
+
+    model, method = train_utils.resume(
+        args, lambda: SimpleRNN(vocab, args.hidden_size, vocab),
+        lambda: SGD(learning_rate=args.learning_rate,
+                    learning_rate_decay=args.learning_rate_decay,
+                    weight_decay=args.weight_decay, momentum=args.momentum))
+
+    criterion = nn.TimeDistributedCriterion(
+        nn.CrossEntropyCriterion(), size_average=True)
+    optimizer = train_utils.build_optimizer(
+        args, model, DataSet.array(samples), criterion)
+    optimizer.set_optim_method(method)
+    train_utils.wire_common(optimizer, args, samples[:min(len(samples), 64)],
+                            [Loss(criterion)])
+    return optimizer.optimize()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""Shared CLI plumbing for the model zoo Train/Test mains.
+
+Reference: each model ships a scopt options parser in ``Utils.scala``
+(e.g. models/lenet/Utils.scala TrainParams/TestParams, models/resnet/
+Utils.scala) and a spark-submit main (models/lenet/Train.scala:23-80).
+TPU-native: argparse CLIs runnable as ``python -m bigdl_tpu.models.<m>.train``;
+the spark-submit cluster plumbing collapses into Engine.init + an optional
+``--distributed`` data-parallel mesh over the local devices.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+from typing import Optional, Tuple
+
+from bigdl_tpu.optim.optim_method import OptimMethod
+from bigdl_tpu.nn.module import Module
+
+
+def train_parser(description: str, default_batch: int = 128,
+                 default_epochs: int = 5, default_lr: float = 0.01) -> argparse.ArgumentParser:
+    """Common TrainParams flags (≙ models/*/Utils.scala trainParser)."""
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument("-f", "--folder", default="./", help="data folder")
+    p.add_argument("--model", default=None, help="model snapshot to resume from")
+    p.add_argument("--state", default=None, help="optim-state snapshot to resume from")
+    p.add_argument("--checkpoint", default=None, help="checkpoint dir")
+    p.add_argument("--resume", action="store_true",
+                   help="auto-resume from the newest snapshot in --checkpoint")
+    p.add_argument("-b", "--batch-size", type=int, default=default_batch)
+    p.add_argument("-e", "--max-epoch", type=int, default=default_epochs)
+    p.add_argument("-r", "--learning-rate", type=float, default=default_lr)
+    p.add_argument("--learning-rate-decay", type=float, default=0.0)
+    p.add_argument("--weight-decay", type=float, default=0.0)
+    p.add_argument("--momentum", type=float, default=0.0)
+    p.add_argument("--max-iteration", type=int, default=None,
+                   help="stop by iteration count instead of epochs")
+    p.add_argument("--distributed", action="store_true",
+                   help="data-parallel DistriOptimizer over all local devices")
+    p.add_argument("--summary-dir", default=None, help="tensorboard log dir")
+    p.add_argument("--overwrite", action="store_true")
+    return p
+
+
+def test_parser(description: str, default_batch: int = 128) -> argparse.ArgumentParser:
+    """Common TestParams flags (≙ models/*/Utils.scala testParser)."""
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument("-f", "--folder", default="./")
+    p.add_argument("--model", required=True, help="model snapshot to evaluate")
+    p.add_argument("-b", "--batch-size", type=int, default=default_batch)
+    return p
+
+
+def resume(args, fresh_model, fresh_method) -> Tuple[Module, OptimMethod]:
+    """--model/--state explicit snapshots, or --resume scanning --checkpoint
+    (≙ Train.scala's ``Module.load(param.modelSnapshot)`` arms +
+    DistriOptimizer.getLatestFile)."""
+    from bigdl_tpu.optim.optimizer import load_latest_checkpoint
+    from bigdl_tpu.utils import file as bt_file
+
+    model: Optional[Module] = None
+    method: Optional[OptimMethod] = None
+    if args.resume and args.checkpoint:
+        model, method, tag = load_latest_checkpoint(args.checkpoint)
+        if model is not None:
+            logging.getLogger("bigdl_tpu").info(
+                "resumed from %s (iteration %s)", args.checkpoint, tag)
+    if model is None and args.model:
+        model = bt_file.load_module(args.model)
+    if method is None and args.state:
+        method = OptimMethod.load(args.state)
+    return (model if model is not None else fresh_model(),
+            method if method is not None else fresh_method())
+
+
+def build_optimizer(args, model, dataset, criterion):
+    """Local loop by default; ``--distributed`` runs the production SPMD
+    DistriOptimizer over a data mesh of every addressable device."""
+    from bigdl_tpu.optim import Trigger
+    from bigdl_tpu.optim.optimizer import LocalOptimizer
+
+    end = (Trigger.max_iteration(args.max_iteration)
+           if args.max_iteration else Trigger.max_epoch(args.max_epoch))
+    if args.distributed:
+        import jax
+
+        from bigdl_tpu.parallel import DistriOptimizer, Engine
+
+        mesh = Engine.create_mesh([("data", len(jax.devices()))])
+        return DistriOptimizer(model=model, dataset=dataset, criterion=criterion,
+                               batch_size=args.batch_size, end_when=end,
+                               mesh=mesh, parameter_sync="sharded")
+    return LocalOptimizer(model=model, dataset=dataset, criterion=criterion,
+                          batch_size=args.batch_size, end_when=end)
+
+
+def wire_common(optimizer, args, val_samples=None, val_methods=None):
+    """Checkpoint trigger, summaries, validation — the shared tail of every
+    Train.scala main."""
+    from bigdl_tpu.optim import Trigger
+
+    if args.checkpoint:
+        optimizer.set_checkpoint(args.checkpoint, Trigger.every_epoch(),
+                                 is_overwrite=args.overwrite)
+    if val_samples is not None and val_methods:
+        optimizer.set_validation(Trigger.every_epoch(), val_samples, val_methods,
+                                 batch_size=args.batch_size)
+    if args.summary_dir:
+        from bigdl_tpu.visualization import TrainSummary, ValidationSummary
+
+        app = os.path.basename(args.summary_dir.rstrip("/")) or "train"
+        optimizer.set_train_summary(TrainSummary(args.summary_dir, app))
+        optimizer.set_validation_summary(ValidationSummary(args.summary_dir, app))
+    return optimizer

@@ -1,0 +1,1 @@
+from bigdl_tpu.models.vgg.model import Vgg16, VggForCifar10

@@ -1,0 +1,31 @@
+"""VGG CIFAR-10 evaluation main (≙ models/vgg/Test.scala)."""
+
+from __future__ import annotations
+
+import logging
+
+from bigdl_tpu.dataset import cifar
+from bigdl_tpu.models import train_utils
+from bigdl_tpu.models.vgg.train import cifar_eval_pipeline, raw_samples
+from bigdl_tpu.optim import Evaluator, Top1Accuracy
+from bigdl_tpu.parallel import Engine
+from bigdl_tpu.utils import file as bt_file
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    args = train_utils.test_parser("Evaluate VGG on CIFAR-10").parse_args(argv)
+    Engine.init()
+    import os
+    vi, vl = cifar.load_batch(os.path.join(args.folder, "test_batch.bin"))
+    samples = list(cifar_eval_pipeline()(iter(raw_samples(vi, vl))))
+    model = bt_file.load_module(args.model)
+    results = Evaluator(model).test(samples, [Top1Accuracy()],
+                                    batch_size=args.batch_size)
+    for method, result in results:
+        print(f"{result} is {method}")
+    return results
+
+
+if __name__ == "__main__":
+    main()
