@@ -74,3 +74,4 @@ from bigdl_tpu.nn.criterion import (
     MultiCriterion, TimeDistributedCriterion, PGCriterion,
     ActivityRegularization, SmoothL1CriterionWithWeights,
 )
+from bigdl_tpu.nn import ops  # TF-style Operation modules (nn/ops/, SURVEY.md §2.3)

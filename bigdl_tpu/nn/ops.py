@@ -1,0 +1,534 @@
+"""TF-style operation modules (the ``nn/ops`` layer).
+
+Reference: nn/ops/ — 71 files, each an ``Operation`` module (forward-only,
+`nn/ops/Operation.scala`: backward is an error) so imported TF graphs
+execute natively.  This build keeps the same contract: each op is a Module
+whose ``forward`` is jax.numpy/lax — under jit they fuse into the
+surrounding program; ``backward`` raises (use autodiff over ``pure_apply``
+for gradients instead).
+
+Inputs follow the reference convention: multi-input ops take a Table/list.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils.table import Table
+
+
+class Operation(Module):
+    """Forward-only module (≙ nn/ops/Operation.scala: gradInput undefined)."""
+
+    def backward(self, input, grad_output):
+        raise RuntimeError(
+            f"{type(self).__name__} is a forward-only Operation "
+            "(reference: nn/ops/Operation.scala); differentiate through "
+            "pure_apply instead")
+
+    update_grad_input = backward
+
+    @staticmethod
+    def _pair(input):
+        if isinstance(input, Table):
+            return input[1], input[2]
+        a, b = input
+        return a, b
+
+
+class ModuleToOperation(Operation):
+    """Wrap any Module as a forward-only Operation
+    (≙ nn/ops/ModuleToOperation.scala)."""
+
+    def __init__(self, module: Module):
+        super().__init__()
+        self.module = module
+
+    def forward(self, input):
+        return self.module.forward(input)
+
+
+def _unary(name, fn, doc):
+    cls = type(name, (Operation,), {
+        "forward": lambda self, x, _fn=fn: _fn(jnp.asarray(x)),
+        "__doc__": doc,
+    })
+    return cls
+
+
+Ceil = _unary("Ceil", jnp.ceil, "≙ nn/ops/Ceil.scala")
+Floor = _unary("Floor", jnp.floor, "≙ nn/ops/Floor.scala")
+Round = _unary("Round", jnp.round, "≙ nn/ops/Round.scala")
+Rint = _unary("Rint", jnp.rint, "≙ nn/ops/Rint.scala")
+Exp = _unary("Exp", jnp.exp, "≙ nn/ops/Exp.scala")
+Expm1 = _unary("Expm1", jnp.expm1, "≙ nn/ops/Expm1.scala")
+Inv = _unary("Inv", lambda x: 1.0 / x, "≙ nn/ops/Inv.scala (reciprocal)")
+Sign = _unary("Sign", jnp.sign, "≙ nn/ops/Sign.scala")
+Erf = _unary("Erf", jax.scipy.special.erf, "≙ nn/ops/Erf.scala")
+Erfc = _unary("Erfc", jax.scipy.special.erfc, "≙ nn/ops/Erfc.scala")
+Lgamma = _unary("Lgamma", jax.scipy.special.gammaln, "≙ nn/ops/Lgamma.scala")
+Digamma = _unary("Digamma", jax.scipy.special.digamma, "≙ nn/ops/Digamma.scala")
+IsFinite = _unary("IsFinite", jnp.isfinite, "≙ nn/ops/IsFinite.scala")
+IsInf = _unary("IsInf", jnp.isinf, "≙ nn/ops/IsInf.scala")
+IsNan = _unary("IsNan", jnp.isnan, "≙ nn/ops/IsNan.scala")
+LogicalNot = _unary("LogicalNot", jnp.logical_not, "≙ nn/ops/LogicalNot.scala")
+
+
+class Cast(Operation):
+    """≙ nn/ops/Cast.scala."""
+
+    def __init__(self, dtype):
+        super().__init__()
+        self.dtype = np.dtype(dtype) if not isinstance(dtype, str) else np.dtype(dtype)
+
+    def forward(self, x):
+        return jnp.asarray(x).astype(self.dtype)
+
+
+def _binary(name, fn, doc):
+    def forward(self, input, _fn=fn):
+        a, b = self._pair(input)
+        return _fn(jnp.asarray(a), jnp.asarray(b))
+
+    return type(name, (Operation,), {"forward": forward, "__doc__": doc})
+
+
+Pow = _binary("Pow", jnp.power, "≙ nn/ops/Pow.scala")
+FloorDiv = _binary("FloorDiv", jnp.floor_divide, "≙ nn/ops/FloorDiv.scala")
+FloorMod = _binary("FloorMod", jnp.mod, "≙ nn/ops/FloorMod.scala")
+Mod = _binary("Mod", jnp.mod, "≙ nn/ops/Mod.scala")
+TruncateDiv = _binary(
+    "TruncateDiv", lambda a, b: jnp.trunc(a / b).astype(a.dtype),
+    "≙ nn/ops/TruncateDiv.scala")
+SquaredDifference = _binary("SquaredDifference", lambda a, b: (a - b) ** 2,
+                            "≙ nn/ops/SquaredDifference.scala")
+Maximum = _binary("Maximum", jnp.maximum, "≙ nn/ops/Maximum.scala")
+Minimum = _binary("Minimum", jnp.minimum, "≙ nn/ops/Minimum.scala")
+Equal = _binary("Equal", lambda a, b: a == b, "≙ nn/ops/Equal.scala")
+NotEqual = _binary("NotEqual", lambda a, b: a != b, "≙ nn/ops/NotEqual.scala")
+Greater = _binary("Greater", lambda a, b: a > b, "≙ nn/ops/Greater.scala")
+GreaterEqual = _binary("GreaterEqual", lambda a, b: a >= b,
+                       "≙ nn/ops/GreaterEqual.scala")
+Less = _binary("Less", lambda a, b: a < b, "≙ nn/ops/Less.scala")
+LessEqual = _binary("LessEqual", lambda a, b: a <= b, "≙ nn/ops/LessEqual.scala")
+LogicalAnd = _binary("LogicalAnd", jnp.logical_and, "≙ nn/ops/LogicalAnd.scala")
+LogicalOr = _binary("LogicalOr", jnp.logical_or, "≙ nn/ops/LogicalOr.scala")
+
+
+class ApproximateEqual(Operation):
+    """≙ nn/ops/ApproximateEqual.scala."""
+
+    def __init__(self, tolerance: float = 1e-5):
+        super().__init__()
+        self.tolerance = tolerance
+
+    def forward(self, input):
+        a, b = self._pair(input)
+        return jnp.abs(jnp.asarray(a) - jnp.asarray(b)) < self.tolerance
+
+
+class _Reduce(Operation):
+    def __init__(self, axis: Optional[Sequence[int]] = None, keep_dims: bool = False):
+        super().__init__()
+        self.axis = tuple(axis) if axis is not None else None
+        self.keep_dims = keep_dims
+
+    def forward(self, x):
+        return self._red(jnp.asarray(x), axis=self.axis, keepdims=self.keep_dims)
+
+
+class All(_Reduce):
+    """≙ nn/ops/All.scala."""
+    _red = staticmethod(jnp.all)
+
+
+class Any(_Reduce):
+    """≙ nn/ops/Any.scala."""
+    _red = staticmethod(jnp.any)
+
+
+class Max(_Reduce):
+    """≙ nn/ops/Max.scala."""
+    _red = staticmethod(jnp.max)
+
+
+class Prod(_Reduce):
+    """≙ nn/ops/Prod.scala."""
+    _red = staticmethod(jnp.prod)
+
+
+class Sum(_Reduce):
+    """≙ nn/ops/Sum.scala."""
+    _red = staticmethod(jnp.sum)
+
+
+class ArgMax(Operation):
+    """≙ nn/ops/ArgMax.scala — axis comes with the input (TF style) or at
+    construction."""
+
+    def __init__(self, axis: Optional[int] = None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, input):
+        if self.axis is not None:
+            return jnp.argmax(jnp.asarray(input), axis=self.axis)
+        x, axis = self._pair(input)
+        return jnp.argmax(jnp.asarray(x), axis=int(np.asarray(axis)))
+
+
+class BatchMatMul(Operation):
+    """≙ nn/ops/BatchMatMul.scala (adj_x/adj_y transposes)."""
+
+    def __init__(self, adj_x: bool = False, adj_y: bool = False):
+        super().__init__()
+        self.adj_x, self.adj_y = adj_x, adj_y
+
+    def forward(self, input):
+        a, b = self._pair(input)
+        a, b = jnp.asarray(a), jnp.asarray(b)
+        if self.adj_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.adj_y:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+
+
+class Gather(Operation):
+    """≙ nn/ops/Gather.scala (axis 0, TF Gather semantics)."""
+
+    def __init__(self, axis: int = 0):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, input):
+        params, indices = self._pair(input)
+        return jnp.take(jnp.asarray(params),
+                        jnp.asarray(indices).astype(jnp.int32), axis=self.axis)
+
+
+class OneHot(Operation):
+    """≙ nn/ops/OneHot.scala."""
+
+    def __init__(self, depth: int, on_value: float = 1.0, off_value: float = 0.0,
+                 axis: int = -1):
+        super().__init__()
+        self.depth, self.on, self.off, self.axis = depth, on_value, off_value, axis
+
+    def forward(self, indices):
+        oh = jax.nn.one_hot(jnp.asarray(indices).astype(jnp.int32),
+                            self.depth, axis=self.axis)
+        return oh * (self.on - self.off) + self.off
+
+
+class TopK(Operation):
+    """≙ nn/ops/TopK.scala — returns Table(values, indices)."""
+
+    def __init__(self, k: int, sorted: bool = True):
+        super().__init__()
+        self.k = k
+
+    def forward(self, x):
+        values, indices = jax.lax.top_k(jnp.asarray(x), self.k)
+        return Table(values, indices)
+
+
+class InTopK(Operation):
+    """≙ nn/ops/InTopK.scala — predictions (N, C), 0-based targets (N,)."""
+
+    def __init__(self, k: int):
+        super().__init__()
+        self.k = k
+
+    def forward(self, input):
+        preds, targets = self._pair(input)
+        preds = jnp.asarray(preds)
+        targets = jnp.asarray(targets).astype(jnp.int32)
+        target_scores = jnp.take_along_axis(preds, targets[:, None], axis=1)[:, 0]
+        rank = jnp.sum(preds > target_scores[:, None], axis=1)
+        return rank < self.k
+
+
+class Rank(Operation):
+    """≙ nn/ops/Rank.scala."""
+
+    def forward(self, x):
+        return jnp.asarray(jnp.asarray(x).ndim, jnp.int32)
+
+
+class Shape(Operation):
+    """Static shape as an int32 vector (≙ nn/tf/Shape)."""
+
+    def forward(self, x):
+        return jnp.asarray(jnp.asarray(x).shape, jnp.int32)
+
+
+class Select(Operation):
+    """≙ nn/ops/Select.scala: (condition, then, else) elementwise pick."""
+
+    def forward(self, input):
+        if isinstance(input, Table):
+            c, t, e = input[1], input[2], input[3]
+        else:
+            c, t, e = input
+        return jnp.where(jnp.asarray(c).astype(bool), jnp.asarray(t), jnp.asarray(e))
+
+
+class Slice(Operation):
+    """≙ nn/ops/Slice.scala (begin/size, -1 size = to end)."""
+
+    def __init__(self, begin: Sequence[int], size: Sequence[int]):
+        super().__init__()
+        self.begin, self.size = list(begin), list(size)
+
+    def forward(self, x):
+        x = jnp.asarray(x)
+        idx = tuple(
+            slice(b, x.shape[d] if s == -1 else b + s)
+            for d, (b, s) in enumerate(zip(self.begin, self.size)))
+        return x[idx]
+
+
+class Tile(Operation):
+    """≙ nn/ops/Tile.scala."""
+
+    def __init__(self, multiples: Optional[Sequence[int]] = None):
+        super().__init__()
+        self.multiples = multiples
+
+    def forward(self, input):
+        if self.multiples is not None:
+            return jnp.tile(jnp.asarray(input), self.multiples)
+        x, m = self._pair(input)
+        return jnp.tile(jnp.asarray(x), tuple(int(v) for v in np.asarray(m)))
+
+
+class Pad(Operation):
+    """≙ nn/ops/Pad.scala (constant padding)."""
+
+    def __init__(self, paddings: Sequence[Sequence[int]], value: float = 0.0):
+        super().__init__()
+        self.paddings = tuple((int(a), int(b)) for a, b in paddings)
+        self.value = value
+
+    def forward(self, x):
+        return jnp.pad(jnp.asarray(x), self.paddings, constant_values=self.value)
+
+
+class RangeOps(Operation):
+    """≙ nn/ops/RangeOps.scala."""
+
+    def __init__(self, start, limit, delta=1):
+        super().__init__()
+        self.start, self.limit, self.delta = start, limit, delta
+
+    def forward(self, input=None):
+        return jnp.arange(self.start, self.limit, self.delta)
+
+
+class L2Loss(Operation):
+    """sum(x^2)/2 (≙ nn/ops/L2Loss.scala)."""
+
+    def forward(self, x):
+        x = jnp.asarray(x)
+        return jnp.sum(x * x) / 2
+
+
+class SegmentSum(Operation):
+    """≙ nn/ops/SegmentSum.scala; segment ids must be sorted, num_segments
+    static for XLA."""
+
+    def __init__(self, num_segments: Optional[int] = None):
+        super().__init__()
+        self.num_segments = num_segments
+
+    def forward(self, input):
+        x, ids = self._pair(input)
+        ids = jnp.asarray(ids).astype(jnp.int32)
+        n = self.num_segments or int(np.asarray(ids).max()) + 1
+        return jax.ops.segment_sum(jnp.asarray(x), ids, num_segments=n)
+
+
+class CrossEntropy(Operation):
+    """Softmax cross-entropy per row on (logits, 0-based labels)
+    (≙ nn/ops/CrossEntropy.scala)."""
+
+    def forward(self, input):
+        logits, labels = self._pair(input)
+        logp = jax.nn.log_softmax(jnp.asarray(logits), axis=-1)
+        labels = jnp.asarray(labels).astype(jnp.int32)
+        return -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+
+
+class RandomUniform(Operation):
+    """≙ nn/ops/RandomUniform.scala (stateless per-call draw from the global
+    stream)."""
+
+    def __init__(self, minval: float = 0.0, maxval: float = 1.0, seed=None):
+        super().__init__()
+        self.minval, self.maxval = minval, maxval
+        self.seed = seed
+
+    def forward(self, shape):
+        from bigdl_tpu.utils import random as bt_random
+
+        key = (jax.random.PRNGKey(self.seed) if self.seed is not None
+               else bt_random.next_key())
+        shape = tuple(int(s) for s in np.asarray(shape).reshape(-1))
+        return jax.random.uniform(key, shape, jnp.float32, self.minval, self.maxval)
+
+
+class TruncatedNormal(Operation):
+    """≙ nn/ops/TruncatedNormal.scala (±2σ truncation)."""
+
+    def __init__(self, mean: float = 0.0, stddev: float = 1.0, seed=None):
+        super().__init__()
+        self.mean, self.stddev, self.seed = mean, stddev, seed
+
+    def forward(self, shape):
+        from bigdl_tpu.utils import random as bt_random
+
+        key = (jax.random.PRNGKey(self.seed) if self.seed is not None
+               else bt_random.next_key())
+        shape = tuple(int(s) for s in np.asarray(shape).reshape(-1))
+        return self.mean + self.stddev * jax.random.truncated_normal(
+            key, -2.0, 2.0, shape, jnp.float32)
+
+
+class ResizeBilinearOp(Operation):
+    """NHWC bilinear resize (≙ nn/ops/ResizeBilinear.scala)."""
+
+    def __init__(self, out_height: int, out_width: int,
+                 align_corners: bool = False):
+        super().__init__()
+        self.oh, self.ow = out_height, out_width
+        self.align = align_corners
+
+    def forward(self, x):
+        x = jnp.asarray(x)
+        n, h, w, c = x.shape
+        method = "linear"
+        if self.align and h > 1 and w > 1:
+            # align_corners: endpoints map to endpoints
+            ys = jnp.linspace(0, h - 1, self.oh)
+            xs = jnp.linspace(0, w - 1, self.ow)
+            y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 2)
+            x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 2)
+            wy = (ys - y0)[None, :, None, None]
+            wx = (xs - x0)[None, None, :, None]
+            g = lambda yy, xx: x[:, yy][:, :, xx]
+            top = g(y0, x0) * (1 - wx) + g(y0, x0 + 1) * wx
+            bot = g(y0 + 1, x0) * (1 - wx) + g(y0 + 1, x0 + 1) * wx
+            return top * (1 - wy) + bot * wy
+        return jax.image.resize(x, (n, self.oh, self.ow, c), method)
+
+
+# ------------------------------------------------------------ feature columns
+
+def _fnv1a(data: bytes) -> int:
+    """Deterministic 64-bit FNV-1a (the reference relies on Scala
+    MurmurHash; any fixed hash works as long as it is stable across runs)."""
+    h = 0xCBF29CE484222325
+    for b in data:
+        h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+class BucketizedCol(Operation):
+    """Continuous → bucket index by boundaries (≙ nn/ops/BucketizedCol.scala)."""
+
+    def __init__(self, boundaries: Sequence[float]):
+        super().__init__()
+        self.boundaries = jnp.asarray(list(boundaries), jnp.float32)
+
+    def forward(self, x):
+        return jnp.searchsorted(self.boundaries, jnp.asarray(x), side="right")
+
+
+class CategoricalColHashBucket(Operation):
+    """String/int category → stable hash bucket
+    (≙ nn/ops/CategoricalColHashBucket.scala). Host-side op (strings are
+    not XLA values)."""
+
+    def __init__(self, hash_bucket_size: int):
+        super().__init__()
+        self.hash_bucket_size = hash_bucket_size
+
+    def forward(self, values):
+        out = [
+            _fnv1a(str(v).encode()) % self.hash_bucket_size
+            for v in np.asarray(values).reshape(-1)
+        ]
+        return jnp.asarray(out, jnp.int32).reshape(np.asarray(values).shape)
+
+
+class IndicatorCol(Operation):
+    """Category indices → multi-hot vector (≙ nn/ops/IndicatorCol.scala)."""
+
+    def __init__(self, feat_len: int):
+        super().__init__()
+        self.feat_len = feat_len
+
+    def forward(self, indices):
+        oh = jax.nn.one_hot(jnp.asarray(indices).astype(jnp.int32), self.feat_len)
+        return jnp.clip(oh.sum(axis=-2), 0, 1) if oh.ndim > 2 else oh
+
+
+class CrossCol(Operation):
+    """Hash-crossed categorical columns (≙ nn/ops/CrossCol.scala).
+    Host-side: takes a list of equal-length string/int columns."""
+
+    def __init__(self, hash_bucket_size: int):
+        super().__init__()
+        self.hash_bucket_size = hash_bucket_size
+
+    def forward(self, columns):
+        cols = [np.asarray(c).reshape(-1) for c in
+                (columns if isinstance(columns, (list, tuple)) else list(columns))]
+        n = len(cols[0])
+        out = []
+        for i in range(n):
+            key = "_X_".join(str(c[i]) for c in cols)
+            out.append(_fnv1a(key.encode()) % self.hash_bucket_size)
+        return jnp.asarray(out, jnp.int32)
+
+
+class Kv2Tensor(Operation):
+    """'k:v' string pairs → dense vector (≙ nn/ops/Kv2Tensor.scala).
+    Host-side string op."""
+
+    def __init__(self, kv_delimiter: str = ",", item_delimiter: str = ":",
+                 feat_len: int = 0):
+        super().__init__()
+        self.kv_delimiter = kv_delimiter
+        self.item_delimiter = item_delimiter
+        self.feat_len = feat_len
+
+    def forward(self, rows):
+        rows = np.asarray(rows).reshape(-1)
+        out = np.zeros((len(rows), self.feat_len), np.float32)
+        for i, row in enumerate(rows):
+            for item in str(row).split(self.kv_delimiter):
+                if not item:
+                    continue
+                k, v = item.split(self.item_delimiter)
+                out[i, int(k)] = float(v)
+        return jnp.asarray(out)
+
+
+class MkString(Operation):
+    """Sparse row → joined string (≙ nn/ops/MkString.scala). Host-side."""
+
+    def __init__(self, str_delimiter: str = ","):
+        super().__init__()
+        self.str_delimiter = str_delimiter
+
+    def forward(self, rows):
+        arr = np.asarray(rows)
+        return np.asarray([self.str_delimiter.join(str(v) for v in row)
+                           for row in arr.reshape(arr.shape[0], -1)])

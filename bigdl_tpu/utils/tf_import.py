@@ -16,9 +16,13 @@ from __future__ import annotations
 import struct
 from typing import Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
+#: ops whose module emits a Table of outputs; consumers reference "name:i"
+_MULTI_OUTPUT_OPS = {"Split", "SplitV", "Unpack", "TopK", "TopKV2"}
 
 from bigdl_tpu import nn
 from bigdl_tpu.nn.module import Module
@@ -37,21 +41,28 @@ def _parse_tensor(tensor_bytes: bytes) -> np.ndarray:
         shape_msg = pw.decode(msg[2][0])
         for dim in shape_msg.get(2, []):
             shape.append(pw.as_signed(pw.decode(dim).get(1, [0])[0]))
+    # TensorProto field numbers (tensorflow/core/framework/tensor.proto):
+    # 4 tensor_content, 5 float_val, 6 double_val, 7 int_val, 9 int64_val,
+    # 10 bool_val.  A tensor with NO value field is all default (zeros).
     if 4 in msg and msg[4][0]:  # tensor_content: raw bytes
         arr = np.frombuffer(msg[4][0], dtype=dtype).copy()
     elif 5 in msg:  # float_val
         vals = []
         for v in msg[5]:
             vals.extend(pw.packed_floats(v) if isinstance(v, bytes)
-                        else [struct.unpack("<f", struct.pack("<I", v))[0]])
+                        else [struct.unpack("<f", v if isinstance(v, bytes)
+                                            else struct.pack("<I", v))[0]])
         arr = np.asarray(vals, np.float32)
-    elif 6 in msg:  # int_val
-        arr = np.asarray(pw.repeated_varints(msg[6]), np.int32)
+    elif 7 in msg:  # int_val
+        arr = np.asarray([pw.as_signed(v) for v in pw.repeated_varints(msg[7])],
+                         np.int32)
     elif 9 in msg:  # int64_val
         arr = np.asarray([pw.as_signed(v) for v in pw.repeated_varints(msg[9])],
                          np.int64)
+    elif 10 in msg:  # bool_val
+        arr = np.asarray(pw.repeated_varints(msg[10]), bool)
     else:
-        arr = np.zeros(shape or (0,), dtype)
+        arr = np.zeros(tuple(shape), dtype)
     if shape:
         if arr.size == 1 and int(np.prod(shape)) > 1:
             arr = np.full(shape, arr.reshape(-1)[0])
@@ -85,6 +96,23 @@ class _TFNode:
     def attr_b(self, key: str, default=False) -> bool:
         a = self.attr.get(key, {})
         return bool(a[5][0]) if 5 in a else default
+
+    def attr_f(self, key: str, default: float = 0.0) -> float:
+        a = self.attr.get(key, {})
+        if 4 not in a:
+            return default
+        v = a[4][0]
+        if isinstance(v, bytes):  # protowire yields fixed32 as raw bytes
+            return struct.unpack("<f", v)[0]
+        return struct.unpack("<f", struct.pack("<I", v))[0]
+
+    def attr_i(self, key: str, default: int = 0) -> int:
+        a = self.attr.get(key, {})
+        return pw.as_signed(a[3][0]) if 3 in a else default
+
+    def attr_type(self, key: str):
+        a = self.attr.get(key, {})
+        return _DT.get(a[6][0]) if 6 in a else None
 
     def attr_tensor(self) -> Optional[np.ndarray]:
         a = self.attr.get("value", {})
@@ -205,19 +233,34 @@ class TensorflowLoader:
             return None
 
         graph_nodes: Dict[str, nn.Node] = {}
+        multi_bases: Dict[str, nn.Node] = {}
         input_nodes = []
         for name in inputs:
             node = nn.Input()
             graph_nodes[_clean(name)] = node
             input_nodes.append(node)
 
-        def build(name: str) -> nn.Node:
-            name = _clean(name)
-            if name in graph_nodes:
-                return graph_nodes[name]
-            n = self.nodes[name]
-            node = self._convert(n, build, const_of)
-            graph_nodes[name] = node
+        def build(ref: str) -> nn.Node:
+            base = _clean(ref)
+            body = ref.lstrip("^")
+            idx = int(body.split(":")[1]) if ":" in body else 0
+            if base in graph_nodes:       # single-output / graph input
+                return graph_nodes[base]
+            key = f"{base}:{idx}"
+            if key in graph_nodes:
+                return graph_nodes[key]
+            n = self.nodes[base]
+            if n.op in _MULTI_OUTPUT_OPS:
+                # node emits a Table; each consumed :idx gets a selector
+                if base not in multi_bases:
+                    multi_bases[base] = self._convert(n, build, const_of)
+                node = (_Fn(lambda *xs, i=idx: xs[i])
+                        .set_name(f"{n.name}_out{idx}")
+                        .inputs(multi_bases[base]))
+                graph_nodes[key] = node
+            else:
+                node = self._convert(n, build, const_of)
+                graph_nodes[base] = node
             return node
 
         output_nodes = [build(o) for o in outputs]
@@ -231,8 +274,28 @@ class TensorflowLoader:
         def prev(i=0):
             return build(data_inputs[i])
 
-        if op in ("Identity", "StopGradient", "Cast", "CheckNumerics"):
+        def unary(fn):
+            return _Fn(fn).set_name(n.name).inputs(prev(0))
+
+        def binop(fn):
+            """Binary op folding a const operand on either side."""
+            c0 = const_of(data_inputs[0])
+            c1 = const_of(data_inputs[1])
+            if c1 is not None:
+                return _Fn(lambda x, c=jnp.asarray(c1): fn(x, c)
+                           ).set_name(n.name).inputs(prev(0))
+            if c0 is not None:
+                return _Fn(lambda x, c=jnp.asarray(c0): fn(c, x)
+                           ).set_name(n.name).inputs(prev(1))
+            return _Fn(fn).set_name(n.name).inputs(prev(0), prev(1))
+
+        if op in ("Identity", "StopGradient", "CheckNumerics"):
             return prev()
+        if op == "Cast":
+            dst = n.attr_type("DstT")
+            if dst is None:
+                return prev()
+            return unary(lambda x, d=dst: jnp.asarray(x).astype(d))
         if op == "Placeholder":
             raise ValueError(
                 f"placeholder {n.name!r} reached but not listed in inputs")
@@ -276,9 +339,13 @@ class TensorflowLoader:
         if op == "Reshape":
             shape = const_of(data_inputs[1])
             tgt = tuple(int(s) for s in np.asarray(shape).reshape(-1))
-            return _Fn(lambda x, t=tgt: x.reshape(
-                tuple(x.shape[0] if d == -1 else d for d in t))
-            ).set_name(n.name).inputs(prev(0))
+
+            def reshape(x, t=tgt):
+                known = int(np.prod([d for d in t if d != -1])) or 1
+                return x.reshape(tuple(
+                    int(x.size // known) if d == -1 else d for d in t))
+
+            return _Fn(reshape).set_name(n.name).inputs(prev(0))
         if op == "Squeeze":
             dims = n.attr_ints("squeeze_dims")
             return _Fn(lambda x, d=tuple(dims): jnp.squeeze(x, axis=d or None)
@@ -298,6 +365,222 @@ class TensorflowLoader:
             prevs = [build(i) for i in data_inputs[:-1]]
             return _Fn(lambda *xs, a=axis: jnp.concatenate(xs, axis=a)
                        ).set_name(n.name).inputs(*prevs)
+
+        # ----- elementwise unary (utils/tf/loaders/{Neg,Rsqrt,Sqrt,...}.scala)
+        _UNARY = {
+            "Neg": jnp.negative, "Rsqrt": lambda x: 1.0 / jnp.sqrt(x),
+            "Sqrt": jnp.sqrt, "Square": jnp.square, "Exp": jnp.exp,
+            "Log": jnp.log, "Log1p": jnp.log1p, "Abs": jnp.abs,
+            "Floor": jnp.floor, "Ceil": jnp.ceil, "Round": jnp.round,
+            "Rint": jnp.rint, "Sign": jnp.sign, "Erf": jax.scipy.special.erf,
+            "Erfc": jax.scipy.special.erfc, "Reciprocal": lambda x: 1.0 / x,
+            "Inv": lambda x: 1.0 / x,
+            "Softplus": jax.nn.softplus, "Softsign": jax.nn.soft_sign,
+            "Elu": jax.nn.elu, "Selu": jax.nn.selu,
+            "LogSoftmax": lambda x: jax.nn.log_softmax(x, axis=-1),
+            "Tanh": jnp.tanh,
+        }
+        if op in _UNARY:
+            return unary(_UNARY[op])
+        if op == "LeakyRelu":
+            alpha = n.attr_f("alpha", 0.2)
+            return unary(lambda x, a=alpha: jnp.where(x > 0, x, a * x))
+
+        # ----- elementwise binary (Sub/Mul/RealDiv/... loaders)
+        _BINARY = {
+            "Sub": jnp.subtract, "Mul": jnp.multiply, "RealDiv": jnp.divide,
+            "Div": jnp.divide, "Maximum": jnp.maximum, "Minimum": jnp.minimum,
+            "Pow": jnp.power, "SquaredDifference": lambda a, b: (a - b) ** 2,
+            "FloorDiv": jnp.floor_divide, "FloorMod": jnp.mod,
+            "Greater": lambda a, b: a > b, "GreaterEqual": lambda a, b: a >= b,
+            "Less": lambda a, b: a < b, "LessEqual": lambda a, b: a <= b,
+            "Equal": lambda a, b: a == b, "NotEqual": lambda a, b: a != b,
+            "LogicalAnd": jnp.logical_and, "LogicalOr": jnp.logical_or,
+            "TruncateDiv": lambda a, b: jnp.trunc(a / b).astype(jnp.asarray(a).dtype),
+        }
+        if op in _BINARY:
+            return binop(_BINARY[op])
+        if op == "AddN":
+            prevs = [build(i) for i in data_inputs]
+            return _Fn(lambda *xs: sum(xs[1:], xs[0])
+                       ).set_name(n.name).inputs(*prevs)
+        if op == "LogicalNot":
+            return unary(jnp.logical_not)
+
+        # ----- batch norm (utils/tf/loaders/FusedBatchNorm*.scala)
+        if op in ("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3"):
+            scale = jnp.asarray(const_of(data_inputs[1]))
+            offset = jnp.asarray(const_of(data_inputs[2]))
+            mean = jnp.asarray(const_of(data_inputs[3]))
+            var = jnp.asarray(const_of(data_inputs[4]))
+            eps = n.attr_f("epsilon", 1e-4)
+            inv = scale / jnp.sqrt(var + eps)
+
+            def bn(x, inv=inv, off=offset, mu=mean):
+                return x * inv + (off - mu * inv)
+
+            return unary(bn)
+        if op == "LRN":
+            radius = n.attr_i("depth_radius", 5)
+            bias = n.attr_f("bias", 1.0)
+            alpha = n.attr_f("alpha", 1.0)
+            beta = n.attr_f("beta", 0.5)
+
+            def lrn(x, r=radius, b=bias, a=alpha, be=beta):
+                sq = jnp.square(x)
+                # sum over the channel window [c-r, c+r] (NHWC)
+                pads = [(0, 0)] * (x.ndim - 1) + [(r, r)]
+                padded = jnp.pad(sq, pads)
+                win = sum(padded[..., i:i + x.shape[-1]] for i in range(2 * r + 1))
+                return x / jnp.power(b + a * win, be)
+
+            return unary(lrn)
+
+        # ----- shape/layout ops
+        if op == "Transpose":
+            perm = tuple(int(p) for p in np.asarray(const_of(data_inputs[1])).reshape(-1))
+            return unary(lambda x, pm=perm: jnp.transpose(x, pm))
+        if op == "ExpandDims":
+            dim = int(np.asarray(const_of(data_inputs[1])).reshape(())[()])
+            return unary(lambda x, d=dim: jnp.expand_dims(x, d))
+        if op == "Pack":
+            axis = n.attr_i("axis", 0)
+            prevs = [build(i) for i in data_inputs]
+            return _Fn(lambda *xs, a=axis: jnp.stack(xs, axis=a)
+                       ).set_name(n.name).inputs(*prevs)
+        if op == "Tile":
+            mult = tuple(int(m) for m in np.asarray(const_of(data_inputs[1])).reshape(-1))
+            return unary(lambda x, m=mult: jnp.tile(x, m))
+        if op == "StridedSlice":
+            begin = np.asarray(const_of(data_inputs[1])).reshape(-1)
+            end = np.asarray(const_of(data_inputs[2])).reshape(-1)
+            strides = np.asarray(const_of(data_inputs[3])).reshape(-1)
+            bm = n.attr_i("begin_mask")
+            em = n.attr_i("end_mask")
+            sm = n.attr_i("shrink_axis_mask")
+            nm = n.attr_i("new_axis_mask")
+            elm = n.attr_i("ellipsis_mask")
+
+            def sslice(x, begin=begin, end=end, strides=strides,
+                       bm=bm, em=em, sm=sm, nm=nm, elm=elm):
+                idx = []
+                for d in range(len(begin)):
+                    if elm & (1 << d):
+                        idx.append(Ellipsis)
+                        continue
+                    if nm & (1 << d):
+                        idx.append(None)  # np.newaxis
+                        continue
+                    if sm & (1 << d):
+                        idx.append(int(begin[d]))
+                        continue
+                    b = None if bm & (1 << d) else int(begin[d])
+                    e = None if em & (1 << d) else int(end[d])
+                    idx.append(slice(b, e, int(strides[d])))
+                return x[tuple(idx)]
+
+            return unary(sslice)
+
+        # ----- reductions (Max/Min/Sum/Prod loaders; Mean handled above)
+        _REDUCE = {"Max": jnp.max, "Min": jnp.min, "Sum": jnp.sum,
+                   "Prod": jnp.prod, "All": jnp.all, "Any": jnp.any}
+        if op in _REDUCE:
+            axes = const_of(data_inputs[1])
+            keep = n.attr_b("keep_dims") or n.attr_b("keepdims")
+            ax = tuple(int(a) for a in np.asarray(axes).reshape(-1))
+            return unary(lambda x, a=ax, k=keep, f=_REDUCE[op]:
+                         f(x, axis=a, keepdims=k))
+        if op == "ArgMax":
+            dim = int(np.asarray(const_of(data_inputs[1])).reshape(())[()])
+            return unary(lambda x, d=dim: jnp.argmax(x, axis=d))
+
+        # ----- gather/select/matmul family
+        if op in ("Gather", "GatherV2"):
+            axis = 0
+            if op == "GatherV2" and len(data_inputs) > 2:
+                axis = int(np.asarray(const_of(data_inputs[2])).reshape(())[()])
+            ind = const_of(data_inputs[1])
+            if ind is not None:
+                return unary(lambda p, i=jnp.asarray(ind).astype(jnp.int32),
+                             a=axis: jnp.take(p, i, axis=a))
+            par = const_of(data_inputs[0])
+            if par is not None:  # const table, computed indices
+                return _Fn(lambda i, p=jnp.asarray(par), a=axis:
+                           jnp.take(p, i.astype(jnp.int32), axis=a)
+                           ).set_name(n.name).inputs(prev(1))
+            return _Fn(lambda p, i, a=axis:
+                       jnp.take(p, i.astype(jnp.int32), axis=a)
+                       ).set_name(n.name).inputs(prev(0), prev(1))
+        if op in ("Select", "SelectV2"):
+            return _Fn(lambda c, t, e: jnp.where(c.astype(bool), t, e)
+                       ).set_name(n.name).inputs(prev(0), prev(1), prev(2))
+        if op in ("BatchMatMul", "BatchMatMulV2"):
+            adj_x = n.attr_b("adj_x")
+            adj_y = n.attr_b("adj_y")
+
+            def bmm(a, b, ax=adj_x, ay=adj_y):
+                if ax:
+                    a = jnp.swapaxes(a, -1, -2)
+                if ay:
+                    b = jnp.swapaxes(b, -1, -2)
+                return jnp.matmul(a, b)
+
+            c1 = const_of(data_inputs[1])
+            if c1 is not None:
+                return unary(lambda a, c=jnp.asarray(c1): bmm(a, c))
+            return _Fn(bmm).set_name(n.name).inputs(prev(0), prev(1))
+        if op == "OneHot":
+            depth = int(np.asarray(const_of(data_inputs[1])).reshape(())[()])
+            on = float(np.asarray(const_of(data_inputs[2])).reshape(())[()])
+            off = float(np.asarray(const_of(data_inputs[3])).reshape(())[()])
+            axis = n.attr_i("axis", -1)
+            return unary(lambda x, d=depth, o=on, f=off, a=axis:
+                         jax.nn.one_hot(x.astype(jnp.int32), d, axis=a) * (o - f) + f)
+        if op == "ResizeBilinear":
+            size = np.asarray(const_of(data_inputs[1])).reshape(-1)
+            align = n.attr_b("align_corners")
+            from bigdl_tpu.nn.ops import ResizeBilinearOp
+
+            return (ResizeBilinearOp(int(size[0]), int(size[1]), align)
+                    .set_name(n.name).inputs(prev(0)))
+
+        # ----- multi-output ops (emit a Table; load() adds :idx selectors)
+        if op == "Split":
+            num = n.attr_i("num_split", 1)
+            axis = int(np.asarray(const_of(data_inputs[0])).reshape(())[()])
+            from bigdl_tpu.utils.table import Table as _T
+
+            return _Fn(lambda x, k=num, a=axis: _T(*jnp.split(x, k, axis=a))
+                       ).set_name(n.name).inputs(prev(1))
+        if op == "SplitV":
+            sizes = tuple(int(s) for s in np.asarray(const_of(data_inputs[1])).reshape(-1))
+            axis = int(np.asarray(const_of(data_inputs[2])).reshape(())[()])
+            offsets = np.cumsum((0,) + sizes)[:-1]
+            from bigdl_tpu.utils.table import Table as _T
+
+            def splitv(x, offs=tuple(offsets), szs=sizes, a=axis):
+                return _T(*[lax.dynamic_slice_in_dim(x, int(o), int(s), axis=a)
+                            for o, s in zip(offs, szs)])
+
+            return _Fn(splitv).set_name(n.name).inputs(prev(0))
+        if op == "Unpack":
+            num = n.attr_i("num", 1)
+            axis = n.attr_i("axis", 0)
+            from bigdl_tpu.utils.table import Table as _T
+
+            return _Fn(lambda x, k=num, a=axis:
+                       _T(*[jnp.take(x, i, axis=a) for i in range(k)])
+                       ).set_name(n.name).inputs(prev(0))
+        if op in ("TopK", "TopKV2"):
+            if op == "TopKV2":
+                k = int(np.asarray(const_of(data_inputs[1])).reshape(())[()])
+            else:
+                k = n.attr_i("k", 1)
+            from bigdl_tpu.utils.table import Table as _T
+
+            return _Fn(lambda x, kk=k: _T(*jax.lax.top_k(x, kk))
+                       ).set_name(n.name).inputs(prev(0))
+
         raise ValueError(f"unsupported tf op {op!r} ({n.name})")
 
 

@@ -1,0 +1,156 @@
+"""TF-oracle import tests: real TensorFlow builds + executes a frozen
+graph, then our protowire-based loader must reproduce its predictions.
+
+This mirrors the reference's oracle strategy (SURVEY.md §4: Torch-oracle
+tests shell out to `th`; Keras-oracle tests run real Keras) — TensorFlow
+here is a *test-only* oracle, never a runtime dependency.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+
+from bigdl_tpu.utils.tf_import import load_tf  # noqa: E402
+
+
+def freeze(fn, path):
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    cf = convert_variables_to_constants_v2(fn.get_concrete_function())
+    gd = cf.graph.as_graph_def()
+    with open(path, "wb") as f:
+        f.write(gd.SerializeToString())
+    return cf
+
+
+def run_both(tmp_path, tf_fn, x, inputs=("x",), outputs=("Identity",)):
+    pb = str(tmp_path / "g.pb")
+    cf = freeze(tf_fn, pb)
+    ref = cf(tf.constant(x))
+    ref = [r.numpy() for r in (ref if isinstance(ref, (list, tuple)) else [ref])]
+    model = load_tf(pb, list(inputs), list(outputs))
+    model.evaluate()
+    got = model(x)
+    got = [np.asarray(g) for g in (list(got) if hasattr(got, "__len__")
+                                   and not hasattr(got, "shape") else [got])]
+    for r, g in zip(ref, got):
+        np.testing.assert_allclose(r, g, rtol=2e-4, atol=2e-5)
+    return model
+
+
+def test_cnn_graph_matches_tf(tmp_path):
+    rng = np.random.RandomState(0)
+    w = tf.constant(rng.randn(3, 3, 3, 8).astype(np.float32) * 0.3)
+    b = tf.constant(rng.randn(8).astype(np.float32))
+    dw = tf.constant(rng.randn(3, 3, 8, 1).astype(np.float32) * 0.3)
+    scale = tf.constant(rng.rand(8).astype(np.float32) + 0.5)
+    offset = tf.constant(rng.randn(8).astype(np.float32))
+    mean = tf.constant(rng.randn(8).astype(np.float32) * 0.1)
+    var = tf.constant(rng.rand(8).astype(np.float32) + 0.5)
+    dense = tf.constant(rng.randn(4 * 4 * 8, 10).astype(np.float32) * 0.1)
+
+    @tf.function(input_signature=[tf.TensorSpec([2, 16, 16, 3], tf.float32)])
+    def f(x):
+        y = tf.nn.conv2d(x, w, strides=[1, 2, 2, 1], padding="SAME")
+        y = tf.nn.bias_add(y, b)
+        y, _, _ = tf.raw_ops.FusedBatchNormV3(
+            x=y, scale=scale, offset=offset, mean=mean, variance=var,
+            is_training=False)[:3]
+        y = tf.nn.relu(y)
+        y = tf.nn.depthwise_conv2d(y, dw, strides=[1, 1, 1, 1], padding="SAME")
+        y = tf.nn.max_pool2d(y, 2, 2, "VALID")
+        y = tf.reshape(y, [2, -1])
+        y = tf.matmul(y, dense)
+        return tf.nn.softmax(y)
+
+    x = np.random.RandomState(1).randn(2, 16, 16, 3).astype(np.float32)
+    run_both(tmp_path, f, x)
+
+
+def test_elementwise_medley_matches_tf(tmp_path):
+    c = tf.constant(np.random.RandomState(2).rand(4, 6).astype(np.float32) + 0.5)
+
+    @tf.function(input_signature=[tf.TensorSpec([4, 6], tf.float32)])
+    def f(x):
+        y = tf.abs(x) + 0.5
+        a = tf.sqrt(y) * tf.math.rsqrt(y + 1.0)
+        b = tf.square(x) - tf.exp(-y)
+        z = tf.maximum(a, b) / tf.minimum(y, c)
+        z = tf.math.log1p(tf.abs(z))
+        w = tf.transpose(z)                     # (6, 4)
+        w = tf.reduce_sum(w, axis=0)            # (4,)
+        s = tf.reduce_max(z, axis=1)            # (4,)
+        return z - (w + s)[:, None]
+
+    x = np.random.RandomState(3).randn(4, 6).astype(np.float32)
+    run_both(tmp_path, f, x)
+
+
+def test_split_pack_slice_matches_tf(tmp_path):
+    @tf.function(input_signature=[tf.TensorSpec([2, 8], tf.float32)])
+    def f(x):
+        lo, hi = tf.split(x, 2, axis=1)         # multi-output consumers
+        y = tf.stack([lo, hi], axis=0)          # Pack
+        y = y[:, :, 1:3]                        # StridedSlice
+        y = tf.concat([y[0], y[1]], axis=1)     # more StridedSlice + ConcatV2
+        return y * 2.0 - lo[:, :1]
+
+    x = np.random.RandomState(4).randn(2, 8).astype(np.float32)
+    run_both(tmp_path, f, x)
+
+
+def test_activation_chain_matches_tf(tmp_path):
+    @tf.function(input_signature=[tf.TensorSpec([3, 5], tf.float32)])
+    def f(x):
+        y = tf.nn.leaky_relu(x, alpha=0.1)
+        y = tf.nn.elu(y) + tf.nn.softplus(x) + tf.nn.softsign(x)
+        y = tf.sigmoid(y) + tf.nn.log_softmax(x, axis=-1)
+        return tf.tanh(y)
+
+    x = np.random.RandomState(5).randn(3, 5).astype(np.float32)
+    run_both(tmp_path, f, x)
+
+
+def test_gather_onehot_argmax_matches_tf(tmp_path):
+    table = tf.constant(np.random.RandomState(6).randn(10, 4).astype(np.float32))
+
+    @tf.function(input_signature=[tf.TensorSpec([3, 4], tf.float32)])
+    def f(x):
+        idx = tf.argmax(x, axis=1)                       # int64
+        g = tf.gather(table, idx)                        # GatherV2
+        oh = tf.one_hot(idx, 4, on_value=2.0, off_value=-1.0)
+        return g + oh + tf.cast(idx[:, None], tf.float32)
+
+    x = np.random.RandomState(7).randn(3, 4).astype(np.float32)
+    run_both(tmp_path, f, x)
+
+
+def test_imported_graph_is_jittable(tmp_path):
+    """The imported Graph must trace under jit (engineType=tpu predict)."""
+    import jax
+
+    w = tf.constant(np.random.RandomState(8).randn(6, 3).astype(np.float32))
+
+    @tf.function(input_signature=[tf.TensorSpec([2, 6], tf.float32)])
+    def f(x):
+        return tf.nn.softmax(tf.matmul(x, w))
+
+    pb = str(tmp_path / "g.pb")
+    cf = freeze(f, pb)
+    x = np.random.RandomState(9).randn(2, 6).astype(np.float32)
+    model = load_tf(pb, ["x"], ["Identity"])
+    model.evaluate()
+    from bigdl_tpu.nn.module import pure_apply
+
+    fn = pure_apply(model)
+    out = jax.jit(lambda p, xx: fn(p, {}, xx, training=False)[0])(
+        model.params_dict(), x)
+    np.testing.assert_allclose(cf(tf.constant(x))[0].numpy(), np.asarray(out),
+                               rtol=2e-5, atol=1e-6)
