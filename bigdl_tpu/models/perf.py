@@ -22,9 +22,10 @@ from bigdl_tpu.optim.optimizer import make_train_step
 from bigdl_tpu.utils import random as bt_random
 
 
-def build_model(name: str, class_num: int = 1000):
+def build_model(name: str, class_num: int = 1000, format: str = "NCHW"):
     """Model + (input shape sans batch, target kind) by name
-    (≙ DistriOptimizerPerf's --model flag)."""
+    (≙ DistriOptimizerPerf's --model flag). ``format="NHWC"`` builds the
+    channels-last variant (TPU-preferred) where the model supports it."""
     from bigdl_tpu.models.inception import InceptionV1NoAuxClassifier
     from bigdl_tpu.models.lenet import LeNet5
     from bigdl_tpu.models.resnet import DatasetType, ResNet
@@ -41,8 +42,10 @@ def build_model(name: str, class_num: int = 1000):
         return InceptionV1NoAuxClassifier(class_num), (3, 224, 224), class_num
     if name.startswith("resnet"):
         depth = int(name[len("resnet"):] or 50)
-        return (ResNet(class_num, {"depth": depth, "dataSet": DatasetType.ImageNet}),
-                (3, 224, 224), class_num)
+        shape = (224, 224, 3) if format == "NHWC" else (3, 224, 224)
+        return (ResNet(class_num, {"depth": depth, "dataSet": DatasetType.ImageNet,
+                                   "format": format}),
+                shape, class_num)
     raise ValueError(f"unknown perf model {name!r}")
 
 
@@ -50,13 +53,19 @@ def run_perf(model_name: str = None, batch_size: int = 32,
              iterations: int = 20, warmup: int = 3,
              dtype=jnp.float32, criterion=None,
              model: Optional[Module] = None, input_shape=None,
-             class_num: int = 1000, log=print) -> dict:
+             class_num: int = 1000, log=print, format: str = "NCHW",
+             master_f32: bool = False, profile_dir: Optional[str] = None) -> dict:
     """Time a jitted train step on synthetic data; returns a summary dict
     with records/sec (the reference's per-iteration Throughput line,
-    optim/DistriOptimizer.scala:387-393)."""
+    optim/DistriOptimizer.scala:387-393).
+
+    ``master_f32=True`` keeps f32 master params and casts to ``dtype`` once
+    inside the step (mixed precision); otherwise params are stored in
+    ``dtype`` directly. ``profile_dir`` captures a jax.profiler trace of the
+    timed region."""
     if model is None:
         model_name = model_name or "resnet50"
-        model, input_shape, class_num = build_model(model_name, class_num)
+        model, input_shape, class_num = build_model(model_name, class_num, format=format)
     elif input_shape is None:
         raise ValueError("input_shape is required when passing a custom model")
     else:
@@ -79,11 +88,14 @@ def run_perf(model_name: str = None, batch_size: int = 32,
             lambda a: a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a, t)
 
     method = SGD(learning_rate=0.01)
-    ts = make_train_step(model, criterion, method)
+    ts = make_train_step(model, criterion, method,
+                         compute_dtype=dtype if master_f32 else None)
     # copy params out of the module before donation — step() donates its
     # buffers, which must not invalidate the caller's live model arrays
-    params = to_dtype(jax.tree.map(jnp.copy, model.params_dict()))
-    buffers = to_dtype(jax.tree.map(jnp.copy, model.buffers_dict()))
+    params = jax.tree.map(jnp.copy, model.params_dict())
+    buffers = jax.tree.map(jnp.copy, model.buffers_dict())
+    if not master_f32:
+        params, buffers = to_dtype(params), to_dtype(buffers)
     slots = ts.init_slots(params)
     lrs = ts.current_lrs()
     step = jax.jit(ts.step, donate_argnums=(0, 1, 2))
@@ -95,12 +107,16 @@ def run_perf(model_name: str = None, batch_size: int = 32,
     float(loss)  # value fetch: block_until_ready is unreliable over the axon tunnel
     compile_s = time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    for _ in range(iterations):
-        loss, params, buffers, slots = step(params, buffers, slots, x, y, lrs,
-                                            bt_random.next_key())
-    loss_v = float(loss)
-    elapsed = time.perf_counter() - t0
+    import contextlib
+    prof = (jax.profiler.trace(profile_dir) if profile_dir
+            else contextlib.nullcontext())
+    with prof:
+        t0 = time.perf_counter()
+        for _ in range(iterations):
+            loss, params, buffers, slots = step(params, buffers, slots, x, y, lrs,
+                                                bt_random.next_key())
+        loss_v = float(loss)
+        elapsed = time.perf_counter() - t0
 
     rec_per_sec = batch_size * iterations / elapsed
     summary = {
@@ -126,9 +142,16 @@ def main(argv=None):
     p.add_argument("--batch-size", type=int, default=32)
     p.add_argument("--iterations", type=int, default=20)
     p.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"])
+    p.add_argument("--format", default="NCHW", choices=["NCHW", "NHWC"])
+    p.add_argument("--master-f32", action="store_true",
+                   help="f32 master params + compute-dtype cast in-step")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the timed loop")
     args = p.parse_args(argv)
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-    run_perf(args.model, args.batch_size, args.iterations, dtype=dtype)
+    run_perf(args.model, args.batch_size, args.iterations, dtype=dtype,
+             format=args.format, master_f32=args.master_f32,
+             profile_dir=args.profile)
 
 
 if __name__ == "__main__":

@@ -20,19 +20,20 @@ from bigdl_tpu.optim.regularizer import L2Regularizer
 
 
 def _conv(n_in: int, n_out: int, kw: int, kh: int, dw: int = 1, dh: int = 1,
-          pw: int = 0, ph: int = 0, propagate_back: bool = True) -> nn.Module:
+          pw: int = 0, ph: int = 0, propagate_back: bool = True,
+          format: str = "NCHW") -> nn.Module:
     """≙ the reference's Convolution helper (ResNet.scala:35-62): MSRA init
     and L2(1e-4) weight decay on every conv."""
     return nn.SpatialConvolution(
         n_in, n_out, kw, kh, dw, dh, pw, ph,
         propagate_back=propagate_back,
         w_regularizer=L2Regularizer(1e-4), b_regularizer=L2Regularizer(1e-4),
-        init_method=init.MsraFiller(False))
+        init_method=init.MsraFiller(False), format=format)
 
 
-def _sbn(n_out: int) -> nn.Module:
+def _sbn(n_out: int, format: str = "NCHW") -> nn.Module:
     """≙ Sbn (ResNet.scala:64-73): BN with eps 1e-3, gamma=1, beta=0."""
-    return nn.SpatialBatchNormalization(n_out, 1e-3)
+    return nn.SpatialBatchNormalization(n_out, 1e-3, format=format)
 
 
 class ShortcutType:
@@ -46,17 +47,20 @@ class DatasetType:
     ImageNet = "ImageNet"
 
 
-def _shortcut(n_in: int, n_out: int, stride: int, shortcut_type: str) -> nn.Module:
+def _shortcut(n_in: int, n_out: int, stride: int, shortcut_type: str,
+              format: str = "NCHW") -> nn.Module:
     use_conv = shortcut_type == ShortcutType.C or (
         shortcut_type == ShortcutType.B and n_in != n_out)
     if use_conv:
         return (nn.Sequential()
-                .add(_conv(n_in, n_out, 1, 1, stride, stride))
-                .add(_sbn(n_out)))
+                .add(_conv(n_in, n_out, 1, 1, stride, stride, format=format))
+                .add(_sbn(n_out, format)))
     if n_in != n_out:
+        # channel dim is 2 (1-based, batch-included) in NCHW, 4 in NHWC
+        ch_dim = 4 if format == "NHWC" else 2
         return (nn.Sequential()
-                .add(nn.SpatialAveragePooling(1, 1, stride, stride))
-                .add(nn.Concat(2)
+                .add(nn.SpatialAveragePooling(1, 1, stride, stride, format=format))
+                .add(nn.Concat(ch_dim)
                      .add(nn.Identity())
                      .add(nn.MulConstant(0.0))))
     return nn.Identity()
@@ -74,6 +78,8 @@ class ResNet:
         depth = opt.get("depth", 18)
         shortcut_type = opt.get("shortcutType", ShortcutType.B)
         dataset = opt.get("dataSet", DatasetType.CIFAR10)
+        # TPU-preferred channels-last activations; input must be NHWC too
+        fmt = opt.get("format", "NCHW")
 
         state = {"ichannels": 0}
 
@@ -81,13 +87,13 @@ class ResNet:
             n_in = state["ichannels"]
             state["ichannels"] = n
             s = (nn.Sequential()
-                 .add(_conv(n_in, n, 3, 3, stride, stride, 1, 1))
-                 .add(_sbn(n))
+                 .add(_conv(n_in, n, 3, 3, stride, stride, 1, 1, format=fmt))
+                 .add(_sbn(n, fmt))
                  .add(nn.ReLU())
-                 .add(_conv(n, n, 3, 3, 1, 1, 1, 1))
-                 .add(_sbn(n)))
+                 .add(_conv(n, n, 3, 3, 1, 1, 1, 1, format=fmt))
+                 .add(_sbn(n, fmt)))
             return (nn.Sequential()
-                    .add(nn.ConcatTable().add(s).add(_shortcut(n_in, n, stride, shortcut_type)))
+                    .add(nn.ConcatTable().add(s).add(_shortcut(n_in, n, stride, shortcut_type, fmt)))
                     .add(nn.CAddTable())
                     .add(nn.ReLU()))
 
@@ -95,20 +101,20 @@ class ResNet:
             n_in = state["ichannels"]
             state["ichannels"] = n * 4
             s = (nn.Sequential()
-                 .add(_conv(n_in, n, 1, 1, 1, 1, 0, 0))
-                 .add(_sbn(n))
+                 .add(_conv(n_in, n, 1, 1, 1, 1, 0, 0, format=fmt))
+                 .add(_sbn(n, fmt))
                  .add(nn.ReLU())
-                 .add(_conv(n, n, 3, 3, stride, stride, 1, 1))
-                 .add(_sbn(n))
+                 .add(_conv(n, n, 3, 3, stride, stride, 1, 1, format=fmt))
+                 .add(_sbn(n, fmt))
                  .add(nn.ReLU())
-                 .add(_conv(n, n * 4, 1, 1, 1, 1, 0, 0))
+                 .add(_conv(n, n * 4, 1, 1, 1, 1, 0, 0, format=fmt))
                  # zero-gamma on the block's last BN so the residual branch
                  # starts as identity (≙ Sbn(n*4).setInitMethod(Zeros, Zeros),
                  # ResNet.scala:208)
                  .add(nn.SpatialBatchNormalization(
-                     n * 4, 1e-3, init_weight=jnp.zeros((n * 4,)))))
+                     n * 4, 1e-3, init_weight=jnp.zeros((n * 4,)), format=fmt)))
             return (nn.Sequential()
-                    .add(nn.ConcatTable().add(s).add(_shortcut(n_in, n * 4, stride, shortcut_type)))
+                    .add(nn.ConcatTable().add(s).add(_shortcut(n_in, n * 4, stride, shortcut_type, fmt)))
                     .add(nn.CAddTable())
                     .add(nn.ReLU()))
 
@@ -132,15 +138,15 @@ class ResNet:
                 raise ValueError(f"Invalid depth {depth}")
             loop, n_features, block = cfg[depth]
             state["ichannels"] = 64
-            (model.add(_conv(3, 64, 7, 7, 2, 2, 3, 3, propagate_back=False))
-                  .add(_sbn(64))
+            (model.add(_conv(3, 64, 7, 7, 2, 2, 3, 3, propagate_back=False, format=fmt))
+                  .add(_sbn(64, fmt))
                   .add(nn.ReLU())
-                  .add(nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1))
+                  .add(nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1, format=fmt))
                   .add(layer(block, 64, loop[0]))
                   .add(layer(block, 128, loop[1], 2))
                   .add(layer(block, 256, loop[2], 2))
                   .add(layer(block, 512, loop[3], 2))
-                  .add(nn.SpatialAveragePooling(7, 7, 1, 1))
+                  .add(nn.SpatialAveragePooling(7, 7, 1, 1, format=fmt))
                   .add(nn.View(n_features))
                   .add(nn.Linear(n_features, class_num,
                                  w_regularizer=L2Regularizer(1e-4),
@@ -151,13 +157,13 @@ class ResNet:
                 raise ValueError("depth should be one of 20, 32, 44, 56, 110, 1202")
             n = (depth - 2) // 6
             state["ichannels"] = 16
-            (model.add(_conv(3, 16, 3, 3, 1, 1, 1, 1, propagate_back=False))
-                  .add(_sbn(16))
+            (model.add(_conv(3, 16, 3, 3, 1, 1, 1, 1, propagate_back=False, format=fmt))
+                  .add(_sbn(16, fmt))
                   .add(nn.ReLU())
                   .add(layer(basic_block, 16, n))
                   .add(layer(basic_block, 32, n, 2))
                   .add(layer(basic_block, 64, n, 2))
-                  .add(nn.SpatialAveragePooling(8, 8, 1, 1))
+                  .add(nn.SpatialAveragePooling(8, 8, 1, 1, format=fmt))
                   .add(nn.View(64))
                   .add(nn.Linear(64, class_num)))
         else:

@@ -10,9 +10,13 @@ assignment).
 API parity notes:
 - ctor argument order follows the reference: (kernelW, kernelH, strideW,
   strideH, padW, padH) — W before H.
-- data layout is NCHW like the reference; XLA:TPU internally picks optimal
-  layouts, so this is a semantic choice only.
-- weight layout is (out_channels, in_channels/groups, kH, kW).
+- ``format`` selects NCHW (default, reference DataFormat.NCHW) or NHWC
+  (reference DataFormat.NHWC, nn/abstractnn/DataFormat.scala). NHWC is the
+  TPU-preferred activation layout: the channel dim rides the 128-lane
+  minor axis, so conv fusion avoids transposes.
+- weight layout is (out_channels, in_channels/groups, kH, kW) in BOTH
+  formats (checkpoints are layout-independent; XLA re-lays out the weight
+  for the MXU either way).
 """
 
 from __future__ import annotations
@@ -29,6 +33,12 @@ def _pair_pad(pad_h, pad_w, in_h=None, in_w=None):
         # SAME padding (reference uses -1 to mean "same", SpatialConvolution.scala)
         return "SAME"
     return [(pad_h, pad_h), (pad_w, pad_w)]
+
+
+def _check_format(format):
+    if format not in ("NCHW", "NHWC"):
+        raise ValueError(f"format must be 'NCHW' or 'NHWC', got {format!r}")
+    return format
 
 
 class SpatialConvolution(Module):
@@ -52,9 +62,11 @@ class SpatialConvolution(Module):
         init_bias=None,
         with_bias: bool = True,
         init_method=None,
+        format: str = "NCHW",
     ):
         super().__init__()
         assert n_input_plane % n_group == 0 and n_output_plane % n_group == 0
+        self.format = _check_format(format)
         self.n_input_plane = n_input_plane
         self.n_output_plane = n_output_plane
         self.kernel_w, self.kernel_h = kernel_w, kernel_h
@@ -94,16 +106,21 @@ class SpatialConvolution(Module):
             window_strides=(self.stride_h, self.stride_w),
             padding=_pair_pad(self.pad_h, self.pad_w),
             rhs_dilation=dilation,
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            dimension_numbers=(self.format, "OIHW", self.format),
             feature_group_count=self.n_group,
         )
+
+    def _add_bias(self, out):
+        if self.format == "NHWC":
+            return out + self.bias
+        return out + self.bias[None, :, None, None]
 
     def forward(self, input):
         squeeze = input.ndim == 3
         x = input[None] if squeeze else input
         out = self._conv(x, self.weight)
         if self.with_bias:
-            out = out + self.bias[None, :, None, None]
+            out = self._add_bias(out)
         return out[0] if squeeze else out
 
     def _extra_repr(self):
@@ -127,7 +144,7 @@ class SpatialDilatedConvolution(SpatialConvolution):
         x = input[None] if squeeze else input
         out = self._conv(x, self.weight, dilation=(self.dilation_h, self.dilation_w))
         if self.with_bias:
-            out = out + self.bias[None, :, None, None]
+            out = self._add_bias(out)
         return out[0] if squeeze else out
 
 

@@ -27,13 +27,16 @@ class BatchNormalization(Module):
 
     def __init__(self, n_output: int, eps: float = 1e-5, momentum: float = 0.1,
                  affine: bool = True, init_weight=None, init_bias=None,
-                 global_stats_axis: str = None):
+                 global_stats_axis: str = None, format: str = "NCHW"):
         super().__init__()
         self.n_output = n_output
         self.eps = eps
         self.momentum = momentum
         self.affine = affine
         self.global_stats_axis = global_stats_axis
+        from bigdl_tpu.nn.conv import _check_format
+        # NHWC puts the channel on the minor axis (DataFormat parity)
+        self.format = _check_format(format)
         if affine:
             w = jnp.asarray(init_weight) if init_weight is not None else jnp.ones((n_output,))
             b = jnp.asarray(init_bias) if init_bias is not None else jnp.zeros((n_output,))
@@ -44,8 +47,12 @@ class BatchNormalization(Module):
 
     def forward(self, input):
         x = input
-        # batched input has n_dim dims (channel at 1); unbatched n_dim-1 (channel at 0)
-        ch_ax = 1 if x.ndim >= self.n_dim else 0
+        # batched input has n_dim dims (channel at 1); unbatched n_dim-1 (channel at 0);
+        # NHWC keeps the channel on the minor axis in both cases
+        if self.format == "NHWC":
+            ch_ax = x.ndim - 1
+        else:
+            ch_ax = 1 if x.ndim >= self.n_dim else 0
         axes = tuple(i for i in range(x.ndim) if i != ch_ax)
         if self.training:
             mean = jnp.mean(x, axis=axes)

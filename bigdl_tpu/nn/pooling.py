@@ -11,6 +11,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+from bigdl_tpu.nn.conv import _check_format
 from bigdl_tpu.nn.module import Module
 
 
@@ -31,17 +32,29 @@ def _pool_padding(in_size, out_size, k, stride, pad):
     return (pad, hi)
 
 
+def _spatial_window(format, kh, kw, dh, dw, pad_h, pad_w):
+    """(window_dims, strides, padding) for a 4-D pool in the given format
+    (≙ DataFormat.getHWCDims, nn/abstractnn/DataFormat.scala)."""
+    if format == "NHWC":
+        return ((1, kh, kw, 1), (1, dh, dw, 1),
+                ((0, 0), pad_h, pad_w, (0, 0)))
+    return ((1, 1, kh, kw), (1, 1, dh, dw),
+            ((0, 0), (0, 0), pad_h, pad_w))
+
+
 class SpatialMaxPooling(Module):
-    """Max pooling over NCHW (reference: nn/SpatialMaxPooling.scala)."""
+    """Max pooling over NCHW or NHWC (reference: nn/SpatialMaxPooling.scala,
+    DataFormat arg)."""
 
     def __init__(self, kw: int, kh: int, dw: int = None, dh: int = None,
-                 pad_w: int = 0, pad_h: int = 0):
+                 pad_w: int = 0, pad_h: int = 0, format: str = "NCHW"):
         super().__init__()
         self.kw, self.kh = kw, kh
         self.dw = dw if dw is not None else kw
         self.dh = dh if dh is not None else kh
         self.pad_w, self.pad_h = pad_w, pad_h
         self.ceil_mode = False
+        self.format = _check_format(format)
 
     def ceil(self) -> "SpatialMaxPooling":
         self.ceil_mode = True
@@ -54,16 +67,17 @@ class SpatialMaxPooling(Module):
     def forward(self, input):
         squeeze = input.ndim == 3
         x = input[None] if squeeze else input
-        h, w = x.shape[2], x.shape[3]
+        hax = 1 if self.format == "NHWC" else 2
+        h, w = x.shape[hax], x.shape[hax + 1]
         out_h = _pool_out_size(h, self.kh, self.dh, self.pad_h, self.ceil_mode)
         out_w = _pool_out_size(w, self.kw, self.dw, self.pad_w, self.ceil_mode)
         pad_h = _pool_padding(h, out_h, self.kh, self.dh, self.pad_h)
         pad_w = _pool_padding(w, out_w, self.kw, self.dw, self.pad_w)
+        dims, strides, pads = _spatial_window(
+            self.format, self.kh, self.kw, self.dh, self.dw, pad_h, pad_w)
         out = lax.reduce_window(
             x, -jnp.inf, lax.max,
-            window_dimensions=(1, 1, self.kh, self.kw),
-            window_strides=(1, 1, self.dh, self.dw),
-            padding=((0, 0), (0, 0), pad_h, pad_w),
+            window_dimensions=dims, window_strides=strides, padding=pads,
         )
         return out[0] if squeeze else out
 
@@ -78,7 +92,7 @@ class SpatialAveragePooling(Module):
     def __init__(self, kw: int, kh: int, dw: int = None, dh: int = None,
                  pad_w: int = 0, pad_h: int = 0, global_pooling: bool = False,
                  ceil_mode: bool = False, count_include_pad: bool = True,
-                 divide: bool = True):
+                 divide: bool = True, format: str = "NCHW"):
         super().__init__()
         self.kw, self.kh = kw, kh
         self.dw = dw if dw is not None else kw
@@ -88,6 +102,7 @@ class SpatialAveragePooling(Module):
         self.ceil_mode = ceil_mode
         self.count_include_pad = count_include_pad
         self.divide = divide
+        self.format = _check_format(format)
 
     def ceil(self):
         self.ceil_mode = True
@@ -96,19 +111,19 @@ class SpatialAveragePooling(Module):
     def forward(self, input):
         squeeze = input.ndim == 3
         x = input[None] if squeeze else input
-        h, w = x.shape[2], x.shape[3]
+        hax = 1 if self.format == "NHWC" else 2
+        h, w = x.shape[hax], x.shape[hax + 1]
         kh, kw = (h, w) if self.global_pooling else (self.kh, self.kw)
         dh, dw = (1, 1) if self.global_pooling else (self.dh, self.dw)
         out_h = _pool_out_size(h, kh, dh, self.pad_h, self.ceil_mode)
         out_w = _pool_out_size(w, kw, dw, self.pad_w, self.ceil_mode)
         pad_h = _pool_padding(h, out_h, kh, dh, self.pad_h)
         pad_w = _pool_padding(w, out_w, kw, dw, self.pad_w)
-        padding = ((0, 0), (0, 0), pad_h, pad_w)
+        dims, strides, padding = _spatial_window(
+            self.format, kh, kw, dh, dw, pad_h, pad_w)
         summed = lax.reduce_window(
             x, 0.0, lax.add,
-            window_dimensions=(1, 1, kh, kw),
-            window_strides=(1, 1, dh, dw),
-            padding=padding,
+            window_dimensions=dims, window_strides=strides, padding=padding,
         )
         if not self.divide:
             out = summed
@@ -118,9 +133,7 @@ class SpatialAveragePooling(Module):
             ones = jnp.ones_like(x)
             counts = lax.reduce_window(
                 ones, 0.0, lax.add,
-                window_dimensions=(1, 1, kh, kw),
-                window_strides=(1, 1, dh, dw),
-                padding=padding,
+                window_dimensions=dims, window_strides=strides, padding=padding,
             )
             out = summed / counts
         return out[0] if squeeze else out

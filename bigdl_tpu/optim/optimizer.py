@@ -98,10 +98,17 @@ def _method_groups(model: Module, default_method: OptimMethod, sub_methods):
 class TrainStep:
     """The pure train step + grouped optimizer state (shared by Local and
     Distri optimizers). ``step(params, buffers, slots, x, y, lrs, rng)`` is
-    jit/pjit-safe; ``lrs`` is one scalar per optimizer group (host-scheduled)."""
+    jit/pjit-safe; ``lrs`` is one scalar per optimizer group (host-scheduled).
+
+    ``compute_dtype`` enables the mixed-precision master split: params stay
+    at their stored dtype (f32 master), are cast once to ``compute_dtype``
+    (bf16) for forward+backward, and grads come back f32 through the cast's
+    vjp — the TPU-native analog of the reference's FP16 wire format applied
+    to compute rather than communication."""
 
     def __init__(self, model: Module, criterion, optim_method: OptimMethod,
-                 grad_clip: Optional[dict] = None, sub_methods=None):
+                 grad_clip: Optional[dict] = None, sub_methods=None,
+                 compute_dtype=None):
         apply_fn = pure_apply(model)
         trainable = model.trainable_dict()
         any_frozen = not all(
@@ -113,9 +120,15 @@ class TrainStep:
         self._idxs_per_group = idxs_per_group
 
         def loss_fn(params, buffers, x, y, rng):
-            out, new_buffers = apply_fn(params, buffers, x, rng=rng, training=True)
+            if compute_dtype is not None:
+                cparams = jax.tree.map(
+                    lambda a: a.astype(compute_dtype)
+                    if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
+            else:
+                cparams = params
+            out, new_buffers = apply_fn(cparams, buffers, x, rng=rng, training=True)
             loss = criterion.forward(out, y)
-            loss = loss + model.regularization_loss(params)
+            loss = loss + model.regularization_loss(cparams)
             return loss, new_buffers
 
         def step(params, buffers, slots, x, y, lrs, rng):
@@ -167,8 +180,10 @@ class TrainStep:
 
 
 def make_train_step(model: Module, criterion, optim_method: OptimMethod,
-                    grad_clip: Optional[dict] = None, sub_methods=None) -> TrainStep:
-    return TrainStep(model, criterion, optim_method, grad_clip, sub_methods)
+                    grad_clip: Optional[dict] = None, sub_methods=None,
+                    compute_dtype=None) -> TrainStep:
+    return TrainStep(model, criterion, optim_method, grad_clip, sub_methods,
+                     compute_dtype=compute_dtype)
 
 
 def _named_param_leaves(params):

@@ -88,3 +88,54 @@ def test_lenet_learns_tiny_problem():
         loss, params, buffers, slots = step(params, buffers, slots, x, y,
                                             ts.current_lrs(), None)
     assert float(loss) < 0.1
+
+
+def test_resnet_nhwc_matches_nchw():
+    """NHWC (channels-last, TPU-preferred) builds share the OIHW weight
+    layout with NCHW builds, so outputs must agree after transposing the
+    input (reference DataFormat parity, nn/abstractnn/DataFormat.scala)."""
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu.models.resnet import DatasetType, ResNet
+
+    m_nchw = ResNet(10, {"depth": 18, "dataSet": DatasetType.ImageNet})
+    m_nhwc = ResNet(10, {"depth": 18, "dataSet": DatasetType.ImageNet,
+                         "format": "NHWC"})
+    m_nhwc.load_params_dict(m_nchw.params_dict())
+    m_nchw.evaluate()
+    m_nhwc.evaluate()
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 3, 224, 224))
+    out_nchw = m_nchw.forward(x)
+    out_nhwc = m_nhwc.forward(jnp.transpose(x, (0, 2, 3, 1)))
+    assert jnp.allclose(out_nchw, out_nhwc, atol=2e-4), (
+        float(jnp.max(jnp.abs(out_nchw - out_nhwc))))
+
+
+def test_train_step_master_f32_mixed_precision():
+    """compute_dtype keeps f32 masters, casts to bf16 in-step; params stay
+    f32 after update and the loss decreases."""
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim.optim_method import SGD
+    from bigdl_tpu.optim.optimizer import make_train_step
+    from bigdl_tpu.utils import random as bt_random
+
+    model = (nn.Sequential().add(nn.Linear(8, 16)).add(nn.ReLU())
+             .add(nn.Linear(16, 4)).add(nn.LogSoftMax()))
+    ts = make_train_step(model, nn.ClassNLLCriterion(), SGD(learning_rate=0.1),
+                         compute_dtype=jnp.bfloat16)
+    params = model.params_dict()
+    buffers = model.buffers_dict()
+    slots = ts.init_slots(params)
+    lrs = ts.current_lrs()
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 8))
+    y = jnp.ones((16,), jnp.int32)
+    step = jax.jit(ts.step)
+    loss0, params, buffers, slots = step(params, buffers, slots, x, y, lrs,
+                                         bt_random.next_key())
+    for _ in range(20):
+        loss, params, buffers, slots = step(params, buffers, slots, x, y, lrs,
+                                            bt_random.next_key())
+    assert all(p.dtype == jnp.float32 for p in jax.tree.leaves(params))
+    assert float(loss) < float(loss0)
