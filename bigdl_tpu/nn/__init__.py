@@ -75,3 +75,8 @@ from bigdl_tpu.nn.criterion import (
     ActivityRegularization, SmoothL1CriterionWithWeights,
 )
 from bigdl_tpu.nn import ops  # TF-style Operation modules (nn/ops/, SURVEY.md §2.3)
+from bigdl_tpu.nn import tf_ops  # TF infra ops (nn/tf/, SURVEY.md §2.3)
+from bigdl_tpu.nn.tf_ops import (
+    WhileLoop, If, ControlNodes, Variable, Assign, AssignAdd, AssignSub,
+    TensorArray, ParseExample,
+)

@@ -79,10 +79,13 @@ class Graph(Module):
 
     def __init__(self,
                  inputs: Union[Node, Sequence[Node]],
-                 outputs: Union[Node, Sequence[Node]]):
+                 outputs: Union[Node, Sequence[Node]],
+                 allow_unused_inputs: bool = False):
         super().__init__()
         self.input_nodes = [inputs] if isinstance(inputs, Node) else list(inputs)
         self.output_nodes = [outputs] if isinstance(outputs, Node) else list(outputs)
+        # function subgraphs (TF While cond/body) legally ignore loop vars
+        self._allow_unused_inputs = allow_unused_inputs
         self._stop_gradient_names: set = set()
         self._topo = self._topo_sort()
         # Register every distinct module once so params/buffers pytrees and
@@ -106,6 +109,7 @@ class Graph(Module):
             "inputs": [idx[n._uid] for n in self.input_nodes],
             "outputs": [idx[n._uid] for n in self.output_nodes],
             "stop_gradient": sorted(self._stop_gradient_names),
+            "allow_unused_inputs": self._allow_unused_inputs,
         }
 
     @classmethod
@@ -116,7 +120,8 @@ class Graph(Module):
             node.prev = [nodes[i] for i in nrec["prev"]]
             nodes.append(node)
         g = cls([nodes[i] for i in spec["inputs"]],
-                [nodes[i] for i in spec["outputs"]])
+                [nodes[i] for i in spec["outputs"]],
+                allow_unused_inputs=spec.get("allow_unused_inputs", False))
         if spec.get("stop_gradient"):
             g.stop_gradient(spec["stop_gradient"])
         return g
@@ -131,8 +136,10 @@ class Graph(Module):
             if s == 1:
                 return
             if s == 0:
-                raise ValueError("graph contains a cycle; use the ops layer's "
-                                 "lax.while_loop lowering for control flow")
+                raise ValueError(
+                    "graph contains a cycle; loops must be expressed with "
+                    "nn.tf_ops.WhileLoop / ControlNodes.while_loop "
+                    "(lax.while_loop lowering), not back-edges")
             state[node._uid] = 0
             for p in node.prev:
                 visit(p)
@@ -143,8 +150,11 @@ class Graph(Module):
             visit(out)
         for inp in self.input_nodes:
             if state.get(inp._uid) != 1:
-                raise ValueError(
-                    f"input node {inp.name} is not connected to any output")
+                if not getattr(self, "_allow_unused_inputs", False):
+                    raise ValueError(
+                        f"input node {inp.name} is not connected to any output")
+                state[inp._uid] = 1
+                order.insert(0, inp)
         return order
 
     def node(self, name: str) -> Node:

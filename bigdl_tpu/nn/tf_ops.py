@@ -1,0 +1,511 @@
+"""TF infra ops: control flow, state, TensorArray dataflow, tf.Example parsing.
+
+Reference: the ``nn/tf/`` package — ControlOps.scala (Switch/Merge/Enter/
+Exit/NextIteration + ControlNodes.whileLoop), StateOps.scala (Variable/
+Assign/AssignGrad), DataFlowOps.scala (TensorArray*), ParsingOps.scala
+(ParseExample), Assert.scala, NoOp.scala, ControlDependency.scala.
+
+TPU-native redesign: the reference executes loops by *dataflow scheduling* —
+Switch/Merge nodes gate edge readiness and a FrameManager tracks loop
+frames (nn/Scheduler.scala:36, nn/FrameManager.scala). Under XLA that whole
+machine collapses to structured control-flow primitives traced once:
+
+- ``WhileLoop(cond, body)``  -> ``lax.while_loop``   (one compiled region,
+  loop-invariant hoisting + layout done by the compiler)
+- ``If(then, else)``         -> ``lax.cond``
+- ``Switch``/``Merge`` outside loops -> predicated ``select`` (both branches
+  are pure; XLA evaluates them fused, which on TPU is usually cheaper than
+  dynamic dispatch)
+
+``ControlNodes.while_loop`` keeps the reference's builder signature shape
+(condition, body, loop_vars) but returns a single composite node rather
+than wiring Enter/Merge/Switch/Exit chains (ControlOps.scala:296-326).
+
+TensorArray maps to a fixed-capacity stacked buffer updated with
+``dynamic_update_slice`` — the XLA-native dataflow container (size must be
+static under jit, matching lax.while_loop's static-shape contract).
+
+ParseExample is a HOST op: it consumes serialized ``tf.Example`` protos
+(bytes) via utils/protowire and emits dense numpy batches. It runs eagerly
+at the data boundary — strings never enter an XLA program (ParsingOps.scala
+runs JVM-side in the reference for the same reason).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils import protowire as pw
+from bigdl_tpu.utils.table import Table
+
+
+def _as_tuple(act):
+    if isinstance(act, Table):
+        return tuple(act)
+    if isinstance(act, (list, tuple)):
+        return tuple(act)
+    return (act,)
+
+
+def _as_activity(vals):
+    vals = tuple(vals)
+    return vals[0] if len(vals) == 1 else Table(*vals)
+
+
+def _bool_scalar(x):
+    x = jnp.asarray(x)
+    return x.reshape(()).astype(bool)
+
+
+class WhileLoop(Module):
+    """Run ``body`` while ``cond`` holds over a tuple of loop vars
+    (≙ ControlNodes.whileLoop, ControlOps.scala:296-326; executes as ONE
+    ``lax.while_loop`` instead of a Switch/Merge frame walk).
+
+    ``cond``/``body`` are callables over the unpacked loop vars (a Module —
+    e.g. an imported sub-Graph — or any function). ``body`` must return the
+    same number of vars with the same shapes/dtypes (XLA's loop contract;
+    the reference enforces the same via NextIteration pairing)."""
+
+    def __init__(self, cond: Callable, body: Callable,
+                 max_iterations: Optional[int] = None):
+        super().__init__()
+        self._cond, self._body = cond, body
+        self.max_iterations = max_iterations
+
+    def _call(self, fn, vals):
+        out = fn(_as_activity(vals)) if isinstance(fn, Module) else fn(*vals)
+        return out
+
+    def forward(self, input):
+        vals = _as_tuple(input)
+        if self.max_iterations is None:
+            def cond_fn(vs):
+                return _bool_scalar(self._call(self._cond, vs))
+
+            def body_fn(vs):
+                return tuple(_as_tuple(self._call(self._body, vs)))
+
+            out = lax.while_loop(cond_fn, body_fn, vals)
+        else:
+            def cond_fn(carry):
+                i, vs = carry
+                return jnp.logical_and(i < self.max_iterations,
+                                       _bool_scalar(self._call(self._cond, vs)))
+
+            def body_fn(carry):
+                i, vs = carry
+                return i + 1, tuple(_as_tuple(self._call(self._body, vs)))
+
+            _, out = lax.while_loop(cond_fn, body_fn, (jnp.asarray(0), vals))
+        return _as_activity(out)
+
+
+class If(Module):
+    """Predicated branch (≙ the TF If op / reference cond subgraphs):
+    input = Table(pred, *branch_args) -> ``lax.cond(pred, then, else)``."""
+
+    def __init__(self, then_branch: Callable, else_branch: Callable):
+        super().__init__()
+        self._then, self._else = then_branch, else_branch
+
+    def _call(self, fn, vals):
+        if isinstance(fn, Module):
+            return fn(_as_activity(vals))
+        return fn(*vals)
+
+    def forward(self, input):
+        vals = _as_tuple(input)
+        pred, args = _bool_scalar(vals[0]), vals[1:]
+        return lax.cond(pred,
+                        lambda a: self._call(self._then, a),
+                        lambda a: self._call(self._else, a), args)
+
+
+class Switch(Module):
+    """≙ SwitchOps (ControlOps.scala:66): input Table(data, pred) ->
+    Table(false_out, true_out). Outside a dataflow scheduler both outputs
+    are the data itself; consumers created via ``Merge`` select by the
+    predicate. Kept for graph-shape parity with imported TF1 graphs."""
+
+    def forward(self, input):
+        data, pred = _as_tuple(input)
+        return Table(data, data, _bool_scalar(pred))
+
+
+class Merge(Module):
+    """≙ MergeOps (ControlOps.scala:86): select whichever branch was taken.
+    TPU-native: both branches are computed (pure) and a ``jnp.where``
+    selects — no scheduler needed."""
+
+    def forward(self, input):
+        vals = _as_tuple(input)
+        if len(vals) == 3:  # (false_val, true_val, pred) from paired Switch
+            f, t, pred = vals
+            return jax.tree.map(lambda a, b: jnp.where(pred, b, a), f, t)
+        return vals[0]
+
+
+class Enter(Module):
+    """Loop-frame entry marker (≙ Enter, ControlOps.scala:198). Identity
+    under structured control flow."""
+
+    def __init__(self, frame: str = ""):
+        super().__init__()
+        self.frame = frame
+
+    def forward(self, input):
+        return input
+
+
+class Exit(Module):
+    """≙ Exit (ControlOps.scala:226); identity under structured control flow."""
+
+    def forward(self, input):
+        return input
+
+
+class NextIteration(Module):
+    """≙ NextIteration (ControlOps.scala:179); identity under structured
+    control flow."""
+
+    def forward(self, input):
+        return input
+
+
+class NoOp(Module):
+    """≙ nn/tf/NoOp.scala — control-dependency anchor; passes input through."""
+
+    def forward(self, input):
+        return input
+
+
+class ControlDependency(NoOp):
+    """≙ nn/tf/ControlDependency.scala — ordering edge; identity on data."""
+
+
+class Assert(Module):
+    """≙ nn/tf/Assert.scala: input Table(pred, data). Eager mode raises on a
+    false predicate; under jit the check is skipped (XLA has no host traps —
+    use checkify for debugging)."""
+
+    def __init__(self, message: str = "assertion failed"):
+        super().__init__()
+        self.message = message
+
+    def forward(self, input):
+        pred, data = _as_tuple(input)[0], _as_tuple(input)[1:]
+        try:
+            ok = bool(jnp.asarray(pred).reshape(()))
+        except jax.errors.TracerBoolConversionError:
+            ok = True  # traced: assertion elided, matching XLA semantics
+        if not ok:
+            raise AssertionError(self.message)
+        return _as_activity(data)
+
+
+class ControlNodes:
+    """Factory mirroring the reference's ControlNodes object
+    (ControlOps.scala:240-326) with structured lowering."""
+
+    @staticmethod
+    def while_loop(cond: Callable, body: Callable, loop_vars,
+                   name: str = None, max_iterations: Optional[int] = None):
+        """Immediate-mode while loop over concrete loop vars. The reference
+        wires Enter/Merge/Switch/Exit nodes and returns exit nodes; here the
+        loop is a single composite executed now (or traced under jit)."""
+        m = WhileLoop(cond, body, max_iterations)
+        if name:
+            m.set_name(name)
+        return m.forward(_as_activity(loop_vars))
+
+    @staticmethod
+    def switch(data, condition):
+        return Switch().forward(Table(data, condition))
+
+    @staticmethod
+    def merge(*branches):
+        return Merge().forward(Table(*branches))
+
+
+# --------------------------------------------------------------- state ops
+class Variable(Module):
+    """≙ nn/tf/StateOps.scala Variable: a stateful tensor exposed as a
+    trainable parameter (its gradient accumulates like any weight)."""
+
+    def __init__(self, value, trainable: bool = True):
+        super().__init__()
+        self.register_parameter("value", jnp.asarray(value))
+        if not trainable:
+            self.freeze()
+
+    def forward(self, input=None):
+        return self.value
+
+
+class Assign(Module):
+    """≙ StateOps.scala Assign (:71): input Table(ref_ignored, value) or
+    value; writes into the bound Variable eagerly and returns the new value.
+    Host-side mutation — inside jit use the functional buffers path."""
+
+    def __init__(self, variable: Variable, op: str = "set"):
+        super().__init__()
+        self._var = variable
+        self._op = op
+
+    def forward(self, input):
+        vals = _as_tuple(input)
+        value = vals[-1]
+        cur = self._var.value
+        if self._op == "add":
+            value = cur + value
+        elif self._op == "sub":
+            value = cur - value
+        self._var._set_param("value", jnp.asarray(value))
+        return self._var.value
+
+
+def AssignAdd(variable):  # ≙ tf AssignAdd lowering
+    return Assign(variable, op="add")
+
+
+def AssignSub(variable):
+    return Assign(variable, op="sub")
+
+
+# ----------------------------------------------------------- TensorArray ops
+class TensorArray:
+    """Fixed-capacity stacked buffer (≙ DataFlowOps.scala TensorArray:45).
+
+    The reference grows a JVM array dynamically; XLA requires static shapes,
+    so capacity is fixed at creation (dynamic_size maps to "pick a bound").
+    The buffer materializes lazily on first write/scatter/split/unstack."""
+
+    def __init__(self, size: int, dtype=jnp.float32,
+                 element_shape: Optional[Sequence[int]] = None):
+        self.size = size
+        self.dtype = dtype
+        self.buffer = (jnp.zeros((size,) + tuple(element_shape), dtype)
+                       if element_shape is not None else None)
+        self._written = np.zeros((size,), bool)
+
+    def _ensure(self, elem):
+        if self.buffer is None:
+            self.buffer = jnp.zeros((self.size,) + tuple(jnp.shape(elem)),
+                                    jnp.asarray(elem).dtype)
+
+    def write(self, index, value) -> "TensorArray":
+        value = jnp.asarray(value)
+        self._ensure(value)
+        self.buffer = lax.dynamic_update_index_in_dim(
+            self.buffer, value.astype(self.buffer.dtype), jnp.asarray(index), 0)
+        if isinstance(index, (int, np.integer)):
+            self._written[int(index)] = True
+        return self
+
+    def read(self, index):
+        if self.buffer is None:
+            raise ValueError("reading from an empty TensorArray")
+        return lax.dynamic_index_in_dim(self.buffer, jnp.asarray(index), 0,
+                                        keepdims=False)
+
+    def gather(self, indices):
+        return jnp.take(self.buffer, jnp.asarray(indices), axis=0)
+
+    def scatter(self, indices, values) -> "TensorArray":
+        values = jnp.asarray(values)
+        self._ensure(values[0])
+        self.buffer = self.buffer.at[jnp.asarray(indices)].set(
+            values.astype(self.buffer.dtype))
+        return self
+
+    def unstack(self, values) -> "TensorArray":
+        values = jnp.asarray(values)
+        self.size = int(values.shape[0])
+        self.buffer = values
+        self._written[:] = True
+        return self
+
+    def stack(self):
+        return self.buffer
+
+    def concat(self):
+        b = self.buffer
+        return b.reshape((-1,) + tuple(b.shape[2:]))
+
+    def split(self, value, lengths) -> "TensorArray":
+        """≙ TensorArraySplit: rows of ``value`` chunked by ``lengths``.
+        XLA needs equal chunks; unequal lengths fall back to host split."""
+        value = jnp.asarray(value)
+        lengths = [int(v) for v in np.asarray(lengths)]
+        if len(set(lengths)) == 1:
+            self.unstack(value.reshape((len(lengths), lengths[0])
+                                       + tuple(value.shape[1:])))
+        else:
+            pieces = np.split(np.asarray(value), np.cumsum(lengths)[:-1])
+            width = max(lengths)
+            padded = [np.pad(p, [(0, width - p.shape[0])] + [(0, 0)] * (p.ndim - 1))
+                      for p in pieces]
+            self.unstack(np.stack(padded))
+        return self
+
+
+class TensorArrayCreator(Module):
+    """≙ DataFlowOps.scala TensorArrayCreator(:176): size -> new handle."""
+
+    def __init__(self, dtype=jnp.float32, element_shape=None):
+        super().__init__()
+        self.dtype = dtype
+        self.element_shape = element_shape
+
+    def forward(self, input):
+        return TensorArray(int(np.asarray(input).reshape(())), self.dtype,
+                           self.element_shape)
+
+
+class TensorArrayWrite(Module):
+    def forward(self, input):
+        ta, index, value = _as_tuple(input)
+        return ta.write(index, value)
+
+
+class TensorArrayRead(Module):
+    def forward(self, input):
+        ta, index = _as_tuple(input)
+        return ta.read(index)
+
+
+class TensorArrayGather(Module):
+    def forward(self, input):
+        ta, indices = _as_tuple(input)
+        return ta.gather(indices)
+
+
+class TensorArrayScatter(Module):
+    def forward(self, input):
+        ta, indices, values = _as_tuple(input)
+        return ta.scatter(indices, values)
+
+
+class TensorArrayConcat(Module):
+    def forward(self, input):
+        (ta,) = _as_tuple(input)[:1]
+        return ta.concat()
+
+
+class TensorArraySize(Module):
+    def forward(self, input):
+        (ta,) = _as_tuple(input)[:1]
+        return jnp.asarray(ta.size, jnp.int32)
+
+
+class TensorArraySplit(Module):
+    def forward(self, input):
+        ta, value, lengths = _as_tuple(input)
+        return ta.split(value, lengths)
+
+
+class TensorArrayClose(Module):
+    def forward(self, input):
+        return jnp.zeros((), jnp.int32)
+
+
+# ------------------------------------------------------------- parsing ops
+_EXAMPLE_FEATURES = 1   # Example.features
+_FEATURES_MAP = 1       # Features.feature (map<string, Feature>)
+_BYTES_LIST, _FLOAT_LIST, _INT64_LIST = 1, 2, 3  # Feature oneof fields
+_LIST_VALUE = 1
+
+
+def parse_example_bytes(serialized: bytes) -> dict:
+    """Decode one tf.Example proto into {feature_name: numpy array} using
+    the protowire decoder (≙ ParsingOps.scala ParseExample's JVM proto
+    parse)."""
+    out = {}
+    ex = pw.decode(serialized)
+    if _EXAMPLE_FEATURES not in ex:
+        return out
+    feats = pw.decode(ex[_EXAMPLE_FEATURES][0])
+    for entry in feats.get(_FEATURES_MAP, []):
+        em = pw.decode(entry)
+        name = pw.as_string(em[1][0])
+        fm = pw.decode(em[2][0])
+        if _BYTES_LIST in fm:
+            lst = pw.decode(fm[_BYTES_LIST][0])
+            out[name] = np.asarray(lst.get(_LIST_VALUE, []), object)
+        elif _FLOAT_LIST in fm:
+            lst = pw.decode(fm[_FLOAT_LIST][0])
+            vals = []
+            for v in lst.get(_LIST_VALUE, []):
+                vals.extend(pw.packed_floats(v) if isinstance(v, bytes) else [v])
+            out[name] = np.asarray(vals, np.float32)
+        elif _INT64_LIST in fm:
+            lst = pw.decode(fm[_INT64_LIST][0])
+            out[name] = np.asarray(
+                [pw.as_signed(v) for v in pw.repeated_varints(lst.get(_LIST_VALUE, []))],
+                np.int64)
+    return out
+
+
+class ParseExample(Module):
+    """≙ nn/tf/ParsingOps.scala ParseExample(:36): parse a batch of
+    serialized tf.Example protos into dense feature tensors.
+
+    Input: Table(serialized, names, key_1..key_nDense, default_1..default_nDense)
+    exactly like the reference; ``serialized`` is a 1-D array/list of bytes.
+    Output: Table of nDense dense tensors, each (batch,) + dense_shape.
+
+    HOST op — runs on CPU at the data boundary; never traced into XLA."""
+
+    def __init__(self, n_dense: int, t_dense: Sequence, dense_shapes: Sequence):
+        super().__init__()
+        self.n_dense = n_dense
+        self.t_dense = [np.dtype(t) for t in t_dense]
+        self.dense_shapes = [tuple(s) for s in dense_shapes]
+
+    def forward(self, input):
+        vals = _as_tuple(input)
+        serialized = vals[0]
+        keys = [self._key(v) for v in vals[2:2 + self.n_dense]]
+        defaults = list(vals[2 + self.n_dense:2 + 2 * self.n_dense])
+        records = [np.asarray(b) if not isinstance(b, bytes) else b
+                   for b in (serialized if not isinstance(serialized, bytes)
+                             else [serialized])]
+        cols: List[List[np.ndarray]] = [[] for _ in range(self.n_dense)]
+        for rec in records:
+            rec_b = rec if isinstance(rec, bytes) else bytes(rec.tolist()) \
+                if rec.dtype == object else rec.tobytes()
+            feats = parse_example_bytes(
+                rec_b if isinstance(rec_b, bytes) else bytes(rec_b))
+            for j, key in enumerate(keys):
+                shape = self.dense_shapes[j]
+                if key in feats and feats[key].size:
+                    v = feats[key]
+                else:
+                    v = np.asarray(defaults[j])
+                if self.t_dense[j] == np.dtype(object):
+                    cols[j].append(v.reshape(shape) if shape else v.reshape(()))
+                else:
+                    cols[j].append(np.asarray(v, self.t_dense[j]).reshape(shape))
+        outs = []
+        for j in range(self.n_dense):
+            if self.t_dense[j] == np.dtype(object):
+                outs.append(np.stack(cols[j]) if cols[j] else np.zeros((0,), object))
+            else:
+                outs.append(jnp.asarray(np.stack(cols[j])))
+        return _as_activity(outs)
+
+    @staticmethod
+    def _key(v):
+        if isinstance(v, bytes):
+            return v.decode()
+        if isinstance(v, str):
+            return v
+        a = np.asarray(v).reshape(-1)[0]
+        return a.decode() if isinstance(a, bytes) else str(a)

@@ -24,13 +24,23 @@ from jax import lax
 #: ops whose module emits a Table of outputs; consumers reference "name:i"
 _MULTI_OUTPUT_OPS = {"Split", "SplitV", "Unpack", "TopK", "TopKV2"}
 
+#: FunctionDef refs name the output arg ("node:out_arg:idx"); flat output
+#: index = arg's base offset + idx. Ops with one (possibly repeated) output
+#: arg have offset 0 and are omitted.
+_OUT_ARG_OFFSET = {
+    "TopK": {"values": 0, "indices": 1},
+    "TopKV2": {"values": 0, "indices": 1},
+    "Switch": {"output_false": 0, "output_true": 1},
+    "Merge": {"output": 0, "value_index": 1},
+}
+
 from bigdl_tpu import nn
 from bigdl_tpu.nn.module import Module
 from bigdl_tpu.utils import protowire as pw
 
-# tensorflow dtype enum (subset)
+# tensorflow dtype enum (subset); 7 = DT_STRING (object arrays of bytes)
 _DT = {1: np.float32, 2: np.float64, 3: np.int32, 4: np.uint8, 6: np.int8,
-       9: np.int64, 10: bool}
+       7: object, 9: np.int64, 10: bool}
 
 
 def _parse_tensor(tensor_bytes: bytes) -> np.ndarray:
@@ -44,7 +54,10 @@ def _parse_tensor(tensor_bytes: bytes) -> np.ndarray:
     # TensorProto field numbers (tensorflow/core/framework/tensor.proto):
     # 4 tensor_content, 5 float_val, 6 double_val, 7 int_val, 9 int64_val,
     # 10 bool_val.  A tensor with NO value field is all default (zeros).
-    if 4 in msg and msg[4][0]:  # tensor_content: raw bytes
+    if dtype is object:  # DT_STRING: string_val = field 8
+        vals = [v for v in msg.get(8, [])]
+        arr = np.asarray(vals, object)
+    elif 4 in msg and msg[4][0]:  # tensor_content: raw bytes
         arr = np.frombuffer(msg[4][0], dtype=dtype).copy()
     elif 5 in msg:  # float_val
         vals = []
@@ -67,6 +80,8 @@ def _parse_tensor(tensor_bytes: bytes) -> np.ndarray:
         if arr.size == 1 and int(np.prod(shape)) > 1:
             arr = np.full(shape, arr.reshape(-1)[0])
         arr = arr.reshape(shape)
+    elif arr.size == 1 and arr.ndim == 1:
+        arr = arr.reshape(())  # TensorProto with scalar shape
     return arr
 
 
@@ -97,6 +112,36 @@ class _TFNode:
         a = self.attr.get(key, {})
         return bool(a[5][0]) if 5 in a else default
 
+    def attr_func(self, key: str) -> Optional[str]:
+        """AttrValue.func (NameAttrList, field 10) -> function name."""
+        a = self.attr.get(key, {})
+        if 10 not in a:
+            return None
+        nal = pw.decode(a[10][0])
+        return pw.as_string(nal.get(1, [b""])[0])
+
+    def attr_types(self, key: str) -> List[type]:
+        """AttrValue.list.type (repeated DataType, ListValue field 6)."""
+        a = self.attr.get(key, {})
+        if 1 not in a:
+            return []
+        lst = pw.decode(a[1][0])
+        return [_DT.get(int(v), np.float32)
+                for v in pw.repeated_varints(lst.get(6, []))]
+
+    def attr_shapes(self, key: str) -> List[tuple]:
+        """AttrValue.list.shape (repeated TensorShapeProto, ListValue field 7)."""
+        a = self.attr.get(key, {})
+        if 1 not in a:
+            return []
+        lst = pw.decode(a[1][0])
+        shapes = []
+        for sb in lst.get(7, []):
+            sm = pw.decode(sb)
+            shapes.append(tuple(
+                pw.as_signed(pw.decode(d).get(1, [0])[0]) for d in sm.get(2, [])))
+        return shapes
+
     def attr_f(self, key: str, default: float = 0.0) -> float:
         a = self.attr.get(key, {})
         if 4 not in a:
@@ -123,6 +168,37 @@ def parse_graphdef(data: bytes) -> List[_TFNode]:
     return [_TFNode(nb) for nb in pw.decode(data).get(1, [])]
 
 
+class _TFFunction:
+    """FunctionDef (tensorflow/core/framework/function.proto): signature
+    OpDef (1), node_def (3), ret map (4). Inside a function body, input refs
+    use ``node:out_arg:idx`` / ``arg_name`` syntax."""
+
+    def __init__(self, data: bytes):
+        msg = pw.decode(data)
+        sig = pw.decode(msg[1][0])
+        self.name = pw.as_string(sig.get(1, [b""])[0])
+        self.input_args = [pw.as_string(pw.decode(a).get(1, [b""])[0])
+                           for a in sig.get(2, [])]
+        self.output_args = [pw.as_string(pw.decode(a).get(1, [b""])[0])
+                            for a in sig.get(3, [])]
+        self.nodes = [_TFNode(nb) for nb in msg.get(3, [])]
+        self.ret: Dict[str, str] = {}
+        for e in msg.get(4, []):
+            em = pw.decode(e)
+            self.ret[pw.as_string(em[1][0])] = pw.as_string(em[2][0])
+
+
+def parse_function_library(data: bytes) -> Dict[str, _TFFunction]:
+    """GraphDef.library (field 2) -> {name: _TFFunction}."""
+    fns: Dict[str, _TFFunction] = {}
+    for lib in pw.decode(data).get(2, []):
+        lm = pw.decode(lib)
+        for fb in lm.get(1, []):
+            fn = _TFFunction(fb)
+            fns[fn.name] = fn
+    return fns
+
+
 def _clean(name: str) -> str:
     name = name.lstrip("^")
     return name.split(":")[0]
@@ -140,6 +216,31 @@ class _Fn(Module):
         if isinstance(x, Table):
             return self._fn(*list(x))
         return self._fn(x)
+
+
+class _ConstBind(Module):
+    """Wrap a multi-arg module, baking const operands in at fixed positions
+    (functional ops like While take consts as loop vars; they can't fold
+    into the function body because position matters)."""
+
+    def __init__(self, inner: Module, consts: dict, n_total: int):
+        super().__init__()
+        self.inner = inner
+        self._consts = consts
+        self._n_total = n_total
+
+    def forward(self, input):
+        from bigdl_tpu.utils.table import Table
+
+        dyn = list(input) if isinstance(input, Table) else [input]
+        full, di = [], 0
+        for pos in range(self._n_total):
+            if pos in self._consts:
+                full.append(self._consts[pos])
+            else:
+                full.append(dyn[di])
+                di += 1
+        return self.inner.forward(Table(*full) if len(full) > 1 else full[0])
 
 
 class _Conv2D(Module):
@@ -215,9 +316,53 @@ class TensorflowLoader:
 
     def __init__(self, graph_pb_path: str):
         with open(graph_pb_path, "rb") as f:
-            self.nodes = {n.name: n for n in parse_graphdef(f.read())}
+            data = f.read()
+        self.nodes = {n.name: n for n in parse_graphdef(data)}
+        self.functions = parse_function_library(data)
+        self._fn_models: Dict[str, object] = {}
 
-    def load(self, inputs: List[str], outputs: List[str]):
+    def _function_model(self, fname: str):
+        """Build (once) an nn.Graph executing the named FunctionDef — used
+        as the cond/body of While and the branches of If (≙ the reference
+        executing loop-frame subgraphs via Scheduler; here the subgraph is a
+        plain module traced into lax control flow)."""
+        if fname not in self._fn_models:
+            fdef = self.functions[fname]
+            sub = TensorflowLoader.__new__(TensorflowLoader)
+            sub.nodes = {n.name: n for n in fdef.nodes}
+            sub.functions = self.functions
+            sub._fn_models = self._fn_models
+            outs = [fdef.ret.get(o, o) for o in fdef.output_args]
+            if not fdef.input_args:
+                # zero-arg branch (e.g. `lambda: tf.constant(c)`): outputs
+                # must be const-only; return a plain callable
+                consts = {nd.name: nd.attr_tensor() for nd in fdef.nodes
+                          if nd.op == "Const"}
+
+                def c_of(ref):
+                    b = _clean(ref)
+                    if b in consts:
+                        return consts[b]
+                    nd = sub.nodes.get(b)
+                    if nd is not None and nd.op == "Identity":
+                        return c_of(nd.inputs[0])
+                    raise ValueError(
+                        f"zero-arg function {fname!r}: output {ref!r} is "
+                        "not constant")
+
+                vals = [jnp.asarray(c_of(o)) for o in outs]
+                from bigdl_tpu.utils.table import Table as _T
+
+                self._fn_models[fname] = (
+                    lambda *a, vals=tuple(vals):
+                    vals[0] if len(vals) == 1 else _T(*vals))
+            else:
+                self._fn_models[fname] = sub.load(list(fdef.input_args), outs,
+                                                  allow_unused_inputs=True)
+        return self._fn_models[fname]
+
+    def load(self, inputs: List[str], outputs: List[str],
+             allow_unused_inputs: bool = False):
         consts: Dict[str, np.ndarray] = {}
         for n in self.nodes.values():
             if n.op == "Const":
@@ -234,6 +379,7 @@ class TensorflowLoader:
 
         graph_nodes: Dict[str, nn.Node] = {}
         multi_bases: Dict[str, nn.Node] = {}
+        tf1_frames: Dict[str, tuple] = {}
         input_nodes = []
         for name in inputs:
             node = nn.Input()
@@ -243,14 +389,43 @@ class TensorflowLoader:
         def build(ref: str) -> nn.Node:
             base = _clean(ref)
             body = ref.lstrip("^")
-            idx = int(body.split(":")[1]) if ":" in body else 0
+            # GraphDef refs are "node[:idx]"; FunctionDef bodies use
+            # "node:out_arg[:idx]" — flat index = arg offset + idx
+            parts = body.split(":")
+            if len(parts) >= 3:
+                idx = int(parts[-1])
+                prod = self.nodes.get(parts[0])
+                if prod is not None:
+                    idx += _OUT_ARG_OFFSET.get(prod.op, {}).get(parts[1], 0)
+            elif len(parts) == 2 and parts[1].isdigit():
+                idx = int(parts[1])
+            else:
+                idx = 0
             if base in graph_nodes:       # single-output / graph input
                 return graph_nodes[base]
             key = f"{base}:{idx}"
             if key in graph_nodes:
                 return graph_nodes[key]
             n = self.nodes[base]
-            if n.op in _MULTI_OUTPUT_OPS:
+            if n.op == "Const" and input_nodes:
+                # a Const used structurally (e.g. an If branch returning a
+                # constant): emit a literal node anchored on the first input
+                c = const_of(base)
+                cval = (np.asarray(c) if np.asarray(c).dtype == object
+                        else jnp.asarray(c))
+                node = (_Fn(lambda *_a, c=cval: c).set_name(base)
+                        .inputs(input_nodes[0]))
+                graph_nodes[base] = node
+                return node
+            if n.op == "Exit":
+                # TF1 while frame: reconstruct once, select this exit's var
+                wl_node, exit_of = self._tf1_while(n, build, const_of,
+                                                   tf1_frames)
+                node = (_Fn(lambda *xs, i=exit_of[base]: xs[i])
+                        .set_name(base).inputs(wl_node))
+                graph_nodes[base] = node
+                return node
+            if n.op in _MULTI_OUTPUT_OPS or self._n_outputs(n) > 1:
                 # node emits a Table; each consumed :idx gets a selector
                 if base not in multi_bases:
                     multi_bases[base] = self._convert(n, build, const_of)
@@ -264,8 +439,176 @@ class TensorflowLoader:
             return node
 
         output_nodes = [build(o) for o in outputs]
-        model = nn.Graph(input_nodes, output_nodes)
+        model = nn.Graph(input_nodes, output_nodes,
+                         allow_unused_inputs=allow_unused_inputs)
         return model
+
+    def _n_outputs(self, n: _TFNode) -> int:
+        """Output arity for functional ops (loop vars / branch results)."""
+        if n.op in ("While", "StatelessWhile"):
+            return len([i for i in n.inputs if not i.startswith("^")])
+        if n.op in ("If", "StatelessIf"):
+            f = n.attr_func("then_branch")
+            return len(self.functions[f].output_args) if f in self.functions else 1
+        if n.op in ("PartitionedCall", "StatefulPartitionedCall"):
+            f = n.attr_func("f")
+            return len(self.functions[f].output_args) if f in self.functions else 1
+        if n.op in ("ParseExample", "ParseExampleV2"):
+            return len(n.attr_types("Tdense"))
+        if n.op in ("Switch", "Merge"):
+            return 2
+        return 1
+
+    # ---------------- TF1 raw control flow (lowered Switch/Merge frames)
+    @staticmethod
+    def _ref_idx(ref: str) -> int:
+        parts = ref.lstrip("^").split(":")
+        return int(parts[-1]) if len(parts) > 1 and parts[-1].isdigit() else 0
+
+    def _trace_switch(self, ref: str, _depth=0):
+        """Walk ancestors from ``ref`` to the gating Switch; returns
+        (switch_node, output_index_used) or None.
+
+        Nested conds: an intervening Merge means an inner cond already
+        resolved on that path — it is skipped by continuing from its own
+        gating Switch's *data* input (the value that entered the inner
+        cond), so the outer Merge finds the outer Switch. Memoized so
+        diamond fan-in stays linear."""
+        memo = getattr(self, "_trace_memo", None)
+        if memo is None:
+            memo = self._trace_memo = {}
+        if ref in memo:
+            return memo[ref]
+        if _depth > 500:
+            return None
+        base = _clean(ref)
+        nd = self.nodes.get(base)
+        found = None
+        if nd is not None:
+            if nd.op == "Switch":
+                found = (nd, self._ref_idx(ref))
+            elif nd.op == "Merge":
+                inner = self._trace_switch(nd.inputs[0], _depth + 1)
+                if inner is not None:
+                    found = self._trace_switch(inner[0].inputs[0], _depth + 1)
+            else:
+                for i in nd.inputs:
+                    if i.startswith("^"):
+                        continue
+                    found = self._trace_switch(i, _depth + 1)
+                    if found:
+                        break
+        memo[ref] = found
+        return found
+
+    def _branch_side(self, ref: str) -> bool:
+        """True if ``ref`` flows from a Switch's true (:1) output."""
+        found = self._trace_switch(ref)
+        return bool(found and found[1] == 1)
+
+    def _switch_pred(self, ref: str):
+        found = self._trace_switch(ref)
+        return found[0].inputs[1] if found else None
+
+    @staticmethod
+    def _bind_consts(module: Module, refs: List[str], const_of):
+        """Bake const operands of a multi-arg functional module in place;
+        returns (module, dynamic_refs) (shared by wire_call + _tf1_while)."""
+        consts, dyn_refs = {}, []
+        for pos, ref in enumerate(refs):
+            c = const_of(ref)
+            if c is not None:
+                consts[pos] = (jnp.asarray(c) if np.asarray(c).dtype != object
+                               else np.asarray(c))
+            else:
+                dyn_refs.append(ref)
+        if consts:
+            module = _ConstBind(module, consts, len(refs))
+        return module, dyn_refs
+
+    def _consumers(self):
+        if not hasattr(self, "_consumers_idx"):
+            idx: Dict[str, list] = {}
+            for nd in self.nodes.values():
+                for i in nd.inputs:
+                    idx.setdefault(_clean(i), []).append(nd)
+            self._consumers_idx = idx
+        return self._consumers_idx
+
+    def _subgraph(self, input_names: List[str], output_refs: List[str]):
+        """Sub-model over this graph's nodes with the given names seeded as
+        placeholders (used for TF1 loop-frame cond/body extraction)."""
+        sub = TensorflowLoader.__new__(TensorflowLoader)
+        sub.nodes = self.nodes
+        sub.functions = self.functions
+        sub._fn_models = self._fn_models
+        return sub.load(input_names, output_refs, allow_unused_inputs=True)
+
+    def _tf1_while(self, exit_node: _TFNode, build, const_of, frames: dict):
+        """Reconstruct a TF1 while frame (Enter/Merge/Switch/LoopCond/
+        NextIteration/Exit — the graph the reference walks with
+        Scheduler/FrameManager, nn/Scheduler.scala:36) into ONE structured
+        WhileLoop lowered to lax.while_loop.
+
+        Loop vars are the frame's Merge nodes; loop invariants are Enter
+        nodes without a Merge consumer, appended as extra carried vars."""
+        from bigdl_tpu.nn.tf_ops import WhileLoop
+
+        switch = self.nodes[_clean(exit_node.inputs[0])]
+        merge0 = self.nodes[_clean(switch.inputs[0])]
+        enter0 = self.nodes[_clean(merge0.inputs[0])]
+        frame = enter0.attr_s("frame_name") or ""
+        if frame in frames:
+            return frames[frame]
+
+        consumers = self._consumers()
+        enters = sorted((nd for nd in self.nodes.values()
+                         if nd.op == "Enter"
+                         and (nd.attr_s("frame_name") or "") == frame),
+                        key=lambda e: e.name)
+        merges, inv_enters = [], []
+        for e in enters:
+            ms = [c for c in consumers.get(e.name, []) if c.op == "Merge"]
+            (merges.append(ms[0]) if ms else inv_enters.append(e))
+        merges = sorted(set(merges), key=lambda m: m.name)
+
+        switches, exit_of = [], {}
+        loopcond_ref = None
+        for m in merges:
+            sw = [c for c in consumers.get(m.name, []) if c.op == "Switch"]
+            if not sw:
+                raise ValueError(f"while frame {frame!r}: loop var "
+                                 f"{m.name!r} has no Switch")
+            switches.append(sw[0])
+            loopcond_ref = sw[0].inputs[1]
+            for c in consumers.get(sw[0].name, []):
+                if c.op == "Exit":
+                    exit_of[c.name] = len(switches) - 1
+        loopcond = self.nodes[_clean(loopcond_ref)]
+
+        var_seeds = [m.name for m in merges] + [e.name for e in inv_enters]
+        cond_model = self._subgraph(var_seeds, [loopcond.inputs[0]])
+        body_seeds = ([sw.name for sw in switches]
+                      + [e.name for e in inv_enters])
+        nextit_refs = []
+        for m in merges:
+            ni = self.nodes[_clean(m.inputs[1])]
+            if ni.op != "NextIteration":
+                raise ValueError(f"while frame {frame!r}: merge {m.name!r} "
+                                 f"second input is {ni.op}, not NextIteration")
+            nextit_refs.append(ni.inputs[0])
+        body_model = self._subgraph(
+            body_seeds, nextit_refs + [e.name for e in inv_enters])
+
+        # outer wiring: initial values enter through each var's Enter
+        outer_refs = ([self.nodes[_clean(m.inputs[0])].inputs[0] for m in merges]
+                      + [e.inputs[0] for e in inv_enters])
+        module, dyn_refs = self._bind_consts(
+            WhileLoop(cond_model, body_model), outer_refs, const_of)
+        node = module.set_name(f"while_frame/{frame}").inputs(
+            *[build(r) for r in dyn_refs])
+        frames[frame] = (node, exit_of)
+        return frames[frame]
 
     def _convert(self, n: _TFNode, build, const_of) -> nn.Node:
         op = n.op
@@ -580,6 +923,83 @@ class TensorflowLoader:
 
             return _Fn(lambda x, kk=k: _T(*jax.lax.top_k(x, kk))
                        ).set_name(n.name).inputs(prev(0))
+
+        # ----- functional control flow (≙ nn/tf/ControlOps.scala; lowered to
+        # lax.while_loop / lax.cond instead of Switch/Merge scheduling)
+        def wire_call(module):
+            """Wire a multi-arg functional module, binding const operands
+            (loop counters, max_iterations, captured constants) in place."""
+            module, dyn_refs = self._bind_consts(module, data_inputs, const_of)
+            return module.set_name(n.name).inputs(*[build(r) for r in dyn_refs])
+
+        if op in ("While", "StatelessWhile"):
+            from bigdl_tpu.nn.tf_ops import WhileLoop
+
+            cond_m = self._function_model(n.attr_func("cond"))
+            body_m = self._function_model(n.attr_func("body"))
+            return wire_call(WhileLoop(cond_m, body_m))
+        if op in ("If", "StatelessIf"):
+            from bigdl_tpu.nn.tf_ops import If
+
+            then_m = self._function_model(n.attr_func("then_branch"))
+            else_m = self._function_model(n.attr_func("else_branch"))
+            return wire_call(If(then_m, else_m))
+        if op in ("PartitionedCall", "StatefulPartitionedCall"):
+            return wire_call(self._function_model(n.attr_func("f")))
+        if op in ("NoOp", "ControlTrigger"):
+            return prev()  # control anchors: identity on data
+        if op == "Switch":
+            # TF1 cond lowering: both outputs carry the data (pure branches
+            # are evaluated unconditionally; Merge selects by the predicate)
+            from bigdl_tpu.utils.table import Table as _T
+
+            return (_Fn(lambda d, p: _T(d, d))
+                    .set_name(n.name).inputs(prev(0), prev(1)))
+        if op == "Merge":
+            # TF1 cond Merge: select between branch values by the predicate
+            # of the Switch that gates them (≙ MergeOps, ControlOps.scala:86,
+            # minus the scheduler: both branches computed, jnp.where selects)
+            from bigdl_tpu.utils.table import Table as _T
+
+            side0 = self._branch_side(data_inputs[0])
+            pred_ref = self._switch_pred(data_inputs[0]) or \
+                self._switch_pred(data_inputs[1])
+            if pred_ref is None:
+                raise ValueError(
+                    f"Merge {n.name!r}: cannot locate gating Switch predicate")
+            prevs = [build(data_inputs[0]), build(data_inputs[1]),
+                     build(pred_ref)]
+
+            def mg(a, b, p, s0=side0):
+                t, f = (a, b) if s0 else (b, a)
+                val = jax.tree.map(lambda u, v: jnp.where(p, u, v), t, f)
+                return _T(val, jnp.asarray(0, jnp.int32))
+
+            return _Fn(mg).set_name(n.name).inputs(*prevs)
+
+        # ----- tf.Example parsing (≙ nn/tf/ParsingOps.scala ParseExample)
+        if op in ("ParseExample", "ParseExampleV2"):
+            from bigdl_tpu.nn.tf_ops import ParseExample as _PE
+            from bigdl_tpu.utils.table import Table as _T
+
+            tdense = n.attr_types("Tdense")
+            shapes = n.attr_shapes("dense_shapes")
+            ndense = len(tdense)
+            if op == "ParseExampleV2":
+                keys = [k for k in np.asarray(const_of(data_inputs[3])).reshape(-1)]
+                defaults = [const_of(i) for i in data_inputs[5:5 + ndense]]
+            else:
+                nsparse = n.attr_i("Nsparse", 0)
+                ks = 2 + nsparse
+                keys = [const_of(i) for i in data_inputs[ks:ks + ndense]]
+                defaults = [const_of(i)
+                            for i in data_inputs[ks + ndense:ks + 2 * ndense]]
+            pe = _PE(ndense, tdense, shapes)
+
+            def parse(serialized, pe=pe, keys=keys, defaults=defaults):
+                return pe.forward(_T(serialized, None, *keys, *defaults))
+
+            return _Fn(parse).set_name(n.name).inputs(prev(0))
 
         raise ValueError(f"unsupported tf op {op!r} ({n.name})")
 

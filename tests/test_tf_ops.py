@@ -1,0 +1,160 @@
+"""TF infra ops (nn/tf_ops.py ≙ reference nn/tf/): control flow, state,
+TensorArray, parsing."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn import tf_ops
+from bigdl_tpu.utils.table import Table
+
+
+def test_while_loop_module_eager_and_jit():
+    m = nn.WhileLoop(cond=lambda i, acc: i < 10,
+                     body=lambda i, acc: (i + 1, acc + i))
+    out = m(Table(jnp.asarray(0), jnp.asarray(0)))
+    assert int(out[2]) == sum(range(10))
+
+    f = jax.jit(lambda i0, a0: tuple(m(Table(i0, a0))))
+    i, acc = f(jnp.asarray(0), jnp.asarray(0))
+    assert int(acc) == 45 and int(i) == 10
+
+
+def test_while_loop_max_iterations():
+    m = nn.WhileLoop(cond=lambda i: i < 100, body=lambda i: i + 1,
+                     max_iterations=7)
+    assert int(m(jnp.asarray(0))) == 7
+
+
+def test_control_nodes_while_loop_matches_reference_builder():
+    """ControlNodes.while_loop(condition, body, loopVars)
+    ≙ ControlOps.scala:296-326."""
+    out = tf_ops.ControlNodes.while_loop(
+        cond=lambda v: jnp.sum(v) < 100.0,
+        body=lambda v: v * 2.0,
+        loop_vars=[jnp.ones((4,))])
+    assert float(jnp.sum(out)) >= 100.0
+
+
+def test_if_module_both_branches():
+    m = nn.If(then_branch=lambda x: x * 2.0, else_branch=lambda x: x - 1.0)
+    np.testing.assert_allclose(
+        np.asarray(m(Table(jnp.asarray(True), jnp.ones((3,))))), 2 * np.ones(3))
+    np.testing.assert_allclose(
+        np.asarray(m(Table(jnp.asarray(False), jnp.ones((3,))))), np.zeros(3))
+
+
+def test_switch_merge_select():
+    sw = tf_ops.Switch()
+    mg = tf_ops.Merge()
+    data = jnp.asarray([1.0, 2.0])
+    out_t = sw(Table(data, jnp.asarray(True)))
+    picked = mg(out_t)
+    np.testing.assert_allclose(np.asarray(picked), np.asarray(data))
+
+
+def test_variable_assign():
+    v = nn.Variable(jnp.zeros((3,)))
+    nn.Assign(v)(jnp.ones((3,)))
+    np.testing.assert_allclose(np.asarray(v.value), np.ones(3))
+    nn.AssignAdd(v)(2 * jnp.ones((3,)))
+    np.testing.assert_allclose(np.asarray(v.value), 3 * np.ones(3))
+    nn.AssignSub(v)(jnp.ones((3,)))
+    np.testing.assert_allclose(np.asarray(v.value), 2 * np.ones(3))
+
+
+def test_variable_is_trainable_parameter():
+    v = nn.Variable(jnp.ones((2,)))
+    assert "value" in v.params_dict()["~params"]
+
+
+def test_tensor_array_write_read_stack_gather():
+    ta = nn.TensorArray(4, element_shape=(2,))
+    for i in range(4):
+        ta.write(i, jnp.full((2,), float(i)))
+    np.testing.assert_allclose(np.asarray(ta.read(2)), [2.0, 2.0])
+    assert ta.stack().shape == (4, 2)
+    np.testing.assert_allclose(np.asarray(ta.gather([1, 3]))[:, 0], [1.0, 3.0])
+    np.testing.assert_allclose(np.asarray(ta.concat()),
+                               np.repeat([0., 1, 2, 3], 2))
+
+
+def test_tensor_array_scatter_unstack_split():
+    ta = nn.TensorArray(3)
+    ta.scatter([0, 1, 2], jnp.arange(6.0).reshape(3, 2))
+    np.testing.assert_allclose(np.asarray(ta.read(1)), [2.0, 3.0])
+    ta2 = nn.TensorArray(2)
+    ta2.split(jnp.arange(6.0), [3, 3])
+    np.testing.assert_allclose(np.asarray(ta2.read(1)), [3.0, 4.0, 5.0])
+
+
+def test_tensor_array_in_while_loop():
+    """TensorArray buffer threads through lax control flow as a loop var
+    (the XLA-native analog of DataFlowOps' per-iteration writes)."""
+    buf = jnp.zeros((5, 2))
+
+    def body(i, b):
+        return i + 1, jax.lax.dynamic_update_index_in_dim(
+            b, jnp.full((2,), i, jnp.float32), i, 0)
+
+    _, out = jax.lax.while_loop(lambda c: c[0] < 5, lambda c: body(*c),
+                                (jnp.asarray(0), buf))
+    np.testing.assert_allclose(np.asarray(out)[:, 0], np.arange(5.0))
+
+
+def test_parse_example_module_roundtrip():
+    """ParseExample vs protos built by hand with protowire (no TF needed)."""
+    from bigdl_tpu.utils import protowire as pw
+
+    def feature_float(vals):
+        return pw.enc_bytes(2, pw.enc_packed_floats(1, vals))
+
+    def feature_int(vals):
+        return pw.enc_bytes(3, pw.enc_packed_varints(1, vals))
+
+    def example(feats: dict):
+        entries = b"".join(
+            pw.enc_bytes(1, pw.enc_string(1, k) + pw.enc_bytes(2, fv))
+            for k, fv in feats.items())
+        return pw.enc_bytes(1, entries)
+
+    recs = [
+        example({"feat": feature_float([1.0, 2.0]), "label": feature_int([5])}),
+        example({"feat": feature_float([3.0, 4.0]), "label": feature_int([8])}),
+    ]
+    pe = nn.ParseExample(2, [np.float32, np.int64], [(2,), ()])
+    out = pe(Table(np.asarray(recs, object), None,
+                   "feat", "label",
+                   np.zeros((2,), np.float32), np.asarray(0, np.int64)))
+    np.testing.assert_allclose(np.asarray(out[1]), [[1, 2], [3, 4]])
+    np.testing.assert_allclose(np.asarray(out[2]), [5, 8])
+
+
+def test_parse_example_missing_feature_uses_default():
+    from bigdl_tpu.utils import protowire as pw
+
+    empty = pw.enc_bytes(1, b"")  # Example with empty Features
+    pe = nn.ParseExample(1, [np.float32], [(3,)])
+    out = pe(Table(np.asarray([empty], object), None, "feat",
+                   np.asarray([7.0, 8.0, 9.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(out), [[7.0, 8.0, 9.0]])
+
+
+def test_assert_module():
+    a = tf_ops.Assert("boom")
+    out = a(Table(jnp.asarray(True), jnp.ones((2,))))
+    assert out.shape == (2,)
+    with pytest.raises(AssertionError):
+        a(Table(jnp.asarray(False), jnp.ones((2,))))
+
+
+def test_graph_cycle_error_mentions_while_loop():
+    lin = nn.Linear(2, 2)
+    n1 = nn.Node(lin)
+    n2 = nn.Node(nn.ReLU())
+    n1.inputs(n2)
+    n2.inputs(n1)
+    with pytest.raises(ValueError, match="WhileLoop"):
+        nn.Graph([n1], [n2])
