@@ -1,0 +1,6 @@
+from bigdl_tpu.dlframes.dlframes import (
+    DLClassifier, DLClassifierModel, DLEstimator, DLImageReader, DLModel,
+)
+
+__all__ = ["DLEstimator", "DLModel", "DLClassifier", "DLClassifierModel",
+           "DLImageReader"]

@@ -574,3 +574,13 @@ def pure_apply(module: Module) -> Callable:
         return out, new_buffers
 
     return apply_fn
+
+
+def jit_inference_fn(module: Module) -> Callable:
+    """Jitted eval-mode forward ``fn(params, buffers, input) -> out`` shared
+    by the inference facades (LocalPredictor / PredictionService / DLModel):
+    one compile per input signature, buffers read-only."""
+    import jax
+
+    apply_fn = pure_apply(module)
+    return jax.jit(lambda p, b, x: apply_fn(p, b, x, training=False)[0])

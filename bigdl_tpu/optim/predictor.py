@@ -8,26 +8,23 @@ returns 1-based indices (Appendix B.1)."""
 
 from __future__ import annotations
 
-import queue
 import threading
 from typing import List, Optional
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu.dataset.dataset import LocalDataSet
 from bigdl_tpu.dataset.sample import Sample
 from bigdl_tpu.dataset.transformer import SampleToMiniBatch
-from bigdl_tpu.nn.module import Module, pure_apply
+from bigdl_tpu.nn.module import Module, jit_inference_fn
 
 
 class LocalPredictor:
     def __init__(self, model: Module, batch_size: int = 32):
         self.model = model
         self.batch_size = batch_size
-        apply_fn = pure_apply(model)
-        self._fn = jax.jit(lambda p, b, x: apply_fn(p, b, x, training=False)[0])
+        self._fn = jit_inference_fn(model)
 
     def _batches(self, dataset):
         if isinstance(dataset, (list, tuple)):
@@ -59,26 +56,7 @@ class LocalPredictor:
         return np.asarray([int(np.argmax(p)) + 1 for p in preds])
 
 
-class PredictionService:
-    """Thread-safe concurrent serving (reference: optim/PredictionService.scala:56):
-    a blocking pool of model instances; under JAX the compiled function is
-    already thread-safe, so the pool bounds concurrency, not correctness."""
-
-    def __init__(self, model: Module, num_instances: int = 2, batch_size: int = 32):
-        self._pool: "queue.Queue[LocalPredictor]" = queue.Queue()
-        for _ in range(max(1, num_instances)):
-            self._pool.put(LocalPredictor(model, batch_size=batch_size))
-
-    def predict(self, input_activity):
-        """Predict one batched Activity. Inputs must carry a leading batch
-        dimension (single-sample callers add it: ``x[None]``)."""
-        predictor = self._pool.get()
-        try:
-            x = jnp.asarray(input_activity)
-            if x.ndim == 0:
-                raise ValueError("scalar input")
-            params = predictor.model.params_dict()
-            buffers = predictor.model.buffers_dict()
-            return np.asarray(predictor._fn(params, buffers, x))
-        finally:
-            self._pool.put(predictor)
+# The full serving facade (bytes protocol, error tensors, micro-batching)
+# lives in bigdl_tpu.optim.prediction_service; re-exported for parity with
+# the reference's optim package layout.
+from bigdl_tpu.optim.prediction_service import PredictionService  # noqa: E402,F401

@@ -1,0 +1,104 @@
+"""DataFrame estimator layer (≙ dlframes/DLEstimator.scala,
+DLClassifier.scala) over pandas."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.dlframes import DLClassifier, DLEstimator, DLImageReader
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.utils import random as rnd
+
+
+def _regression_df(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 4).astype(np.float32)
+    w = np.asarray([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    y = x @ w + 0.1
+    return pd.DataFrame({"features": list(x), "label": list(y)})
+
+
+def test_dlestimator_fit_transform_regression():
+    rnd.set_seed(3)
+    df = _regression_df()
+    model = nn.Sequential().add(nn.Linear(4, 1))
+    est = (DLEstimator(model, nn.MSECriterion(), [4], [1])
+           .set_batch_size(16)
+           .set_learning_rate(0.05)
+           .set_end_when(Trigger.max_epoch(60)))
+    fitted = est.fit(df)
+    out = fitted.transform(df)
+    assert "prediction" in out.columns
+    preds = np.asarray(out["prediction"].tolist()).reshape(-1)
+    truth = np.asarray(df["label"].tolist()).reshape(-1)
+    mse = float(np.mean((preds - truth) ** 2))
+    assert mse < 0.05, mse
+
+
+def test_dlclassifier_fit_transform():
+    rnd.set_seed(4)
+    rng = np.random.RandomState(1)
+    x = rng.randn(80, 2).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.int64) + 1  # classes 1/2
+    df = pd.DataFrame({"features": list(x), "label": list(y.astype(np.float32))})
+    model = (nn.Sequential().add(nn.Linear(2, 8)).add(nn.ReLU())
+             .add(nn.Linear(8, 2)).add(nn.LogSoftMax()))
+    clf = (DLClassifier(model, nn.ClassNLLCriterion(), [2])
+           .set_batch_size(16).set_learning_rate(0.1)
+           .set_end_when(Trigger.max_epoch(40)))
+    fitted = clf.fit(df)
+    out = fitted.transform(df)
+    preds = np.asarray(out["prediction"].tolist())
+    acc = float(np.mean(preds == y))
+    assert acc > 0.9, acc
+    assert set(np.unique(preds)) <= {1, 2}  # 1-based like the reference
+
+
+def test_sklearn_style_params():
+    model = nn.Sequential().add(nn.Linear(4, 1))
+    est = DLEstimator(model, nn.MSECriterion(), [4], [1])
+    p = est.get_params()
+    assert p["features_col"] == "features" and p["batch_size"] == 32
+    est.set_params(batch_size=8, features_col="f2")
+    assert est.batch_size == 8 and est.features_col == "f2"
+    with pytest.raises(ValueError):
+        est.set_params(bogus=1)
+
+
+def test_transform_respects_custom_cols_and_tail_batch():
+    rnd.set_seed(5)
+    df = _regression_df(n=19).rename(columns={"features": "f", "label": "y"})
+    model = nn.Sequential().add(nn.Linear(4, 1))
+    est = (DLEstimator(model, nn.MSECriterion(), [4], [1])
+           .set_features_col("f").set_label_col("y")
+           .set_prediction_col("pred").set_batch_size(8)
+           .set_end_when(Trigger.max_epoch(1)))
+    fitted = est.fit(df)
+    out = fitted.transform(df)
+    assert "pred" in out.columns and len(out) == 19
+
+
+def test_dlimage_reader_npy(tmp_path):
+    a = np.arange(12.0, dtype=np.float32).reshape(2, 2, 3)
+    p = str(tmp_path / "img0.npy")
+    np.save(p, a)
+    df = DLImageReader.read_images([p])
+    assert list(df.columns) == ["origin", "height", "width", "n_channels",
+                                "data"]
+    assert df.iloc[0]["data"].shape == (3, 2, 2)  # CHW
+
+
+def test_sklearn_clone_compatible():
+    """sklearn.base.clone reconstructs via type(est)(**est.get_params())."""
+    from sklearn.base import clone
+
+    model = nn.Sequential().add(nn.Linear(4, 1))
+    est = (DLEstimator(model, nn.MSECriterion(), [4], [1])
+           .set_batch_size(8).set_prediction_col("p"))
+    c = clone(est)
+    assert c is not est
+    assert c.batch_size == 8 and c.prediction_col == "p"
+    clf = DLClassifier(model, nn.ClassNLLCriterion(), [4], batch_size=4)
+    c2 = clone(clf)
+    assert c2.batch_size == 4 and list(c2.label_size) == [1]
