@@ -158,3 +158,12 @@ class DataSet:
                 seed: int = 1) -> ShardedDataSet:
         """≙ DataSet.rdd — shard records across hosts."""
         return ShardedDataSet(samples, shard_id=shard_id, num_shards=num_shards, seed=seed)
+
+
+def dataset_base(dataset):
+    """Unwrap Transformed/derived datasets to the backing store (shared by
+    Optimizer dispatch and DistriOptimizer's sharding guard)."""
+    base = dataset
+    while hasattr(base, "base"):
+        base = base.base
+    return base

@@ -230,11 +230,9 @@ class Optimizer:
         dataset = dataset if dataset is not None else training_set
         if cls is Optimizer:
             from bigdl_tpu.parallel.distri_optimizer import DistriOptimizer
-            from bigdl_tpu.dataset.dataset import ShardedDataSet
+            from bigdl_tpu.dataset.dataset import ShardedDataSet, dataset_base
 
-            base = dataset
-            while hasattr(base, "base"):
-                base = base.base
+            base = dataset_base(dataset)
             if isinstance(base, ShardedDataSet):
                 inst = object.__new__(DistriOptimizer)
             else:

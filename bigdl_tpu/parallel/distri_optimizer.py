@@ -179,10 +179,35 @@ class DistriOptimizer(LocalOptimizer):
         return jitted, ts
 
     # ---------------------------------------------------------- data feeding
+    @staticmethod
+    def _dataset_base(dataset):
+        from bigdl_tpu.dataset.dataset import dataset_base
+
+        return dataset_base(dataset)
+
     def _minibatches(self, dataset, batch_size, train=True):
         """Per-host batch = global batch / process_count (≙ per-partition
-        batch, dataset/Utils.scala:25-38). Single-host keeps the full batch."""
+        batch, dataset/Utils.scala:25-38). Single-host keeps the full batch.
+
+        Multi-host guard (≙ the reference's RDD partitioning making shards
+        disjoint BY CONSTRUCTION, dataset/DataSet.scala:358-367): a
+        non-sharded dataset iterated on every host would feed IDENTICAL
+        samples to each — silently destroying data parallelism. Sample
+        streams are auto-sharded by striding: host k keeps records where
+        i%nproc==k. PRECONDITION (documented in the warning): every host
+        must build the dataset from the same records in the same order with
+        the same seed — disjointness follows from identical streams, which
+        auto-striding cannot itself verify. Pre-batched MiniBatch streams
+        can't be split safely and raise; so does a ShardedDataSet whose
+        num_shards doesn't match the process count."""
         nproc = jax.process_count()
+        base = self._dataset_base(dataset)
+        pre_sharded = hasattr(base, "shard_id")  # ShardedDataSet/RecordFile
+        if pre_sharded and getattr(base, "num_shards", nproc) != nproc:
+            raise ValueError(
+                f"dataset is sharded {base.num_shards}-way but the run has "
+                f"{nproc} processes; shards would overlap or go unread — "
+                "rebuild with num_shards matching jax.process_count()")
         it = dataset.data(train=train)
         first = next(iter(it), None)
         if first is None:
@@ -196,8 +221,33 @@ class DistriOptimizer(LocalOptimizer):
         from bigdl_tpu.dataset.transformer import SampleToMiniBatch
 
         if isinstance(first, MiniBatch):
+            if nproc > 1 and not pre_sharded:
+                raise ValueError(
+                    "multi-host training with a pre-batched non-sharded "
+                    "dataset would feed identical batches to every host; "
+                    "build a ShardedDataSet/RecordFileDataSet instead")
             return chain()
-        return SampleToMiniBatch(batch_size, parallelism=nproc)(chain())
+        stream = chain()
+        if nproc > 1 and not pre_sharded:
+            if not getattr(self, "_warned_autoshard", False):
+                self._warned_autoshard = True
+                logger.warning(
+                    "multi-host run with a non-sharded dataset: auto-"
+                    "sharding the sample stream by process (stride %d, "
+                    "offset %d). This is only disjoint if EVERY host built "
+                    "the dataset from the same records in the same order "
+                    "with the same seed; for IO-scalable, verified-disjoint "
+                    "input use ShardedDataSet/RecordFileDataSet",
+                    nproc, jax.process_index())
+            rank = jax.process_index()
+
+            def strided(src=stream, k=nproc, r=rank):
+                for i, s in enumerate(src):
+                    if i % k == r:
+                        yield s
+
+            stream = strided()
+        return SampleToMiniBatch(batch_size, parallelism=nproc)(stream)
 
     def _to_global(self, host_array: np.ndarray, sharding):
         """Assemble the global device array from this process's local rows

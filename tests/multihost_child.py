@@ -1,0 +1,39 @@
+"""Child process for the 2-process jax.distributed data-path test.
+
+Usage: python multihost_child.py <port> <process_id> <mode>
+mode: "local" (non-sharded dataset -> auto-strided) or "sharded".
+Prints one line: SHARD <process_id> <sorted label list of its first batch>.
+"""
+
+import sys
+
+port, pid, mode = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+
+import jax
+
+jax.distributed.initialize(f"localhost:{port}", num_processes=2,
+                           process_id=pid)
+
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset.dataset import LocalDataSet, ShardedDataSet
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.parallel.distri_optimizer import DistriOptimizer
+from bigdl_tpu.parallel.engine import Engine
+
+mesh = Engine.default_mesh()
+
+# 16 distinguishable samples: feature == label index
+samples = [Sample(np.full((2,), float(i), np.float32),
+                  np.asarray([i + 1], np.float32)) for i in range(16)]
+ds = (LocalDataSet(samples, seed=7) if mode == "local"
+      else ShardedDataSet(samples, seed=7))
+
+opt = DistriOptimizer(
+    model=nn.Sequential().add(nn.Linear(2, 2)),
+    dataset=ds, criterion=nn.MSECriterion(), batch_size=8, mesh=mesh)
+
+mb = next(iter(opt._minibatches(ds, 8)))
+ids = sorted(int(v) for v in np.asarray(mb.get_input())[:, 0])
+print(f"SHARD {pid} {ids}", flush=True)
