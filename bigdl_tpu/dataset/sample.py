@@ -18,11 +18,25 @@ ArrayLike = Union[np.ndarray, float, int, Sequence]
 class Sample:
     __slots__ = ("features", "labels")
 
+    @staticmethod
+    def _as_feature(f):
+        # sparse features stay sparse (≙ Sample over SparseTensor feeding
+        # SparseMiniBatch, dataset/MiniBatch.scala:588); import deferred to
+        # avoid a dataset <-> nn cycle
+        from bigdl_tpu.nn.sparse import SparseTensor
+        from jax.experimental import sparse as jsparse
+
+        if isinstance(f, SparseTensor):
+            return f
+        if isinstance(f, jsparse.BCOO):
+            return SparseTensor(f)
+        return np.asarray(f)
+
     def __init__(self, features, labels=None):
         if isinstance(features, np.ndarray) or not isinstance(features, (list, tuple)):
-            features = [np.asarray(features)]
+            features = [self._as_feature(features)]
         else:
-            features = [np.asarray(f) for f in features]
+            features = [self._as_feature(f) for f in features]
         self.features: List[np.ndarray] = features
         if labels is None:
             self.labels: List[np.ndarray] = []

@@ -69,15 +69,25 @@ class SampleToMiniBatch(Transformer):
         self.label_padding = label_padding
         self.partial_batch = partial_batch
 
+    @staticmethod
+    def _batch(buf, feature_padding, label_padding):
+        # samples carrying sparse features route to SparseMiniBatch
+        # (≙ MiniBatch.scala:588's SparseMiniBatch dispatch)
+        if any(type(f).__name__ == "SparseTensor" for f in buf[0].features):
+            from bigdl_tpu.nn.sparse import SparseMiniBatch
+
+            return SparseMiniBatch.from_samples(buf)
+        return MiniBatch.from_samples(buf, feature_padding, label_padding)
+
     def __call__(self, it):
         buf: List[Sample] = []
         for s in it:
             buf.append(s)
             if len(buf) == self.batch_per_iter:
-                yield MiniBatch.from_samples(buf, self.feature_padding, self.label_padding)
+                yield self._batch(buf, self.feature_padding, self.label_padding)
                 buf = []
         if buf and self.partial_batch:
-            yield MiniBatch.from_samples(buf, self.feature_padding, self.label_padding)
+            yield self._batch(buf, self.feature_padding, self.label_padding)
 
 
 class Normalizer(Transformer):

@@ -80,3 +80,7 @@ from bigdl_tpu.nn.tf_ops import (
     WhileLoop, If, ControlNodes, Variable, Assign, AssignAdd, AssignSub,
     TensorArray, ParseExample,
 )
+from bigdl_tpu.nn.sparse import (
+    LookupTableSparse, SparseJoinTable, SparseLinear, SparseMiniBatch,
+    SparseTensor,
+)

@@ -1,0 +1,313 @@
+"""Sparse layer stack for the wide-and-deep / recommendation capability class.
+
+Reference: tensor/SparseTensor.scala (COO tensor + sparse BLAS),
+nn/SparseLinear.scala:44, nn/LookupTableSparse.scala:49,
+nn/SparseJoinTable.scala, dataset/MiniBatch.scala:588 (SparseMiniBatch).
+
+TPU-native substrate: ``jax.experimental.sparse.BCOO`` — batched-COO with
+static nse, which XLA lowers to gather/scatter/segment ops the TPU handles
+well. The reference's hand-written sparse BLAS (SparseTensorBLAS.scala) is
+absorbed by ``bcoo_dot_general``; its dynamic per-row storage becomes a
+fixed-nse layout (pad-with-zeros), the standard static-shape trade.
+
+``SparseTensor`` here is the user-facing facade with the reference's
+1-based Torch ctor conventions; internally everything is BCOO.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from bigdl_tpu.nn import init as bt_init
+from bigdl_tpu.nn.module import Module
+
+
+class SparseTensor:
+    """COO facade over BCOO (≙ tensor/SparseTensor.scala; ``Tensor.sparse``
+    ctor shapes). ``indices`` are 0-based here (numpy convention — the
+    Scala API's 1-based storage offsets are a JVM detail)."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self.bcoo = bcoo
+
+    # --------------------------------------------------------- constructors
+    @staticmethod
+    def coo(indices, values, shape) -> "SparseTensor":
+        """``Tensor.sparse(Array(rowIdx, colIdx), values, shape)`` analog:
+        ``indices`` is (ndim, nse) or (nse, ndim). When both readings fit
+        (nse == ndim), the DOCUMENTED (ndim, nse) orientation wins — square
+        index arrays are never silently read the other way."""
+        idx = np.asarray(indices)
+        if idx.ndim != 2:
+            raise ValueError("indices must be 2-D")
+        if idx.shape[0] == len(shape):
+            idx = idx.T  # (ndim, nse) -> (nse, ndim)
+        elif idx.shape[1] != len(shape):
+            raise ValueError(
+                f"indices {idx.shape} fit neither (ndim, nse) nor "
+                f"(nse, ndim) for shape {tuple(shape)}")
+        return SparseTensor(jsparse.BCOO(
+            (jnp.asarray(values), jnp.asarray(idx, jnp.int32)),
+            shape=tuple(shape)))
+
+    @staticmethod
+    def from_dense(dense, nse: Optional[int] = None) -> "SparseTensor":
+        return SparseTensor(jsparse.BCOO.fromdense(jnp.asarray(dense),
+                                                   nse=nse))
+
+    # -------------------------------------------------------------- views
+    @property
+    def shape(self):
+        return self.bcoo.shape
+
+    @property
+    def indices(self):
+        return self.bcoo.indices
+
+    @property
+    def values(self):
+        return self.bcoo.data
+
+    def to_dense(self):
+        return self.bcoo.todense()
+
+    def __repr__(self):
+        return f"SparseTensor(shape={self.shape}, nse={self.bcoo.nse})"
+
+
+# SparseTensor flows through jit/vjp like any activity (BCOO is a pytree)
+jax.tree_util.register_pytree_node(
+    SparseTensor,
+    lambda st: ((st.bcoo,), None),
+    lambda aux, children: SparseTensor(children[0]))
+
+
+def _as_bcoo(x) -> jsparse.BCOO:
+    if isinstance(x, SparseTensor):
+        return x.bcoo
+    if isinstance(x, jsparse.BCOO):
+        return x
+    return jsparse.BCOO.fromdense(jnp.asarray(x))
+
+
+class SparseLinear(Module):
+    """≙ nn/SparseLinear.scala:44: dense layer over a sparse (batch, in)
+    activation; y = xW^T + b via ``bcoo_dot_general`` (the MXU sees a
+    gather + matmul, no dense materialization of x).
+
+    ``backward_start``/``backward_length`` (1-based, matching the
+    reference) confine gradInput to a column slice — the Wide&Deep trick
+    where only the dense tail of a concatenated input needs gradient."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 with_bias: bool = True, backward_start: int = -1,
+                 backward_length: int = -1, w_regularizer=None,
+                 b_regularizer=None, init_weight=None, init_bias=None):
+        super().__init__()
+        self.input_size, self.output_size = input_size, output_size
+        self.with_bias = with_bias
+        self.backward_start = backward_start
+        self.backward_length = backward_length
+        w = (jnp.asarray(init_weight) if init_weight is not None else
+             bt_init.Xavier()((output_size, input_size),
+                              fan_in=input_size, fan_out=output_size))
+        self.register_parameter("weight", w, regularizer=w_regularizer)
+        if with_bias:
+            b = (jnp.asarray(init_bias) if init_bias is not None
+                 else jnp.zeros((output_size,)))
+            self.register_parameter("bias", b, regularizer=b_regularizer)
+
+    def forward(self, input):
+        x = _as_bcoo(input)
+        out = jsparse.bcoo_dot_general(
+            x, self.weight.T,
+            dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())))
+        if self.with_bias:
+            out = out + self.bias
+        return out
+
+    def backward(self, input, grad_output):
+        """With backward_start/length set, gradInput is the DENSE column
+        slice [start, start+length) (1-based) — the only part of a
+        sparse-wide input that feeds a differentiable upstream
+        (SparseLinear.scala:87-99). Weight/bias grads still accumulate
+        through the standard path."""
+        if self.backward_start > 0 and self.backward_length > 0:
+            # standard vjp for accGradParameters (its full sparse gradInput
+            # cotangent costs one sparse matmul we discard — accepted to
+            # keep the cached-vjp path single-sourced)
+            super().backward(input, grad_output)
+            s = self.backward_start - 1
+            w_slice = self.weight[:, s:s + self.backward_length]
+            gi = jnp.asarray(grad_output) @ w_slice
+            self.grad_input = gi  # eager-API state matches what we return
+            return gi
+        return super().backward(input, grad_output)
+
+    def _extra_repr(self):
+        return f"({self.input_size} -> {self.output_size})"
+
+
+class LookupTableSparse(Module):
+    """≙ nn/LookupTableSparse.scala:49: embedding bag over sparse id lists.
+
+    Input: Table(ids, weights?) where ids is a SparseTensor/BCOO of shape
+    (batch, max_ids) holding **1-BASED** category ids at the active
+    positions (0 = inactive — the reference's Torch convention,
+    LookupTableSparse.scala:49; this also makes zero-padded batched
+    sparse tensors naturally safe), or a dense padded id matrix with
+    0-based ids and ``pad_id`` marking empties. ``combiner`` in
+    {sum, mean, sqrtn}; ``max_norm`` L2-clips each embedding row before
+    combining, exactly like the reference."""
+
+    def __init__(self, n_index: int, n_output: int, combiner: str = "sum",
+                 max_norm: float = -1.0, w_regularizer=None,
+                 pad_id: int = -1):
+        super().__init__()
+        if combiner not in ("sum", "mean", "sqrtn"):
+            raise ValueError(f"bad combiner {combiner!r}")
+        self.n_index, self.n_output = n_index, n_output
+        self.combiner = combiner
+        self.max_norm = max_norm
+        self.pad_id = pad_id
+        self.register_parameter(
+            "weight",
+            bt_init.RandomNormal(0.0, 1.0 / np.sqrt(n_output))(
+                (n_index, n_output)),
+            regularizer=w_regularizer)
+
+    def _ids_mask_weights(self, input):
+        from bigdl_tpu.utils.table import Table
+
+        per_id_w = None
+        ids = input
+        if isinstance(input, Table):
+            vals = list(input)
+            ids = vals[0]
+            if len(vals) > 1:
+                per_id_w = vals[1]
+        if isinstance(ids, (SparseTensor, jsparse.BCOO)):
+            # 1-based sparse ids -> dense via a pure-jnp max-scatter (jit/
+            # vjp-safe): padded duplicates carry value 0 and can never beat
+            # a real (>=1) id, whatever the entry order
+            b = _as_bcoo(ids)
+            idx = tuple(jnp.moveaxis(b.indices, -1, 0))
+            dense = jnp.zeros(b.shape, jnp.int32).at[idx].max(
+                b.data.astype(jnp.int32))
+            mask = dense > 0
+            safe = jnp.maximum(dense - 1, 0)
+            if isinstance(per_id_w, (SparseTensor, jsparse.BCOO)):
+                wb = _as_bcoo(per_id_w)
+                widx = tuple(jnp.moveaxis(wb.indices, -1, 0))
+                per_id_w = jnp.zeros(wb.shape, wb.data.dtype).at[widx].add(
+                    wb.data)
+            return safe, mask, per_id_w
+        ids = jnp.asarray(ids)
+        mask = (ids != self.pad_id)
+        safe = jnp.where(mask, ids, 0).astype(jnp.int32)
+        return safe, mask, per_id_w
+
+    def forward(self, input):
+        ids, mask, per_id_w = self._ids_mask_weights(input)
+        emb = jnp.take(self.weight, ids, axis=0)  # (batch, L, d)
+        if self.max_norm > 0:
+            norms = jnp.linalg.norm(emb, axis=-1, keepdims=True)
+            emb = emb * jnp.minimum(1.0, self.max_norm / (norms + 1e-12))
+        w = mask.astype(emb.dtype)
+        if per_id_w is not None:
+            w = w * jnp.asarray(per_id_w, emb.dtype)
+        summed = jnp.einsum("bl,bld->bd", w, emb)
+        if self.combiner == "sum":
+            return summed
+        denom = jnp.sum(w, axis=1, keepdims=True)
+        if self.combiner == "mean":
+            return summed / jnp.maximum(denom, 1e-12)
+        return summed / jnp.sqrt(jnp.maximum(
+            jnp.sum(w * w, axis=1, keepdims=True), 1e-12))
+
+
+class SparseJoinTable(Module):
+    """≙ nn/SparseJoinTable.scala: concatenate sparse activations along
+    ``dimension`` (1-based, Torch legacy)."""
+
+    def __init__(self, dimension: int):
+        super().__init__()
+        self.dimension = dimension
+
+    def forward(self, input):
+        mats = [_as_bcoo(x) for x in input]
+        out = jsparse.bcoo_concatenate(mats, dimension=self.dimension - 1)
+        return SparseTensor(out)
+
+
+class SparseMiniBatch:
+    """≙ dataset/MiniBatch.scala:588 SparseMiniBatch: batch Samples whose
+    features mix sparse and dense tensors. Sparse features (given as
+    (indices, values, shape) triples or SparseTensor rows) batch into one
+    BCOO with a fresh leading batch dim; dense features np.stack."""
+
+    def __init__(self, features: List, labels=None):
+        self.features = features
+        self.labels = labels
+
+    @staticmethod
+    def _batch_sparse(rows: Sequence[SparseTensor]) -> SparseTensor:
+        shape = rows[0].shape
+        nse = max(int(r.bcoo.nse) for r in rows)
+        idx, vals = [], []
+        for r in rows:
+            b = r.bcoo
+            pad = nse - int(b.nse)
+            ri = np.asarray(b.indices)
+            rv = np.asarray(b.data)
+            if pad:
+                ri = np.concatenate([ri, np.zeros((pad, ri.shape[1]),
+                                                  ri.dtype)])
+                rv = np.concatenate([rv, np.zeros((pad,), rv.dtype)])
+            idx.append(ri)
+            vals.append(rv)
+        n = len(rows)
+        batch_idx = np.repeat(np.arange(n), nse)[:, None]
+        flat_idx = np.concatenate(idx)
+        full_idx = np.concatenate([batch_idx, flat_idx], axis=1)
+        return SparseTensor(jsparse.BCOO(
+            (jnp.asarray(np.concatenate(vals)),
+             jnp.asarray(full_idx, jnp.int32)),
+            shape=(n,) + tuple(shape)))
+
+    @classmethod
+    def from_samples(cls, samples) -> "SparseMiniBatch":
+        from bigdl_tpu.utils.table import Table
+
+        n_feat = len(samples[0].features)
+        feats = []
+        for j in range(n_feat):
+            col = [s.features[j] for s in samples]
+            if isinstance(col[0], SparseTensor):
+                feats.append(cls._batch_sparse(col))
+            else:
+                feats.append(jnp.asarray(np.stack(col)))
+        labels = None
+        if samples[0].labels:
+            cols = [jnp.asarray(np.stack([s.labels[j] for s in samples]))
+                    for j in range(len(samples[0].labels))]
+            labels = cols[0] if len(cols) == 1 else Table(*cols)
+        return cls(feats, labels)
+
+    def get_input(self):
+        from bigdl_tpu.utils.table import Table
+
+        return self.features[0] if len(self.features) == 1 \
+            else Table(*self.features)
+
+    def get_target(self):
+        return self.labels
+
+    def size(self) -> int:
+        f = self.features[0]
+        return int(f.shape[0])

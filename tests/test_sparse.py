@@ -1,0 +1,224 @@
+"""Sparse stack (≙ tensor/SparseTensor.scala, nn/SparseLinear.scala,
+nn/LookupTableSparse.scala, nn/SparseJoinTable.scala, SparseMiniBatch)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.sparse import SparseMiniBatch, SparseTensor
+from bigdl_tpu.utils.table import Table
+
+
+def test_sparse_tensor_coo_roundtrip():
+    st = SparseTensor.coo(indices=[[0, 1], [1, 0]], values=[3.0, 4.0],
+                          shape=(2, 3))
+    d = np.asarray(st.to_dense())
+    np.testing.assert_allclose(d, [[0, 3, 0], [4, 0, 0]])
+    back = SparseTensor.from_dense(d)
+    np.testing.assert_allclose(np.asarray(back.to_dense()), d)
+
+
+def test_sparse_linear_matches_dense_linear():
+    rng = np.random.RandomState(0)
+    dense = rng.rand(4, 6).astype(np.float32)
+    dense[dense < 0.7] = 0.0
+    w = rng.randn(3, 6).astype(np.float32)
+    b = rng.randn(3).astype(np.float32)
+    sl = nn.SparseLinear(6, 3, init_weight=w, init_bias=b)
+    out = np.asarray(sl(SparseTensor.from_dense(dense)))
+    np.testing.assert_allclose(out, dense @ w.T + b, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_linear_trains():
+    rng = np.random.RandomState(1)
+    x = (rng.rand(32, 10) * (rng.rand(32, 10) > 0.8)).astype(np.float32)
+    true_w = rng.randn(1, 10).astype(np.float32)
+    y = x @ true_w.T
+    sl = nn.SparseLinear(10, 1)
+    crit = nn.MSECriterion()
+    sx = SparseTensor.from_dense(x)
+    for _ in range(120):
+        sl.zero_grad_parameters()
+        out = sl(sx)
+        loss = crit(out, jnp.asarray(y))
+        sl.backward(sx, crit.backward(out, jnp.asarray(y)))
+        sl.update_parameters(0.3)
+    assert float(loss) < 0.05, float(loss)
+
+
+@pytest.mark.parametrize("combiner", ["sum", "mean", "sqrtn"])
+def test_lookup_table_sparse_combiners(combiner):
+    lt = nn.LookupTableSparse(10, 4, combiner=combiner)
+    ids = jnp.asarray([[1, 3, -1], [2, -1, -1]])  # -1 = padding
+    out = np.asarray(lt(ids))
+    w = np.asarray(lt.weight)
+    row0 = w[1] + w[3]
+    row1 = w[2]
+    if combiner == "mean":
+        row0, row1 = row0 / 2, row1 / 1
+    elif combiner == "sqrtn":
+        row0, row1 = row0 / np.sqrt(2), row1 / 1
+    np.testing.assert_allclose(out, np.stack([row0, row1]), rtol=1e-5)
+
+
+def test_lookup_table_sparse_ids_as_sparse_tensor_with_weights():
+    lt = nn.LookupTableSparse(10, 4, combiner="sum")
+    # sparse ids are 1-BASED (0 = inactive, LookupTableSparse.scala:49):
+    # row 0 has ids {1 (w 2.0), 3 (w 0.5)}, row 1 has {2 (w 1.0)}
+    ids = SparseTensor.coo([[0, 0, 1], [0, 1, 0]], [1, 3, 2], (2, 2))
+    wts = SparseTensor.coo([[0, 0, 1], [0, 1, 0]], [2.0, 0.5, 1.0], (2, 2))
+    out = np.asarray(lt(Table(ids, wts)))
+    w = np.asarray(lt.weight)
+    np.testing.assert_allclose(out[0], 2.0 * w[0] + 0.5 * w[2], rtol=1e-5)
+    np.testing.assert_allclose(out[1], w[1], rtol=1e-5)
+
+
+def test_lookup_sparse_minibatch_pad_safe_and_jittable():
+    """Regression: zero-padded batched sparse ids must NOT clobber real ids
+    (pad value 0 = inactive under 1-based ids), and the sparse-id path must
+    trace under jit and backward."""
+    import jax
+
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.nn.sparse import SparseMiniBatch
+
+    lt = nn.LookupTableSparse(10, 3, combiner="sum")
+    w = np.asarray(lt.weight)
+    # 1-based ids: {4, 5} and {6}; position 0 is occupied by real entries
+    s1 = Sample(SparseTensor.coo([[0, 1]], [4, 5], (2,)), np.asarray([1.0]))
+    s2 = Sample(SparseTensor.coo([[0]], [6], (2,)), np.asarray([2.0]))
+    mb = SparseMiniBatch.from_samples([s1, s2])
+    ids = mb.get_input()
+    out = np.asarray(lt(ids))
+    np.testing.assert_allclose(out[0], w[3] + w[4], rtol=1e-5)
+    np.testing.assert_allclose(out[1], w[5], rtol=1e-5)  # NOT w[0]
+    # jit parity through the pure path
+    from bigdl_tpu.nn.module import pure_apply
+
+    fn = pure_apply(lt)
+    outj = np.asarray(jax.jit(
+        lambda p, t: fn(p, {}, t, training=False)[0])(lt.params_dict(), ids))
+    np.testing.assert_allclose(outj, out, rtol=1e-5)
+    # backward accumulates embedding grads without tracer errors
+    lt.zero_grad_parameters()
+    lt.backward(ids, jnp.ones((2, 3)))
+
+
+def test_sample_to_minibatch_dispatches_sparse():
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+    from bigdl_tpu.nn.sparse import SparseMiniBatch
+
+    samples = [Sample(SparseTensor.coo([[0]], [float(i + 1)], (3,)),
+                      np.asarray([float(i)])) for i in range(4)]
+    batches = list(SampleToMiniBatch(2)(iter(samples)))
+    assert len(batches) == 2
+    assert isinstance(batches[0], SparseMiniBatch)
+    assert batches[0].size() == 2
+
+
+def test_coo_square_indices_use_documented_orientation():
+    """Regression: nse == ndim index arrays read as (ndim, nse) — the
+    documented Tensor.sparse orientation — not silently transposed."""
+    st = SparseTensor.coo([[0, 0], [1, 2]], [1.0, 2.0], (2, 3))
+    np.testing.assert_allclose(np.asarray(st.to_dense()),
+                               [[0, 1, 2], [0, 0, 0]])
+
+
+def test_lookup_table_max_norm():
+    lt = nn.LookupTableSparse(5, 3, combiner="sum", max_norm=0.1)
+    lt._set_param("weight", jnp.ones((5, 3)))  # norm sqrt(3) >> 0.1
+    out = np.asarray(lt(jnp.asarray([[0, -1]])))
+    np.testing.assert_allclose(np.linalg.norm(out[0]), 0.1, rtol=1e-4)
+
+
+def test_sparse_join_table():
+    a = SparseTensor.from_dense(np.asarray([[1.0, 0], [0, 2.0]]))
+    b = SparseTensor.from_dense(np.asarray([[0, 3.0], [4.0, 0]]))
+    joined = nn.SparseJoinTable(2)(Table(a, b))
+    np.testing.assert_allclose(np.asarray(joined.to_dense()),
+                               [[1, 0, 0, 3], [0, 2, 4, 0]])
+
+
+def test_sparse_minibatch_from_samples():
+    from bigdl_tpu.dataset.sample import Sample
+
+    s1 = Sample([SparseTensor.coo([[0], [2]], [1.0, 2.0], (4,)),
+                 np.asarray([9.0, 9.0], np.float32)], np.asarray([1.0]))
+    s2 = Sample([SparseTensor.coo([[1]], [5.0], (4,)),
+                 np.asarray([7.0, 7.0], np.float32)], np.asarray([2.0]))
+    mb = SparseMiniBatch.from_samples([s1, s2])
+    assert mb.size() == 2
+    feats = mb.get_input()
+    sp = np.asarray(feats[1].to_dense())
+    np.testing.assert_allclose(sp, [[1, 0, 2, 0], [0, 5, 0, 0]])
+    np.testing.assert_allclose(np.asarray(feats[2]), [[9, 9], [7, 7]])
+    np.testing.assert_allclose(np.asarray(mb.get_target()), [[1], [2]])
+
+
+def test_wide_and_deep_smoke():
+    """Wide (SparseLinear over crossed one-hots) + Deep (embedding + MLP)
+    composing and training — the capability class the sparse stack exists
+    for (≙ the reference's wide-and-deep recommendation example)."""
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(9)
+    rng = np.random.RandomState(2)
+    n, wide_dim, n_cat = 64, 20, 8
+    wide_x = (rng.rand(n, wide_dim) * (rng.rand(n, wide_dim) > 0.9)
+              ).astype(np.float32)
+    cat_ids = rng.randint(0, n_cat, (n, 2))
+    deep_x = rng.randn(n, 4).astype(np.float32)
+    logits_true = (wide_x.sum(1) * 0.5 + (cat_ids[:, 0] == 3) * 2.0
+                   + deep_x[:, 0] - 0.5)
+    y = (logits_true > 0).astype(np.float32)[:, None]
+
+    wide = nn.SparseLinear(wide_dim, 1)
+    emb = nn.LookupTableSparse(n_cat, 4, combiner="mean")
+    deep = (nn.Sequential().add(nn.Linear(8, 8)).add(nn.ReLU())
+            .add(nn.Linear(8, 1)))
+    sig = nn.Sigmoid()
+    crit = nn.BCECriterion()
+
+    sx = SparseTensor.from_dense(wide_x)
+    ids = jnp.asarray(cat_ids)
+    dx = jnp.asarray(deep_x)
+    yj = jnp.asarray(y)
+
+    losses = []
+    for _ in range(60):
+        for m in (wide, emb, deep):
+            m.zero_grad_parameters()
+        e = emb(ids)
+        deep_in = jnp.concatenate([e, dx], axis=1)
+        d_out = deep(deep_in)
+        w_out = wide(sx)
+        out = sig(w_out + d_out)
+        losses.append(float(crit(out, yj)))
+        g = crit.backward(out, yj)
+        g = sig.backward(w_out + d_out, g)
+        wide.backward(sx, g)
+        g_deep_in = deep.backward(deep_in, g)
+        emb.backward(ids, g_deep_in[:, :4])
+        for m in (wide, emb, deep):
+            m.update_parameters(0.5)
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_sparse_linear_backward_slice():
+    """backward_start/length confine gradInput to a dense column slice
+    (≙ SparseLinear.scala:87-99, the Wide&Deep input-tail gradient)."""
+    rng = np.random.RandomState(3)
+    x = (rng.rand(4, 6) * (rng.rand(4, 6) > 0.5)).astype(np.float32)
+    w = rng.randn(2, 6).astype(np.float32)
+    sl = nn.SparseLinear(6, 2, init_weight=w, backward_start=3,
+                         backward_length=2)
+    sx = SparseTensor.from_dense(x)
+    go = rng.randn(4, 2).astype(np.float32)
+    sl.zero_grad_parameters()
+    sl(sx)
+    gi = np.asarray(sl.backward(sx, jnp.asarray(go)))
+    assert gi.shape == (4, 2)
+    np.testing.assert_allclose(gi, go @ w[:, 2:4], rtol=1e-5)
