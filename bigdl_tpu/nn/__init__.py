@@ -84,3 +84,7 @@ from bigdl_tpu.nn.sparse import (
     LookupTableSparse, SparseJoinTable, SparseLinear, SparseMiniBatch,
     SparseTensor,
 )
+from bigdl_tpu.nn.detection import (
+    Anchor, DetectionOutputSSD, Nms, PriorBox, Proposal, RoiPooling,
+    bbox_iou, decode_boxes, nms,
+)

@@ -1,0 +1,448 @@
+"""Detection heads: SSD + Faster-RCNN building blocks.
+
+Reference: nn/PriorBox.scala:41, nn/Anchor.scala, nn/Nms.scala:26,
+nn/Proposal.scala:34, nn/RoiPooling.scala:42,
+nn/DetectionOutputSSD.scala:301, nn/DetectionOutputFrcnn.scala, and the
+box math in transform/vision/image/util/BboxUtil.scala:283 (decodeBoxes).
+
+TPU-native notes:
+- NMS is the classic data-dependent loop; the reference runs a JVM greedy
+  scan (Nms.scala). Here ``nms`` is a FIXED-ITERATION masked greedy scan
+  (``lax.fori_loop`` over top-k candidates) — static shapes, compiles once,
+  returns (keep_indices, keep_count) with tail padding. The same function
+  runs eagerly on host for the inference heads.
+- RoiPooling avoids dynamic slicing (impossible under XLA) by masked
+  max-reduction over the full feature map per output cell — dense FLOPs
+  traded for static shapes, the standard TPU formulation.
+- DetectionOutputSSD/Frcnn are inference-only heads emitting variable-length
+  results; they run HOST-side on numpy exactly like the reference runs them
+  JVM-side post-forward (DetectionOutputSSD.scala's output assembly).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils.table import Table
+
+
+# ------------------------------------------------------------------ box math
+def bbox_iou(boxes_a, boxes_b):
+    """IoU matrix (Na, Nb); boxes are (x1, y1, x2, y2)."""
+    a = jnp.asarray(boxes_a)
+    b = jnp.asarray(boxes_b)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return inter / (area_a[:, None] + area_b[None, :] - inter + 1e-12)
+
+
+def decode_boxes(prior_boxes, prior_variances, deltas,
+                 variance_encoded_in_target: bool = False,
+                 clip: bool = False):
+    """SSD center-size decode (≙ BboxUtil.decodeBoxes:283)."""
+    p = jnp.asarray(prior_boxes)
+    v = jnp.asarray(prior_variances)
+    d = jnp.asarray(deltas)
+    pw = p[:, 2] - p[:, 0]
+    ph = p[:, 3] - p[:, 1]
+    pcx = (p[:, 0] + p[:, 2]) / 2
+    pcy = (p[:, 1] + p[:, 3]) / 2
+    if variance_encoded_in_target:
+        cx = d[:, 0] * pw + pcx
+        cy = d[:, 1] * ph + pcy
+        w = jnp.exp(d[:, 2]) * pw
+        h = jnp.exp(d[:, 3]) * ph
+    else:
+        cx = v[:, 0] * d[:, 0] * pw + pcx
+        cy = v[:, 1] * d[:, 1] * ph + pcy
+        w = jnp.exp(v[:, 2] * d[:, 2]) * pw
+        h = jnp.exp(v[:, 3] * d[:, 3]) * ph
+    out = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=1)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+def bbox_transform_inv(boxes, deltas):
+    """RCNN-style delta application (≙ BboxUtil.bboxTransformInv)."""
+    boxes = jnp.asarray(boxes)
+    deltas = jnp.asarray(deltas)
+    w = boxes[:, 2] - boxes[:, 0] + 1.0
+    h = boxes[:, 3] - boxes[:, 1] + 1.0
+    cx = boxes[:, 0] + 0.5 * w
+    cy = boxes[:, 1] + 0.5 * h
+    pcx = deltas[:, 0::4] * w[:, None] + cx[:, None]
+    pcy = deltas[:, 1::4] * h[:, None] + cy[:, None]
+    pw = jnp.exp(deltas[:, 2::4]) * w[:, None]
+    ph = jnp.exp(deltas[:, 3::4]) * h[:, None]
+    out = jnp.stack([pcx - 0.5 * pw, pcy - 0.5 * ph,
+                     pcx + 0.5 * pw - 1.0, pcy + 0.5 * ph - 1.0], axis=2)
+    return out.reshape(boxes.shape[0], -1)
+
+
+def clip_boxes(boxes, height, width):
+    x1 = jnp.clip(boxes[:, 0::4], 0, width - 1.0)
+    y1 = jnp.clip(boxes[:, 1::4], 0, height - 1.0)
+    x2 = jnp.clip(boxes[:, 2::4], 0, width - 1.0)
+    y2 = jnp.clip(boxes[:, 3::4], 0, height - 1.0)
+    out = jnp.stack([x1, y1, x2, y2], axis=2)
+    return out.reshape(boxes.shape[0], -1)
+
+
+# ----------------------------------------------------------------------- NMS
+def nms(scores, boxes, thresh: float, topk: int = 200):
+    """Greedy IoU suppression (≙ nn/Nms.scala:26) as a fixed-iteration
+    masked scan. Returns (indices[topk], count): the first ``count``
+    indices are kept detections sorted by score, the tail is padding."""
+    scores = jnp.asarray(scores)
+    boxes = jnp.asarray(boxes)
+    n = scores.shape[0]
+    k = min(topk, n)
+    order = jnp.argsort(-scores)[:k]
+    cand_boxes = boxes[order]
+    iou = bbox_iou(cand_boxes, cand_boxes)
+
+    def body(i, keep):
+        # keep[i] survives only if no earlier kept box suppresses it
+        sup = jnp.any((iou[i] > thresh) & keep & (jnp.arange(k) < i))
+        return keep.at[i].set(jnp.logical_not(sup))
+
+    keep = lax.fori_loop(0, k, body, jnp.ones((k,), bool))
+    count = jnp.sum(keep.astype(jnp.int32))
+    # stable-compact kept indices to the front (-1 tail padding); dropped
+    # entries scatter to out-of-bounds index k and vanish (mode="drop")
+    rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    compact = jnp.full((k,), -1, jnp.int32).at[
+        jnp.where(keep, rank, k)].set(order.astype(jnp.int32), mode="drop")
+    return compact, count
+
+
+class Nms:
+    """Object-style facade matching the reference's Nms class."""
+
+    def __call__(self, scores, boxes, thresh: float, topk: int = 200):
+        return nms(scores, boxes, thresh, topk)
+
+
+# ------------------------------------------------------------------ PriorBox
+class PriorBox(Module):
+    """SSD prior/default box generation (≙ nn/PriorBox.scala:41).
+
+    Input: the feature map (N, C, layer_h, layer_w) (or Table whose first
+    element is it). Output (1, 2, layer_h*layer_w*num_priors*4): channel 0 =
+    prior coords, channel 1 = variances — the reference's exact layout."""
+
+    def __init__(self, min_sizes: Sequence[float],
+                 max_sizes: Optional[Sequence[float]] = None,
+                 aspect_ratios: Optional[Sequence[float]] = None,
+                 is_flip: bool = True, is_clip: bool = False,
+                 variances: Optional[Sequence[float]] = None,
+                 offset: float = 0.5, img_h: int = 0, img_w: int = 0,
+                 img_size: int = 0, step_h: float = 0.0, step_w: float = 0.0,
+                 step: float = 0.0):
+        super().__init__()
+        self.min_sizes = [float(s) for s in min_sizes]
+        self.max_sizes = [float(s) for s in (max_sizes or [])]
+        ars = [1.0]
+        for ar in (aspect_ratios or []):
+            if any(abs(ar - a) < 1e-6 for a in ars):
+                continue
+            ars.append(float(ar))
+            if is_flip:
+                ars.append(1.0 / float(ar))
+        self.aspect_ratios = ars
+        self.is_clip = is_clip
+        self.variances = [float(v) for v in (variances or [0.1])]
+        self.offset = offset
+        self.img_h = img_h or img_size
+        self.img_w = img_w or img_size
+        self.step_h = step_h or step
+        self.step_w = step_w or step
+        self.num_priors = (len(self.min_sizes) * len(ars)
+                           + len(self.max_sizes))
+
+    def forward(self, input):
+        x = input[1] if isinstance(input, Table) else input
+        layer_h, layer_w = int(x.shape[-2]), int(x.shape[-1])
+        img_h, img_w = self.img_h, self.img_w
+        if not img_h or not img_w:
+            # ≙ PriorBox.scala: image size falls back to the data tensor's
+            # spatial dims, passed as the Table's second element
+            if isinstance(input, Table) and len(input) > 1:
+                data = input[2]
+                img_h, img_w = int(data.shape[-2]), int(data.shape[-1])
+            else:
+                raise ValueError(
+                    "PriorBox needs img_h/img_w (or img_size), or a "
+                    "Table(featureMap, data) input to derive them from")
+        step_h = self.step_h or img_h / layer_h
+        step_w = self.step_w or img_w / layer_w
+        cache_key = (layer_h, layer_w, img_h, img_w, step_h, step_w)
+        if getattr(self, "_prior_cache_key", None) == cache_key:
+            return self._prior_cache  # priors are static per feature size
+
+        boxes = []
+        for h in range(layer_h):
+            for w in range(layer_w):
+                cx = (w + self.offset) * step_w
+                cy = (h + self.offset) * step_h
+                for k, ms in enumerate(self.min_sizes):
+                    def push(bw, bh):
+                        boxes.append([(cx - bw / 2) / img_w,
+                                      (cy - bh / 2) / img_h,
+                                      (cx + bw / 2) / img_w,
+                                      (cy + bh / 2) / img_h])
+
+                    push(ms, ms)
+                    if self.max_sizes:
+                        pr = math.sqrt(ms * self.max_sizes[k])
+                        push(pr, pr)
+                    for ar in self.aspect_ratios:
+                        if abs(ar - 1.0) < 1e-6:
+                            continue
+                        push(ms * math.sqrt(ar), ms / math.sqrt(ar))
+        pri = np.asarray(boxes, np.float32)
+        if self.is_clip:
+            pri = np.clip(pri, 0.0, 1.0)
+        n = pri.shape[0]
+        if len(self.variances) == 1:
+            var = np.full((n, 4), self.variances[0], np.float32)
+        else:
+            var = np.tile(np.asarray(self.variances, np.float32), (n, 1))
+        out = jnp.asarray(np.stack([pri.reshape(-1), var.reshape(-1)])[None])
+        self._prior_cache_key = cache_key
+        self._prior_cache = out
+        return out
+
+
+# -------------------------------------------------------------------- Anchor
+class Anchor:
+    """RPN anchor generation (≙ nn/Anchor.scala): base 16x16 box scaled and
+    reshaped by ratios/scales, shifted over the feature grid."""
+
+    def __init__(self, ratios: Sequence[float], scales: Sequence[float],
+                 base_size: int = 16):
+        self.ratios = np.asarray(ratios, np.float32)
+        self.scales = np.asarray(scales, np.float32)
+        self.base_size = base_size
+        self.base_anchors = self._generate_base()
+        self.num = len(self.base_anchors)
+
+    def _generate_base(self) -> np.ndarray:
+        base = np.asarray([0, 0, self.base_size - 1, self.base_size - 1],
+                          np.float32)
+        w = base[2] - base[0] + 1
+        h = base[3] - base[1] + 1
+        cx = base[0] + 0.5 * (w - 1)
+        cy = base[1] + 0.5 * (h - 1)
+        anchors = []
+        size = w * h
+        for r in self.ratios:
+            ws = np.round(np.sqrt(size / r))
+            hs = np.round(ws * r)
+            for s in self.scales:
+                wss, hss = ws * s, hs * s
+                anchors.append([cx - 0.5 * (wss - 1), cy - 0.5 * (hss - 1),
+                                cx + 0.5 * (wss - 1), cy + 0.5 * (hss - 1)])
+        return np.asarray(anchors, np.float32)
+
+    def generate_anchors(self, width: int, height: int,
+                         feat_stride: float = 16.0) -> np.ndarray:
+        sx = np.arange(width) * feat_stride
+        sy = np.arange(height) * feat_stride
+        shift_x, shift_y = np.meshgrid(sx, sy)
+        shifts = np.stack([shift_x.ravel(), shift_y.ravel(),
+                           shift_x.ravel(), shift_y.ravel()], axis=1)
+        return (self.base_anchors[None] + shifts[:, None].astype(np.float32)
+                ).reshape(-1, 4)
+
+
+# ------------------------------------------------------------------ Proposal
+class Proposal(Module):
+    """RPN proposal layer (≙ nn/Proposal.scala:34): anchors + deltas ->
+    clipped boxes -> top-N by score -> NMS -> (post_nms_topn, 5) rois with
+    a leading batch index column."""
+
+    def __init__(self, pre_nms_topn: int, post_nms_topn: int,
+                 ratios: Sequence[float], scales: Sequence[float],
+                 rpn_pre_nms_topn_train: int = 12000,
+                 rpn_post_nms_topn_train: int = 2000,
+                 min_size: int = 16, feat_stride: float = 16.0,
+                 nms_thresh: float = 0.7):
+        super().__init__()
+        self.pre_nms_topn_test = pre_nms_topn
+        self.post_nms_topn_test = post_nms_topn
+        self.pre_nms_topn_train = rpn_pre_nms_topn_train
+        self.post_nms_topn_train = rpn_post_nms_topn_train
+        self.anchor = Anchor(ratios, scales)
+        self.min_size = min_size
+        self.feat_stride = feat_stride
+        self.nms_thresh = nms_thresh
+
+    def forward(self, input):
+        scores_all, deltas, im_info = list(input)[:3]
+        pre_n = (self.pre_nms_topn_train if self.training
+                 else self.pre_nms_topn_test)
+        post_n = (self.post_nms_topn_train if self.training
+                  else self.post_nms_topn_test)
+        a = self.anchor.num
+        # scores: (1, 2A, H, W) — second half = foreground probs
+        scores = np.asarray(scores_all)[0, a:]
+        h, w = scores.shape[-2:]
+        anchors = self.anchor.generate_anchors(w, h, self.feat_stride)
+        d = np.asarray(deltas)[0].reshape(a * 4, h, w)
+        d = d.reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        s = scores.reshape(a, h, w).transpose(1, 2, 0).reshape(-1)
+        boxes = np.asarray(bbox_transform_inv(anchors, jnp.asarray(d)))
+        info = np.asarray(im_info).reshape(-1)
+        boxes = np.asarray(clip_boxes(jnp.asarray(boxes), info[0], info[1]))
+        ws = boxes[:, 2] - boxes[:, 0] + 1
+        hs = boxes[:, 3] - boxes[:, 1] + 1
+        min_sz = self.min_size * (info[2] if info.size > 2 else 1.0)
+        valid = (ws >= min_sz) & (hs >= min_sz)
+        boxes, s = boxes[valid], s[valid]  # drop, don't just down-score
+        if boxes.shape[0] == 0:
+            return jnp.zeros((0, 5), jnp.float32)
+        order = np.argsort(-s)[:pre_n]
+        boxes, s = boxes[order], s[order]
+        # suppress over the FULL pre-NMS set, then keep the first post_n
+        # survivors (≙ Proposal.scala:126-133's nms-then-slice order)
+        keep_idx, count = nms(jnp.asarray(s), jnp.asarray(boxes),
+                              self.nms_thresh, topk=pre_n)
+        keep_idx = np.asarray(keep_idx)[:min(int(count), post_n)]
+        kept = boxes[keep_idx]
+        rois = np.concatenate(
+            [np.zeros((kept.shape[0], 1), np.float32), kept], axis=1)
+        return jnp.asarray(rois)
+
+
+# ---------------------------------------------------------------- RoiPooling
+class RoiPooling(Module):
+    """ROI max pooling (≙ nn/RoiPooling.scala:42). Input Table(features
+    (N, C, H, W), rois (R, 5) with [batch_idx, x1, y1, x2, y2]); output
+    (R, C, pooled_h, pooled_w). Masked dense max per output cell — static
+    shapes, jit-safe."""
+
+    def __init__(self, pooled_w: int, pooled_h: int, spatial_scale: float):
+        super().__init__()
+        self.pooled_w, self.pooled_h = pooled_w, pooled_h
+        self.spatial_scale = spatial_scale
+
+    def forward(self, input):
+        feats, rois = list(input)[:2]
+        feats = jnp.asarray(feats)
+        rois = jnp.asarray(rois)
+        n, c, height, width = feats.shape
+        ph, pw = self.pooled_h, self.pooled_w
+
+        def one_roi(roi):
+            bi = roi[0].astype(jnp.int32)
+            x1 = jnp.round(roi[1] * self.spatial_scale)
+            y1 = jnp.round(roi[2] * self.spatial_scale)
+            x2 = jnp.round(roi[3] * self.spatial_scale)
+            y2 = jnp.round(roi[4] * self.spatial_scale)
+            rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+            rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+            bin_w = rw / pw
+            bin_h = rh / ph
+            fmap = feats[bi]  # (C, H, W)
+            hs = jnp.arange(height, dtype=jnp.float32)
+            ws = jnp.arange(width, dtype=jnp.float32)
+
+            def cell(py, px):
+                hstart = jnp.floor(py * bin_h) + y1
+                hend = jnp.ceil((py + 1) * bin_h) + y1
+                wstart = jnp.floor(px * bin_w) + x1
+                wend = jnp.ceil((px + 1) * bin_w) + x1
+                hmask = (hs >= jnp.clip(hstart, 0, height)) & \
+                        (hs < jnp.clip(hend, 0, height))
+                wmask = (ws >= jnp.clip(wstart, 0, width)) & \
+                        (ws < jnp.clip(wend, 0, width))
+                mask = hmask[:, None] & wmask[None, :]
+                empty = ~jnp.any(mask)
+                vals = jnp.where(mask[None], fmap, -jnp.inf)
+                mx = jnp.max(vals, axis=(1, 2))
+                return jnp.where(empty, 0.0, mx)
+
+            py = jnp.arange(ph)
+            px = jnp.arange(pw)
+            grid = jax.vmap(lambda y: jax.vmap(lambda x: cell(y, x))(px))(py)
+            return jnp.transpose(grid, (2, 0, 1))  # (C, ph, pw)
+
+        return jax.vmap(one_roi)(rois)
+
+
+# -------------------------------------------------------- DetectionOutputSSD
+class DetectionOutputSSD(Module):
+    """SSD inference head (≙ nn/DetectionOutputSSD.scala:301): decode loc
+    against priors, per-class NMS, cross-class keep-top-k. HOST op.
+
+    Input Table(loc (1, nPriors*4), conf (1, nPriors*nClasses),
+    priors (1, 2, nPriors*4)); output (1, 1, n_kept, 7) rows
+    [batch_id, label, score, x1, y1, x2, y2] — reference layout."""
+
+    def __init__(self, n_classes: int = 21, share_location: bool = True,
+                 bg_label: int = 0, nms_thresh: float = 0.45,
+                 nms_topk: int = 400, keep_top_k: int = 200,
+                 conf_thresh: float = 0.01,
+                 variance_encoded_in_target: bool = False):
+        super().__init__()
+        if not share_location:
+            raise NotImplementedError(
+                "per-class location predictions (share_location=False) are "
+                "not supported; the SSD zoo models all share locations")
+        self.n_classes = n_classes
+        self.share_location = share_location
+        self.bg_label = bg_label
+        self.nms_thresh = nms_thresh
+        self.nms_topk = nms_topk
+        self.keep_top_k = keep_top_k
+        self.conf_thresh = conf_thresh
+        self.variance_encoded_in_target = variance_encoded_in_target
+
+    def forward(self, input):
+        loc, conf, priors = list(input)[:3]
+        loc = np.asarray(loc).reshape(-1, 4)
+        pr = np.asarray(priors)
+        n_priors = loc.shape[0]
+        prior_boxes = pr[0, 0].reshape(-1, 4)[:n_priors]
+        prior_vars = pr[0, 1].reshape(-1, 4)[:n_priors]
+        conf = np.asarray(conf).reshape(n_priors, self.n_classes)
+        decoded = np.asarray(decode_boxes(
+            prior_boxes, prior_vars, loc,
+            self.variance_encoded_in_target, clip=True))
+
+        results = []
+        for cls in range(self.n_classes):
+            if cls == self.bg_label:
+                continue
+            scores = conf[:, cls]
+            sel = scores > self.conf_thresh
+            if not np.any(sel):
+                continue
+            idx = np.where(sel)[0]
+            keep, count = nms(jnp.asarray(scores[idx]),
+                              jnp.asarray(decoded[idx]),
+                              self.nms_thresh, topk=self.nms_topk)
+            keep = np.asarray(keep)[:int(count)]
+            for j in idx[keep]:
+                results.append([0.0, float(cls), float(conf[j, cls])]
+                               + decoded[j].tolist())
+        if self.keep_top_k > 0 and len(results) > self.keep_top_k:
+            results.sort(key=lambda r: -r[2])
+            results = results[:self.keep_top_k]
+        if not results:
+            return jnp.zeros((1, 1, 0, 7), jnp.float32)
+        out = np.asarray(results, np.float32)[None, None]
+        return jnp.asarray(out)

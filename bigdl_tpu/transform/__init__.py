@@ -1,0 +1,1 @@
+from bigdl_tpu.transform import vision  # noqa: F401

@@ -1,0 +1,312 @@
+"""ImageFrame / ImageFeature vision pipeline with ROI label transforms.
+
+Reference: transform/vision/image/ImageFrame.scala:36 (Local/Distributed
+frames + ``read``/``array`` factories), ImageFeature.scala (string-keyed
+feature map: bytes/mat/label/originalSize/...), FeatureTransformer.scala
+(transform one ImageFeature, chainable with ``->``), the augmentation
+package (Resize/HFlip/ChannelNormalize/Expand/Crop...) and the ROI label
+transforms (label/roi/RoiTransformer.scala: RoiNormalize, RoiHFlip,
+RoiResize) that keep ground-truth boxes consistent with image ops.
+
+TPU-native notes: images live as numpy HWC float arrays host-side (this is
+the CPU data pipeline feeding the chip — same role as the reference's
+OpenCVMat stage); ``ImageFeatureToBatch`` is the exit point that stacks to
+device arrays (≙ MTImageFeatureToBatch.scala)."""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.dataset import image as dimage
+from bigdl_tpu.dataset.transformer import Transformer
+
+
+class ImageFeature(dict):
+    """String-keyed per-image record (≙ ImageFeature.scala). Well-known
+    keys mirror the reference's constants."""
+
+    bytes_key = "bytes"
+    mat = "mat"            # decoded HWC float ndarray
+    label = "label"
+    uri = "uri"
+    original_size = "originalSize"
+    size = "size"
+    boxes = "boxes"        # (n, 4) x1,y1,x2,y2 ground-truth ROIs
+    classes = "classes"    # (n,) ROI labels
+
+    def __init__(self, image: Optional[np.ndarray] = None, label=None,
+                 uri: str = None, **kw):
+        super().__init__()
+        if image is not None:
+            image = np.asarray(image, np.float32)
+            self[self.mat] = image
+            self[self.original_size] = image.shape
+            self[self.size] = image.shape
+        if label is not None:
+            self[self.label] = label
+        if uri is not None:
+            self[self.uri] = uri
+        self.update(kw)
+
+    def image(self) -> np.ndarray:
+        return self[self.mat]
+
+    def set_image(self, arr: np.ndarray):
+        self[self.mat] = np.asarray(arr, np.float32)
+        self[self.size] = self[self.mat].shape
+        return self
+
+    def get_size(self):
+        return self.get(self.size)
+
+    def width(self) -> int:
+        return int(self[self.mat].shape[1])
+
+    def height(self) -> int:
+        return int(self[self.mat].shape[0])
+
+
+class FeatureTransformer(Transformer):
+    """≙ FeatureTransformer.scala: per-ImageFeature op, ``->`` chainable
+    (inherits Transformer's chaining)."""
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        raise NotImplementedError
+
+    def __call__(self, it: Iterator) -> Iterator:
+        for f in it:
+            yield self.transform(f)
+
+
+class ImageFrame:
+    """≙ ImageFrame.scala:36. ``ImageFrame.read(paths)`` /
+    ``ImageFrame.array(ndarray, labels)`` build a LocalImageFrame; the
+    distributed analog is sharding the path list per process."""
+
+    @staticmethod
+    def read(paths) -> "LocalImageFrame":
+        from bigdl_tpu.dlframes.dlframes import _decode_image
+
+        if isinstance(paths, str):
+            import glob
+
+            paths = sorted(glob.glob(paths))
+        feats = []
+        for p in paths:
+            arr = _decode_image(p)
+            feats.append(ImageFeature(arr, uri=p))
+        return LocalImageFrame(feats)
+
+    @staticmethod
+    def array(images: np.ndarray, labels=None) -> "LocalImageFrame":
+        feats = []
+        for i, img in enumerate(images):
+            lab = None if labels is None else labels[i]
+            feats.append(ImageFeature(img, label=lab))
+        return LocalImageFrame(feats)
+
+
+class LocalImageFrame(ImageFrame):
+    def __init__(self, features: List[ImageFeature]):
+        self.features = list(features)
+
+    def transform(self, transformer) -> "LocalImageFrame":
+        return LocalImageFrame(list(transformer(iter(self.features))))
+
+    __rshift__ = transform
+
+    def to_local(self) -> "LocalImageFrame":
+        return self
+
+    def is_local(self) -> bool:
+        return True
+
+    def __len__(self):
+        return len(self.features)
+
+    def __iter__(self):
+        return iter(self.features)
+
+
+# ------------------------------------------------------------- image ops
+class Resize(FeatureTransformer):
+    """≙ augmentation/Resize.scala (bilinear)."""
+
+    def __init__(self, resize_h: int, resize_w: int):
+        self.resize_h, self.resize_w = resize_h, resize_w
+
+    def transform(self, f: ImageFeature) -> ImageFeature:
+        f.set_image(dimage.resize_bilinear(f.image(), self.resize_h,
+                                           self.resize_w))
+        return f
+
+
+class HFlip(FeatureTransformer):
+    """≙ augmentation/HFlip.scala — always flips (randomness comes from
+    RandomTransformer)."""
+
+    def transform(self, f: ImageFeature) -> ImageFeature:
+        f.set_image(f.image()[:, ::-1])
+        return f
+
+
+class ChannelNormalize(FeatureTransformer):
+    """≙ augmentation/ChannelNormalize.scala."""
+
+    def __init__(self, means: Sequence[float], stds: Sequence[float] = None):
+        self.means = np.asarray(means, np.float32)
+        self.stds = np.asarray(stds if stds is not None
+                               else [1.0] * len(means), np.float32)
+
+    def transform(self, f: ImageFeature) -> ImageFeature:
+        f.set_image((f.image() - self.means) / self.stds)
+        return f
+
+
+class CenterCrop(FeatureTransformer):
+    def __init__(self, crop_h: int, crop_w: int):
+        self.crop_h, self.crop_w = crop_h, crop_w
+
+    def transform(self, f: ImageFeature) -> ImageFeature:
+        f.set_image(dimage.center_crop(f.image(), self.crop_h, self.crop_w))
+        return f
+
+
+class Brightness(FeatureTransformer):
+    """≙ augmentation/Brightness.scala: add a delta drawn in [lo, hi]."""
+
+    def __init__(self, delta_low: float, delta_high: float, seed: int = 1):
+        self.lo, self.hi = delta_low, delta_high
+        self._rng = np.random.RandomState(seed)
+
+    def transform(self, f: ImageFeature) -> ImageFeature:
+        f.set_image(f.image() + self._rng.uniform(self.lo, self.hi))
+        return f
+
+
+class Expand(FeatureTransformer):
+    """≙ augmentation/Expand.scala: place the image on a larger mean-filled
+    canvas (used by SSD augmentation); updates ROIs if present."""
+
+    def __init__(self, means: Sequence[float] = (123, 117, 104),
+                 max_expand_ratio: float = 4.0, seed: int = 1):
+        self.means = np.asarray(means, np.float32)
+        self.max_ratio = max_expand_ratio
+        self._rng = np.random.RandomState(seed)
+
+    def transform(self, f: ImageFeature) -> ImageFeature:
+        img = f.image()
+        h, w = img.shape[:2]
+        ratio = self._rng.uniform(1.0, self.max_ratio)
+        nh, nw = int(h * ratio), int(w * ratio)
+        top = self._rng.randint(0, nh - h + 1)
+        left = self._rng.randint(0, nw - w + 1)
+        canvas = np.tile(self.means, (nh, nw, 1)).astype(np.float32)
+        canvas[top:top + h, left:left + w] = img
+        f.set_image(canvas)
+        if ImageFeature.boxes in f:
+            b = np.asarray(f[ImageFeature.boxes], np.float32)
+            f[ImageFeature.boxes] = b + [left, top, left, top]
+        return f
+
+
+class RandomTransformer(FeatureTransformer):
+    """≙ augmentation/RandomTransformer.scala: apply inner with prob p."""
+
+    def __init__(self, inner: FeatureTransformer, prob: float,
+                 seed: int = 1):
+        self.inner = inner
+        self.prob = prob
+        self._rng = np.random.RandomState(seed)
+
+    def transform(self, f: ImageFeature) -> ImageFeature:
+        if self._rng.rand() < self.prob:
+            return self.inner.transform(f)
+        return f
+
+
+class MatToTensor(FeatureTransformer):
+    """≙ Convertor.scala MatToTensor: HWC -> CHW float under key 'tensor'."""
+
+    def transform(self, f: ImageFeature) -> ImageFeature:
+        f["tensor"] = np.transpose(f.image(), (2, 0, 1)).copy()
+        return f
+
+
+# -------------------------------------------------------- ROI label ops
+class RoiNormalize(FeatureTransformer):
+    """≙ label/roi/RoiTransformer.scala RoiNormalize: boxes to [0,1]."""
+
+    def transform(self, f: ImageFeature) -> ImageFeature:
+        if ImageFeature.boxes in f:
+            h, w = f.image().shape[:2]
+            b = np.asarray(f[ImageFeature.boxes], np.float32)
+            f[ImageFeature.boxes] = b / [w, h, w, h]
+        return f
+
+
+class RoiHFlip(FeatureTransformer):
+    """≙ RoiHFlip: mirror boxes after an HFlip; ``normalized`` tells
+    whether boxes are in [0,1] or pixel coords."""
+
+    def __init__(self, normalized: bool = True):
+        self.normalized = normalized
+
+    def transform(self, f: ImageFeature) -> ImageFeature:
+        if ImageFeature.boxes in f:
+            w = 1.0 if self.normalized else float(f.image().shape[1])
+            b = np.asarray(f[ImageFeature.boxes], np.float32).copy()
+            x1 = b[:, 0].copy()
+            b[:, 0] = w - b[:, 2]
+            b[:, 2] = w - x1
+            f[ImageFeature.boxes] = b
+        return f
+
+
+class RoiResize(FeatureTransformer):
+    """≙ RoiResize: rescale pixel-coordinate boxes when the image was
+    resized from originalSize to the current size."""
+
+    def transform(self, f: ImageFeature) -> ImageFeature:
+        if ImageFeature.boxes in f and ImageFeature.original_size in f:
+            oh, ow = f[ImageFeature.original_size][:2]
+            nh, nw = f.image().shape[:2]
+            sx, sy = nw / ow, nh / oh
+            b = np.asarray(f[ImageFeature.boxes], np.float32)
+            f[ImageFeature.boxes] = b * [sx, sy, sx, sy]
+        return f
+
+
+# ---------------------------------------------------------------- batching
+class ImageFeatureToBatch(Transformer):
+    """≙ MTImageFeatureToBatch.scala: stack N ImageFeatures to a device
+    MiniBatch (CHW float) with labels."""
+
+    def __init__(self, batch_size: int, to_chw: bool = True,
+                 partial_batch: bool = False):
+        self.batch_size = batch_size
+        self.to_chw = to_chw
+        self.partial_batch = partial_batch
+
+    def _emit(self, buf):
+        from bigdl_tpu.dataset.minibatch import MiniBatch
+
+        imgs = np.stack([np.transpose(f.image(), (2, 0, 1))
+                         if self.to_chw else f.image() for f in buf])
+        labels = None
+        if all(ImageFeature.label in f for f in buf):
+            labels = np.stack([np.asarray(f[ImageFeature.label])
+                               for f in buf])
+        return MiniBatch(imgs, labels)
+
+    def __call__(self, it: Iterator) -> Iterator:
+        buf = []
+        for f in it:
+            buf.append(f)
+            if len(buf) == self.batch_size:
+                yield self._emit(buf)
+                buf = []
+        if buf and self.partial_batch:
+            yield self._emit(buf)

@@ -1,0 +1,212 @@
+"""Detection heads (≙ nn/PriorBox.scala, nn/Nms.scala, nn/Proposal.scala,
+nn/RoiPooling.scala, nn/DetectionOutputSSD.scala) + vision pipeline."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.detection import (
+    Anchor, DetectionOutputSSD, PriorBox, Proposal, RoiPooling, bbox_iou,
+    decode_boxes, nms,
+)
+from bigdl_tpu.utils.table import Table
+
+
+def test_bbox_iou():
+    a = jnp.asarray([[0, 0, 10, 10.0]])
+    b = jnp.asarray([[0, 0, 10, 10.0], [5, 5, 15, 15], [20, 20, 30, 30]])
+    iou = np.asarray(bbox_iou(a, b))[0]
+    np.testing.assert_allclose(iou, [1.0, 25 / 175, 0.0], rtol=1e-5)
+
+
+def test_nms_suppresses_overlaps():
+    boxes = jnp.asarray([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30.0]])
+    scores = jnp.asarray([0.9, 0.8, 0.7])
+    keep, count = nms(scores, boxes, thresh=0.5, topk=3)
+    assert int(count) == 2
+    assert set(np.asarray(keep)[:2].tolist()) == {0, 2}
+
+
+def test_nms_jit_compatible():
+    boxes = jnp.asarray([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30.0]])
+    scores = jnp.asarray([0.9, 0.8, 0.7])
+    keep, count = jax.jit(lambda s, b: nms(s, b, 0.5, 3))(scores, boxes)
+    assert int(count) == 2
+
+
+def test_prior_box_shapes_and_values():
+    pb = PriorBox(min_sizes=[30.0], max_sizes=[60.0],
+                  aspect_ratios=[2.0], is_flip=True, is_clip=False,
+                  variances=[0.1, 0.1, 0.2, 0.2], img_h=300, img_w=300,
+                  step_h=100.0, step_w=100.0)
+    # priors per cell: min + max + 2 flipped ratios = 4
+    assert pb.num_priors == 4
+    fmap = jnp.zeros((1, 8, 3, 3))
+    out = np.asarray(pb(fmap))
+    assert out.shape == (1, 2, 3 * 3 * 4 * 4)
+    boxes = out[0, 0].reshape(-1, 4)
+    # first cell center = (0.5*100, 0.5*100) = (50, 50); first box 30x30
+    np.testing.assert_allclose(
+        boxes[0], [(50 - 15) / 300, (50 - 15) / 300,
+                   (50 + 15) / 300, (50 + 15) / 300], rtol=1e-5)
+    var = out[0, 1].reshape(-1, 4)
+    np.testing.assert_allclose(var[0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_decode_boxes_identity_and_shift():
+    priors = jnp.asarray([[0.2, 0.2, 0.4, 0.4]])
+    vars_ = jnp.asarray([[0.1, 0.1, 0.2, 0.2]])
+    out = np.asarray(decode_boxes(priors, vars_, jnp.zeros((1, 4))))
+    np.testing.assert_allclose(out, [[0.2, 0.2, 0.4, 0.4]], atol=1e-6)
+    # positive dx shifts center right by v*d*w = 0.1*1*0.2 = 0.02
+    out = np.asarray(decode_boxes(priors, vars_,
+                                  jnp.asarray([[1.0, 0, 0, 0]])))
+    np.testing.assert_allclose(out, [[0.22, 0.2, 0.42, 0.4]], atol=1e-6)
+
+
+def test_anchor_generation():
+    a = Anchor(ratios=[1.0], scales=[8.0])
+    assert a.num == 1
+    base = a.base_anchors[0]
+    # 16*8 = 128-wide box centered on the 16x16 base cell
+    assert base[2] - base[0] + 1 == 128
+    grid = a.generate_anchors(2, 2, feat_stride=16.0)
+    assert grid.shape == (4, 4)
+    np.testing.assert_allclose(grid[1] - grid[0], [16, 0, 16, 0])
+
+
+def test_roi_pooling_matches_manual():
+    feats = jnp.arange(16.0).reshape(1, 1, 4, 4)
+    rois = jnp.asarray([[0, 0, 0, 3, 3.0]])  # whole 4x4 map
+    rp = RoiPooling(2, 2, spatial_scale=1.0)
+    out = np.asarray(rp(Table(feats, rois)))
+    assert out.shape == (1, 1, 2, 2)
+    np.testing.assert_allclose(out[0, 0], [[5, 7], [13, 15]])
+
+
+def test_roi_pooling_respects_batch_index_and_scale():
+    feats = jnp.stack([jnp.zeros((1, 4, 4)),
+                       jnp.arange(16.0).reshape(1, 4, 4)])
+    rois = jnp.asarray([[1, 0, 0, 6, 6.0]])  # scale 0.5 -> cover 0..3
+    rp = RoiPooling(1, 1, spatial_scale=0.5)
+    out = np.asarray(rp(Table(feats, rois)))
+    np.testing.assert_allclose(out[0, 0], [[15.0]])
+
+
+def test_proposal_emits_rois():
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(1)
+    prop = Proposal(pre_nms_topn=50, post_nms_topn=5, ratios=[1.0],
+                    scales=[4.0])
+    prop.evaluate()
+    a = prop.anchor.num
+    h = w = 4
+    rng = np.random.RandomState(0)
+    scores = jnp.asarray(rng.rand(1, 2 * a, h, w).astype(np.float32))
+    deltas = jnp.asarray(0.1 * rng.randn(1, 4 * a, h, w).astype(np.float32))
+    im_info = jnp.asarray([64.0, 64.0, 1.0, 1.0])
+    rois = np.asarray(prop(Table(scores, deltas, im_info)))
+    assert rois.shape[1] == 5 and 1 <= rois.shape[0] <= 5
+    assert np.all(rois[:, 0] == 0)
+    assert np.all(rois[:, 1] >= 0) and np.all(rois[:, 3] <= 63)
+
+
+def test_detection_output_ssd():
+    # 2 priors, 3 classes (bg=0); prior 0 strongly class 1, prior 1 class 2
+    priors = np.asarray([[0.1, 0.1, 0.3, 0.3], [0.5, 0.5, 0.9, 0.9]],
+                        np.float32)
+    vars_ = np.full((2, 4), 0.1, np.float32)
+    pr = np.stack([priors.reshape(-1), vars_.reshape(-1)])[None]
+    loc = np.zeros((1, 8), np.float32)  # zero deltas: boxes = priors
+    conf = np.asarray([[0.05, 0.9, 0.05, 0.1, 0.1, 0.8]], np.float32)
+    head = DetectionOutputSSD(n_classes=3, conf_thresh=0.3, nms_thresh=0.45)
+    out = np.asarray(head(Table(jnp.asarray(loc), jnp.asarray(conf),
+                                jnp.asarray(pr))))
+    assert out.shape[:2] == (1, 1) and out.shape[3] == 7
+    rows = out[0, 0]
+    assert rows.shape[0] == 2
+    by_label = {int(r[1]): r for r in rows}
+    np.testing.assert_allclose(by_label[1][2], 0.9, rtol=1e-5)
+    np.testing.assert_allclose(by_label[1][3:], priors[0], atol=1e-5)
+    np.testing.assert_allclose(by_label[2][3:], priors[1], atol=1e-5)
+
+
+def test_image_frame_pipeline_with_roi_transforms():
+    from bigdl_tpu.transform.vision import (
+        ChannelNormalize, HFlip, ImageFeature, ImageFrame, ImageFeatureToBatch,
+        Resize, RoiHFlip, RoiNormalize, RoiResize,
+    )
+
+    rng = np.random.RandomState(0)
+    imgs = rng.rand(3, 8, 8, 3).astype(np.float32)
+    frame = ImageFrame.array(imgs, labels=np.asarray([1, 2, 3]))
+    assert len(frame) == 3 and frame.is_local()
+    # attach a ground-truth box to every feature
+    for f in frame:
+        f[ImageFeature.boxes] = np.asarray([[2.0, 2.0, 6.0, 6.0]])
+
+    out = frame.transform(Resize(16, 16)).transform(RoiResize())
+    f0 = list(out)[0]
+    assert f0.image().shape == (16, 16, 3)
+    np.testing.assert_allclose(f0[ImageFeature.boxes], [[4, 4, 12, 12]])
+
+    flipped = out.transform(HFlip()).transform(RoiHFlip(normalized=False))
+    b = list(flipped)[0][ImageFeature.boxes]
+    np.testing.assert_allclose(b, [[4, 4, 12, 12]])  # symmetric box
+
+    norm = flipped.transform(RoiNormalize())
+    b = list(norm)[0][ImageFeature.boxes]
+    np.testing.assert_allclose(b, [[0.25, 0.25, 0.75, 0.75]])
+
+    batches = list(ImageFeatureToBatch(3)(iter(
+        norm.transform(ChannelNormalize([0.5, 0.5, 0.5])).features)))
+    assert len(batches) == 1
+    assert batches[0].get_input().shape == (3, 3, 16, 16)
+
+
+def test_expand_updates_boxes():
+    from bigdl_tpu.transform.vision import Expand, ImageFeature
+
+    f = ImageFeature(np.ones((4, 4, 3), np.float32))
+    f[ImageFeature.boxes] = np.asarray([[1.0, 1.0, 3.0, 3.0]])
+    e = Expand(means=(0, 0, 0), max_expand_ratio=2.0, seed=3)
+    out = e.transform(f)
+    b = out[ImageFeature.boxes][0]
+    h, w = out.image().shape[:2]
+    assert h >= 4 and w >= 4
+    assert b[0] >= 1.0 - 1e-6 and b[2] <= w
+
+
+def test_prior_box_derives_img_size_from_table_and_caches():
+    pb = PriorBox(min_sizes=[30.0], step_h=100.0, step_w=100.0)
+    fmap = jnp.zeros((1, 8, 3, 3))
+    data = jnp.zeros((1, 3, 300, 300))
+    out1 = pb(Table(fmap, data))
+    out2 = pb(Table(fmap, data))
+    assert out1 is out2  # cached for static feature/image size
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="img_h"):
+        PriorBox(min_sizes=[30.0])(fmap)
+
+
+def test_detection_output_ssd_rejects_unshared_location():
+    import pytest as _pytest
+    with _pytest.raises(NotImplementedError):
+        DetectionOutputSSD(n_classes=3, share_location=False)
+
+
+def test_proposal_drops_small_boxes():
+    prop = Proposal(pre_nms_topn=50, post_nms_topn=10, ratios=[1.0],
+                    scales=[1.0], min_size=64)
+    prop.evaluate()
+    a = prop.anchor.num
+    h = w = 2
+    rng = np.random.RandomState(0)
+    scores = jnp.asarray(rng.rand(1, 2 * a, h, w).astype(np.float32))
+    deltas = jnp.zeros((1, 4 * a, h, w), jnp.float32)
+    # anchors are 16x16-ish at scale 1 -> all below min_size 64
+    rois = np.asarray(prop(Table(scores, deltas,
+                                 jnp.asarray([64.0, 64.0, 1.0]))))
+    assert rois.shape[0] == 0
