@@ -88,3 +88,4 @@ from bigdl_tpu.nn.detection import (
     Anchor, DetectionOutputSSD, Nms, PriorBox, Proposal, RoiPooling,
     bbox_iou, decode_boxes, nms,
 )
+from bigdl_tpu.nn.tree_lstm import BinaryTreeLSTM, TreeLSTM

@@ -1,0 +1,143 @@
+"""Binary (Constituency) TreeLSTM.
+
+Reference: nn/TreeLSTM.scala:25 (abstract base), nn/BinaryTreeLSTM.scala:41
+(leaf module + composer built as small Graphs, cloned per node with shared
+storage, driven by a JVM recursion over TensorTree), and TensorTree's
+encoding (BinaryTreeLSTM.scala:478-513): ``trees`` is (batch, n_nodes, 3)
+where row i (1-based node i) = [left_child, right_child, leaf_index]; 0
+children mark a leaf whose embedding is ``input[:, leaf_index - 1]``; an
+all-zero row is padding.
+
+TPU-native redesign: the reference clones a cell per tree node and shares
+parameter storage (TreeLSTM.shareParams); here ONE leaf module and ONE
+composer are plain child modules reused functionally at every node — the
+recursion builds a pure jnp expression over them. Trees are HOST data
+(numpy) steering trace-time recursion, exactly like the reference's JVM
+recursion; the math between nodes is jnp and differentiates end-to-end
+(``backward`` runs an untraced vjp with the tree held static)."""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.linear import Linear
+from bigdl_tpu.nn.module import Module, pure_apply
+from bigdl_tpu.utils.table import Table
+
+
+class TreeLSTM(Module):
+    """≙ nn/TreeLSTM.scala:25."""
+
+    def __init__(self, input_size: int, hidden_size: int = 150):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+
+
+class BinaryTreeLSTM(TreeLSTM):
+    """≙ nn/BinaryTreeLSTM.scala:41. Output (batch, n_nodes, hidden) with
+    each internal/leaf node's h at its node row (padding rows stay 0)."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 gate_output: bool = True):
+        super().__init__(input_size, hidden_size)
+        self.gate_output = gate_output
+        # leaf module (createLeafModuleWithGraph): c = W x; h = sig(Wo x)*tanh(c)
+        self.leaf_c = Linear(input_size, hidden_size)
+        if gate_output:
+            self.leaf_o = Linear(input_size, hidden_size)
+        # composer (createComposerWithGraph): each gate is
+        # W_l lh + W_r rh (CAddTable of two Linears)
+        gates = ["i", "lf", "rf", "update"] + (["o"] if gate_output else [])
+        self._gates = gates
+        for g in gates:
+            setattr(self, f"comp_{g}_l", Linear(hidden_size, hidden_size))
+            setattr(self, f"comp_{g}_r", Linear(hidden_size, hidden_size))
+
+    # ------------------------------------------------------------ cell math
+    def _leaf(self, x):
+        c = self.leaf_c(x)
+        if self.gate_output:
+            h = jax.nn.sigmoid(self.leaf_o(x)) * jnp.tanh(c)
+        else:
+            h = jnp.tanh(c)
+        return c, h
+
+    def _gate(self, name, lh, rh):
+        return (getattr(self, f"comp_{name}_l")(lh)
+                + getattr(self, f"comp_{name}_r")(rh))
+
+    def _compose(self, lc, lh, rc, rh):
+        i = jax.nn.sigmoid(self._gate("i", lh, rh))
+        lf = jax.nn.sigmoid(self._gate("lf", lh, rh))
+        rf = jax.nn.sigmoid(self._gate("rf", lh, rh))
+        update = jnp.tanh(self._gate("update", lh, rh))
+        c = i * update + lf * lc + rf * rc
+        if self.gate_output:
+            o = jax.nn.sigmoid(self._gate("o", lh, rh))
+            h = o * jnp.tanh(c)
+        else:
+            h = jnp.tanh(c)
+        return c, h
+
+    # -------------------------------------------------------------- forward
+    def forward(self, input):
+        inputs, trees = input[1], input[2]
+        trees_np = np.asarray(trees).astype(np.int64)  # HOST tree structure
+        inputs = jnp.asarray(inputs)
+        batch, n_nodes = trees_np.shape[0], trees_np.shape[1]
+        rows = []
+        for b in range(batch):
+            memo: Dict[int, Tuple] = {}
+
+            def recurse(i: int, b: int, memo: Dict[int, Tuple]):
+                if i in memo:
+                    return memo[i]
+                left, right, leaf = trees_np[b, i - 1]
+                if left == 0 and right == 0:
+                    out = self._leaf(inputs[b, int(leaf) - 1])
+                else:
+                    lc, lh = recurse(int(left), b, memo)
+                    rc, rh = recurse(int(right), b, memo)
+                    out = self._compose(lc, lh, rc, rh)
+                memo[i] = out
+                return out
+
+            node_hs = []
+            for i in range(1, n_nodes + 1):
+                if trees_np[b, i - 1].any():
+                    _, h = recurse(i, b, memo)
+                else:
+                    h = jnp.zeros((self.hidden_size,), inputs.dtype)
+                node_hs.append(h)
+            rows.append(jnp.stack(node_hs))
+        return jnp.stack(rows)
+
+    def backward(self, input, grad_output):
+        """Untraced vjp with the tree held static (host recursion can't run
+        under a jitted trace; ≙ the reference's recursiveBackward,
+        BinaryTreeLSTM.scala:296-313)."""
+        import time
+
+        t0 = time.perf_counter()
+        embeddings = jnp.asarray(input[1])
+        trees = np.asarray(input[2])
+        params = self.params_dict()
+        buffers = self.buffers_dict()
+
+        def f(p, x):
+            out, _ = pure_apply(self)(p, buffers, Table(x, trees),
+                                      training=self.training)
+            return out
+
+        _, vjp_fn = jax.vjp(f, params, embeddings)
+        dparams, dx = vjp_fn(jnp.asarray(grad_output))
+        self._acc_grad_dict(dparams)
+        self.grad_input = Table(dx, jnp.zeros_like(jnp.asarray(
+            input[2], jnp.float32)))
+        self._backward_time += time.perf_counter() - t0
+        return self.grad_input

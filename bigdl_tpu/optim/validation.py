@@ -112,3 +112,29 @@ class MAE(ValidationMethod):
         t = np.asarray(target)
         n = out.shape[0] if out.ndim else 1
         return LossResult(float(np.sum(np.abs(out - t)) / max(out[0].size, 1)), n)
+
+
+class TreeNNAccuracy(ValidationMethod):
+    """Root-node accuracy for tree models (reference:
+    optim/ValidationMethod.scala:118 TreeNNAccuracy): output (batch,
+    n_nodes, n_classes) -> the ROOT (first node)'s prediction vs
+    target[:, 0]. Binary single-logit outputs threshold at 0.5; otherwise
+    1-based argmax, matching the reference."""
+
+    def __call__(self, output, target):
+        out = np.asarray(output)
+        tgt = np.asarray(target)
+        if out.ndim == 3:
+            root = out[:, 0]
+            t = tgt[:, 0] if tgt.ndim > 1 else tgt
+        elif out.ndim == 2:
+            root = out[0][None]
+            t = np.asarray([tgt.reshape(-1)[0]])
+        else:
+            raise ValueError(f"unexpected output rank {out.ndim}")
+        if root.shape[-1] == 1:
+            pred = (root[:, 0] >= 0.5).astype(np.int64)
+        else:
+            pred = np.argmax(root, axis=-1) + 1  # 1-based
+        return AccuracyResult(int(np.sum(pred == t.astype(np.int64))),
+                              root.shape[0])

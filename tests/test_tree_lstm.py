@@ -1,0 +1,93 @@
+"""BinaryTreeLSTM (≙ nn/BinaryTreeLSTM.scala:41) + TreeNNAccuracy."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.optim.validation import TreeNNAccuracy
+from bigdl_tpu.utils.table import Table
+
+
+def _tiny_tree():
+    """3-node tree: node1 = root(children 2,3); nodes 2,3 = leaves over
+    embeddings 1 and 2 (TensorTree rows: [left, right, leaf_index])."""
+    return np.asarray([[[2, 3, 0], [0, 0, 1], [0, 0, 2]]], np.float32)
+
+
+def test_forward_shapes_and_padding():
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(2)
+    m = nn.BinaryTreeLSTM(input_size=4, hidden_size=6)
+    x = jnp.asarray(np.random.RandomState(0).randn(1, 2, 4), jnp.float32)
+    trees = np.concatenate([_tiny_tree(),
+                            np.zeros((1, 1, 3), np.float32)], axis=1)
+    out = np.asarray(m(Table(x, jnp.asarray(trees))))
+    assert out.shape == (1, 4, 6)
+    assert np.any(out[0, 0] != 0)          # root
+    np.testing.assert_allclose(out[0, 3], 0.0)  # padding row
+
+
+def test_leaf_and_composer_math():
+    """Root h must equal the hand-computed composer over the two leaves."""
+    m = nn.BinaryTreeLSTM(input_size=3, hidden_size=2)
+    x = jnp.asarray(np.random.RandomState(1).randn(1, 2, 3), jnp.float32)
+    out = np.asarray(m(Table(x, jnp.asarray(_tiny_tree()))))
+    lc1, lh1 = m._leaf(x[0, 0])
+    lc2, lh2 = m._leaf(x[0, 1])
+    _, hroot = m._compose(lc1, lh1, lc2, lh2)
+    np.testing.assert_allclose(out[0, 1], np.asarray(lh1), rtol=1e-5)
+    np.testing.assert_allclose(out[0, 2], np.asarray(lh2), rtol=1e-5)
+    np.testing.assert_allclose(out[0, 0], np.asarray(hroot), rtol=1e-5)
+
+
+def test_tree_lstm_learns_root_classification():
+    """Tree sentiment-style smoke: classify by root representation."""
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(5)
+    rng = np.random.RandomState(3)
+    n = 16
+    x = rng.randn(n, 2, 4).astype(np.float32)
+    y = (x[:, 0, 0] + x[:, 1, 0] > 0).astype(np.int64) + 1  # classes 1/2
+    trees = np.repeat(_tiny_tree(), n, axis=0)
+
+    tree = nn.BinaryTreeLSTM(4, 8)
+    head = nn.Sequential().add(nn.Linear(8, 2)).add(nn.LogSoftMax())
+    crit = nn.ClassNLLCriterion()
+    xj, tj = jnp.asarray(x), jnp.asarray(trees)
+    inp = Table(xj, tj)
+    losses = []
+    for _ in range(40):
+        tree.zero_grad_parameters()
+        head.zero_grad_parameters()
+        states = tree(inp)          # (n, 3, 8)
+        root = states[:, 0]
+        out = head(root)
+        loss = crit(out, jnp.asarray(y))
+        losses.append(float(loss))
+        g = crit.backward(out, jnp.asarray(y))
+        g_root = head.backward(root, g)
+        g_states = jnp.zeros_like(states).at[:, 0].set(g_root)
+        tree.backward(inp, g_states)
+        tree.update_parameters(0.2)
+        head.update_parameters(0.2)
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+
+def test_tree_nn_accuracy():
+    # (batch 2, nodes 2, classes 3); root predictions argmax+1 = [2, 3]
+    out = np.asarray([[[0.1, 0.8, 0.1], [0.9, 0.05, 0.05]],
+                      [[0.1, 0.2, 0.7], [0.9, 0.05, 0.05]]])
+    target = np.asarray([[2.0, 1.0], [1.0, 1.0]])
+    acc = TreeNNAccuracy()(out, target)
+    val, count = acc.result()
+    assert count == 2 and abs(val - 0.5) < 1e-9
+
+
+def test_tree_nn_accuracy_binary():
+    out = np.asarray([[[0.8], [0.2]], [[0.3], [0.9]]])
+    target = np.asarray([[1.0, 0.0], [0.0, 0.0]])
+    acc = TreeNNAccuracy()(out, target)
+    val, count = acc.result()
+    assert count == 2 and val == 1.0
