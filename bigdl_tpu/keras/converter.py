@@ -1,0 +1,202 @@
+"""Keras 1.2.2 model import: json topology + hdf5 weights -> keras layers.
+
+Reference: pyspark/bigdl/keras/converter.py:32-420 (DefinitionLoader /
+WeightLoader + per-layer LayerConverter methods) — the reference pins
+Keras 1.2.2 and walks ``model.get_config()``; here we parse the SAME json
+document directly (class_name/config tree) and the Keras-1.x hdf5 weight
+layout (root attr ``layer_names``, per-layer group attr ``weight_names``).
+
+Supported layer subset mirrors the reference's converter coverage for
+Sequential models: Dense, Activation, Dropout, Flatten, Reshape,
+Convolution2D (th dim-ordering), MaxPooling2D, AveragePooling2D,
+BatchNormalization, Embedding.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import keras as bk
+from bigdl_tpu.nn.module import Module
+
+
+def _tuplify(v):
+    return tuple(int(x) for x in v) if v is not None else None
+
+
+class DefinitionLoader:
+    """json -> un-weighted keras model (≙ converter.py DefinitionLoader)."""
+
+    @staticmethod
+    def from_json_str(text: str, input_shape=None):
+        spec = json.loads(text)
+        return DefinitionLoader._convert_model(spec, input_shape)
+
+    @staticmethod
+    def from_json_path(path: str):
+        with open(path) as f:
+            return DefinitionLoader.from_json_str(f.read())
+
+    # ------------------------------------------------------------- builders
+    @staticmethod
+    def _convert_model(spec: dict, input_shape=None):
+        cls = spec.get("class_name")
+        if cls != "Sequential":
+            raise ValueError(
+                f"unsupported keras model class {cls!r} (Sequential only, "
+                "like the reference's Sequential-first coverage)")
+        cfg = spec["config"]
+        layer_specs = cfg["layers"] if isinstance(cfg, dict) else cfg
+        if (input_shape is not None and layer_specs
+                and not layer_specs[0]["config"].get("batch_input_shape")):
+            layer_specs[0]["config"]["batch_input_shape"] = \
+                [None] + list(input_shape)
+        model = bk.Sequential()
+        for lspec in layer_specs:
+            layer = DefinitionLoader._convert_layer(lspec)
+            if layer is not None:
+                model.add(layer)  # Sequential builds + shape-infers here
+        return model
+
+    @staticmethod
+    def _convert_layer(lspec: dict):
+        cls = lspec["class_name"]
+        c = lspec["config"]
+        in_shape = None
+        if c.get("batch_input_shape"):
+            in_shape = _tuplify(c["batch_input_shape"][1:])
+        if cls == "Dense":
+            units = c.get("output_dim", c.get("units"))
+            return bk.Dense(units, activation=c.get("activation") or None,
+                            bias=c.get("bias", c.get("use_bias", True)),
+                            input_shape=in_shape)
+        if cls == "Activation":
+            return bk.Activation(c["activation"], input_shape=in_shape)
+        if cls == "Dropout":
+            return bk.Dropout(c.get("p", c.get("rate", 0.5)),
+                              input_shape=in_shape)
+        if cls == "Flatten":
+            return bk.Flatten(input_shape=in_shape)
+        if cls == "Reshape":
+            return bk.Reshape(_tuplify(c["target_shape"]),
+                              input_shape=in_shape)
+        if cls in ("Convolution2D", "Conv2D"):
+            if c.get("dim_ordering", "th") != "th":
+                raise ValueError("only th (channels-first) dim_ordering")
+            nb = c.get("nb_filter", c.get("filters"))
+            row = c.get("nb_row", (c.get("kernel_size") or [None])[0])
+            col = c.get("nb_col", (c.get("kernel_size") or [None, None])[1])
+            sub = _tuplify(c.get("subsample", c.get("strides", (1, 1))))
+            return bk.Convolution2D(
+                nb, row, col, subsample=sub,
+                border_mode=c.get("border_mode", c.get("padding", "valid")),
+                activation=c.get("activation") or None,
+                input_shape=in_shape)
+        if cls == "MaxPooling2D":
+            return bk.MaxPooling2D(
+                pool_size=_tuplify(c.get("pool_size", (2, 2))),
+                strides=_tuplify(c.get("strides")) or None,
+                border_mode=c.get("border_mode", "valid"),
+                input_shape=in_shape)
+        if cls == "AveragePooling2D":
+            return bk.AveragePooling2D(
+                pool_size=_tuplify(c.get("pool_size", (2, 2))),
+                strides=_tuplify(c.get("strides")) or None,
+                border_mode=c.get("border_mode", "valid"),
+                input_shape=in_shape)
+        if cls == "BatchNormalization":
+            return bk.BatchNormalization(epsilon=c.get("epsilon", 1e-3),
+                                         momentum=c.get("momentum", 0.99),
+                                         input_shape=in_shape)
+        if cls == "Embedding":
+            return bk.Embedding(c["input_dim"], c["output_dim"],
+                                input_shape=in_shape
+                                or ((c["input_length"],)
+                                    if c.get("input_length") else None))
+        raise ValueError(f"unsupported keras layer {cls!r}")
+
+
+class WeightLoader:
+    """hdf5 -> weights into a built model (≙ converter.py WeightLoader)."""
+
+    @staticmethod
+    def load_weights(model, h5_path: str):
+        import h5py
+
+        with h5py.File(h5_path, "r") as f:
+            root = f["model_weights"] if "model_weights" in f else f
+            layer_names = [n.decode() if isinstance(n, bytes) else n
+                           for n in root.attrs.get("layer_names", [])]
+            weighted = [l for l in model._layers
+                        if getattr(l, "layer", None) is not None
+                        and l.layer.params_dict()]
+            w_groups = []
+            for ln in layer_names:
+                grp = root[ln]
+                wn = [n.decode() if isinstance(n, bytes) else n
+                      for n in grp.attrs.get("weight_names", [])]
+                if wn:
+                    w_groups.append([np.asarray(grp[n]) for n in wn])
+            if len(w_groups) != len(weighted):
+                raise ValueError(
+                    f"weight/layer mismatch: {len(w_groups)} weighted hdf5 "
+                    f"layers vs {len(weighted)} weighted model layers")
+            for layer, weights in zip(weighted, w_groups):
+                _set_layer_weights(layer, weights)
+
+
+def _set_layer_weights(klayer, weights: List[np.ndarray]):
+    from bigdl_tpu.keras import layers as kl
+
+    inner = klayer.layer
+    if isinstance(klayer, kl.Dense):
+        lin = _find(inner, "Linear")
+        lin._set_param("weight", jnp.asarray(weights[0].T))  # (in,out)->(out,in)
+        if len(weights) > 1:
+            lin._set_param("bias", jnp.asarray(weights[1]))
+    elif isinstance(klayer, kl.Convolution2D):
+        conv = _find(inner, "SpatialConvolution")
+        conv._set_param("weight", jnp.asarray(weights[0]))  # th: OIHW already
+        if len(weights) > 1:
+            conv._set_param("bias", jnp.asarray(weights[1]))
+    elif isinstance(klayer, kl.BatchNormalization):
+        bn = _find(inner, "BatchNormalization", startswith=True)
+        gamma, beta, mean, var = weights[:4]
+        bn._set_param("weight", jnp.asarray(gamma))
+        bn._set_param("bias", jnp.asarray(beta))
+        bn._set_buffer("running_mean", jnp.asarray(mean))
+        bn._set_buffer("running_var", jnp.asarray(var))
+    elif isinstance(klayer, kl.Embedding):
+        emb = _find(inner, "LookupTable", startswith=True)
+        emb._set_param("weight", jnp.asarray(weights[0]))
+    else:
+        raise ValueError(
+            f"no weight mapping for {type(klayer).__name__}")
+
+
+def _find(module: Module, cls_name: str, startswith: bool = False):
+    for _, m in module.named_modules():
+        n = type(m).__name__
+        if n == cls_name or (startswith and n.startswith(cls_name)):
+            return m
+    raise ValueError(f"no {cls_name} inside {type(module).__name__}")
+
+
+def load_keras(json_path: Optional[str] = None,
+               hdf5_path: Optional[str] = None,
+               json_str: Optional[str] = None,
+               input_shape=None):
+    """≙ the reference's Model.load_keras(json_path, hdf5_path). Builds the
+    model (shape inference needs either batch_input_shape in the json or an
+    explicit ``input_shape``), then loads weights if given."""
+    if json_str is None:
+        with open(json_path) as f:
+            json_str = f.read()
+    model = DefinitionLoader.from_json_str(json_str, input_shape)
+    if hdf5_path:
+        WeightLoader.load_weights(model, hdf5_path)
+    return model

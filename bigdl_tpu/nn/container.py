@@ -108,3 +108,14 @@ class Bottle(Container):
         flat = input.reshape((-1,) + shape[len(shape) - self.n_input_dim + 1 :])
         out = self[0](flat)
         return out.reshape(lead + out.shape[1:])
+
+
+def flatten_sequential(module):
+    """Flatten nested Sequentials to a layer list (shared by the tf/caffe
+    exporters' linear-pipeline walks)."""
+    if isinstance(module, Sequential):
+        out = []
+        for m in module._modules.values():
+            out.extend(flatten_sequential(m))
+        return out
+    return [module]

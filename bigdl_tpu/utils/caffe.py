@@ -199,9 +199,12 @@ def _pool_module(p: dict) -> nn.Module:
     pad = _g1(p, "pad", 0)
     ph = _g1(p, "pad_h", pad)
     pb = _g1(p, "pad_w", pad)
+    # caffe defaults to CEIL; round_mode: FLOOR (enum 1) opts out
+    ceil = _g1(p, "round_mode", "CEIL") not in ("FLOOR", 1)
     if mode in ("MAX", 0):
-        return nn.SpatialMaxPooling(kw, kh, sw, sh, pb, ph).ceil()  # caffe ceils
-    return nn.SpatialAveragePooling(kw, kh, sw, sh, pb, ph, ceil_mode=True)
+        mp = nn.SpatialMaxPooling(kw, kh, sw, sh, pb, ph)
+        return mp.ceil() if ceil else mp
+    return nn.SpatialAveragePooling(kw, kh, sw, sh, pb, ph, ceil_mode=ceil)
 
 
 class _GlobalMaxPool(nn.Module):
@@ -250,7 +253,12 @@ class CaffeLoader:
 
     # ---------------------------------------------------------------- build
     def load(self, input_channels: int = 3):
-        """Build the Graph and copy weights. Returns (model, input_names)."""
+        """Build the Graph and copy weights. Returns (model, input_names).
+        ``input_dim`` lines in the prototxt (N, C, H, W) override the
+        ``input_channels`` default."""
+        dims = self.net.proto.get("input_dim", [])
+        if len(dims) >= 2:
+            input_channels = int(dims[1])
         defs = [d for d in self.net.layer_defs()
                 if not self._is_train_only(d)]
         blob_node: Dict[str, nn.Node] = {}
