@@ -1,0 +1,50 @@
+"""ML-pipeline example (≙ example/MLPipeline/DLClassifierLeNet.scala and
+DLEstimator* examples): a DLClassifier inside an sklearn Pipeline over a
+pandas DataFrame — the TPU-native analog of Spark-ML pipeline composition.
+
+Run: python -m bigdl_tpu.example.MLPipeline.train
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.dlframes import DLClassifier
+from bigdl_tpu.optim.trigger import Trigger
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows", type=int, default=128)
+    p.add_argument("--epochs", type=int, default=30)
+    args = p.parse_args(argv)
+
+    import pandas as pd
+
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(4)
+    rng = np.random.RandomState(0)
+    x = rng.randn(args.rows, 4).astype(np.float32)
+    y = (x[:, 0] - x[:, 2] > 0).astype(np.float32) + 1  # classes 1/2
+    df = pd.DataFrame({"features": list(x), "label": list(y)})
+    train_df, test_df = df[: args.rows * 3 // 4], df[args.rows * 3 // 4:]
+
+    model = (nn.Sequential().add(nn.Linear(4, 16)).add(nn.ReLU())
+             .add(nn.Linear(16, 2)).add(nn.LogSoftMax()))
+    clf = (DLClassifier(model, nn.ClassNLLCriterion(), [4])
+           .set_batch_size(16).set_learning_rate(0.1)
+           .set_end_when(Trigger.max_epoch(args.epochs)))
+    fitted = clf.fit(train_df)
+    out = fitted.transform(test_df)
+    acc = float(np.mean(np.asarray(out["prediction"])
+                        == np.asarray(test_df["label"], np.int64)))
+    print(f"test accuracy: {acc:.3f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

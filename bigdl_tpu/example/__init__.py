@@ -1,0 +1,2 @@
+"""End-to-end example applications (≙ the reference's example/ tree):
+capability demos proving train + import/export + serve compose."""

@@ -1,0 +1,89 @@
+"""PTB-style word-level language model (≙ example/languagemodel/PTBModel +
+PTBWordLM.scala): embedding -> LSTM stack -> per-step softmax, trained on a
+token stream cut into (num_steps)-long windows.
+
+Run: python -m bigdl_tpu.example.languagemodel.train [--data ptb.train.txt]
+Falls back to a synthetic repeating-pattern corpus when --data is absent,
+so the example runs hermetically.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset.dataset import LocalDataSet
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.optim.optim_method import Adagrad
+from bigdl_tpu.optim.optimizer import Optimizer
+from bigdl_tpu.optim.trigger import Trigger
+
+
+def build_model(vocab: int, embed: int = 64, hidden: int = 128) -> nn.Module:
+    """≙ PTBModel.transformer=false branch: LookupTable -> Recurrent LSTM
+    -> TimeDistributed(Linear) -> LogSoftMax."""
+    return (nn.Sequential()
+            .add(nn.LookupTable(vocab, embed))
+            .add(nn.Recurrent(nn.LSTM(embed, hidden)))
+            .add(nn.TimeDistributed(nn.Linear(hidden, vocab)))
+            .add(nn.TimeDistributedLogSoftMax()
+                 if hasattr(nn, "TimeDistributedLogSoftMax")
+                 else nn.LogSoftMax()))
+
+
+def load_tokens(path: str | None, vocab: int, n_tokens: int = 4000):
+    if path:
+        with open(path) as f:
+            words = f.read().split()
+        idx = {}
+        stream = []
+        for w in words:
+            idx.setdefault(w, len(idx) + 1)  # 1-based ids
+            stream.append(idx[w])
+        return np.asarray(stream, np.int64), len(idx) + 1
+    # synthetic corpus: noisy arithmetic-progression patterns
+    rng = np.random.RandomState(0)
+    base = np.arange(1, vocab)
+    stream = np.concatenate([np.roll(base, -s)[:vocab // 2]
+                             for s in rng.randint(0, vocab, 40)])
+    return stream[:n_tokens], vocab
+
+
+def windows(stream: np.ndarray, num_steps: int):
+    n = (len(stream) - 1) // num_steps
+    samples = []
+    for i in range(n):
+        x = stream[i * num_steps:(i + 1) * num_steps]
+        y = stream[i * num_steps + 1:(i + 1) * num_steps + 1]
+        samples.append(Sample(x.astype(np.int64), y.astype(np.int64)))
+    return samples
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--data", default=None, help="PTB token file (optional)")
+    p.add_argument("--vocab", type=int, default=40)
+    p.add_argument("--num-steps", type=int, default=20)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--max-epoch", type=int, default=2)
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--embed", type=int, default=32)
+    args = p.parse_args(argv)
+
+    stream, vocab = load_tokens(args.data, args.vocab)
+    samples = windows(stream, args.num_steps)
+    model = build_model(vocab, args.embed, args.hidden)
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                       size_average=True)
+    opt = Optimizer(model=model, dataset=LocalDataSet(samples),
+                    criterion=crit, batch_size=args.batch_size,
+                    end_when=Trigger.max_epoch(args.max_epoch))
+    opt.set_optim_method(Adagrad(learning_rate=0.1))
+    trained = opt.optimize()
+    return trained
+
+
+if __name__ == "__main__":
+    main()

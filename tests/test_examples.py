@@ -1,0 +1,72 @@
+"""Smoke tests for the example apps (≙ the reference's example/ tree:
+capability demos proving train + import + serve compose)."""
+
+import numpy as np
+
+from bigdl_tpu.utils import random as rnd
+
+
+def test_languagemodel_example():
+    from bigdl_tpu.example.languagemodel.train import main
+
+    rnd.set_seed(1)
+    trained = main(["--vocab", "20", "--num-steps", "8", "--batch-size", "8",
+                    "--max-epoch", "1", "--hidden", "16", "--embed", "8"])
+    assert trained is not None
+
+
+def test_textclassification_example():
+    from bigdl_tpu.example.textclassification.train import main
+
+    rnd.set_seed(2)
+    _, acc = main(["--class-num", "3", "--seq-len", "16", "--embed-dim", "8",
+                   "--batch-size", "16", "--max-epoch", "4",
+                   "--samples", "96"])
+    assert acc > 0.6, acc
+
+
+def test_imageclassification_example(tmp_path):
+    from bigdl_tpu import nn
+    from bigdl_tpu.example.imageclassification.predict import main
+    from bigdl_tpu.utils.file import save_module
+
+    rnd.set_seed(3)
+    model = (nn.Sequential()
+             .add(nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1))
+             .add(nn.ReLU())
+             .add(nn.SpatialAveragePooling(32, 32, global_pooling=True))
+             .add(nn.View(4)).add(nn.Linear(4, 3)).add(nn.SoftMax()))
+    mpath = str(tmp_path / "m.bigdl")
+    save_module(model, mpath)
+    rng = np.random.RandomState(0)
+    paths = []
+    for i in range(3):
+        p = str(tmp_path / f"img{i}.npy")
+        np.save(p, rng.rand(16, 16, 3).astype(np.float32))
+        paths.append(p)
+    preds = main(["--model", mpath, "--model-type", "bigdl",
+                  "--images", str(tmp_path / "img*.npy")])
+    assert len(preds) == 3 and all(1 <= c <= 3 for c in preds)
+
+
+def test_udfpredictor_example():
+    from bigdl_tpu.example.udfpredictor.predict import main
+
+    df = main(["--rows", "16"])
+    assert set(df["prediction"].unique()) <= {1, 2}
+
+
+def test_tree_lstm_sentiment_example():
+    from bigdl_tpu.example.treeLSTMSentiment.train import main
+
+    rnd.set_seed(5)
+    loss, acc = main(["--samples", "16", "--leaves", "2", "--embed-dim", "4",
+                      "--hidden", "8", "--epochs", "15", "--lr", "0.3"])
+    assert acc >= 0.7, acc
+
+
+def test_mlpipeline_example():
+    from bigdl_tpu.example.MLPipeline.train import main
+
+    acc = main(["--rows", "96", "--epochs", "20"])
+    assert acc > 0.7, acc
