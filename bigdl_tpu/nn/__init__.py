@@ -54,7 +54,8 @@ from bigdl_tpu.nn.normalization import (
 from bigdl_tpu.nn.embedding import LookupTable
 from bigdl_tpu.nn.graph import Graph, StaticGraph, DynamicGraph, Node, Input
 from bigdl_tpu.nn.recurrent import (
-    Cell, RnnCell, LSTM, LSTMPeephole, GRU, ConvLSTMPeephole, MultiRNNCell,
+    Cell, RnnCell, LSTM, LSTMPeephole, GRU, ConvLSTMPeephole,
+    ConvLSTMPeephole3D, MultiRNNCell,
     Recurrent, BiRecurrent, RecurrentDecoder, TimeDistributed,
 )
 from bigdl_tpu.nn.attention import (
@@ -73,6 +74,7 @@ from bigdl_tpu.nn.criterion import (
     DiceCoefficientCriterion, ClassSimplexCriterion, ParallelCriterion,
     MultiCriterion, TimeDistributedCriterion, PGCriterion,
     ActivityRegularization, SmoothL1CriterionWithWeights,
+    SoftmaxWithCriterion, TimeDistributedMaskCriterion, TransformerCriterion,
 )
 from bigdl_tpu.nn import ops  # TF-style Operation modules (nn/ops/, SURVEY.md §2.3)
 from bigdl_tpu.nn import tf_ops  # TF infra ops (nn/tf/, SURVEY.md §2.3)
@@ -89,3 +91,5 @@ from bigdl_tpu.nn.detection import (
     bbox_iou, decode_boxes, nms,
 )
 from bigdl_tpu.nn.tree_lstm import BinaryTreeLSTM, TreeLSTM
+from bigdl_tpu.nn.pooling import SpatialMaxPoolingWithIndices, SpatialUnpooling
+from bigdl_tpu.nn.conv import LocallyConnected1D, SpatialConvolutionMap

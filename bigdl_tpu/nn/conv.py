@@ -320,3 +320,114 @@ class VolumetricConvolution(Module):
         if self.with_bias:
             out = out + self.bias[None, :, None, None, None]
         return out[0] if squeeze else out
+
+
+class SpatialConvolutionMap(Module):
+    """Convolution with an explicit input->output connection table
+    (reference: nn/SpatialConvolutionMap.scala; Torch legacy used by early
+    LeNet variants). ``conn_table`` is (n_pairs, 2) of 1-based
+    (in_channel, out_channel) pairs, each pair owning its own (kh, kw)
+    kernel. ``full``/``one_to_one``/``random`` build the classic tables."""
+
+    def __init__(self, conn_table, kw: int, kh: int, dw: int = 1, dh: int = 1,
+                 pad_w: int = 0, pad_h: int = 0):
+        super().__init__()
+        import numpy as _np
+
+        table = _np.asarray(conn_table, _np.int64)
+        assert table.ndim == 2 and table.shape[1] == 2
+        self.conn_table = table
+        self.kernel_w, self.kernel_h = kw, kh
+        self.stride_w, self.stride_h = dw, dh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.n_input_plane = int(table[:, 0].max())
+        self.n_output_plane = int(table[:, 1].max())
+        n_pairs = table.shape[0]
+        fan_in = kh * kw * max(1, n_pairs // self.n_output_plane)
+        self.register_parameter(
+            "weight", bt_init.Xavier()((n_pairs, kh, kw),
+                                       fan_in=fan_in, fan_out=fan_in))
+        self.register_parameter("bias", jnp.zeros((self.n_output_plane,)))
+
+    @staticmethod
+    def full(n_in: int, n_out: int):
+        import numpy as _np
+
+        ins, outs = _np.meshgrid(_np.arange(1, n_in + 1),
+                                 _np.arange(1, n_out + 1))
+        return _np.stack([ins.reshape(-1), outs.reshape(-1)], axis=1)
+
+    @staticmethod
+    def one_to_one(n: int):
+        import numpy as _np
+
+        r = _np.arange(1, n + 1)
+        return _np.stack([r, r], axis=1)
+
+    @staticmethod
+    def random(n_in: int, n_out: int, n_from: int, seed: int = 1):
+        import numpy as _np
+
+        rng = _np.random.RandomState(seed)
+        rows = []
+        for o in range(1, n_out + 1):
+            for i in rng.choice(_np.arange(1, n_in + 1), size=n_from,
+                                replace=False):
+                rows.append([int(i), o])
+        return _np.asarray(rows, _np.int64)
+
+    def forward(self, input):
+        squeeze = input.ndim == 3
+        x = input[None] if squeeze else input
+        # masked full conv: scatter per-pair kernels into an (out, in, kh, kw)
+        # weight (absent pairs stay zero) -> ONE MXU conv
+        w = jnp.zeros((self.n_output_plane, self.n_input_plane,
+                       self.kernel_h, self.kernel_w), x.dtype)
+        w = w.at[self.conn_table[:, 1] - 1,
+                 self.conn_table[:, 0] - 1].add(self.weight.astype(x.dtype))
+        out = lax.conv_general_dilated(
+            x, w, window_strides=(self.stride_h, self.stride_w),
+            padding=[(self.pad_h, self.pad_h), (self.pad_w, self.pad_w)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        out = out + self.bias[None, :, None, None]
+        return out[0] if squeeze else out
+
+
+class LocallyConnected1D(Module):
+    """Unshared 1-D conv over (batch, n_input_frame, input_frame_size)
+    (reference: nn/LocallyConnected1D.scala): every output frame owns its
+    own kernel — patch extraction + per-position einsum (batched matmul)."""
+
+    def __init__(self, n_input_frame: int, input_frame_size: int,
+                 output_frame_size: int, kernel_w: int, stride_w: int = 1,
+                 propagate_back: bool = True, w_regularizer=None,
+                 b_regularizer=None):
+        super().__init__()
+        self.n_input_frame = n_input_frame
+        self.input_frame_size = input_frame_size
+        self.output_frame_size = output_frame_size
+        self.kernel_w, self.stride_w = kernel_w, stride_w
+        n_out = (n_input_frame - kernel_w) // stride_w + 1
+        self.n_output_frame = n_out
+        fan_in = input_frame_size * kernel_w
+        self.register_parameter(
+            "weight",
+            bt_init.Xavier()((n_out, output_frame_size,
+                              input_frame_size * kernel_w),
+                             fan_in=fan_in,
+                             fan_out=output_frame_size * kernel_w),
+            regularizer=w_regularizer)
+        self.register_parameter("bias",
+                                jnp.zeros((n_out, output_frame_size)),
+                                regularizer=b_regularizer)
+
+    def forward(self, input):
+        squeeze = input.ndim == 2
+        x = input[None] if squeeze else input  # (b, t, c)
+        b = x.shape[0]
+        # (b, n_out, k*c) patch matrix
+        idx = (jnp.arange(self.n_output_frame)[:, None] * self.stride_w
+               + jnp.arange(self.kernel_w)[None, :])
+        patches = x[:, idx].reshape(b, self.n_output_frame, -1)
+        out = jnp.einsum("btk,tok->bto", patches, self.weight) + self.bias
+        return out[0] if squeeze else out

@@ -517,3 +517,108 @@ class SmoothL1CriterionWithWeights(Criterion):
                          ad - 0.5 / self.sigma2)
         loss = jnp.sum(w_out * loss)
         return loss / self.num if self.num > 0 else loss
+
+
+class SoftmaxWithCriterion(Criterion):
+    """Softmax + multinomial logistic loss over spatial score maps
+    (reference: nn/SoftmaxWithCriterion.scala:35). Input (N, C, [H, W])
+    raw scores; target (N, [H, W]) 1-based labels. ``ignore_label`` entries
+    contribute no loss; ``normalize_mode`` in {VALID, FULL, BATCH_SIZE,
+    NONE} picks the normalizer (SoftmaxWithCriterion.scala:86)."""
+
+    def __init__(self, ignore_label=None, normalize_mode: str = "VALID"):
+        super().__init__()
+        if normalize_mode not in ("VALID", "FULL", "BATCH_SIZE", "NONE"):
+            raise ValueError(f"bad normalize_mode {normalize_mode!r}")
+        self.ignore_label = ignore_label
+        self.normalize_mode = normalize_mode
+
+    def forward(self, input, target):
+        x = jnp.asarray(input)
+        t = jnp.asarray(target).astype(jnp.int32)
+        if t.ndim == x.ndim:  # (N,1,H,W) style
+            t = jnp.squeeze(t, axis=1)
+        logp = jax.nn.log_softmax(x, axis=1)
+        idx = jnp.clip(t - 1, 0, x.shape[1] - 1)  # 1-based labels
+        picked = jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0]
+        mask = jnp.ones_like(picked, bool) if self.ignore_label is None \
+            else (t != self.ignore_label)
+        loss = -jnp.sum(jnp.where(mask, picked, 0.0))
+        count = jnp.sum(mask)
+        if self.normalize_mode == "VALID":
+            norm = jnp.maximum(count, 1)
+        elif self.normalize_mode == "FULL":
+            norm = picked.size
+        elif self.normalize_mode == "BATCH_SIZE":
+            norm = x.shape[0]
+        else:
+            norm = 1
+        return loss / norm
+
+
+class TimeDistributedMaskCriterion(Criterion):
+    """Time-distributed criterion that masks padded steps (reference:
+    nn/TimeDistributedMaskCriterion.scala:42): entries whose TARGET equals
+    ``padding_value`` contribute no loss; the sum is normalized by the
+    count of non-padded entries.
+
+    Supports inner criterions with an elementwise decomposition —
+    ClassNLLCriterion (input (B, T, C) log-probs, target (B, T) 1-based)
+    and MSECriterion (matching shapes) — which covers the reference's
+    padded-sequence labeling use case."""
+
+    def __init__(self, criterion, padding_value: int = 0):
+        super().__init__()
+        self.criterion = criterion
+        self.padding_value = padding_value
+
+    def forward(self, input, target):
+        x = jnp.asarray(input)
+        t = jnp.asarray(target)
+        if isinstance(self.criterion, ClassNLLCriterion):
+            ti = t.astype(jnp.int32)
+            mask = ti != self.padding_value
+            idx = jnp.clip(ti - 1, 0, x.shape[-1] - 1)
+            picked = jnp.take_along_axis(x, idx[..., None], axis=-1)[..., 0]
+            loss = -jnp.sum(jnp.where(mask, picked, 0.0))
+            return loss / jnp.maximum(jnp.sum(mask), 1)
+        if isinstance(self.criterion, MSECriterion):
+            mask = t != self.padding_value
+            se = jnp.where(mask, (x - t) ** 2, 0.0)
+            return jnp.sum(se) / jnp.maximum(jnp.sum(mask), 1)
+        raise ValueError(
+            "TimeDistributedMaskCriterion supports ClassNLL/MSE inner "
+            f"criterions, got {type(self.criterion).__name__}")
+
+
+class TransformerCriterion(Criterion):
+    """Apply transformations to input and/or target before an inner
+    criterion (reference: nn/TransformerCriterion.scala:41 — used to embed
+    e.g. a pretrained feature extractor inside the loss; gradients flow
+    back through the input transformer)."""
+
+    def __init__(self, criterion, input_transformer=None,
+                 target_transformer=None):
+        super().__init__()
+        self.criterion = criterion
+        self.input_transformer = input_transformer
+        self.target_transformer = target_transformer
+
+    def _transformed_target(self, target):
+        if self.target_transformer is None:
+            return target
+        return jax.lax.stop_gradient(self.target_transformer(target))
+
+    def forward(self, input, target):
+        x = self.input_transformer(input) if self.input_transformer else input
+        return self.criterion.forward(x, self._transformed_target(target))
+
+    def backward(self, input, target):
+        t = self._transformed_target(target)
+
+        def f(x):
+            xi = self.input_transformer(x) if self.input_transformer else x
+            return self.criterion.forward(xi, t)
+
+        self.grad_input = jax.grad(f)(input)
+        return self.grad_input

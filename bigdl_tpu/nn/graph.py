@@ -215,3 +215,37 @@ class DynamicGraph(Graph):
     nn/Scheduler.scala:36). Under jit, lazy scheduling and static order
     trace to the same XLA program, so this shares Graph's execution; it
     exists for API parity with imported TF graphs."""
+
+
+# appended to Graph via method assignment below (keeps the class body at
+# the top of the file readable)
+def _check_duplicate(self, raise_on_shared: bool = False):
+    """Diagnostic parity with AbstractModule.checkDuplicate: find module
+    INSTANCES wired into more than one node. Under the reference's
+    imperative backward, a duplicated module corrupts gradients, so it
+    raises; here sharing is functionally correct (shared params simply get
+    summed gradients), so by default the shared list is returned —
+    ``raise_on_shared=True`` restores the reference's strictness. Duplicate
+    module NAMES always raise: they make ``Graph.node(name)`` ambiguous."""
+    by_id = {}
+    for node in self._topo:
+        by_id.setdefault(id(node.module), []).append(node)
+    shared = [nodes[0].module for nodes in by_id.values() if len(nodes) > 1]
+    # shared instances legitimately appear under one name several times;
+    # only DISTINCT modules colliding on a name are ambiguous
+    name_to_ids = {}
+    for node in self._topo:
+        name_to_ids.setdefault(node.name, set()).add(id(node.module))
+    ambiguous = sorted(n for n, ids in name_to_ids.items() if len(ids) > 1)
+    if ambiguous:
+        raise ValueError(f"distinct modules share names {ambiguous}; "
+                         "rename with set_name() for unambiguous lookup")
+    if raise_on_shared and shared:
+        raise ValueError(
+            f"modules used by multiple nodes: "
+            f"{[m.get_name() for m in shared]} (reference checkDuplicate "
+            "semantics)")
+    return shared
+
+
+Graph.check_duplicate = _check_duplicate

@@ -204,3 +204,97 @@ class VolumetricAveragePooling(Module):
         )
         out = summed / (self.k[0] * self.k[1] * self.k[2])
         return out[0] if squeeze else out
+
+
+class SpatialMaxPoolingWithIndices(Module):
+    """Max pooling that also emits argmax indices (reference:
+    nn/SpatialMaxPoolingWithIndices.scala:65): output Table(pooled,
+    indices); indices are 1-based flat positions in the H*W plane (Torch
+    convention), consumable by SpatialUnpooling."""
+
+    def __init__(self, kw: int, kh: int, dw: int = None, dh: int = None,
+                 pad_w: int = 0, pad_h: int = 0, format: str = "NCHW"):
+        super().__init__()
+        if format != "NCHW":
+            raise ValueError("indices pooling supports NCHW only")
+        self.kw, self.kh = kw, kh
+        self.dw = dw if dw is not None else kw
+        self.dh = dh if dh is not None else kh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.ceil_mode = False
+
+    def ceil(self):
+        self.ceil_mode = True
+        return self
+
+    def forward(self, input):
+        from bigdl_tpu.utils.table import Table
+
+        squeeze = input.ndim == 3
+        x = input[None] if squeeze else input
+        n, c, h, w = x.shape
+        out_h = _pool_out_size(h, self.kh, self.dh, self.pad_h, self.ceil_mode)
+        out_w = _pool_out_size(w, self.kw, self.dw, self.pad_w, self.ceil_mode)
+        pad_h = _pool_padding(h, out_h, self.kh, self.dh, self.pad_h)
+        pad_w = _pool_padding(w, out_w, self.kw, self.dw, self.pad_w)
+        xp = jnp.pad(x, ((0, 0), (0, 0), pad_h, pad_w),
+                     constant_values=-jnp.inf)
+        # flat plane index of every padded position (1-based in the
+        # UNPADDED plane; padded cells get an out-of-range marker)
+        hs = jnp.arange(xp.shape[2]) - pad_h[0]
+        ws = jnp.arange(xp.shape[3]) - pad_w[0]
+        flat = hs[:, None] * w + ws[None, :] + 1
+        valid = ((hs[:, None] >= 0) & (hs[:, None] < h)
+                 & (ws[None, :] >= 0) & (ws[None, :] < w))
+        flat = jnp.where(valid, flat, 0)
+        patches = lax.conv_general_dilated_patches(
+            xp.reshape(n * c, 1, xp.shape[2], xp.shape[3]),
+            (self.kh, self.kw), (self.dh, self.dw), padding="VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )  # (n*c, kh*kw, out_h, out_w)
+        arg = jnp.argmax(patches, axis=1)  # (n*c, out_h, out_w)
+        pooled = jnp.max(patches, axis=1).reshape(n, c, out_h, out_w)
+        # map window-local argmax to plane-flat index
+        ky, kx = jnp.unravel_index(arg, (self.kh, self.kw))
+        oy = jnp.arange(out_h)[None, :, None] * self.dh
+        ox = jnp.arange(out_w)[None, None, :] * self.dw
+        iy = oy + ky
+        ix = ox + kx
+        idx = flat[iy, ix].reshape(n, c, out_h, out_w).astype(jnp.float32)
+        if squeeze:
+            return Table(pooled[0], idx[0])
+        return Table(pooled, idx)
+
+
+class SpatialUnpooling(Module):
+    """Inverse of max pooling using saved indices (reference:
+    nn/SpatialUnpooling.scala:43): input Table(pooled, indices) -> scatter
+    each pooled value back to its argmax position in the recovered
+    (H, W) = ((outH-1)*dH - 2*padH + kH, ...) plane."""
+
+    def __init__(self, kw: int, kh: int, dw: int = None, dh: int = None,
+                 pad_w: int = 0, pad_h: int = 0, format: str = "NCHW"):
+        super().__init__()
+        if format != "NCHW":
+            raise ValueError("unpooling supports NCHW only")
+        self.kw, self.kh = kw, kh
+        self.dw = dw if dw is not None else kw
+        self.dh = dh if dh is not None else kh
+        self.pad_w, self.pad_h = pad_w, pad_h
+
+    def forward(self, input):
+        pooled, indices = list(input)[:2]
+        squeeze = pooled.ndim == 3
+        p = pooled[None] if squeeze else pooled
+        idx = (indices[None] if squeeze else indices).astype(jnp.int32)
+        n, c, oh, ow = p.shape
+        h = (oh - 1) * self.dh - 2 * self.pad_h + self.kh
+        w = (ow - 1) * self.dw - 2 * self.pad_w + self.kw
+        flat = jnp.zeros((n, c, h * w + 1), p.dtype)  # slot 0 = pad sink
+        flat = flat.at[
+            jnp.arange(n)[:, None, None, None],
+            jnp.arange(c)[None, :, None, None],
+            idx,
+        ].add(p)
+        out = flat[:, :, 1:].reshape(n, c, h, w)
+        return out[0] if squeeze else out

@@ -368,6 +368,62 @@ class ConvLSTMPeephole(Cell):
         return h_new, (h_new, c_new)
 
 
+class ConvLSTMPeephole3D(ConvLSTMPeephole):
+    """3-D convolutional peephole LSTM over (C, D, H, W) volumes
+    (reference: nn/ConvLSTMPeephole3D.scala) — same fused-gate structure as
+    the 2-D cell with volumetric SAME convs."""
+
+    def __init__(self, input_size: int, output_size: int, kernel_i: int = 3,
+                 kernel_c: int = 3, stride: int = 1,
+                 with_peephole: bool = True):
+        Cell.__init__(self)
+        self.input_size = input_size
+        self.output_size = output_size
+        self.kernel_i = kernel_i
+        self.kernel_c = kernel_c
+        self.with_peephole = with_peephole
+        fan = input_size * kernel_i ** 3
+        self.register_random_parameter(
+            "w_in", lambda: bt_init.RandomNormal(0.0, (2.0 / fan) ** 0.5)(
+                (4 * output_size, input_size,
+                 kernel_i, kernel_i, kernel_i)))
+        fanh = output_size * kernel_c ** 3
+        self.register_random_parameter(
+            "w_hid", lambda: bt_init.RandomNormal(0.0, (2.0 / fanh) ** 0.5)(
+                (4 * output_size, output_size,
+                 kernel_c, kernel_c, kernel_c)))
+        self.register_parameter("bias", jnp.zeros((4 * output_size,)))
+        if with_peephole:
+            self.register_parameter("w_ci", jnp.zeros((output_size, 1, 1, 1)))
+            self.register_parameter("w_cf", jnp.zeros((output_size, 1, 1, 1)))
+            self.register_parameter("w_co", jnp.zeros((output_size, 1, 1, 1)))
+
+    def _conv(self, x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=(1, 1, 1), padding="SAME",
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+
+    def step(self, x, state, rng=None):
+        h, c = state
+        z = self._conv(x, self.w_in) + self._conv(h, self.w_hid) \
+            + self.bias[None, :, None, None, None]
+        n = self.output_size
+        zi, zf, zg, zo = (z[:, 0 * n:1 * n], z[:, 1 * n:2 * n],
+                          z[:, 2 * n:3 * n], z[:, 3 * n:4 * n])
+        if self.with_peephole:
+            zi = zi + self.w_ci * c
+            zf = zf + self.w_cf * c
+        i = jax.nn.sigmoid(zi)
+        f = jax.nn.sigmoid(zf)
+        g = jnp.tanh(zg)
+        c_new = f * c + i * g
+        if self.with_peephole:
+            zo = zo + self.w_co * c_new
+        o = jax.nn.sigmoid(zo)
+        h_new = o * jnp.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+
 class MultiRNNCell(Cell):
     """Stack of cells applied in sequence at each step (reference:
     nn/MultiRNNCell.scala); state is the tuple of per-cell states."""

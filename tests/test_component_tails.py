@@ -1,0 +1,151 @@
+"""Tail components closing VERDICT r2 partial rows: SoftmaxWithCriterion,
+TimeDistributedMaskCriterion, TransformerCriterion, indices pooling +
+unpooling, SpatialConvolutionMap, LocallyConnected1D, ConvLSTMPeephole3D,
+RowTransformer, Graph.check_duplicate."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils.table import Table
+
+
+def test_softmax_with_criterion_matches_manual():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 3, 2, 2).astype(np.float32))
+    t = jnp.asarray(rng.randint(1, 4, (2, 2, 2)))
+    crit = nn.SoftmaxWithCriterion()
+    loss = float(crit.forward(x, t))
+    logp = np.asarray(jnp.log(jnp.exp(x) / jnp.exp(x).sum(1, keepdims=True)))
+    tn = np.asarray(t)
+    manual = -np.mean([logp[b, tn[b, i, j] - 1, i, j]
+                       for b in range(2) for i in range(2) for j in range(2)])
+    np.testing.assert_allclose(loss, manual, rtol=1e-5)
+
+
+def test_softmax_with_criterion_ignore_label_and_modes():
+    x = jnp.asarray(np.random.RandomState(1).randn(1, 3, 2, 2)
+                    .astype(np.float32))
+    t = jnp.asarray([[[1, 2], [0, 3]]])  # one ignored entry (label 0)
+    valid = float(nn.SoftmaxWithCriterion(ignore_label=0).forward(x, t))
+    full = float(nn.SoftmaxWithCriterion(ignore_label=0,
+                                         normalize_mode="FULL").forward(x, t))
+    # same summed loss, different normalizer (3 valid vs 4 total)
+    np.testing.assert_allclose(valid * 3, full * 4, rtol=1e-5)
+
+
+def test_time_distributed_mask_criterion():
+    logp = jnp.log(jnp.asarray([[[0.7, 0.3], [0.5, 0.5], [0.9, 0.1]]]))
+    target = jnp.asarray([[1, 2, 0]])  # last step padded
+    crit = nn.TimeDistributedMaskCriterion(nn.ClassNLLCriterion(),
+                                           padding_value=0)
+    loss = float(crit.forward(logp, target))
+    manual = -(np.log(0.7) + np.log(0.5)) / 2
+    np.testing.assert_allclose(loss, manual, rtol=1e-6)
+    # gradient exists and is zero at the padded step
+    g = np.asarray(crit.backward(logp, target))
+    assert np.all(g[0, 2] == 0)
+
+
+def test_transformer_criterion():
+    inner = nn.MSECriterion()
+    double = nn.MulConstant(2.0)
+    crit = nn.TransformerCriterion(inner, input_transformer=double,
+                                   target_transformer=double)
+    x = jnp.asarray([1.0, 2.0])
+    t = jnp.asarray([1.5, 1.0])
+    loss = float(crit.forward(x, t))
+    np.testing.assert_allclose(loss, np.mean((2 * np.asarray(x)
+                                              - 2 * np.asarray(t)) ** 2),
+                               rtol=1e-6)
+    g = np.asarray(crit.backward(x, t))
+    np.testing.assert_allclose(g, 2 * 2 * 2 * (np.asarray(x)
+                                               - np.asarray(t)) / 2, rtol=1e-5)
+
+
+def test_max_pooling_with_indices_unpooling_roundtrip():
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(2, 3, 6, 6).astype(np.float32))
+    mp = nn.SpatialMaxPoolingWithIndices(2, 2)
+    out = mp(x)
+    pooled, idx = out[1], out[2]
+    assert pooled.shape == (2, 3, 3, 3) and idx.shape == (2, 3, 3, 3)
+    rec = nn.SpatialUnpooling(2, 2)(Table(pooled, idx))
+    xn, rn = np.asarray(x), np.asarray(rec)
+    assert rn.shape == xn.shape
+    nz = rn != 0
+    np.testing.assert_allclose(rn[nz], xn[nz], rtol=1e-6)
+    assert nz.sum() == 2 * 3 * 9  # one max per window
+
+
+def test_spatial_convolution_map_full_matches_dense_conv():
+    """A FULL connection table must equal a plain conv with the same
+    per-pair kernels."""
+    rng = np.random.RandomState(3)
+    table = nn.SpatialConvolutionMap.full(2, 3)
+    m = nn.SpatialConvolutionMap(table, 3, 3, pad_w=1, pad_h=1)
+    x = jnp.asarray(rng.randn(1, 2, 5, 5).astype(np.float32))
+    out = np.asarray(m(x))
+    # dense equivalent: scatter kernels to (out,in,kh,kw)
+    w = np.zeros((3, 2, 3, 3), np.float32)
+    for k, (i, o) in enumerate(np.asarray(table)):
+        w[o - 1, i - 1] = np.asarray(m.weight)[k]
+    conv = nn.SpatialConvolution(2, 3, 3, 3, 1, 1, 1, 1, init_weight=w,
+                                 init_bias=np.asarray(m.bias))
+    np.testing.assert_allclose(out, np.asarray(conv(x)), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_locally_connected_1d():
+    rng = np.random.RandomState(4)
+    m = nn.LocallyConnected1D(8, 4, 6, 3, 1)
+    x = jnp.asarray(rng.randn(2, 8, 4).astype(np.float32))
+    out = m(x)
+    assert out.shape == (2, 6, 6)
+    # position 0 output = patch0 . weight[0]
+    patch = np.asarray(x)[0, :3].reshape(-1)
+    manual = np.asarray(m.weight)[0] @ patch + np.asarray(m.bias)[0]
+    np.testing.assert_allclose(np.asarray(out)[0, 0], manual, rtol=1e-4)
+
+
+def test_conv_lstm_peephole_3d():
+    cell = nn.ConvLSTMPeephole3D(2, 3)
+    rec = nn.Recurrent(cell)
+    x = jnp.asarray(np.random.RandomState(5).randn(1, 3, 2, 2, 4, 4)
+                    .astype(np.float32))
+    out = rec(x)
+    assert out.shape == (1, 3, 3, 2, 4, 4)
+
+
+def test_row_transformer_factories():
+    from bigdl_tpu.dataset.row_transformer import RowTransformer
+
+    rows = [{"a": 1.0, "b": 2.0, "c": 3.0},
+            {"a": 4.0, "b": 5.0, "c": 6.0}]
+    atomic = RowTransformer.atomic(["a", "c"])
+    t = list(atomic(iter(rows)))[0]
+    np.testing.assert_allclose(t["a"], [1.0])
+    np.testing.assert_allclose(t["c"], [3.0])
+
+    num = RowTransformer.numeric(["a", "b", "c"])
+    t = list(num(iter(rows)))[1]
+    np.testing.assert_allclose(t["all"], [4.0, 5.0, 6.0])
+
+    mixed = RowTransformer.atomic_with_numeric(["a"], ["b", "c"])
+    t = list(mixed(iter(rows)))[0]
+    np.testing.assert_allclose(t["numeric"], [2.0, 3.0])
+    with pytest.raises(ValueError, match="replicated"):
+        RowTransformer.atomic(["a", "a"])
+
+
+def test_graph_check_duplicate():
+    lin = nn.Linear(4, 4)
+    a = nn.Input()
+    n1 = nn.Node(lin).inputs(a)
+    n2 = nn.Node(lin).inputs(n1)  # same instance twice = shared
+    g = nn.Graph([a], [n2])
+    shared = g.check_duplicate()
+    assert shared == [lin]
+    with pytest.raises(ValueError, match="multiple nodes"):
+        g.check_duplicate(raise_on_shared=True)
