@@ -22,7 +22,8 @@ import numpy as np
 from jax import lax
 
 #: ops whose module emits a Table of outputs; consumers reference "name:i"
-_MULTI_OUTPUT_OPS = {"Split", "SplitV", "Unpack", "TopK", "TopKV2"}
+_MULTI_OUTPUT_OPS = {"Split", "SplitV", "Unpack", "TopK", "TopKV2",
+                     "SoftmaxCrossEntropyWithLogits"}
 
 #: FunctionDef refs name the output arg ("node:out_arg:idx"); flat output
 #: index = arg's base offset + idx. Ops with one (possibly repeated) output
@@ -320,6 +321,16 @@ class TensorflowLoader:
         self.nodes = {n.name: n for n in parse_graphdef(data)}
         self.functions = parse_function_library(data)
         self._fn_models: Dict[str, object] = {}
+        # unfrozen graphs: VariableV2 initial values from Assign(var, Const)
+        # initializers (≙ Session.scala's variable extraction)
+        self._var_init_refs: Dict[str, str] = {}
+        for nd in self.nodes.values():
+            # ref variables (VariableV2+Assign) and resource variables
+            # (VarHandleOp + AssignVariableOp + ReadVariableOp)
+            if nd.op in ("Assign", "AssignVariableOp") and len(nd.inputs) >= 2:
+                self._var_init_refs.setdefault(_clean(nd.inputs[0]),
+                                               nd.inputs[1])
+        self.variables: Dict[str, object] = {}  # name -> Variable module
 
     def _function_model(self, fname: str):
         """Build (once) an nn.Graph executing the named FunctionDef — used
@@ -407,6 +418,30 @@ class TensorflowLoader:
             if key in graph_nodes:
                 return graph_nodes[key]
             n = self.nodes[base]
+            var_base = None
+            if n.op == "VariableV2" and base in self._var_init_refs:
+                var_base = base
+            elif n.op == "ReadVariableOp":
+                handle = _clean(n.inputs[0])
+                if handle in self._var_init_refs:
+                    var_base = handle
+            if var_base is not None:
+                from bigdl_tpu.nn.tf_ops import Variable
+
+                if var_base in self.variables:
+                    var = self.variables[var_base]
+                else:
+                    init = const_of(self._var_init_refs[var_base])
+                    if init is None:
+                        raise ValueError(
+                            f"variable {var_base!r}: initializer is not a "
+                            "constant; freeze the graph or init from consts")
+                    var = Variable(jnp.asarray(init))
+                    var.set_name(var_base)
+                    self.variables[var_base] = var
+                node = nn.Node(var).inputs(input_nodes[0])
+                graph_nodes[base] = node
+                return node
             if n.op == "Const" and input_nodes:
                 # a Const used structurally (e.g. an If branch returning a
                 # constant): emit a literal node anchored on the first input
@@ -649,6 +684,8 @@ class TensorflowLoader:
             w = const_of(data_inputs[1])
             m = _MatMul(w, n.attr_b("transpose_a"), n.attr_b("transpose_b"))
             m.set_name(n.name)
+            if w is None:  # dynamic rhs (e.g. an imported Variable)
+                return m.inputs(prev(0), prev(1))
             return m.inputs(prev(0))
         if op == "BiasAdd" or (op in ("Add", "AddV2")
                                and const_of(data_inputs[1]) is not None):
@@ -681,6 +718,18 @@ class TensorflowLoader:
             return nn.SoftMax().set_name(n.name).inputs(prev(0))
         if op == "Reshape":
             shape = const_of(data_inputs[1])
+            if shape is None:
+                # computed target shape (e.g. TF2's SMCE flatten/unflatten):
+                # resolved from the runtime shape tensor — eager-safe, and
+                # trace-safe whenever the producing ops fold to constants
+                def dyn_reshape(x, s):
+                    t = [int(v) for v in np.asarray(s).reshape(-1)]
+                    known = int(np.prod([d for d in t if d != -1])) or 1
+                    return x.reshape(tuple(
+                        int(x.size // known) if d == -1 else d for d in t))
+
+                return (_Fn(dyn_reshape).set_name(n.name)
+                        .inputs(prev(0), prev(1)))
             tgt = tuple(int(s) for s in np.asarray(shape).reshape(-1))
 
             def reshape(x, t=tgt):
@@ -923,6 +972,116 @@ class TensorflowLoader:
 
             return _Fn(lambda x, kk=k: _T(*jax.lax.top_k(x, kk))
                        ).set_name(n.name).inputs(prev(0))
+
+        # ----- misc math/shape/introspection loaders (utils/tf/loaders/)
+        _UNARY2 = {
+            "Expm1": jnp.expm1, "IsFinite": jnp.isfinite, "IsNan": jnp.isnan,
+            "IsInf": jnp.isinf, "Lgamma": jax.scipy.special.gammaln,
+            "Digamma": jax.scipy.special.digamma,
+        }
+        if op in _UNARY2:
+            return unary(_UNARY2[op])
+        if op in ("Mod", "TruncateMod"):
+            return binop(lambda a, b: jnp.fmod(a, b))
+        if op == "ApproximateEqual":
+            tol = n.attr_f("tolerance", 1e-5)
+            return binop(lambda a, b, t=tol: jnp.abs(a - b) < t)
+        if op == "Shape":
+            return unary(lambda x: jnp.asarray(jnp.shape(x), jnp.int32))
+        if op == "Rank":
+            return unary(lambda x: jnp.asarray(jnp.ndim(x), jnp.int32))
+        if op == "Fill":
+            dims = const_of(data_inputs[0])
+            value = const_of(data_inputs[1])
+            if dims is not None and value is not None:
+                shape = tuple(int(d) for d in np.asarray(dims).reshape(-1))
+                return _Fn(lambda x, s=shape, v=np.asarray(value).reshape(()):
+                           jnp.full(s, v)).set_name(n.name).inputs(prev(0))
+            if dims is not None:
+                shape = tuple(int(d) for d in np.asarray(dims).reshape(-1))
+                return unary(lambda v, s=shape: jnp.full(s, v.reshape(())))
+            raise ValueError(f"Fill {n.name!r}: dynamic dims unsupported")
+        if op == "Range":
+            vals = [const_of(i) for i in data_inputs]
+            if any(v is None for v in vals):
+                raise ValueError(f"Range {n.name!r}: dynamic bounds unsupported")
+            s, e, d = (np.asarray(v).reshape(()) for v in vals)
+            return _Fn(lambda x, arr=jnp.arange(s, e, d): arr
+                       ).set_name(n.name).inputs(prev(0))
+        if op == "Slice":
+            begin = const_of(data_inputs[1])
+            size = const_of(data_inputs[2])
+            if begin is None or size is None:
+                # computed begin/size: resolved from runtime values
+                # (eager-safe, like the dynamic Reshape path)
+                def dyn_slice(x, bg, sz):
+                    bg = [int(v) for v in np.asarray(bg).reshape(-1)]
+                    sz = [int(v) for v in np.asarray(sz).reshape(-1)]
+                    idx = tuple(slice(b, None if s == -1 else b + s)
+                                for b, s in zip(bg, sz))
+                    return x[idx]
+
+                return (_Fn(dyn_slice).set_name(n.name)
+                        .inputs(prev(0), prev(1), prev(2)))
+            b = [int(v) for v in np.asarray(begin).reshape(-1)]
+            sz = [int(v) for v in np.asarray(size).reshape(-1)]
+
+            def slc(x, b=tuple(b), sz=tuple(sz)):
+                idx = tuple(slice(bb, None if ss == -1 else bb + ss)
+                            for bb, ss in zip(b, sz))
+                return x[idx]
+
+            return unary(slc)
+        if op == "L2Loss":
+            return unary(lambda x: jnp.sum(jnp.square(x)) / 2)
+        if op == "SoftmaxCrossEntropyWithLogits":
+            from bigdl_tpu.utils.table import Table as _T
+
+            def smce(logits, labels):
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                loss = -jnp.sum(labels * logp, axis=-1)
+                grad = jax.nn.softmax(logits, axis=-1) - labels
+                return _T(loss, grad)
+
+            return _Fn(smce).set_name(n.name).inputs(prev(0), prev(1))
+        if op == "Substr":
+            pos = const_of(data_inputs[1])
+            ln = const_of(data_inputs[2])
+            p0 = int(np.asarray(pos).reshape(()))
+            l0 = int(np.asarray(ln).reshape(()))
+
+            def substr(x, p=p0, ln=l0):
+                arr = np.asarray(x, object).reshape(-1)
+                out = np.asarray([v[p:p + ln] for v in arr], object)
+                return out.reshape(np.shape(x))
+
+            return unary(substr)
+        if op == "Conv3D":
+            w = const_of(data_inputs[1])  # DHWIO
+            strides = n.attr_ints("strides")  # NDHWC
+            pad = n.attr_s("padding")
+
+            def conv3d(x, w=jnp.asarray(w), s=tuple(strides[1:4]), p=pad):
+                return lax.conv_general_dilated(
+                    x, w, window_strides=s, padding=p,
+                    dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+
+            return unary(conv3d)
+        if op == "DecodeRaw":
+            out_t = n.attr_type("out_type") or np.float32
+
+            def decode_raw(x, dt=np.dtype(out_t)):
+                arr = np.asarray(x, object).reshape(-1)
+                rows = [np.frombuffer(v, dtype=dt) for v in arr]
+                return jnp.asarray(np.stack(rows)) if len(rows) > 1 \
+                    else jnp.asarray(rows[0])
+
+            return unary(decode_raw)
+        if op == "VariableV2":
+            raise ValueError(
+                f"VariableV2 {n.name!r}: graph is not frozen — freeze "
+                "variables to constants first (convert_variables_to_"
+                "constants), matching the reference's frozen-graph contract")
 
         # ----- functional control flow (≙ nn/tf/ControlOps.scala; lowered to
         # lax.while_loop / lax.cond instead of Switch/Merge scheduling)

@@ -1,0 +1,50 @@
+"""Train from an UNFROZEN TF graphdef: variables become trainable params.
+
+Reference: utils/tf/Session.scala:54-330 (BigDLSessionImpl.train): loads a
+TF training graph, turns VariableV2 nodes + their Assign initializers into
+BigDL weights, and drives the standard Optimizer against a chosen loss
+endpoint.
+
+Here TensorflowLoader resolves each VariableV2's initial value from its
+``Assign(var, Const)`` initializer (the tf.compat.v1 initializer pattern);
+the variable becomes an ``nn.tf_ops.Variable`` module whose value is a
+trainable parameter of the imported Graph, so the whole model trains under
+the ordinary Optimizer/TrainStep machinery — no session/feed emulation.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.optim.optim_method import SGD, OptimMethod
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.utils.tf_import import TensorflowLoader
+
+
+class Session:
+    """≙ BigDLSessionImpl (utils/tf/Session.scala:54). ``inputs`` are
+    placeholder names; ``outputs`` the prediction endpoint(s)."""
+
+    def __init__(self, graph_pb_path: str, inputs: List[str],
+                 outputs: List[str]):
+        self._loader = TensorflowLoader(graph_pb_path)
+        self.model: Module = self._loader.load(list(inputs), list(outputs))
+
+    def train(self, dataset, criterion, optim_method: Optional[OptimMethod] = None,
+              end_when: Optional[Trigger] = None, batch_size: int = 32) -> Module:
+        """≙ Session.train(endpoints, rdd, optMethod, criterion, endTrigger):
+        imported variables update in place on the returned model."""
+        from bigdl_tpu.optim.optimizer import Optimizer
+
+        opt = Optimizer(model=self.model, dataset=dataset,
+                        criterion=criterion, batch_size=batch_size,
+                        end_when=end_when or Trigger.max_epoch(1))
+        opt.set_optim_method(optim_method or SGD())
+        return opt.optimize()
+
+    def predict(self, x):
+        self.model.evaluate()
+        import jax.numpy as jnp
+
+        return self.model(jnp.asarray(x))

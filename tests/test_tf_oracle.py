@@ -376,3 +376,59 @@ def test_while_body_with_topk(tmp_path):
     m = load_tf(pb, ["x"], ["Identity"])
     m.evaluate()
     np.testing.assert_allclose(ref, np.asarray(m(x)), rtol=1e-5)
+
+
+def test_misc_math_shape_ops_match_tf(tmp_path):
+    """Round-2→3 handler breadth: Shape/Rank/Fill/Range/Slice/Expm1/Mod/
+    IsFinite/L2Loss against real TF."""
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    @tf.function(input_signature=[tf.TensorSpec([2, 6], tf.float32)])
+    def f(x):
+        a = tf.math.expm1(x) + tf.cast(tf.fill([2, 6], 0.5), tf.float32)
+        b = a + tf.cast(tf.shape(x)[1], tf.float32) \
+            + tf.cast(tf.rank(x), tf.float32)
+        c = tf.slice(b, [0, 1], [2, 4])
+        d = tf.math.floormod(c, 3.0) + tf.cast(
+            tf.math.is_finite(c), tf.float32)
+        rng = tf.cast(tf.range(1.0, 5.0, 1.0), tf.float32)
+        return d * rng + tf.nn.l2_loss(x)
+
+    cf = convert_variables_to_constants_v2(f.get_concrete_function(),
+                                           lower_control_flow=False)
+    pb = str(tmp_path / "m.pb")
+    with open(pb, "wb") as fh:
+        fh.write(cf.graph.as_graph_def().SerializeToString())
+    x = np.random.RandomState(0).randn(2, 6).astype(np.float32)
+    r = cf(tf.constant(x))
+    ref = (r[0] if isinstance(r, list) else r).numpy()
+    m = load_tf(pb, ["x"], ["Identity"])
+    m.evaluate()
+    np.testing.assert_allclose(ref, np.asarray(m(x)), rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_cross_entropy_with_logits_matches_tf(tmp_path):
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    labels = np.asarray([[0, 1, 0.0], [1, 0, 0]], np.float32)
+
+    @tf.function(input_signature=[tf.TensorSpec([2, 3], tf.float32)])
+    def f(x):
+        return tf.nn.softmax_cross_entropy_with_logits(
+            labels=tf.constant(labels), logits=x)
+
+    cf = convert_variables_to_constants_v2(f.get_concrete_function(),
+                                           lower_control_flow=False)
+    pb = str(tmp_path / "sm.pb")
+    with open(pb, "wb") as fh:
+        fh.write(cf.graph.as_graph_def().SerializeToString())
+    x = np.random.RandomState(1).randn(2, 3).astype(np.float32)
+    r = cf(tf.constant(x))
+    ref = (r[0] if isinstance(r, list) else r).numpy()
+    m = load_tf(pb, ["x"], ["Identity"])
+    m.evaluate()
+    np.testing.assert_allclose(ref, np.asarray(m(x)), rtol=1e-5, atol=1e-6)

@@ -1,0 +1,51 @@
+"""Session.train: train an UNFROZEN TF1 graphdef (VariableV2 + Assign
+initializers) with the standard Optimizer (≙ utils/tf/Session.scala:54)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from bigdl_tpu import nn  # noqa: E402
+from bigdl_tpu.dataset.sample import Sample  # noqa: E402
+from bigdl_tpu.optim.optim_method import SGD  # noqa: E402
+from bigdl_tpu.optim.trigger import Trigger  # noqa: E402
+from bigdl_tpu.utils.tf_session import Session  # noqa: E402
+
+
+def _build_tf1_linear_graph(path, w0, b0):
+    g = tf.Graph()
+    with g.as_default():
+        x = tf.compat.v1.placeholder(tf.float32, [None, 3], name="x")
+        w = tf.compat.v1.get_variable(
+            "w", initializer=tf.constant(w0))
+        b = tf.compat.v1.get_variable(
+            "b", initializer=tf.constant(b0))
+        tf.identity(tf.matmul(x, w) + b, name="pred")
+    with open(path, "wb") as f:
+        f.write(g.as_graph_def().SerializeToString())
+
+
+def test_session_trains_imported_variables(tmp_path):
+    rng = np.random.RandomState(0)
+    w_true = np.asarray([[1.0], [-2.0], [0.5]], np.float32)
+    w0 = np.zeros((3, 1), np.float32)
+    b0 = np.zeros((1,), np.float32)
+    pb = str(tmp_path / "train.pb")
+    _build_tf1_linear_graph(pb, w0, b0)
+
+    sess = Session(pb, ["x"], ["pred"])
+    # imported variables are trainable parameters with their init values
+    assert set(sess._loader.variables) == {"w", "b"}
+    x = rng.randn(64, 3).astype(np.float32)
+    y = x @ w_true + 0.25
+    samples = [Sample(x[i], y[i]) for i in range(64)]
+    sess.train(samples, nn.MSECriterion(),
+               optim_method=SGD(learning_rate=0.2),
+               end_when=Trigger.max_epoch(40), batch_size=16)
+    pred = np.asarray(sess.predict(x))
+    mse = float(np.mean((pred - y) ** 2))
+    assert mse < 0.01, mse
+    # the learned weight variable approximates the target
+    w_learned = np.asarray(sess._loader.variables["w"].value)
+    np.testing.assert_allclose(w_learned, w_true, atol=0.15)
