@@ -44,20 +44,45 @@ def quantize_activation(x) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return x_q, scale
 
 
+def quantize_weight_minmax(w, axis: Tuple[int, ...]):
+    """Asymmetric per-channel min/max int8 (≙ BigQuant's per-channel
+    min/max arrays, nn/quantized/Desc.scala:161-181): returns
+    (w_q int8, scale f32, zero_point int32), each scale/zp shaped like w
+    reduced over ``axis`` (kept dims). Dequant: w ≈ (w_q - zp) * scale."""
+    wmin = jnp.min(w, axis=axis, keepdims=True)
+    wmax = jnp.max(w, axis=axis, keepdims=True)
+    rng = jnp.maximum(wmax - wmin, 1e-8)
+    scale = (rng / 255.0).astype(jnp.float32)
+    zp = jnp.round(-wmin / scale) - 128.0
+    w_q = jnp.clip(jnp.round(w / scale) + zp, -128, 127).astype(jnp.int8)
+    return w_q, scale, zp.astype(jnp.int32)
+
+
 class Linear(Module):
     """Int8 linear (≙ nn/quantized/Linear.scala). Build from a float
-    nn.Linear via ``from_float``."""
+    nn.Linear via ``from_float``. ``scheme`` picks symmetric per-channel
+    ("symmetric") or the reference's asymmetric per-channel min/max
+    ("minmax", ≙ BigQuant FCKernelLoadFromModel's min/max arrays) —
+    the zero-point correction rides a row-sum of the quantized
+    activations, still one int32 MXU matmul."""
 
-    def __init__(self, weight_q, w_scale, bias=None):
+    def __init__(self, weight_q, w_scale, bias=None, w_zp=None):
         super().__init__()
         self.register_buffer("weight_q", jnp.asarray(weight_q, jnp.int8))
         self.register_buffer("w_scale", jnp.asarray(w_scale, jnp.float32))
+        self.has_zp = w_zp is not None
+        if self.has_zp:
+            self.register_buffer("w_zp", jnp.asarray(w_zp, jnp.int32))
         self.has_bias = bias is not None
         if self.has_bias:
             self.register_buffer("bias", jnp.asarray(bias))
 
     @classmethod
-    def from_float(cls, m: bt_linear.Linear) -> "Linear":
+    def from_float(cls, m: bt_linear.Linear, scheme: str = "minmax") -> "Linear":
+        if scheme == "minmax":
+            w_q, scale, zp = quantize_weight_minmax(m.weight, axis=(1,))
+            return cls(w_q, scale, m.bias if m.with_bias else None,
+                       w_zp=zp).set_name(m.get_name())
         w_q, scale = quantize_weight(m.weight, axis=(1,))  # per out-channel
         return cls(w_q, scale, m.bias if m.with_bias else None).set_name(m.get_name())
 
@@ -68,6 +93,10 @@ class Linear(Module):
         acc = lax.dot_general(x_q, self.weight_q,
                               (((1,), (1,)), ((), ())),
                               preferred_element_type=jnp.int32)
+        if self.has_zp:
+            # (w_q - zp) unrolls to acc - zp * rowsum(x_q)
+            row = jnp.sum(x_q.astype(jnp.int32), axis=1, keepdims=True)
+            acc = acc - row * self.w_zp[:, 0][None, :]
         out = acc.astype(jnp.float32) * (x_scale * self.w_scale[:, 0])[None, :]
         if self.has_bias:
             out = out + self.bias
