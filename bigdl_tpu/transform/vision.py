@@ -310,3 +310,181 @@ class ImageFeatureToBatch(Transformer):
                 buf = []
         if buf and self.partial_batch:
             yield self._emit(buf)
+
+
+class Contrast(FeatureTransformer):
+    """≙ augmentation/Contrast.scala: scale around the mean by a factor
+    drawn in [lo, hi]."""
+
+    def __init__(self, delta_low: float, delta_high: float, seed: int = 1):
+        self.lo, self.hi = delta_low, delta_high
+        self._rng = np.random.RandomState(seed)
+
+    def transform(self, f: ImageFeature) -> ImageFeature:
+        factor = self._rng.uniform(self.lo, self.hi)
+        img = f.image()
+        f.set_image((img - img.mean()) * factor + img.mean())
+        return f
+
+
+class Saturation(FeatureTransformer):
+    """≙ augmentation/Saturation.scala: blend with the grayscale image."""
+
+    def __init__(self, delta_low: float, delta_high: float, seed: int = 1):
+        self.lo, self.hi = delta_low, delta_high
+        self._rng = np.random.RandomState(seed)
+
+    def transform(self, f: ImageFeature) -> ImageFeature:
+        factor = self._rng.uniform(self.lo, self.hi)
+        img = f.image()
+        gray = img.mean(axis=-1, keepdims=True)
+        f.set_image(gray + (img - gray) * factor)
+        return f
+
+
+class Hue(FeatureTransformer):
+    """≙ augmentation/Hue.scala: rotate hue by a delta (degrees) drawn in
+    [lo, hi] — linear RGB approximation of the HSV rotation."""
+
+    def __init__(self, delta_low: float = -18.0, delta_high: float = 18.0,
+                 seed: int = 1):
+        self.lo, self.hi = delta_low, delta_high
+        self._rng = np.random.RandomState(seed)
+
+    def transform(self, f: ImageFeature) -> ImageFeature:
+        theta = np.deg2rad(self._rng.uniform(self.lo, self.hi))
+        c, s = np.cos(theta), np.sin(theta)
+        # YIQ-space hue rotation matrix
+        t = np.asarray([[0.299, 0.587, 0.114],
+                        [0.596, -0.274, -0.322],
+                        [0.211, -0.523, 0.312]], np.float32)
+        rot = np.asarray([[1, 0, 0], [0, c, -s], [0, s, c]], np.float32)
+        m = np.linalg.inv(t) @ rot @ t
+        f.set_image(f.image() @ m.T)
+        return f
+
+
+class ChannelOrder(FeatureTransformer):
+    """≙ augmentation/ChannelOrder.scala: randomly permute channels (the
+    reference's RGB<->BGR jitter)."""
+
+    def __init__(self, seed: int = 1):
+        self._rng = np.random.RandomState(seed)
+
+    def transform(self, f: ImageFeature) -> ImageFeature:
+        perm = self._rng.permutation(f.image().shape[-1])
+        f.set_image(f.image()[..., perm])
+        return f
+
+
+class Crop(FeatureTransformer):
+    """≙ augmentation/Crop.scala: fixed normalized ROI crop; updates boxes
+    when present (shift + clip)."""
+
+    def __init__(self, bbox, normalized: bool = True):
+        self.bbox = tuple(bbox)  # (x1, y1, x2, y2)
+        self.normalized = normalized
+
+    def transform(self, f: ImageFeature) -> ImageFeature:
+        h, w = f.image().shape[:2]
+        x1, y1, x2, y2 = self.bbox
+        if self.normalized:
+            x1, x2 = int(x1 * w), int(x2 * w)
+            y1, y2 = int(y1 * h), int(y2 * h)
+        f.set_image(f.image()[int(y1):int(y2), int(x1):int(x2)])
+        if ImageFeature.boxes in f:
+            b = np.asarray(f[ImageFeature.boxes], np.float32)
+            b = b - [x1, y1, x1, y1]
+            b[:, 0::2] = np.clip(b[:, 0::2], 0, x2 - x1)
+            b[:, 1::2] = np.clip(b[:, 1::2], 0, y2 - y1)
+            f[ImageFeature.boxes] = b
+        return f
+
+
+class RandomCrop(FeatureTransformer):
+    """≙ augmentation/RandomCropper.scala: random fixed-size crop."""
+
+    def __init__(self, crop_h: int, crop_w: int, seed: int = 1):
+        self.crop_h, self.crop_w = crop_h, crop_w
+        self._rng = np.random.RandomState(seed)
+
+    def transform(self, f: ImageFeature) -> ImageFeature:
+        h, w = f.image().shape[:2]
+        top = self._rng.randint(0, max(1, h - self.crop_h + 1))
+        left = self._rng.randint(0, max(1, w - self.crop_w + 1))
+        return Crop((left, top, left + self.crop_w, top + self.crop_h),
+                    normalized=False).transform(f)
+
+
+class RandomResize(FeatureTransformer):
+    """≙ augmentation/RandomResize.scala: resize to a side drawn from the
+    given list (scale jitter)."""
+
+    def __init__(self, sizes: Sequence[int], seed: int = 1):
+        self.sizes = list(sizes)
+        self._rng = np.random.RandomState(seed)
+
+    def transform(self, f: ImageFeature) -> ImageFeature:
+        s = int(self.sizes[self._rng.randint(len(self.sizes))])
+        return Resize(s, s).transform(f)
+
+
+class Filler(FeatureTransformer):
+    """≙ augmentation/Filler.scala: fill a normalized subregion with a
+    constant (occlusion augmentation)."""
+
+    def __init__(self, x1: float, y1: float, x2: float, y2: float,
+                 value: float = 255.0):
+        self.region = (x1, y1, x2, y2)
+        self.value = value
+
+    def transform(self, f: ImageFeature) -> ImageFeature:
+        img = f.image().copy()
+        h, w = img.shape[:2]
+        x1, y1, x2, y2 = self.region
+        img[int(y1 * h):int(y2 * h), int(x1 * w):int(x2 * w)] = self.value
+        f.set_image(img)
+        return f
+
+
+class PixelNormalizer(FeatureTransformer):
+    """≙ augmentation/PixelNormalizer.scala: subtract a per-pixel mean
+    image."""
+
+    def __init__(self, means: np.ndarray):
+        self.means = np.asarray(means, np.float32)
+
+    def transform(self, f: ImageFeature) -> ImageFeature:
+        f.set_image(f.image() - self.means)
+        return f
+
+
+class ChannelScaledNormalizer(FeatureTransformer):
+    """≙ augmentation/ChannelScaledNormalizer.scala: per-channel mean
+    subtract + global scale."""
+
+    def __init__(self, mean_r: float, mean_g: float, mean_b: float,
+                 scale: float):
+        self.means = np.asarray([mean_r, mean_g, mean_b], np.float32)
+        self.scale = scale
+
+    def transform(self, f: ImageFeature) -> ImageFeature:
+        f.set_image((f.image() - self.means) * self.scale)
+        return f
+
+
+class ColorJitter(FeatureTransformer):
+    """≙ augmentation/ColorJitter.scala: random brightness/contrast/
+    saturation in random order."""
+
+    def __init__(self, brightness: float = 32.0, contrast: float = 0.5,
+                 saturation: float = 0.5, seed: int = 1):
+        self._rng = np.random.RandomState(seed)
+        self.ops = [Brightness(-brightness, brightness, seed),
+                    Contrast(1 - contrast, 1 + contrast, seed + 1),
+                    Saturation(1 - saturation, 1 + saturation, seed + 2)]
+
+    def transform(self, f: ImageFeature) -> ImageFeature:
+        for i in self._rng.permutation(len(self.ops)):
+            f = self.ops[i].transform(f)
+        return f

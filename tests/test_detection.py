@@ -210,3 +210,59 @@ def test_proposal_drops_small_boxes():
     rois = np.asarray(prop(Table(scores, deltas,
                                  jnp.asarray([64.0, 64.0, 1.0]))))
     assert rois.shape[0] == 0
+
+
+def test_vision_augmentation_suite():
+    from bigdl_tpu.transform import vision as V
+
+    rng = np.random.RandomState(0)
+    img = rng.rand(16, 16, 3).astype(np.float32) * 255
+
+    f = V.ImageFeature(img.copy())
+    V.Contrast(1.2, 1.2).transform(f)
+    np.testing.assert_allclose(f.image().mean(), img.mean(), rtol=1e-3)
+
+    f = V.ImageFeature(img.copy())
+    V.Saturation(0.0, 0.0).transform(f)  # factor 0 => grayscale
+    assert np.allclose(f.image()[..., 0], f.image()[..., 1], atol=1e-4)
+
+    f = V.ImageFeature(img.copy())
+    V.Hue(0.0, 0.0).transform(f)  # zero rotation => identity
+    np.testing.assert_allclose(f.image(), img, atol=1e-3)
+
+    f = V.ImageFeature(img.copy())
+    V.ChannelOrder(seed=3).transform(f)
+    np.testing.assert_allclose(
+        sorted(f.image().sum(axis=(0, 1)).tolist()),
+        sorted(img.sum(axis=(0, 1)).tolist()), rtol=1e-5)
+
+    f = V.ImageFeature(img.copy())
+    f[V.ImageFeature.boxes] = np.asarray([[4.0, 4.0, 12.0, 12.0]])
+    V.Crop((0.25, 0.25, 0.75, 0.75)).transform(f)
+    assert f.image().shape == (8, 8, 3)
+    np.testing.assert_allclose(f[V.ImageFeature.boxes], [[0, 0, 8, 8]])
+
+    f = V.ImageFeature(img.copy())
+    V.RandomCrop(8, 8, seed=1).transform(f)
+    assert f.image().shape == (8, 8, 3)
+
+    f = V.ImageFeature(img.copy())
+    V.RandomResize([8, 32], seed=2).transform(f)
+    assert f.image().shape[0] in (8, 32)
+
+    f = V.ImageFeature(img.copy())
+    V.Filler(0.0, 0.0, 0.5, 0.5, value=7.0).transform(f)
+    assert np.all(f.image()[:8, :8] == 7.0)
+
+    f = V.ImageFeature(img.copy())
+    V.PixelNormalizer(img).transform(f)
+    np.testing.assert_allclose(f.image(), 0.0, atol=1e-5)
+
+    f = V.ImageFeature(img.copy())
+    V.ChannelScaledNormalizer(10, 20, 30, 0.5).transform(f)
+    np.testing.assert_allclose(
+        f.image(), (img - [10, 20, 30]) * 0.5, rtol=1e-5)
+
+    f = V.ImageFeature(img.copy())
+    V.ColorJitter(seed=5).transform(f)
+    assert f.image().shape == img.shape
