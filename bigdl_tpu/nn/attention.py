@@ -73,7 +73,8 @@ class MultiHeadAttention(Module):
 
     def __init__(self, embed_dim: int, num_heads: int, dropout: float = 0.0,
                  with_bias: bool = True, causal: bool = False,
-                 sequence_parallel: Optional[str] = None):
+                 sequence_parallel: Optional[str] = None,
+                 use_flash: bool = False):
         super().__init__()
         assert embed_dim % num_heads == 0
         self.embed_dim = embed_dim
@@ -82,6 +83,9 @@ class MultiHeadAttention(Module):
         self.causal = causal
         self.dropout_p = dropout
         self.sequence_parallel = sequence_parallel
+        # opt-in pallas flash kernel (bigdl_tpu/ops/flash_attention.py):
+        # O(T*D) memory instead of the dense (T,T) score matrix
+        self.use_flash = use_flash
         self.qkv = Linear(embed_dim, 3 * embed_dim, with_bias=with_bias)
         self.out_proj = Linear(embed_dim, embed_dim, with_bias=with_bias)
         if dropout > 0:
@@ -101,6 +105,10 @@ class MultiHeadAttention(Module):
 
             o = ring_attention(q, k, v, axis_name=self.sequence_parallel,
                                causal=self.causal)
+        elif self.use_flash:
+            from bigdl_tpu.ops.flash_attention import flash_attention
+
+            o = flash_attention(q, k, v, causal=self.causal)
         else:
             o = dot_product_attention(q, k, v, causal=self.causal)
         o = o.transpose(0, 2, 1, 3).reshape(b, t, self.embed_dim)
@@ -116,12 +124,14 @@ class TransformerBlock(Module):
 
     def __init__(self, embed_dim: int, num_heads: int, mlp_ratio: int = 4,
                  dropout: float = 0.0, causal: bool = True,
-                 sequence_parallel: Optional[str] = None):
+                 sequence_parallel: Optional[str] = None,
+                 use_flash: bool = False):
         super().__init__()
         self.ln1 = LayerNorm(embed_dim)
         self.attn = MultiHeadAttention(embed_dim, num_heads, dropout=dropout,
                                        causal=causal,
-                                       sequence_parallel=sequence_parallel)
+                                       sequence_parallel=sequence_parallel,
+                                       use_flash=use_flash)
         self.ln2 = LayerNorm(embed_dim)
         self.fc1 = Linear(embed_dim, mlp_ratio * embed_dim)
         self.fc2 = Linear(mlp_ratio * embed_dim, embed_dim)

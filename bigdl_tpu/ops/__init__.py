@@ -1,0 +1,3 @@
+"""TPU kernels (pallas) for hot ops."""
+
+from bigdl_tpu.ops.flash_attention import flash_attention  # noqa: F401

