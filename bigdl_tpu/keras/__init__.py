@@ -24,4 +24,8 @@ from bigdl_tpu.keras.layers import (
     Merge, ZeroPadding1D, ZeroPadding2D, Cropping1D, Cropping2D,
     UpSampling1D, UpSampling2D, LeakyReLU, ELU, PReLU, SReLU,
     ThresholdedReLU,
+    Convolution3D, MaxPooling3D, AveragePooling3D, GlobalMaxPooling3D,
+    GlobalAveragePooling3D, Cropping3D, ZeroPadding3D, UpSampling3D,
+    SpatialDropout3D, AtrousConvolution1D, LocallyConnected1D, ConvLSTM2D,
+    SoftMax, Input,
 )

@@ -668,3 +668,226 @@ class ThresholdedReLU(KerasLayer):
 
     def build_module(self, input_shape):
         return nn.Threshold(self.theta, 0.0)
+
+
+# ------------------------------------------------------------------- 3-D set
+def _triple(v):
+    return (v, v, v) if isinstance(v, int) else tuple(v)
+
+
+class Convolution3D(KerasLayer):
+    """≙ nn/keras/Convolution3D.scala — th ordering (C, D1, D2, D3)."""
+
+    def __init__(self, nb_filter: int, kernel_dim1: int, kernel_dim2: int,
+                 kernel_dim3: int, activation=None, border_mode: str = "valid",
+                 subsample=(1, 1, 1), bias: bool = True, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.nb_filter = nb_filter
+        self.kernel = (kernel_dim1, kernel_dim2, kernel_dim3)
+        self.activation = activation
+        self.border_mode = border_mode
+        self.subsample = _triple(subsample)
+        self.bias = bias
+
+    def build_module(self, input_shape):
+        c = input_shape[0]
+        kt, kh, kw = self.kernel
+        if self.border_mode == "same":
+            pt, ph, pw = (kt - 1) // 2, (kh - 1) // 2, (kw - 1) // 2
+        else:
+            pt = ph = pw = 0
+        conv = nn.VolumetricConvolution(
+            c, self.nb_filter, kt, kw, kh,
+            self.subsample[0], self.subsample[2], self.subsample[1],
+            pt, pw, ph, with_bias=self.bias)
+        return _with_activation(conv, self.activation)
+
+
+class MaxPooling3D(KerasLayer):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.pool_size = _triple(pool_size)
+        self.strides = _triple(strides) if strides is not None else self.pool_size
+
+    def build_module(self, input_shape):
+        kt, kh, kw = self.pool_size
+        dt, dh, dw = self.strides
+        return nn.VolumetricMaxPooling(kt, kw, kh, dt, dw, dh)
+
+
+class AveragePooling3D(KerasLayer):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.pool_size = _triple(pool_size)
+        self.strides = _triple(strides) if strides is not None else self.pool_size
+
+    def build_module(self, input_shape):
+        kt, kh, kw = self.pool_size
+        dt, dh, dw = self.strides
+        return nn.VolumetricAveragePooling(kt, kw, kh, dt, dw, dh)
+
+
+class GlobalMaxPooling3D(KerasLayer):
+    def build_module(self, input_shape):
+        class _GMax3(Module):
+            def forward(self, x):  # (B, C, D, H, W)
+                return jnp.max(x, axis=(2, 3, 4))
+
+        return _GMax3()
+
+
+class GlobalAveragePooling3D(KerasLayer):
+    def build_module(self, input_shape):
+        class _GAvg3(Module):
+            def forward(self, x):
+                return jnp.mean(x, axis=(2, 3, 4))
+
+        return _GAvg3()
+
+
+class Cropping3D(KerasLayer):
+    def __init__(self, cropping=((1, 1), (1, 1), (1, 1)), input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.cropping = tuple(tuple(c) for c in cropping)
+
+    def build_module(self, input_shape):
+        return nn.Cropping3D(*self.cropping)
+
+
+class ZeroPadding3D(KerasLayer):
+    def __init__(self, padding=(1, 1, 1), input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.padding = _triple(padding)
+
+    def build_module(self, input_shape):
+        p1, p2, p3 = self.padding
+
+        class _Pad3D(Module):
+            def forward(self, x):  # (B, C, D, H, W)
+                return jnp.pad(x, ((0, 0), (0, 0), (p1, p1), (p2, p2), (p3, p3)))
+
+        return _Pad3D()
+
+
+class UpSampling3D(KerasLayer):
+    def __init__(self, size=(2, 2, 2), input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.size = _triple(size)
+
+    def build_module(self, input_shape):
+        return nn.UpSampling3D(self.size)
+
+
+class SpatialDropout3D(KerasLayer):
+    def __init__(self, p: float = 0.5, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.p = p
+
+    def build_module(self, input_shape):
+        return nn.SpatialDropout3D(self.p)
+
+
+class AtrousConvolution1D(KerasLayer):
+    """Dilated temporal conv over (B, T, F) (≙ nn/keras/AtrousConvolution1D
+    .scala). Lowered through SpatialDilatedConvolution with the time axis as
+    height — one MXU conv, no host reshapes in the hot path."""
+
+    def __init__(self, nb_filter: int, filter_length: int,
+                 atrous_rate: int = 1, activation=None,
+                 subsample_length: int = 1, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.nb_filter, self.filter_length = nb_filter, filter_length
+        self.atrous_rate = atrous_rate
+        self.activation = activation
+        self.subsample_length = subsample_length
+
+    def build_module(self, input_shape):
+        f = input_shape[-1]
+        conv = nn.SpatialDilatedConvolution(
+            f, self.nb_filter, 1, self.filter_length,
+            dw=1, dh=self.subsample_length,
+            dilation_w=1, dilation_h=self.atrous_rate)
+
+        class _Atrous1D(Module):
+            def __init__(self):
+                super().__init__()
+                self.conv = conv
+
+            def forward(self, x):  # (B, T, F) -> (B, F, T, 1) -> (B, T', nb)
+                y = self.conv(x.transpose(0, 2, 1)[:, :, :, None])
+                return y[:, :, :, 0].transpose(0, 2, 1)
+
+        return _with_activation(_Atrous1D(), self.activation)
+
+
+class LocallyConnected1D(KerasLayer):
+    def __init__(self, nb_filter: int, filter_length: int, activation=None,
+                 subsample_length: int = 1, input_shape=None):
+        super().__init__(input_shape=input_shape)
+        self.nb_filter, self.filter_length = nb_filter, filter_length
+        self.activation = activation
+        self.subsample_length = subsample_length
+
+    def build_module(self, input_shape):
+        t, f = input_shape
+        conv = nn.LocallyConnected1D(t, f, self.nb_filter,
+                                     self.filter_length, self.subsample_length)
+        return _with_activation(conv, self.activation)
+
+
+class ConvLSTM2D(_KerasRecurrent):
+    """≙ nn/keras/ConvLSTM2D.scala: ConvLSTMPeephole cell over (B, T, C, H, W)
+    sequences; square ``nb_kernel`` kernels, SAME padding."""
+
+    def __init__(self, nb_filter: int, nb_kernel: int, activation=None,
+                 inner_activation=None, return_sequences: bool = False,
+                 go_backwards: bool = False, border_mode: str = "same",
+                 subsample=(1, 1), input_shape=None):
+        super().__init__(nb_filter, activation=activation,
+                         inner_activation=inner_activation,
+                         return_sequences=return_sequences,
+                         go_backwards=go_backwards, input_shape=input_shape)
+        self.nb_kernel = nb_kernel
+        if border_mode != "same":
+            raise ValueError("ConvLSTM2D supports only border_mode='same' "
+                             "(the reference keras layer has the same limit)")
+        self.subsample = _pair(subsample)
+        if self.subsample != (1, 1):
+            raise ValueError(
+                "ConvLSTM2D supports only subsample=(1, 1): the underlying "
+                "ConvLSTMPeephole cell uses stride-1 SAME gate convolutions")
+        if activation not in (None, "tanh") or \
+                inner_activation not in (None, "sigmoid"):
+            raise ValueError(
+                "ConvLSTM2D gate activations are fixed to tanh/sigmoid "
+                "(ConvLSTMPeephole); pass activation='tanh', "
+                "inner_activation='sigmoid' or leave them unset")
+
+    def build_module(self, input_shape):
+        c = input_shape[1]  # (T, C, H, W)
+        seq = nn.Sequential()
+        if self.go_backwards:
+            seq.add(nn.Reverse(2))
+        cell = nn.ConvLSTMPeephole(c, self.output_dim, self.nb_kernel,
+                                   self.nb_kernel, stride=self.subsample[0])
+        seq.add(nn.Recurrent().add(cell))
+        if not self.return_sequences:
+            seq.add(nn.Select(2, -1))
+        return seq
+
+
+class SoftMax(KerasLayer):
+    """≙ nn/keras/SoftMax.scala — the keras-API softmax activation layer."""
+
+    def build_module(self, input_shape):
+        return nn.SoftMax()
+
+
+def Input(shape=None, name: str = ""):
+    """Functional-API input node (≙ nn/keras/Input.scala's Input object):
+    returns an nn Graph Node to wire keras ``Model(input, output)`` graphs."""
+    node = nn.Input()
+    if name:
+        node.module.set_name(name)
+    node.module.input_shape = tuple(shape) if shape else None
+    return node

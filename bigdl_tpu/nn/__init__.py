@@ -28,6 +28,7 @@ from bigdl_tpu.nn.activation import (
     SoftPlus, SoftSign, SoftShrink, HardShrink, SoftMax, SoftMin, LogSoftMax,
     LogSigmoid, Exp, Log, Log1p, Sqrt, Square, Power, Abs, Negative,
     AddConstant, MulConstant, GradientReversal, Identity, Echo, Maxout,
+    L1Penalty, NegativeEntropyPenalty,
 )
 from bigdl_tpu.nn.shape_ops import (
     Reshape, View, Squeeze, Unsqueeze, Transpose, Select, Narrow, Replicate,
@@ -83,13 +84,21 @@ from bigdl_tpu.nn.tf_ops import (
     TensorArray, ParseExample,
 )
 from bigdl_tpu.nn.sparse import (
-    LookupTableSparse, SparseJoinTable, SparseLinear, SparseMiniBatch,
-    SparseTensor,
+    DenseToSparse, LookupTableSparse, SparseJoinTable, SparseLinear,
+    SparseMiniBatch, SparseTensor,
 )
 from bigdl_tpu.nn.detection import (
-    Anchor, DetectionOutputSSD, Nms, PriorBox, Proposal, RoiPooling,
-    bbox_iou, decode_boxes, nms,
+    Anchor, DetectionOutputFrcnn, DetectionOutputSSD, Nms, PriorBox, Proposal,
+    RoiPooling, bbox_iou, decode_boxes, nms,
 )
 from bigdl_tpu.nn.tree_lstm import BinaryTreeLSTM, TreeLSTM
 from bigdl_tpu.nn.pooling import SpatialMaxPoolingWithIndices, SpatialUnpooling
-from bigdl_tpu.nn.conv import LocallyConnected1D, SpatialConvolutionMap
+from bigdl_tpu.nn.conv import (
+    LocallyConnected1D, SpatialConvolutionMap, VolumetricFullConvolution,
+)
+
+# Reference-name aliases: nn/RNN (simple recurrent cell, ≙ nn/RNN.scala) and
+# DynamicContainer (the add()-based container base, ≙ nn/DynamicContainer.scala
+# — our Container already carries add()).
+RNN = RnnCell
+DynamicContainer = Container

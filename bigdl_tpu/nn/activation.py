@@ -306,6 +306,76 @@ class GradientReversal(Module):
         return rev(input)
 
 
+class L1Penalty(Module):
+    """Inline L1 sparsity penalty (reference: nn/L1Penalty.scala): forward is
+    the identity (and records ``self.loss = m * ||input||_1``); backward adds
+    ``m * sign(input)`` to the incoming gradient, with
+    ``m = l1weight / nElement`` when ``size_average``. ``provide_output=False``
+    drops the incoming gradient and propagates only the penalty term."""
+
+    def __init__(self, l1weight: float, size_average: bool = False,
+                 provide_output: bool = True):
+        super().__init__()
+        self.l1weight = l1weight
+        self.size_average = size_average
+        self.provide_output = provide_output
+        self.loss = 0.0
+
+    def forward(self, input):
+        from bigdl_tpu.nn.module import in_pure_bind
+
+        m = self.l1weight / (input.size if self.size_average else 1)
+        if not in_pure_bind():  # don't leak tracers via the side channel
+            self.loss = m * jnp.sum(jnp.abs(input))
+        provide = self.provide_output
+
+        @jax.custom_vjp
+        def pen(x):
+            return x
+
+        def fwd(x):
+            return x, x
+
+        def bwd(x, g):
+            extra = m * jnp.sign(x)
+            return ((g + extra) if provide else extra,)
+
+        pen.defvjp(fwd, bwd)
+        return pen(input)
+
+
+class NegativeEntropyPenalty(Module):
+    """Inline penalty discouraging low-entropy distributions (reference:
+    nn/NegativeEntropyPenalty.scala, used in A3C-style policy training).
+    Identity forward recording ``self.loss = beta * sum(p * log p)``;
+    backward adds ``beta * (1 + log p)`` to the incoming gradient."""
+
+    def __init__(self, beta: float = 0.01):
+        super().__init__()
+        self.beta = beta
+        self.loss = 0.0
+
+    def forward(self, input):
+        from bigdl_tpu.nn.module import in_pure_bind
+
+        beta = self.beta
+        if not in_pure_bind():  # don't leak tracers via the side channel
+            self.loss = beta * jnp.sum(input * jnp.log(input))
+
+        @jax.custom_vjp
+        def pen(x):
+            return x
+
+        def fwd(x):
+            return x, x
+
+        def bwd(x, g):
+            return (g + beta * (jnp.log(x) + 1.0),)
+
+        pen.defvjp(fwd, bwd)
+        return pen(input)
+
+
 class Identity(Module):
     def forward(self, input):
         return input

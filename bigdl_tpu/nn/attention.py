@@ -54,13 +54,24 @@ def dot_product_attention(q, k, v, causal: bool = False, mask=None,
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
+    masked = causal or mask is not None
     if causal:
         tq, tk = scores.shape[-2], scores.shape[-1]
         cm = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
         scores = jnp.where(cm, scores, -jnp.inf)
     if mask is not None:
         scores = jnp.where(mask, scores, -jnp.inf)
-    w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    if masked:
+        # rows with every key masked (e.g. causal with tq > tk) would
+        # softmax to NaN (and poison gradients); run them through a benign
+        # uniform softmax and zero the weights after, matching the pallas
+        # kernel's finalize guard which emits 0 for such rows
+        dead = jnp.all(scores == -jnp.inf, axis=-1, keepdims=True)
+        scores = jnp.where(dead, 0.0, scores)
+        w = jax.nn.softmax(scores, axis=-1)
+        w = jnp.where(dead, 0.0, w).astype(v.dtype)
+    else:
+        w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", w, v)
 
 

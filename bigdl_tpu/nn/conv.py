@@ -322,6 +322,60 @@ class VolumetricConvolution(Module):
         return out[0] if squeeze else out
 
 
+class VolumetricFullConvolution(Module):
+    """3-D transposed convolution over NCDHW (reference:
+    nn/VolumetricFullConvolution.scala). Same lhs-dilation construction as
+    :class:`SpatialFullConvolution` extended to a depth axis."""
+
+    def __init__(self, n_input_plane, n_output_plane, kt, kw, kh,
+                 dt=1, dw=1, dh=1, pad_t=0, pad_w=0, pad_h=0,
+                 adj_t=0, adj_w=0, adj_h=0, n_group=1, with_bias=True,
+                 w_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.kernel = (kt, kh, kw)
+        self.strides = (dt, dh, dw)
+        self.pads = (pad_t, pad_h, pad_w)
+        self.adjs = (adj_t, adj_h, adj_w)
+        self.n_group = n_group
+        self.with_bias = with_bias
+        fan_in = n_output_plane * kt * kh * kw
+        wshape = (n_input_plane, n_output_plane // n_group, kt, kh, kw)
+        self.register_parameter(
+            "weight",
+            bt_init.Xavier()(wshape, fan_in=fan_in,
+                             fan_out=n_input_plane * kt * kh * kw),
+            regularizer=w_regularizer,
+        )
+        if with_bias:
+            self.register_parameter("bias", jnp.zeros((n_output_plane,)),
+                                    regularizer=b_regularizer)
+
+    def forward(self, input):
+        squeeze = input.ndim == 4
+        x = input[None] if squeeze else input
+        g = self.n_group
+        kt, kh, kw = self.kernel
+        pad = [(k - 1 - p, k - 1 - p + a)
+               for k, p, a in zip(self.kernel, self.pads, self.adjs)]
+        w = jnp.flip(self.weight, axis=(-3, -2, -1))
+        w = w.reshape(g, self.n_input_plane // g, self.n_output_plane // g,
+                      kt, kh, kw)
+        w = jnp.swapaxes(w, 1, 2).reshape(
+            self.n_output_plane, self.n_input_plane // g, kt, kh, kw)
+        out = lax.conv_general_dilated(
+            x, w,
+            window_strides=(1, 1, 1),
+            padding=pad,
+            lhs_dilation=self.strides,
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+            feature_group_count=g,
+        )
+        if self.with_bias:
+            out = out + self.bias[None, :, None, None, None]
+        return out[0] if squeeze else out
+
+
 class SpatialConvolutionMap(Module):
     """Convolution with an explicit input->output connection table
     (reference: nn/SpatialConvolutionMap.scala; Torch legacy used by early

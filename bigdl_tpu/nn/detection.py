@@ -446,3 +446,95 @@ class DetectionOutputSSD(Module):
             return jnp.zeros((1, 1, 0, 7), jnp.float32)
         out = np.asarray(results, np.float32)[None, None]
         return jnp.asarray(out)
+
+
+# ------------------------------------------------------- DetectionOutputFrcnn
+class DetectionOutputFrcnn(Module):
+    """Faster-RCNN inference head (≙ nn/DetectionOutputFrcnn.scala:48).
+    HOST op, like the SSD head above.
+
+    Input Table(imInfo (1, 4) [h, w, scale_h, scale_w], rois (R, 5),
+    boxDeltas (R, nClasses*4), scores (R, nClasses)); rois are unscaled back
+    to raw-image space, deltas applied per class, clipped, then per-class
+    score-threshold + NMS and a cross-class ``max_per_image`` cap. Output is
+    the reference's flat layout: (1, 1 + n*6) with ``out[0, 0] = n`` and
+    six-tuples [class, score, x1, y1, x2, y2].
+
+    ``bbox_vote=True`` refines each kept box by the score-weighted average of
+    all same-class candidates with IoU >= 0.5 (BboxUtil.bboxVote:356). The
+    reference's max-per-image re-filter compares the box's last coordinate
+    against the score threshold (DetectionOutputFrcnn.scala:195 — a bug);
+    here the filter is on scores, the py-faster-rcnn behavior it encodes."""
+
+    def __init__(self, nms_thresh: float = 0.3, n_classes: int = 21,
+                 bbox_vote: bool = False, max_per_image: int = 100,
+                 thresh: float = 0.05):
+        super().__init__()
+        self.nms_thresh = nms_thresh
+        self.n_classes = n_classes
+        self.bbox_vote = bbox_vote
+        self.max_per_image = max_per_image
+        self.thresh = thresh
+
+    def forward(self, input):
+        if self.training:
+            return input
+        im_info, rois_data, box_deltas, scores = list(input)[:4]
+        if isinstance(rois_data, Table):
+            rois_data = list(rois_data)[0]
+        info = np.asarray(im_info).reshape(-1)
+        rois = np.asarray(rois_data)[:, 1:5]
+        deltas = np.asarray(box_deltas)
+        scores = np.asarray(scores)
+        # unscale back to raw image space (BboxUtil.scaleBBox)
+        boxes = rois * np.array([1 / info[3], 1 / info[2],
+                                 1 / info[3], 1 / info[2]], np.float32)
+        pred = np.asarray(bbox_transform_inv(boxes, jnp.asarray(deltas)))
+        pred = np.asarray(clip_boxes(jnp.asarray(pred),
+                                     info[0] / info[2], info[1] / info[3]))
+
+        per_class = {}  # cls -> (scores (k,), boxes (k, 4))
+        for cls in range(1, self.n_classes):
+            cls_scores = scores[:, cls]
+            sel = np.where(cls_scores > self.thresh)[0]
+            if sel.size == 0:
+                continue
+            cls_boxes = pred[sel, cls * 4:(cls + 1) * 4]
+            keep, count = nms(jnp.asarray(cls_scores[sel]),
+                              jnp.asarray(cls_boxes),
+                              self.nms_thresh, topk=sel.size)
+            keep = np.asarray(keep)[:int(count)]
+            kept_scores = cls_scores[sel][keep]
+            kept_boxes = cls_boxes[keep]
+            if self.bbox_vote:
+                kept_boxes = self._vote(kept_boxes, cls_scores[sel],
+                                        cls_boxes)
+            per_class[cls] = (kept_scores, kept_boxes)
+
+        if self.max_per_image > 0:
+            all_scores = np.concatenate(
+                [s for s, _ in per_class.values()]
+                or [np.zeros((0,), np.float32)])
+            if all_scores.size > self.max_per_image:
+                cutoff = np.sort(all_scores)[-self.max_per_image]
+                per_class = {
+                    c: (s[s >= cutoff], b[s >= cutoff])
+                    for c, (s, b) in per_class.items()}
+
+        rows = []
+        for cls in sorted(per_class):
+            s, b = per_class[cls]
+            for j in range(s.shape[0]):
+                rows.append([float(cls), float(s[j])] + b[j].tolist())
+        flat = [float(len(rows))] + [v for r in rows for v in r]
+        return jnp.asarray(np.asarray(flat, np.float32)[None])
+
+    def _vote(self, kept_boxes, all_scores, all_boxes):
+        iou = np.asarray(bbox_iou(jnp.asarray(kept_boxes),
+                                  jnp.asarray(all_boxes)))
+        out = np.empty_like(kept_boxes)
+        for i in range(kept_boxes.shape[0]):
+            m = iou[i] >= 0.5
+            w = all_scores[m]
+            out[i] = (w[:, None] * all_boxes[m]).sum(0) / max(w.sum(), 1e-12)
+        return out

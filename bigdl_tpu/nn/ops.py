@@ -532,3 +532,211 @@ class MkString(Operation):
         arr = np.asarray(rows)
         return np.asarray([self.str_delimiter.join(str(v) for v in row)
                            for row in arr.reshape(arr.shape[0], -1)])
+
+
+class CategoricalColVocaList(Operation):
+    """Delimited category strings → (rows, cols) sparse-layout indices
+    (≙ nn/ops/CategoricalColVocaList.scala). Host-side string op; returns a
+    SparseTensor of per-row vocabulary ids. Out-of-vocabulary handling:
+    filtered when ``is_set_default=False`` and ``num_oov_buckets=0``, mapped
+    to the default id ``len(voca)`` when ``is_set_default``, or hashed into
+    ``[len(voca), len(voca)+num_oov_buckets)`` otherwise."""
+
+    def __init__(self, voca_list: Sequence[str], str_delimiter: str = ",",
+                 is_set_default: bool = False, num_oov_buckets: int = 0):
+        super().__init__()
+        if num_oov_buckets < 0:
+            raise ValueError("num_oov_buckets is a negative integer")
+        if is_set_default and num_oov_buckets:
+            raise ValueError("default value and num_oov_buckets are both specified")
+        if not voca_list:
+            raise ValueError("the vocabulary list is empty")
+        self.voca = {v: i for i, v in enumerate(voca_list)}
+        if len(self.voca) != len(voca_list):
+            raise ValueError("the vocabulary list has duplicates")
+        self.str_delimiter = str_delimiter
+        self.is_set_default = is_set_default
+        self.num_oov_buckets = num_oov_buckets
+
+    def forward(self, values):
+        from bigdl_tpu.nn.sparse import SparseTensor
+
+        voca_len = len(self.voca)
+        rows_in = [str(v) for v in np.asarray(values).reshape(-1)]
+        cols = (voca_len + self.num_oov_buckets if self.num_oov_buckets
+                else voca_len + (1 if self.is_set_default else 0))
+        idx, vals = [], []
+        for i, row in enumerate(rows_in):
+            feats = row.split(self.str_delimiter)
+            if not self.is_set_default and not self.num_oov_buckets:
+                feats = [f for f in feats if f in self.voca]
+            if len(feats) > cols:
+                # the (rows, cols) shape contract caps the per-row feature
+                # count; BCOO would silently drop out-of-bounds entries
+                raise ValueError(
+                    f"row {i} has {len(feats)} features but the output shape "
+                    f"allows at most {cols} per row")
+            for j, f in enumerate(feats):
+                if self.num_oov_buckets:
+                    v = self.voca.get(
+                        f, _fnv1a(f.encode()) % self.num_oov_buckets + voca_len)
+                else:
+                    v = self.voca.get(f, voca_len)
+                idx.append([i, j])
+                vals.append(v)
+        if not idx:
+            idx = np.zeros((0, 2), np.int32)
+        return SparseTensor.coo(np.asarray(idx, np.int32).reshape(-1, 2).T,
+                                np.asarray(vals, np.int32),
+                                (len(rows_in), cols))
+
+
+class Compare(Operation):
+    """Base elementwise comparison against the reference's abstract
+    nn/ops/Compare.scala; concrete subclasses pin ``compare_fn``. The
+    factory-built Greater/Less/Equal/... ops above are the instances
+    imported TF graphs use; this class exists for user subclassing parity."""
+
+    compare_fn = None
+
+    def forward(self, input):
+        a, b = self._pair(input)
+        if self.compare_fn is None:
+            raise NotImplementedError("subclass Compare with compare_fn")
+        return type(self).compare_fn(jnp.asarray(a), jnp.asarray(b))
+
+
+class DepthwiseConv2D(Operation):
+    """Depthwise conv taking (input, filter) as runtime activations
+    (≙ nn/ops/DepthwiseConv2D.scala). Filter is HWIM (kh, kw, in_channels,
+    channel_multiplier) — the TF convention; data_format NHWC or NCHW."""
+
+    def __init__(self, stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = 0, pad_h: int = 0, data_format: str = "NHWC"):
+        super().__init__()
+        self.strides = (stride_h, stride_w)
+        self.pads = [(pad_h, pad_h), (pad_w, pad_w)]
+        self.data_format = data_format
+
+    def forward(self, input):
+        x, filt = self._pair(input)
+        x, filt = jnp.asarray(x), jnp.asarray(filt)
+        kh, kw, cin, mult = filt.shape
+        # HWIM -> OIHW with feature_group_count=cin: O = cin*mult, I = 1
+        w = jnp.transpose(filt, (2, 3, 0, 1)).reshape(cin * mult, 1, kh, kw)
+        dn = (self.data_format, "OIHW", self.data_format)
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=self.strides, padding=self.pads,
+            dimension_numbers=dn, feature_group_count=cin)
+
+
+class Dilation2D(Operation):
+    """Grayscale morphological dilation (≙ nn/ops/Dilation2D.scala, the TF
+    op): ``out[y, x, c] = max_{dy, dx} in[y*s + dy*r, x*s + dx*r, c]
+    + filter[dy, dx, c]`` over NHWC input. Static kernel → unrolled max of
+    shifted strided slices, which XLA fuses into one pass."""
+
+    def __init__(self, strides: Sequence[int], rates: Sequence[int],
+                 padding: str = "SAME"):
+        super().__init__()
+        self.strides = list(strides)  # (1, sh, sw, 1), TF layout
+        self.rates = list(rates)
+        self.padding = padding.upper()
+
+    def forward(self, input):
+        x, filt = self._pair(input)
+        x, filt = jnp.asarray(x), jnp.asarray(filt)
+        n, h, w, c = x.shape
+        kh, kw, _ = filt.shape
+        sh, sw = self.strides[1], self.strides[2]
+        rh, rw = self.rates[1], self.rates[2]
+        eff_kh, eff_kw = (kh - 1) * rh + 1, (kw - 1) * rw + 1
+        if self.padding == "SAME":
+            oh = -(-h // sh)
+            ow = -(-w // sw)
+            pad_h = max((oh - 1) * sh + eff_kh - h, 0)
+            pad_w = max((ow - 1) * sw + eff_kw - w, 0)
+            pads = ((pad_h // 2, pad_h - pad_h // 2),
+                    (pad_w // 2, pad_w - pad_w // 2))
+        else:
+            oh = (h - eff_kh) // sh + 1
+            ow = (w - eff_kw) // sw + 1
+            pads = ((0, 0), (0, 0))
+        xp = jnp.pad(x, ((0, 0), pads[0], pads[1], (0, 0)),
+                     constant_values=-jnp.inf)
+        out = None
+        for dy in range(kh):
+            for dx in range(kw):
+                patch = xp[:, dy * rh:dy * rh + (oh - 1) * sh + 1:sh,
+                           dx * rw:dx * rw + (ow - 1) * sw + 1:sw, :]
+                cand = patch + filt[dy, dx][None, None, None, :]
+                out = cand if out is None else jnp.maximum(out, cand)
+        return out
+
+
+class Substr(Operation):
+    """Scalar-string substring (≙ nn/ops/Substr.scala). Host-side: input
+    Table(data, pos, len) of scalar values."""
+
+    def forward(self, input):
+        data, pos, ln = list(input)[:3]
+        s = data if isinstance(data, (str, bytes)) else np.asarray(data).item()
+        p, l = int(np.asarray(pos)), int(np.asarray(ln))
+        return s[p:p + l]
+
+
+class TensorOp(Operation):
+    """Composable tensor-function op (≙ nn/ops/TensorOp.scala): wraps a
+    ``fn(tensor) -> tensor`` and supports the reference's combinator algebra
+    (``+ - * /`` with scalars/tensors, chained transformations). Under jit
+    the whole chain fuses."""
+
+    def __init__(self, fn=None):
+        super().__init__()
+        self._fn = fn or (lambda x: x)
+
+    def forward(self, x):
+        return self._fn(jnp.asarray(x))
+
+    # ---------------------------------------------------------- combinators
+    def then(self, g) -> "TensorOp":
+        f = self._fn
+        return TensorOp(lambda x: g(f(x)))
+
+    def __add__(self, other):
+        return self.then(lambda y: y + other)
+
+    def __sub__(self, other):
+        return self.then(lambda y: y - other)
+
+    def __mul__(self, other):
+        return self.then(lambda y: y * other)
+
+    def __truediv__(self, other):
+        return self.then(lambda y: y / other)
+
+    def __pow__(self, p):
+        return self.then(lambda y: y ** p)
+
+    # named transforms from the reference's TensorOp object
+    def abs(self):
+        return self.then(jnp.abs)
+
+    def sqrt(self):
+        return self.then(jnp.sqrt)
+
+    def log(self):
+        return self.then(jnp.log)
+
+    def exp(self):
+        return self.then(jnp.exp)
+
+    def sigmoid(self):
+        return self.then(jax.nn.sigmoid)
+
+    def tanh(self):
+        return self.then(jnp.tanh)
+
+
+# A.2 name parity: the TF graph importer and reference docs use the bare name.
+ResizeBilinear = ResizeBilinearOp

@@ -245,6 +245,21 @@ class SparseJoinTable(Module):
         return SparseTensor(out)
 
 
+class DenseToSparse(Module):
+    """≙ nn/DenseToSparse.scala: convert a dense activation to its sparse
+    (BCOO) representation. ``nse`` pins the stored-nonzero count for static
+    shapes under jit; defaults to the dense element count (lossless).
+    Backward is dense pass-through, as in the reference."""
+
+    def __init__(self, nse: Optional[int] = None):
+        super().__init__()
+        self.nse = nse
+
+    def forward(self, input):
+        x = jnp.asarray(input)
+        return SparseTensor.from_dense(x, nse=self.nse)
+
+
 class SparseMiniBatch:
     """≙ dataset/MiniBatch.scala:588 SparseMiniBatch: batch Samples whose
     features mix sparse and dense tensors. Sparse features (given as

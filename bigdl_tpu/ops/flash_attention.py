@@ -56,17 +56,24 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         k = k_ref[0, :, :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        mask = None
         if causal:
             rows = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows + kv_offset >= cols, s, _NEG_INF)
+            mask = rows + kv_offset >= cols
+            s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_ref[:, :]                      # (block_q, 1)
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)                    # (block_q, block_k)
+        if mask is not None:
+            # a fully-masked row has m_new == _NEG_INF, making exp(s - m_new)
+            # = 1 for its masked entries; zero them so l stays 0 and the
+            # finalize guard really does emit 0 for such rows
+            p = jnp.where(mask, p, 0.0)
         correction = jnp.exp(m_prev - m_new)      # (block_q, 1)
         l_ref[:, :] = (l_ref[:, :] * correction
                        + jnp.sum(p, axis=1, keepdims=True))

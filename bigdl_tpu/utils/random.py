@@ -43,11 +43,18 @@ class RandomGenerator:
         return bool(self._stack)
 
     def next_key(self):
-        """Return a fresh subkey, advancing whichever stream is active."""
+        """Return a fresh subkey, advancing whichever stream is active.
+
+        The global (unscoped) stream is split under
+        ``ensure_compile_time_eval`` so that a module called inside a raw
+        ``jax.jit`` (instead of the sanctioned pure_apply/bind path, which
+        pushes a scoped key) cannot poison the global key with a tracer —
+        the split runs eagerly and the successor stays concrete."""
         if self._stack:
             self._stack[-1], sub = jax.random.split(self._stack[-1])
             return sub
-        self._key, sub = jax.random.split(self._key)
+        with jax.ensure_compile_time_eval():
+            self._key, sub = jax.random.split(self._key)
         return sub
 
     def peek_key(self):

@@ -6,9 +6,16 @@ build a Graph between user-named inputs and outputs. Here the GraphDef is
 decoded with utils/protowire against the public tensorflow .proto field
 numbers; constants fold into their consumers (weights), and the supported
 op set covers frozen feed-forward inference graphs: Placeholder, Const,
-Identity, MatMul, BiasAdd, Add/AddV2, Relu, Relu6, Tanh, Sigmoid, Softmax,
-Conv2D (NHWC), DepthwiseConv2dNative, MaxPool, AvgPool, Mean, Reshape,
-Squeeze, Pad, ConcatV2.
+Identity, MatMul, BiasAdd/BiasAddV1, Add/AddV2, Relu, Relu6, Tanh, Sigmoid,
+Softmax, Conv2D (NHWC), DepthwiseConv2dNative, MaxPool, AvgPool, Mean and
+the reduction family (Sum/Max/Min/Prod/All/Any), Reshape, Squeeze, Pad,
+ConcatV2, plus control-flow/state/parsing infra (see nn/tf_ops.py).
+
+The reference's ``*Grad`` loaders (ReluGrad, MaxPoolGrad, Conv2DBackprop*,
+FusedBatchNormGrad, ... — 18 files under utils/tf/loaders/) are absorbed by
+design: training an imported graph goes through JAX autodiff over the
+forward program (utils/tf_session.py), so hand-written gradient ops are
+never imported.
 """
 
 from __future__ import annotations
@@ -687,8 +694,9 @@ class TensorflowLoader:
             if w is None:  # dynamic rhs (e.g. an imported Variable)
                 return m.inputs(prev(0), prev(1))
             return m.inputs(prev(0))
-        if op == "BiasAdd" or (op in ("Add", "AddV2")
-                               and const_of(data_inputs[1]) is not None):
+        if op in ("BiasAdd", "BiasAddV1") or (
+                op in ("Add", "AddV2")
+                and const_of(data_inputs[1]) is not None):
             return _BiasAdd(const_of(data_inputs[1])).set_name(n.name).inputs(prev(0))
         if op in ("Add", "AddV2"):
             return nn.CAddTable().set_name(n.name).inputs(prev(0), prev(1))
@@ -747,6 +755,57 @@ class TensorflowLoader:
             keep = n.attr_b("keep_dims")
             ax = tuple(int(a) for a in np.asarray(axes).reshape(-1))
             return _Fn(lambda x, a=ax, k=keep: jnp.mean(x, axis=a, keepdims=k)
+                       ).set_name(n.name).inputs(prev(0))
+        if op == "SegmentSum":
+            ids_c = const_of(data_inputs[1])
+            if ids_c is not None:  # fold num_segments at import time (jit-safe)
+                num = int(np.asarray(ids_c).reshape(-1)[-1]) + 1
+                return unary(lambda x, i=jnp.asarray(ids_c), m=num:
+                             jax.ops.segment_sum(x, i, m))
+
+            def segsum(x, ids):
+                ids = jnp.asarray(ids)
+                num = int(np.asarray(ids)[-1]) + 1  # ids sorted, TF contract
+                return jax.ops.segment_sum(jnp.asarray(x), ids, num)
+
+            return _Fn(segsum).set_name(n.name).inputs(prev(0), prev(1))
+        if op in ("InTopK", "InTopKV2"):
+            if op == "InTopKV2":  # k arrives as a const input, not an attr
+                k = int(np.asarray(const_of(data_inputs[2])).reshape(()))
+            else:
+                k = n.attr_i("k", 1)
+
+            def intopk(pred, tgt, k=k):
+                thresh = jnp.sort(pred, axis=-1)[..., -k]
+                return jnp.take_along_axis(
+                    pred, jnp.asarray(tgt)[:, None].astype(jnp.int32),
+                    axis=-1)[:, 0] >= thresh
+
+            return _Fn(intopk).set_name(n.name).inputs(prev(0), prev(1))
+        if op == "RandomUniform":
+            shape_c = const_of(data_inputs[0])
+            shp = tuple(int(v) for v in np.asarray(shape_c).reshape(-1))
+
+            def randu(_x, shp=shp):
+                from bigdl_tpu.utils import random as bt_random
+                return jax.random.uniform(bt_random.next_key(), shp)
+
+            return _Fn(randu).set_name(n.name).inputs(prev(0))
+        if op == "RandomShuffle":
+            def shuffle(x):
+                from bigdl_tpu.utils import random as bt_random
+                return jax.random.permutation(bt_random.next_key(),
+                                              jnp.asarray(x), axis=0)
+
+            return unary(shuffle)
+        if op == "Dilation2D":
+            from bigdl_tpu.nn.ops import Dilation2D as _Dil
+
+            filt = const_of(data_inputs[1])
+            mod = _Dil(strides=n.attr_ints("strides") or (1, 1, 1, 1),
+                       rates=n.attr_ints("rates") or (1, 1, 1, 1),
+                       padding=n.attr_s("padding") or "SAME")
+            return _Fn(lambda x, m=mod, f=jnp.asarray(filt): m([x, f])
                        ).set_name(n.name).inputs(prev(0))
         if op == "Pad":
             pads = const_of(data_inputs[1])

@@ -107,3 +107,31 @@ def test_transformer_block_plumbs_use_flash():
                     .astype(np.float32))
     np.testing.assert_allclose(np.asarray(blk(x)), np.asarray(blk2(x)),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_causal_longer_q_than_kv_emits_zero_rows():
+    # regression: rows attending zero keys (causal, tq > tk) must emit 0,
+    # not the uniform mean of v, in BOTH the kernel and the dense path
+    import jax
+
+    from bigdl_tpu.nn.attention import dot_product_attention
+
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(k1, (1, 1, 16, 8))
+    k = jax.random.normal(k2, (1, 1, 8, 8))
+    v = jax.random.normal(k3, (1, 1, 8, 8))
+    out = np.asarray(flash_attention(q, k, v, causal=True,
+                                     block_q=8, block_k=8))
+    dense = np.asarray(dot_product_attention(q, k, v, causal=True))
+    assert not np.isnan(dense).any()
+    assert np.abs(out[0, 0, :8]).max() == 0.0
+    np.testing.assert_allclose(out, dense, rtol=2e-5, atol=2e-6)
+    g = jax.grad(lambda q_: float_sum(dot_product_attention(
+        q_, k, v, causal=True)))(q)
+    assert not np.isnan(np.asarray(g)).any()
+
+
+def float_sum(x):
+    import jax.numpy as jnp
+
+    return jnp.sum(x)

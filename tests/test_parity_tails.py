@@ -1,0 +1,322 @@
+"""Appendix A parity tails added in round 3: penalty layers, 3-D transposed
+conv, DenseToSparse, DetectionOutputFrcnn, remaining nn/ops, keras 3-D set.
+
+Reference files cited per test."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn import ops
+from bigdl_tpu.utils.table import Table
+
+
+# ------------------------------------------------------------ penalty layers
+def test_l1_penalty_forward_identity_and_grad():
+    # ≙ nn/L1Penalty.scala: output = input, gradInput = gradOutput + m*sign(x)
+    m = nn.L1Penalty(l1weight=2.0)
+    x = jnp.asarray([[1.0, -2.0, 0.5]])
+    np.testing.assert_allclose(m(x), x)
+    assert float(m.loss) == pytest.approx(2.0 * 3.5)
+
+    g = jax.grad(lambda t: jnp.sum(m.forward(t) * 3.0))(x)
+    np.testing.assert_allclose(g, 3.0 + 2.0 * np.sign(np.asarray(x)))
+
+
+def test_l1_penalty_size_average_and_no_output():
+    m = nn.L1Penalty(l1weight=3.0, size_average=True, provide_output=False)
+    x = jnp.asarray([2.0, -4.0])
+    g = jax.grad(lambda t: jnp.sum(m.forward(t) * 7.0))(x)
+    np.testing.assert_allclose(g, 1.5 * np.sign(np.asarray(x)))
+
+
+def test_negative_entropy_penalty_grad():
+    # ≙ nn/NegativeEntropyPenalty.scala: gradInput = gradOutput + beta*(1+log p)
+    m = nn.NegativeEntropyPenalty(beta=0.1)
+    p = jnp.asarray([0.2, 0.8])
+    np.testing.assert_allclose(m(p), p)
+    g = jax.grad(lambda t: jnp.sum(m.forward(t) * 2.0))(p)
+    np.testing.assert_allclose(g, 2.0 + 0.1 * (np.log(np.asarray(p)) + 1),
+                               rtol=1e-6)
+
+
+# ------------------------------------------------- VolumetricFullConvolution
+def test_volumetric_full_convolution_upsamples():
+    # ≙ nn/VolumetricFullConvolution.scala: stride-2 transposed conv doubles
+    # each spatial dim (with k=2, pad=0): out = (in-1)*d - 2*pad + k + adj
+    m = nn.VolumetricFullConvolution(3, 5, 2, 2, 2, dt=2, dw=2, dh=2)
+    x = jnp.ones((2, 3, 4, 4, 4))
+    out = m(x)
+    assert out.shape == (2, 5, 8, 8, 8)
+
+
+def test_volumetric_full_conv_matches_2d_on_singleton_depth():
+    # depth-1 volume with kt=1 must reduce exactly to SpatialFullConvolution
+    m3 = nn.VolumetricFullConvolution(2, 3, 1, 3, 3, dt=1, dw=2, dh=2,
+                                      pad_w=1, pad_h=1)
+    m2 = nn.SpatialFullConvolution(2, 3, 3, 3, dw=2, dh=2, pad_w=1, pad_h=1)
+    m2.weight = m3.weight[:, :, 0]
+    m2.bias = m3.bias
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 1, 5, 5))
+    np.testing.assert_allclose(np.asarray(m3(x))[:, :, 0],
+                               np.asarray(m2(x[:, :, 0])), rtol=2e-5, atol=1e-5)
+
+
+# -------------------------------------------------------------- DenseToSparse
+def test_dense_to_sparse_roundtrip():
+    # ≙ nn/DenseToSparse.scala
+    x = jnp.asarray([[0.0, 1.5, 0.0], [2.0, 0.0, 3.0]])
+    st = nn.DenseToSparse()(x)
+    np.testing.assert_allclose(np.asarray(st.to_dense()), np.asarray(x))
+
+
+# ------------------------------------------------------- DetectionOutputFrcnn
+def _frcnn_inputs():
+    im_info = jnp.asarray([[20.0, 20.0, 1.0, 1.0]])
+    rois = jnp.asarray([
+        [0, 2.0, 2.0, 8.0, 8.0],
+        [0, 2.5, 2.5, 8.5, 8.5],   # near-duplicate of roi 0
+        [0, 12.0, 12.0, 18.0, 18.0],
+    ])
+    n_cls = 3
+    deltas = jnp.zeros((3, n_cls * 4))
+    scores = jnp.asarray([
+        [0.05, 0.9, 0.05],
+        [0.10, 0.8, 0.05],
+        [0.05, 0.05, 0.7],
+    ])
+    return Table(im_info, rois, deltas, scores), n_cls
+
+
+def test_detection_output_frcnn_nms_and_layout():
+    inp, n_cls = _frcnn_inputs()
+    head = nn.DetectionOutputFrcnn(nms_thresh=0.3, n_classes=n_cls)
+    head.evaluate()
+    out = np.asarray(head(inp))
+    n = int(out[0, 0])
+    assert n == 2  # near-duplicate suppressed
+    rows = out[0, 1:1 + n * 6].reshape(n, 6)
+    # [class, score, x1, y1, x2, y2]; class-1 box survives at score 0.9
+    assert set(rows[:, 0].astype(int)) == {1, 2}
+    assert rows[:, 1].min() >= 0.05
+
+
+def test_detection_output_frcnn_max_per_image_and_training_passthrough():
+    inp, n_cls = _frcnn_inputs()
+    head = nn.DetectionOutputFrcnn(nms_thresh=0.99, n_classes=n_cls,
+                                   max_per_image=1)
+    head.evaluate()
+    out = np.asarray(head(inp))
+    assert int(out[0, 0]) == 1
+    head.training = True
+    assert head(inp) is inp  # training mode: identity (reference behavior)
+
+
+def test_detection_output_frcnn_bbox_vote():
+    inp, n_cls = _frcnn_inputs()
+    head = nn.DetectionOutputFrcnn(nms_thresh=0.3, n_classes=n_cls,
+                                   bbox_vote=True)
+    head.evaluate()
+    out = np.asarray(head(inp))
+    n = int(out[0, 0])
+    rows = out[0, 1:1 + n * 6].reshape(n, 6)
+    cls1 = rows[rows[:, 0] == 1][0]
+    # vote blends the two overlapping class-1 boxes: x1 strictly between them
+    assert 2.0 < cls1[2] < 2.5
+
+
+def test_l1_penalty_no_tracer_leak_under_jit():
+    # self.loss must not capture a tracer when traced via pure_apply
+    from bigdl_tpu.nn.module import pure_apply
+
+    m = nn.L1Penalty(l1weight=1.0)
+    x = jnp.asarray([1.0, -1.0])
+    m(x)  # eager: loss concrete
+    eager_loss = float(m.loss)
+    out, _ = jax.jit(pure_apply(m))(m.params_dict(), m.buffers_dict(), x)
+    np.testing.assert_allclose(out, x)
+    assert float(m.loss) == pytest.approx(eager_loss)  # not a leaked tracer
+
+
+def test_global_rng_survives_raw_jit_module_call():
+    # calling a module inside raw jax.jit (not pure_apply) must not poison
+    # the global key stream with a tracer (utils/random.py next_key guard)
+    from bigdl_tpu.utils import random as rnd
+
+    m = nn.Linear(3, 2)
+    jax.jit(lambda t: m(t))(jnp.ones((1, 3)))
+    k = rnd.next_key()  # must not raise UnexpectedTracerError
+    assert not isinstance(k, jax.core.Tracer)
+
+
+# ------------------------------------------------------------------- nn/ops
+def test_categorical_col_voca_list_modes():
+    # ≙ nn/ops/CategoricalColVocaList.scala
+    op = ops.CategoricalColVocaList(["a", "b", "c"])
+    st = op(np.asarray(["a,b", "c", "zzz"]))
+    assert st.bcoo.shape == (3, 3)
+    dense = np.asarray(st.to_dense())
+    assert dense[0, 0] == 0 and dense[0, 1] == 1 and dense[1, 0] == 2
+    assert dense[2].sum() == 0  # OOV filtered
+
+    op_d = ops.CategoricalColVocaList(["a", "b"], is_set_default=True)
+    st_d = op_d(np.asarray(["zzz"]))
+    assert st_d.bcoo.shape == (1, 3)
+    assert np.asarray(st_d.to_dense())[0, 0] == 2  # default id = len(voca)
+
+    op_h = ops.CategoricalColVocaList(["a", "b"], num_oov_buckets=4)
+    v = int(np.asarray(op_h(np.asarray(["zzz"])).to_dense())[0, 0])
+    assert 2 <= v < 6
+
+    with pytest.raises(ValueError, match="at most"):
+        op(np.asarray(["a,b,c,a"]))  # 4 features > 3 columns: explicit error
+
+
+def test_depthwise_conv2d_op_matches_manual():
+    # ≙ nn/ops/DepthwiseConv2D.scala (NHWC, filter HWIM)
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 5, 5, 2))
+    filt = jax.random.normal(jax.random.PRNGKey(2), (3, 3, 2, 1))
+    out = ops.DepthwiseConv2D()( [x, filt] )
+    assert out.shape == (1, 3, 3, 2)
+    # channel 0 of the output only sees input channel 0
+    manual = jax.lax.conv_general_dilated(
+        x[..., :1].transpose(0, 3, 1, 2), filt[:, :, :1, 0][None].transpose(0, 3, 1, 2),
+        (1, 1), [(0, 0), (0, 0)])
+    np.testing.assert_allclose(np.asarray(out[..., 0]),
+                               np.asarray(manual[:, 0]), rtol=2e-5, atol=1e-5)
+
+
+def test_dilation2d_valid_matches_manual():
+    # ≙ nn/ops/Dilation2D.scala: out = max over window of (x + filter)
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    filt = jnp.zeros((2, 2, 1))
+    out = ops.Dilation2D(strides=(1, 1, 1, 1), rates=(1, 1, 1, 1),
+                         padding="VALID")([x, filt])
+    assert out.shape == (1, 3, 3, 1)
+    # zero filter -> plain 2x2 max pool stride 1
+    np.testing.assert_allclose(np.asarray(out)[0, :, :, 0],
+                               [[5, 6, 7], [9, 10, 11], [13, 14, 15]])
+
+
+def test_dilation2d_same_shape():
+    x = jnp.zeros((1, 5, 5, 2))
+    filt = jnp.ones((3, 3, 2))
+    out = ops.Dilation2D(strides=(1, 2, 2, 1), rates=(1, 1, 1, 1),
+                         padding="SAME")([x, filt])
+    assert out.shape == (1, 3, 3, 2)
+    np.testing.assert_allclose(np.asarray(out)[0, 1, 1], [1.0, 1.0])
+
+
+def test_substr_op():
+    # ≙ nn/ops/Substr.scala
+    assert ops.Substr()(Table("abcdef", 1, 3)) == "bcd"
+
+
+def test_tensor_op_combinators():
+    # ≙ nn/ops/TensorOp.scala: (op + 2) * 3 chains into one function
+    op = (ops.TensorOp() + 2.0) * 3.0
+    np.testing.assert_allclose(np.asarray(op(jnp.asarray([1.0, 0.0]))),
+                               [9.0, 6.0])
+    np.testing.assert_allclose(
+        np.asarray(ops.TensorOp().abs().sqrt()(jnp.asarray([-4.0]))), [2.0])
+
+
+def test_compare_base_subclass():
+    class GreaterPlus(ops.Compare):
+        compare_fn = staticmethod(lambda a, b: a > b)
+
+    out = GreaterPlus()([jnp.asarray([1.0, 5.0]), jnp.asarray([2.0, 2.0])])
+    assert out.tolist() == [False, True]
+
+
+def test_ops_resize_bilinear_name_alias():
+    assert ops.ResizeBilinear is ops.ResizeBilinearOp
+
+
+def test_nn_reference_aliases():
+    assert nn.RNN is nn.RnnCell
+    assert nn.DynamicContainer is nn.Container
+
+
+# ------------------------------------------------------------------ keras 3D
+def test_keras_3d_stack_shapes():
+    from bigdl_tpu import keras as K
+
+    m = K.Sequential()
+    m.add(K.Convolution3D(4, 3, 3, 3, border_mode="same",
+                          input_shape=(2, 8, 8, 8)))
+    m.add(K.MaxPooling3D())
+    m.add(K.AveragePooling3D())
+    m.add(K.GlobalAveragePooling3D())
+    assert m.get_output_shape() == (4,)
+    out = m(jnp.ones((2, 2, 8, 8, 8)))
+    assert out.shape == (2, 4)
+
+
+def test_keras_3d_shape_layers():
+    from bigdl_tpu import keras as K
+
+    m = K.Sequential()
+    m.add(K.ZeroPadding3D(padding=(1, 1, 1), input_shape=(2, 3, 3, 3)))
+    m.add(K.Cropping3D(cropping=((1, 1), (0, 0), (0, 0))))
+    m.add(K.UpSampling3D(size=(2, 1, 1)))
+    m.add(K.SpatialDropout3D(0.5))
+    assert m.get_output_shape() == (2, 6, 5, 5)
+
+
+def test_keras_atrous_conv1d():
+    from bigdl_tpu import keras as K
+
+    m = K.Sequential()
+    m.add(K.AtrousConvolution1D(6, 3, atrous_rate=2, input_shape=(10, 4)))
+    # effective kernel = (3-1)*2+1 = 5 -> T' = 10-5+1 = 6
+    assert m.get_output_shape() == (6, 6)
+    assert m(jnp.ones((2, 10, 4))).shape == (2, 6, 6)
+
+
+def test_keras_locally_connected1d():
+    from bigdl_tpu import keras as K
+
+    m = K.Sequential()
+    m.add(K.LocallyConnected1D(5, 3, input_shape=(8, 4)))
+    assert m.get_output_shape() == (6, 5)
+
+
+def test_keras_conv_lstm2d():
+    from bigdl_tpu import keras as K
+
+    m = K.Sequential()
+    m.add(K.ConvLSTM2D(4, 3, input_shape=(5, 2, 6, 6)))
+    out = m(jnp.ones((2, 5, 2, 6, 6)))
+    assert out.shape == (2, 4, 6, 6)
+
+    ms = K.Sequential()
+    ms.add(K.ConvLSTM2D(4, 3, return_sequences=True, input_shape=(5, 2, 6, 6)))
+    assert ms(jnp.ones((1, 5, 2, 6, 6))).shape == (1, 5, 4, 6, 6)
+
+
+def test_keras_conv_lstm2d_rejects_unsupported_config():
+    from bigdl_tpu import keras as K
+
+    with pytest.raises(ValueError, match="subsample"):
+        K.ConvLSTM2D(4, 3, subsample=(2, 2))
+    with pytest.raises(ValueError, match="activations are fixed"):
+        K.ConvLSTM2D(4, 3, activation="relu")
+
+
+def test_keras_softmax_layer_and_input_node():
+    from bigdl_tpu import keras as K
+
+    m = K.Sequential()
+    m.add(K.SoftMax(input_shape=(5,)))
+    out = np.asarray(m(jnp.ones((2, 5))))
+    np.testing.assert_allclose(out.sum(axis=1), [1.0, 1.0], rtol=1e-6)
+
+    node = K.Input(shape=(4,), name="inp")
+    dense = K.Dense(3, input_shape=(4,))
+    dense.build((4,))
+    out_node = dense.layer.inputs(node)
+    model = K.Model(node, out_node)
+    assert model(jnp.ones((2, 4))).shape == (2, 3)

@@ -432,3 +432,73 @@ def test_softmax_cross_entropy_with_logits_matches_tf(tmp_path):
     m = load_tf(pb, ["x"], ["Identity"])
     m.evaluate()
     np.testing.assert_allclose(ref, np.asarray(m(x)), rtol=1e-5, atol=1e-6)
+
+
+def test_reduction_family_matches_tf(tmp_path):
+    # round-3 handlers: Sum/Max/Min/Prod (const axes) ≙ utils/tf/loaders/
+    @tf.function(input_signature=[tf.TensorSpec([3, 4], tf.float32)])
+    def f(x):
+        return (tf.reduce_sum(x, axis=1) + tf.reduce_max(x, axis=1)
+                + tf.reduce_min(x, axis=1)
+                + tf.reduce_prod(x * 0.5, axis=1, keepdims=False))
+
+    x = np.random.RandomState(3).randn(3, 4).astype(np.float32)
+    run_both(tmp_path, f, x)
+
+
+def test_bool_reductions_match_tf(tmp_path):
+    @tf.function(input_signature=[tf.TensorSpec([3, 4], tf.float32)])
+    def f(x):
+        pos = x > 0
+        return tf.cast(tf.reduce_all(pos, axis=1), tf.float32) + \
+            2.0 * tf.cast(tf.reduce_any(pos, axis=1), tf.float32)
+
+    x = np.random.RandomState(4).randn(3, 4).astype(np.float32)
+    run_both(tmp_path, f, x)
+
+
+def test_segment_sum_matches_tf(tmp_path):
+    ids = np.asarray([0, 0, 1, 2], np.int32)
+
+    @tf.function(input_signature=[tf.TensorSpec([4, 3], tf.float32)])
+    def f(x):
+        return tf.math.segment_sum(x, tf.constant(ids))
+
+    x = np.random.RandomState(5).randn(4, 3).astype(np.float32)
+    run_both(tmp_path, f, x)
+
+
+def test_in_top_k_matches_tf(tmp_path):
+    tgt = np.asarray([1, 0], np.int32)
+
+    @tf.function(input_signature=[tf.TensorSpec([2, 5], tf.float32)])
+    def f(x):
+        return tf.cast(tf.math.in_top_k(tf.constant(tgt), x, k=2), tf.float32)
+
+    x = np.random.RandomState(6).randn(2, 5).astype(np.float32)
+    run_both(tmp_path, f, x)
+
+
+def test_dilation2d_matches_tf(tmp_path):
+    filt = (np.random.RandomState(7).rand(2, 2, 1) * 0.1).astype(np.float32)
+
+    @tf.function(input_signature=[tf.TensorSpec([1, 5, 5, 1], tf.float32)])
+    def f(x):
+        return tf.nn.dilation2d(x, tf.constant(filt), strides=[1, 1, 1, 1],
+                                padding="SAME", data_format="NHWC",
+                                dilations=[1, 1, 1, 1])
+
+    x = np.random.RandomState(8).randn(1, 5, 5, 1).astype(np.float32)
+    run_both(tmp_path, f, x)
+
+
+def test_bias_add_v1_matches_tf(tmp_path):
+    # BiasAddV1 shares the BiasAdd lowering; emit it via raw NodeDef name
+    b = np.asarray([0.5, -0.5], np.float32)
+
+    @tf.function(input_signature=[tf.TensorSpec([2, 2], tf.float32)])
+    def f(x):
+        return tf.nn.bias_add(x, tf.constant(b))
+
+    x = np.random.RandomState(9).randn(2, 2).astype(np.float32)
+    run_both(tmp_path, f, x)
