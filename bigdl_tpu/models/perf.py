@@ -49,6 +49,72 @@ def build_model(name: str, class_num: int = 1000, format: str = "NCHW"):
     raise ValueError(f"unknown perf model {name!r}")
 
 
+def _transformer_perf(batch_size, iterations, warmup, dtype, log,
+                      seq_len=1024, vocab=32000, embed_dim=512, layers=8,
+                      heads=8, use_flash=True, master_f32=True,
+                      profile_dir=None):
+    """Tokens/sec on the long-context flagship (TransformerLM + pallas
+    flash attention). Separate from run_perf because the input is int
+    tokens and the natural unit is tokens/sec, not records/sec."""
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.nn import CrossEntropyCriterion
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    model = TransformerLM(vocab, embed_dim=embed_dim, num_heads=heads,
+                          num_layers=layers, max_len=seq_len,
+                          use_flash=use_flash and not on_cpu)
+
+    class _LMLoss:
+        # next-token CE over the flattened time axis (labels 1-based)
+        def forward(self, logits, ids):
+            lg = logits[:, :-1].reshape(-1, vocab)
+            tg = ids[:, 1:].reshape(-1) + 1
+            return CrossEntropyCriterion().forward(lg, tg)
+
+    method = SGD(learning_rate=0.01)
+    ts = make_train_step(model, _LMLoss(), method,
+                         compute_dtype=dtype if master_f32 else None)
+    params = jax.tree.map(jnp.copy, model.params_dict())
+    buffers = jax.tree.map(jnp.copy, model.buffers_dict())
+    if not master_f32:  # store params directly at the compute dtype
+        cast = lambda t: jax.tree.map(  # noqa: E731
+            lambda a: a.astype(dtype)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, t)
+        params, buffers = cast(params), cast(buffers)
+    slots = ts.init_slots(params)
+    lrs = ts.current_lrs()
+    step = jax.jit(ts.step, donate_argnums=(0, 1, 2))
+    ids = jax.random.randint(jax.random.PRNGKey(0), (batch_size, seq_len),
+                             0, vocab)
+    t0 = time.perf_counter()
+    for _ in range(max(1, warmup)):
+        loss, params, buffers, slots = step(params, buffers, slots, ids, ids,
+                                            lrs, bt_random.next_key())
+    float(loss)
+    compile_s = time.perf_counter() - t0
+    import contextlib
+    prof = (jax.profiler.trace(profile_dir) if profile_dir
+            else contextlib.nullcontext())
+    with prof:
+        t0 = time.perf_counter()
+        for _ in range(iterations):
+            loss, params, buffers, slots = step(params, buffers, slots,
+                                                ids, ids, lrs,
+                                                bt_random.next_key())
+        loss_v = float(loss)
+        elapsed = time.perf_counter() - t0
+    tok_per_sec = batch_size * seq_len * iterations / elapsed
+    s = {"model": "transformer_lm", "batch_size": batch_size,
+         "seq_len": seq_len, "iterations": iterations,
+         "warmup_s": round(compile_s, 3), "time_s": round(elapsed, 4),
+         "records_per_sec": round(tok_per_sec, 2),
+         "ms_per_iter": round(1000.0 * elapsed / iterations, 3),
+         "loss": loss_v}
+    log(f"[perf] transformer_lm batch={batch_size} seq={seq_len}: "
+        f"{tok_per_sec:.0f} tokens/s ({s['ms_per_iter']:.1f} ms/iter)")
+    return s
+
+
 def run_perf(model_name: str = None, batch_size: int = 32,
              iterations: int = 20, warmup: int = 3,
              dtype=jnp.float32, criterion=None,
@@ -65,6 +131,14 @@ def run_perf(model_name: str = None, batch_size: int = 32,
     timed region."""
     if model is None:
         model_name = model_name or "resnet50"
+        if model_name in ("transformer", "transformer_lm"):
+            if criterion is not None:
+                raise ValueError(
+                    "the transformer bench fixes its own next-token CE loss; "
+                    "custom criterion is not supported")
+            return _transformer_perf(batch_size, iterations, warmup, dtype,
+                                     log, master_f32=master_f32,
+                                     profile_dir=profile_dir)
         model, input_shape, class_num = build_model(model_name, class_num, format=format)
     elif input_shape is None:
         raise ValueError("input_shape is required when passing a custom model")

@@ -27,7 +27,7 @@ class TransformerLM(Module):
                  max_len: int = 1024, mlp_ratio: int = 4,
                  dropout: float = 0.0, causal: bool = True,
                  sequence_parallel: Optional[str] = None,
-                 tie_embeddings: bool = True):
+                 tie_embeddings: bool = True, use_flash: bool = False):
         super().__init__()
         self.vocab_size = vocab_size
         self.embed_dim = embed_dim
@@ -41,7 +41,8 @@ class TransformerLM(Module):
             setattr(self, f"block{i}",
                     TransformerBlock(embed_dim, num_heads, mlp_ratio=mlp_ratio,
                                      dropout=dropout, causal=causal,
-                                     sequence_parallel=sequence_parallel))
+                                     sequence_parallel=sequence_parallel,
+                                     use_flash=use_flash))
         self.ln_f = LayerNorm(embed_dim)
         if not tie_embeddings:
             self.head = nn.Linear(embed_dim, vocab_size, with_bias=False)

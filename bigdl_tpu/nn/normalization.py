@@ -54,9 +54,14 @@ class BatchNormalization(Module):
         else:
             ch_ax = 1 if x.ndim >= self.n_dim else 0
         axes = tuple(i for i in range(x.ndim) if i != ch_ax)
+        # statistics in f32 (bf16 accumulations drift), but the normalized
+        # output stays in the INPUT dtype: a bf16 activation must not be
+        # promoted to f32 by the f32 running buffers, or every downstream
+        # matmul/conv silently runs at f32 and the MXU loses half its rate
+        x32 = x if x.dtype == jnp.float32 else x.astype(jnp.float32)
         if self.training:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            mean = jnp.mean(x32, axis=axes)
+            var = jnp.var(x32, axis=axes)
             n = x.size / x.shape[ch_ax]
             if self.global_stats_axis is not None:
                 # global var needs the variance OF the per-shard means too:
@@ -68,22 +73,32 @@ class BatchNormalization(Module):
                 unbiased = var * n / jnp.maximum(1.0, n - 1.0)
             else:
                 unbiased = var * n / max(1.0, n - 1)
+            # keep the buffer dtype stable (f32 stats must not flip a bf16
+            # buffer to f32 mid-training — that would retrace the jitted step)
             self._set_buffer(
                 "running_mean",
-                (1 - self.momentum) * self.running_mean + self.momentum * mean,
+                ((1 - self.momentum) * self.running_mean
+                 + self.momentum * mean).astype(self.running_mean.dtype),
             )
             self._set_buffer(
                 "running_var",
-                (1 - self.momentum) * self.running_var + self.momentum * unbiased,
+                ((1 - self.momentum) * self.running_var
+                 + self.momentum * unbiased).astype(self.running_var.dtype),
             )
         else:
             mean, var = self.running_mean, self.running_var
+        # fold everything into one per-channel scale/shift applied in x.dtype
+        inv = jax.lax.rsqrt(var.astype(jnp.float32) + self.eps)
+        if self.affine:
+            scale = self.weight.astype(jnp.float32) * inv
+            shift = self.bias.astype(jnp.float32) - mean * scale
+        else:
+            scale = inv
+            shift = -mean * inv
         shape = [1] * x.ndim
         shape[ch_ax] = x.shape[ch_ax]
-        out = (x - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + self.eps)
-        if self.affine:
-            out = out * self.weight.reshape(shape) + self.bias.reshape(shape)
-        return out
+        return (x * scale.reshape(shape).astype(x.dtype)
+                + shift.reshape(shape).astype(x.dtype))
 
     def _extra_repr(self):
         return f"({self.n_output}, eps={self.eps}, momentum={self.momentum})"

@@ -764,6 +764,11 @@ class TensorflowLoader:
                              jax.ops.segment_sum(x, i, m))
 
             def segsum(x, ids):
+                if isinstance(ids, jax.core.Tracer):
+                    raise ValueError(
+                        "SegmentSum with non-constant segment ids cannot run "
+                        "under jit (num_segments would be data-dependent); "
+                        "run the imported graph eagerly or freeze the ids")
                 ids = jnp.asarray(ids)
                 num = int(np.asarray(ids)[-1]) + 1  # ids sorted, TF contract
                 return jax.ops.segment_sum(jnp.asarray(x), ids, num)
@@ -802,6 +807,10 @@ class TensorflowLoader:
             from bigdl_tpu.nn.ops import Dilation2D as _Dil
 
             filt = const_of(data_inputs[1])
+            if filt is None:
+                raise ValueError(
+                    f"Dilation2D {n.name!r}: dynamic (non-Const) filters are "
+                    "unsupported; freeze the filter into the graph")
             mod = _Dil(strides=n.attr_ints("strides") or (1, 1, 1, 1),
                        rates=n.attr_ints("rates") or (1, 1, 1, 1),
                        padding=n.attr_s("padding") or "SAME")

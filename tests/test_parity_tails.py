@@ -320,3 +320,26 @@ def test_keras_softmax_layer_and_input_node():
     out_node = dense.layer.inputs(node)
     model = K.Model(node, out_node)
     assert model(jnp.ones((2, 4))).shape == (2, 3)
+
+
+def test_batchnorm_preserves_bf16_activations():
+    # mixed-precision contract: f32 running buffers must not promote a bf16
+    # activation stream to f32 (that silently halves the MXU rate downstream)
+    for training in (True, False):
+        bn = nn.SpatialBatchNormalization(4)
+        bn.training = training
+        x = jnp.ones((2, 4, 5, 5), jnp.bfloat16)
+        out = bn(x)
+        assert out.dtype == jnp.bfloat16, (training, out.dtype)
+        assert bn.running_mean.dtype == jnp.float32
+
+
+def test_batchnorm_numerics_unchanged():
+    bn = nn.SpatialBatchNormalization(3)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 3, 6, 6)) * 2.0 + 1.0
+    out = np.asarray(bn(x))
+    # folded scale/shift must equal the textbook (x - mean)/sqrt(var+eps)
+    m = np.asarray(x).mean(axis=(0, 2, 3), keepdims=True)
+    v = np.asarray(x).var(axis=(0, 2, 3), keepdims=True)
+    np.testing.assert_allclose(out, (np.asarray(x) - m) / np.sqrt(v + bn.eps),
+                               rtol=2e-4, atol=2e-5)
