@@ -9,9 +9,9 @@ flash-attention formulation mapped onto the TPU grid:
   scratch (persists across the kv dimension): running max m, normalizer l,
   and the (block_q, D) output accumulator; finalized at the last kv step.
 
-Backward runs the dense XLA vjp over a recompute (flash-backward is a
-follow-up); forward activation memory is still O(T·D) because only the
-output is saved.
+Backward is the blocked flash recurrence (lax.scan over K/V blocks using
+the saved per-row logsumexp) — O(T·block) live memory, never the dense
+(T, T) matrix; residuals are (q, k, v, out, lse), all O(T·D).
 
 On CPU tests the kernel runs in interpret mode; on TPU it compiles with
 MXU-aligned (128, 128) blocks.
@@ -31,8 +31,8 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                  scale: float, causal: bool, block_q: int, block_k: int,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+                  *, scale: float, causal: bool, block_q: int, block_k: int,
                   kv_offset: int):
     i = pl.program_id(1)
     j = pl.program_id(2)
@@ -89,9 +89,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         l = l_ref[:, :]
         safe = jnp.where(l > 0, l, 1.0)  # fully-masked rows emit 0
         o_ref[0, :, :] = (acc_ref[:, :] / safe).astype(o_ref.dtype)
+        # per-row logsumexp of the scores: the backward pass reconstructs
+        # p = exp(s - lse) from it without rerunning the online softmax;
+        # dead rows keep lse = _NEG_INF (exp never sees it — guarded there)
+        lse_ref[0, :, :] = jnp.where(l > 0, m_ref[:, :] + jnp.log(safe),
+                                     _NEG_INF)
 
 
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    """Returns (out, lse); lse is the per-row score logsumexp (bh, t, 1)."""
     bh, t, d = q.shape
     tk = k.shape[1]
     grid = (bh, t // block_q, tk // block_k)
@@ -100,14 +106,18 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
                                kv_offset=tk - t)
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        out_shape=[jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+                   jax.ShapeDtypeStruct((bh, t, 1), jnp.float32)],
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -119,28 +129,62 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                          interpret)
+    out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                            interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
-
-
-def _dense_ref(q, k, v, causal, scale):
-    """One source of truth: the dense XLA path on head-expanded inputs."""
-    from bigdl_tpu.nn.attention import dot_product_attention
-
-    return dot_product_attention(q[:, None], k[:, None], v[:, None],
-                                 causal=causal, scale=scale)[:, 0]
+    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                              interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q_, k_, v_: _dense_ref(q_, k_, v_, causal, scale),
-                     q, k, v)
-    return vjp(g)
+    """Blocked flash backward (pure XLA, lax.scan over kv blocks): memory
+    O(T·block_k) instead of the dense O(T²) score matrix. Standard
+    recurrence: with P = exp(S - lse) and D = rowsum(dO ∘ O),
+      dS = P ∘ (dO Vᵀ − D) · scale,  dQ = Σ_j dS_j K_j,
+      dK_j = dS_jᵀ Q,  dV_j = P_jᵀ dO.
+    """
+    q, k, v, out, lse = res
+    bh, t, d = q.shape
+    tk = k.shape[1]
+    kv_offset = tk - t
+    qf = q.astype(jnp.float32)
+    do = g.astype(jnp.float32)
+    dD = jnp.sum(do * out.astype(jnp.float32), axis=-1, keepdims=True)
+    # dead rows (lse == -inf) contribute nothing; neutralize the exp
+    dead = lse <= _NEG_INF / 2
+    lse_safe = jnp.where(dead, 0.0, lse)
+    rows = jnp.arange(t)
+    n_kb = tk // block_k
+    kb = k.reshape(bh, n_kb, block_k, d).astype(jnp.float32)
+    vb = v.reshape(bh, n_kb, block_k, d).astype(jnp.float32)
+
+    def one_block(dq_acc, blk):
+        j, k_j, v_j = blk
+        s = jnp.einsum("btd,bkd->btk", qf, k_j) * scale
+        p = jnp.exp(s - lse_safe)
+        if causal:
+            cols = j * block_k + jnp.arange(block_k)
+            live = rows[:, None] + kv_offset >= cols[None, :]
+            p = jnp.where(live[None], p, 0.0)
+        p = jnp.where(dead, 0.0, p)
+        dp = jnp.einsum("btd,bkd->btk", do, v_j)
+        ds = p * (dp - dD) * scale
+        dq_acc = dq_acc + jnp.einsum("btk,bkd->btd", ds, k_j)
+        dk_j = jnp.einsum("btk,btd->bkd", ds, qf)
+        dv_j = jnp.einsum("btk,btd->bkd", p, do)
+        return dq_acc, (dk_j, dv_j)
+
+    dq0 = jnp.zeros((bh, t, d), jnp.float32)
+    dq, (dk_b, dv_b) = jax.lax.scan(
+        one_block, dq0,
+        (jnp.arange(n_kb), jnp.moveaxis(kb, 1, 0), jnp.moveaxis(vb, 1, 0)))
+    dk = jnp.moveaxis(dk_b, 0, 1).reshape(bh, tk, d)
+    dv = jnp.moveaxis(dv_b, 0, 1).reshape(bh, tk, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

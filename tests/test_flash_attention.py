@@ -135,3 +135,30 @@ def float_sum(x):
     import jax.numpy as jnp
 
     return jnp.sum(x)
+
+
+@pytest.mark.parametrize("tq,tk,causal", [(16, 16, False), (16, 16, True),
+                                          (16, 8, True), (8, 16, True)])
+def test_blocked_backward_matches_dense_grads(tq, tk, causal):
+    # flash backward is the blocked lax.scan recurrence over the saved
+    # logsumexp — it must reproduce the dense path's gradients exactly,
+    # including rows that attend zero keys (tq > tk causal)
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn.attention import dot_product_attention
+
+    k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(0), 4)
+    q = jax.random.normal(k1, (2, 2, tq, 8))
+    k = jax.random.normal(k2, (2, 2, tk, 8))
+    v = jax.random.normal(k3, (2, 2, tk, 8))
+    g = jax.random.normal(k4, (2, 2, tq, 8))
+    f = lambda *a: jnp.sum(flash_attention(*a, causal=causal,  # noqa: E731
+                                           block_q=8, block_k=8) * g)
+    r = lambda *a: jnp.sum(dot_product_attention(  # noqa: E731
+        *a, causal=causal) * g)
+    gf = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(r, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-5)
