@@ -105,10 +105,12 @@ class Linear(Module):
 
 
 class SpatialConvolution(Module):
-    """Int8 NCHW conv (≙ nn/quantized/SpatialConvolution.scala)."""
+    """Int8 conv, NCHW or NHWC (≙ nn/quantized/SpatialConvolution.scala;
+    the float layer's ``format`` carries over so NHWC models quantize to
+    NHWC int8 convs)."""
 
     def __init__(self, weight_q, w_scale, bias, stride, padding, n_group,
-                 dilation=(1, 1)):
+                 dilation=(1, 1), format: str = "NCHW"):
         super().__init__()
         self.register_buffer("weight_q", jnp.asarray(weight_q, jnp.int8))
         self.register_buffer("w_scale", jnp.asarray(w_scale, jnp.float32))
@@ -119,6 +121,7 @@ class SpatialConvolution(Module):
         self.padding = tuple(padding)
         self.n_group = n_group
         self.dilation = tuple(dilation)
+        self.format = bt_conv._check_format(format)
 
     @classmethod
     def from_float(cls, m: bt_conv.SpatialConvolution) -> "SpatialConvolution":
@@ -127,7 +130,8 @@ class SpatialConvolution(Module):
         dil = (getattr(m, "dilation_h", 1), getattr(m, "dilation_w", 1))
         return cls(w_q, scale, m.bias if m.with_bias else None,
                    (m.stride_h, m.stride_w), (m.pad_h, m.pad_w),
-                   m.n_group, dil).set_name(m.get_name())
+                   m.n_group, dil,
+                   format=getattr(m, "format", "NCHW")).set_name(m.get_name())
 
     def forward(self, input):
         squeeze = input.ndim == 3
@@ -136,15 +140,18 @@ class SpatialConvolution(Module):
         acc = lax.conv_general_dilated(
             x_q, self.weight_q,
             window_strides=self.stride,
-            padding=((self.padding[0], self.padding[0]),
-                     (self.padding[1], self.padding[1])),
+            # -1 means SAME, like the float layer (conv.py _pair_pad)
+            padding=bt_conv._pair_pad(self.padding[0], self.padding[1]),
             rhs_dilation=self.dilation,
+            dimension_numbers=(self.format, "OIHW", self.format),
             feature_group_count=self.n_group,
             preferred_element_type=jnp.int32)
-        scale = (x_scale * self.w_scale[:, 0, 0, 0])[None, :, None, None]
+        ch = ((None, slice(None), None, None) if self.format == "NCHW"
+              else (None, None, None, slice(None)))
+        scale = (x_scale * self.w_scale[:, 0, 0, 0])[ch]
         out = acc.astype(jnp.float32) * scale
         if self.has_bias:
-            out = out + self.bias[None, :, None, None]
+            out = out + self.bias[ch]
         out = out.astype(input.dtype)
         return out[0] if squeeze else out
 

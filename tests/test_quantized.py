@@ -167,3 +167,40 @@ def test_end_to_end_accuracy_drop_on_lenet():
     qmodel = Quantizer.quantize(trained)
     acc_q = top1(qmodel)
     assert acc_f - acc_q <= 0.02, (acc_f, acc_q)
+
+
+def test_quantized_conv_nhwc_matches_nchw():
+    # the float layer's NHWC format must carry into the int8 swap
+    import jax
+
+    from bigdl_tpu.nn import conv as bt_conv
+    from bigdl_tpu.nn.quantized import SpatialConvolution as QConv
+
+    m = bt_conv.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1, format="NHWC")
+    q = QConv.from_float(m)
+    assert q.format == "NHWC"
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 3))
+    out = q(x)
+    assert out.shape == (2, 8, 8, 8)
+
+    m_nchw = bt_conv.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1)
+    m_nchw.weight = m.weight
+    m_nchw.bias = m.bias
+    q_nchw = QConv.from_float(m_nchw)
+    ref = q_nchw(jnp.transpose(x, (0, 3, 1, 2)))
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(jnp.transpose(ref, (0, 2, 3, 1))),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_quantized_conv_same_padding():
+    # pad=-1 means SAME (reference convention); must not become crop-by-1
+    import jax
+
+    from bigdl_tpu.nn import conv as bt_conv
+    from bigdl_tpu.nn.quantized import SpatialConvolution as QConv
+
+    m = bt_conv.SpatialConvolution(3, 4, 3, 3, 1, 1, -1, -1)
+    q = QConv.from_float(m)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 3, 8, 8))
+    assert q(x).shape == m(x).shape == (2, 4, 8, 8)
