@@ -15,7 +15,10 @@ The reference's ``*Grad`` loaders (ReluGrad, MaxPoolGrad, Conv2DBackprop*,
 FusedBatchNormGrad, ... — 18 files under utils/tf/loaders/) are absorbed by
 design: training an imported graph goes through JAX autodiff over the
 forward program (utils/tf_session.py), so hand-written gradient ops are
-never imported.
+never imported. Likewise the queue/reader input-pipeline loaders
+(QueueDequeue*/QueueEnqueue*/ReaderReadV2) — the reference splices RDDs in
+their place (Session.scala adjustInputNames); here Session.train feeds a
+DataSet directly at the placeholder boundary.
 """
 
 from __future__ import annotations
@@ -816,6 +819,54 @@ class TensorflowLoader:
                        padding=n.attr_s("padding") or "SAME")
             return _Fn(lambda x, m=mod, f=jnp.asarray(filt): m([x, f])
                        ).set_name(n.name).inputs(prev(0))
+        if op in ("DecodeJpeg", "DecodePng", "DecodeImage", "DecodeGif"):
+            channels = n.attr_i("channels", 0)
+            # DecodeImage honors a dtype attr (convert_image_dtype semantics)
+            want_dtype = n.attr_type("dtype") if op == "DecodeImage" else None
+
+            def _scalar_bytes(x):
+                if isinstance(x, (bytes, bytearray)):
+                    return bytes(x)
+                if isinstance(x, str):
+                    return x.encode("latin-1")
+                return np.asarray(x, object).reshape(-1)[0]
+
+            def _frame(img, ch):
+                # ch == 0 keeps the file's own channel count (TF semantics);
+                # palette images expand to RGB like TF does
+                if ch == 1:
+                    img = img.convert("L")
+                elif ch == 4:
+                    img = img.convert("RGBA")
+                elif ch == 3 or img.mode == "P":
+                    img = img.convert("RGB")
+                arr = np.asarray(img)
+                if arr.ndim == 2:
+                    arr = arr[:, :, None]
+                return arr
+
+            def decode(x, ch=channels):
+                import io
+
+                from PIL import Image
+
+                img = Image.open(io.BytesIO(_scalar_bytes(x)))
+                animated = getattr(img, "n_frames", 1) > 1
+                if op == "DecodeGif" or (op == "DecodeImage" and animated):
+                    # 4-D (frames, H, W, 3): TF expands animations
+                    frames = []
+                    for f in range(getattr(img, "n_frames", 1)):
+                        img.seek(f)
+                        frames.append(np.asarray(img.convert("RGB")))
+                    arr = np.stack(frames)
+                else:
+                    arr = _frame(img, ch)
+                if want_dtype is not None and np.issubdtype(want_dtype,
+                                                            np.floating):
+                    arr = arr.astype(np.float32) / 255.0
+                return jnp.asarray(arr)
+
+            return unary(decode)
         if op == "Pad":
             pads = const_of(data_inputs[1])
             p = tuple((int(a), int(b)) for a, b in np.asarray(pads))

@@ -502,3 +502,40 @@ def test_bias_add_v1_matches_tf(tmp_path):
 
     x = np.random.RandomState(9).randn(2, 2).astype(np.float32)
     run_both(tmp_path, f, x)
+
+
+def test_decode_png_matches_tf(tmp_path):
+    # host-side image decode (utils/tf/loaders/DecodePng.scala analog)
+    rgb = (np.random.RandomState(11).rand(6, 5, 3) * 255).astype(np.uint8)
+    png_bytes = tf.io.encode_png(tf.constant(rgb)).numpy()
+
+    @tf.function(input_signature=[tf.TensorSpec([], tf.string)])
+    def f(x):
+        return tf.cast(tf.io.decode_png(x, channels=3), tf.float32)
+
+    pb = str(tmp_path / "d.pb")
+    freeze(f, pb)
+    ref = f(tf.constant(png_bytes)).numpy()
+    model = load_tf(pb, ["x"], ["Identity"])
+    model.evaluate()
+    got = np.asarray(model(png_bytes), np.float32)
+    np.testing.assert_allclose(ref, got)
+
+
+def test_decode_png_grayscale_native_channels(tmp_path):
+    # channels=0 keeps the file's own channel count (here grayscale -> 1)
+    gray = (np.random.RandomState(12).rand(4, 4, 1) * 255).astype(np.uint8)
+    png_bytes = tf.io.encode_png(tf.constant(gray)).numpy()
+
+    @tf.function(input_signature=[tf.TensorSpec([], tf.string)])
+    def f(x):
+        return tf.cast(tf.io.decode_png(x), tf.float32)
+
+    pb = str(tmp_path / "g.pb")
+    freeze(f, pb)
+    ref = f(tf.constant(png_bytes)).numpy()
+    model = load_tf(pb, ["x"], ["Identity"])
+    model.evaluate()
+    got = np.asarray(model(png_bytes), np.float32)
+    assert got.shape == ref.shape == (4, 4, 1)
+    np.testing.assert_allclose(ref, got)
