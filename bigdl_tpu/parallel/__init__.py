@@ -12,3 +12,5 @@ from bigdl_tpu.parallel.ring_attention import ring_attention, ulysses_attention
 from bigdl_tpu.parallel.tp import (
     spec_for_params, transformer_tp_rules, shard_params,
 )
+from bigdl_tpu.parallel.pipeline import pipeline_spmd, stack_stage_params
+from bigdl_tpu.parallel.moe import MoEMLP, moe_spmd

@@ -1,0 +1,89 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a mesh axis.
+
+No reference analog (the reference's only parallelism is synchronous data
+parallelism, SURVEY.md §2.5) — this is beyond-parity capability from the
+driver contract (tp/pp/dp/sp/ep). Design is the standard SPMD pipelining
+recipe (scaling-book "pipelining" chapter shape): every device holds ONE
+stage's parameters (a shard of a stacked params pytree), activations
+rotate down the ring via ``lax.ppermute`` once per tick, and a
+``lax.scan`` over ``n_micro + n_stages - 1`` ticks drives the schedule.
+Bubbles are computed-but-masked (SPMD lockstep; the same trade every
+GPipe implementation makes). Autodiff flows through scan + ppermute, so
+jax.grad of a pipelined loss is the correct pipelined backward.
+
+Use inside ``shard_map`` over the pipe axis:
+
+    stacked = stack_stage_params([blk.params_dict() for blk in blocks])
+    # shard stacked over 'pipe' (leading stage dim), x replicated
+    y = pipeline_spmd(stage_fn, my_stage_params, x, 'pipe', n_micro)
+
+Constraint: every stage must map activations to the SAME shape/dtype
+(true for transformer blocks, the realistic pipeline workload).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def stack_stage_params(stage_params: List):
+    """Stack S same-structure pytrees into one pytree with a leading stage
+    dim — shard that dim over the pipe axis so each device holds its stage."""
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves), *stage_params)
+
+
+def pipeline_spmd(stage_fn: Callable, my_params, x, axis_name: str,
+                  n_microbatches: int):
+    """Run the pipelined forward inside shard_map.
+
+    ``stage_fn(params, x_micro) -> y_micro`` is one stage; ``my_params`` is
+    this device's stage params (the shard_map-sliced stage dim, squeezed or
+    not — a leading dim of 1 is squeezed here); ``x`` is the full
+    (replicated) batch (B, ...); returns the full (B, ...) output, valid on
+    every device (masked psum broadcast from the last stage).
+    """
+    s = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    m = n_microbatches
+    b = x.shape[0]
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by n_microbatches {m}")
+    my_params = jax.tree.map(
+        lambda a: a[0] if a.ndim and a.shape[0] == 1 else a, my_params)
+    xm = x.reshape((m, b // m) + x.shape[1:])
+
+    # stage i sends to i+1; the wrap-around edge feeds stage 0, which
+    # ignores it (selects the fresh microbatch instead)
+    perm = [(i, (i + 1) % s) for i in range(s)]
+    ticks = m + s - 1
+
+    def tick(carry, t):
+        buf, ys = carry
+        x_t = lax.dynamic_index_in_dim(xm, jnp.clip(t, 0, m - 1), 0,
+                                       keepdims=False)
+        feed = jnp.where(t < m, x_t, jnp.zeros_like(x_t))
+        inp = jnp.where(idx == 0, feed, buf)
+        out = stage_fn(my_params, inp)
+        # collect the microbatch leaving the LAST stage at this tick
+        mb = t - (s - 1)
+        valid = jnp.logical_and(mb >= 0, jnp.logical_and(mb < m, idx == s - 1))
+        upd = lax.dynamic_update_index_in_dim(
+            ys, out, jnp.clip(mb, 0, m - 1), 0)
+        ys = jnp.where(valid, upd, ys)
+        return (lax.ppermute(out, axis_name, perm), ys), None
+
+    probe = jax.eval_shape(stage_fn, my_params, xm[0])
+    # the carry is device-varying (each device holds different activations):
+    # mark it so under shard_map's manual-axes tracking
+    buf0 = lax.pcast(jnp.zeros(probe.shape, probe.dtype), (axis_name,),
+                     to="varying")
+    ys0 = lax.pcast(jnp.zeros((m,) + probe.shape, probe.dtype), (axis_name,),
+                    to="varying")
+    (_, ys), _ = lax.scan(tick, (buf0, ys0), jnp.arange(ticks))
+    # broadcast the last stage's collected outputs to every device
+    ys = lax.psum(jnp.where(idx == s - 1, ys, jnp.zeros_like(ys)), axis_name)
+    return ys.reshape((b,) + ys.shape[2:])

@@ -51,7 +51,7 @@ from bigdl_tpu.utils import protowire as pw
 
 # tensorflow dtype enum (subset); 7 = DT_STRING (object arrays of bytes)
 _DT = {1: np.float32, 2: np.float64, 3: np.int32, 4: np.uint8, 6: np.int8,
-       7: object, 9: np.int64, 10: bool}
+       7: object, 9: np.int64, 10: bool, 17: np.uint16}
 
 
 def _parse_tensor(tensor_bytes: bytes) -> np.ndarray:
@@ -851,9 +851,10 @@ class TensorflowLoader:
                 from PIL import Image
 
                 img = Image.open(io.BytesIO(_scalar_bytes(x)))
-                animated = getattr(img, "n_frames", 1) > 1
-                if op == "DecodeGif" or (op == "DecodeImage" and animated):
-                    # 4-D (frames, H, W, 3): TF expands animations
+                is_gif = (img.format or "").upper() == "GIF"
+                if op == "DecodeGif" or (op == "DecodeImage" and is_gif):
+                    # 4-D (frames, H, W, 3): TF expands animations — GIFs
+                    # are rank-4 even with a single frame
                     frames = []
                     for f in range(getattr(img, "n_frames", 1)):
                         img.seek(f)
@@ -861,9 +862,15 @@ class TensorflowLoader:
                     arr = np.stack(frames)
                 else:
                     arr = _frame(img, ch)
-                if want_dtype is not None and np.issubdtype(want_dtype,
-                                                            np.floating):
-                    arr = arr.astype(np.float32) / 255.0
+                # DecodeImage applies convert_image_dtype semantics
+                if want_dtype is not None and arr.dtype != want_dtype:
+                    src_max = np.iinfo(arr.dtype).max
+                    if np.issubdtype(want_dtype, np.floating):
+                        arr = arr.astype(np.float32) / src_max
+                    elif np.issubdtype(want_dtype, np.integer):
+                        dst_max = np.iinfo(want_dtype).max
+                        arr = (arr.astype(np.int64)
+                               * (dst_max // src_max)).astype(want_dtype)
                 return jnp.asarray(arr)
 
             return unary(decode)
