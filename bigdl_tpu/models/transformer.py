@@ -28,7 +28,8 @@ class TransformerLM(Module):
                  dropout: float = 0.0, causal: bool = True,
                  sequence_parallel: Optional[str] = None,
                  tie_embeddings: bool = True, use_flash: bool = False,
-                 remat: bool = False):
+                 remat: bool = False, n_experts: int = 0,
+                 expert_parallel: Optional[str] = None):
         super().__init__()
         self.vocab_size = vocab_size
         self.embed_dim = embed_dim
@@ -43,7 +44,8 @@ class TransformerLM(Module):
                     TransformerBlock(embed_dim, num_heads, mlp_ratio=mlp_ratio,
                                      dropout=dropout, causal=causal,
                                      sequence_parallel=sequence_parallel,
-                                     use_flash=use_flash))
+                                     use_flash=use_flash, n_experts=n_experts,
+                                     expert_parallel=expert_parallel))
         self.ln_f = LayerNorm(embed_dim)
         if not tie_embeddings:
             self.head = nn.Linear(embed_dim, vocab_size, with_bias=False)
@@ -66,24 +68,36 @@ class TransformerLM(Module):
             pos0 = 0
         pos = jax.lax.dynamic_slice_in_dim(self.pos_embed, pos0, t, axis=0)
         x = x + pos[None]
+        aux_total = 0.0
         for i in range(self.num_layers):
             blk = getattr(self, f"block{i}")
             if self.remat:
                 # the block's RNG draws must cross the checkpoint boundary as
-                # an explicit argument: splitting the ambient stream inside
-                # the remat trace would leak its tracer into global state
+                # an explicit ARGUMENT and the MoE aux loss as an explicit
+                # OUTPUT: stashing either through global/module state inside
+                # the remat trace would leak its tracers
                 from bigdl_tpu.utils import random as bt_random
 
                 def run(t, kk, b=blk):
                     bt_random.RNG.push_key(kk)
                     try:
-                        return b(t)
+                        out = b(t)
                     finally:
                         bt_random.RNG.pop_key()
+                    aux = b.mlp.l_aux if b.n_experts > 0 else 0.0
+                    return out, aux
 
-                x = jax.checkpoint(run)(x, bt_random.next_key())
+                x, aux = jax.checkpoint(run)(x, bt_random.next_key())
+                aux_total = aux_total + aux
             else:
                 x = blk(x)
+                if blk.n_experts > 0:
+                    aux_total = aux_total + blk.mlp.l_aux
+        #: summed MoE load-balancing loss of this forward; read it inside
+        #: the same trace (add ``model.l_aux`` to the objective). Valid in
+        #: both remat modes — unlike block.mlp.l_aux, which holds a dead
+        #: inner tracer under remat.
+        self.l_aux = aux_total
         x = self.ln_f(x)
         if self.tie_embeddings:
             logits = jnp.einsum("btc,vc->btv", x, self.tok_embed)

@@ -131,12 +131,16 @@ class MultiHeadAttention(Module):
 
 class TransformerBlock(Module):
     """Pre-norm block: x + MHA(LN(x)); x + MLP(LN(x)). GELU MLP sized
-    ``mlp_ratio``× embed."""
+    ``mlp_ratio``× embed. ``n_experts > 0`` swaps the dense MLP for a
+    top-1 mixture of experts (parallel/moe.py MoEMLP); read the summed
+    load-balancing loss from ``TransformerLM.l_aux`` (valid in both plain
+    and remat modes) — ``block.mlp.l_aux`` is only safe without remat."""
 
     def __init__(self, embed_dim: int, num_heads: int, mlp_ratio: int = 4,
                  dropout: float = 0.0, causal: bool = True,
                  sequence_parallel: Optional[str] = None,
-                 use_flash: bool = False):
+                 use_flash: bool = False, n_experts: int = 0,
+                 expert_parallel: Optional[str] = None):
         super().__init__()
         self.ln1 = LayerNorm(embed_dim)
         self.attn = MultiHeadAttention(embed_dim, num_heads, dropout=dropout,
@@ -144,8 +148,15 @@ class TransformerBlock(Module):
                                        sequence_parallel=sequence_parallel,
                                        use_flash=use_flash)
         self.ln2 = LayerNorm(embed_dim)
-        self.fc1 = Linear(embed_dim, mlp_ratio * embed_dim)
-        self.fc2 = Linear(mlp_ratio * embed_dim, embed_dim)
+        self.n_experts = n_experts
+        if n_experts > 0:
+            from bigdl_tpu.parallel.moe import MoEMLP
+
+            self.mlp = MoEMLP(embed_dim, mlp_ratio * embed_dim, n_experts,
+                              expert_parallel=expert_parallel)
+        else:
+            self.fc1 = Linear(embed_dim, mlp_ratio * embed_dim)
+            self.fc2 = Linear(mlp_ratio * embed_dim, embed_dim)
         if dropout > 0:
             self.drop = Dropout(dropout)
         self.dropout_p = dropout
@@ -153,9 +164,12 @@ class TransformerBlock(Module):
     def forward(self, input):
         x = input + self.attn(self.ln1(input))
         b, t, c = x.shape
-        h = self.fc1(self.ln2(x).reshape(b * t, c))
-        h = jax.nn.gelu(h)
-        h = self.fc2(h).reshape(b, t, c)
+        if self.n_experts > 0:
+            h = self.mlp(self.ln2(x))  # MoEMLP flattens/restores internally
+        else:
+            h = self.fc1(self.ln2(x).reshape(b * t, c))
+            h = jax.nn.gelu(h)
+            h = self.fc2(h).reshape(b, t, c)
         if self.dropout_p > 0:
             h = self.drop(h)
         return x + h
