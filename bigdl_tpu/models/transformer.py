@@ -22,6 +22,10 @@ class TransformerLM(Module):
     """Decoder-only LM. Input: (batch, time) int32 token ids (0-based).
     Output: (batch, time, vocab) logits."""
 
+    #: summed MoE load-balancing loss of the last forward (0.0 until a
+    #: forward runs, and always 0.0 for dense models)
+    l_aux = 0.0
+
     def __init__(self, vocab_size: int, embed_dim: int = 256,
                  num_heads: int = 8, num_layers: int = 4,
                  max_len: int = 1024, mlp_ratio: int = 4,
@@ -50,6 +54,7 @@ class TransformerLM(Module):
         if not tie_embeddings:
             self.head = nn.Linear(embed_dim, vocab_size, with_bias=False)
         self.num_layers = num_layers
+        self.n_experts = n_experts
         #: rematerialize each block in backward (jax.checkpoint): activation
         #: memory drops from O(layers * T * D) to O(T * D) at ~1.3x FLOPs —
         #: the standard long-context trade. Key-splitting happens at trace
@@ -78,26 +83,34 @@ class TransformerLM(Module):
                 # the remat trace would leak its tracers
                 from bigdl_tpu.utils import random as bt_random
 
-                def run(t, kk, b=blk):
+                moe = blk.n_experts > 0
+
+                def run(t, kk, b=blk, moe=moe):
                     bt_random.RNG.push_key(kk)
                     try:
                         out = b(t)
                     finally:
                         bt_random.RNG.pop_key()
-                    aux = b.mlp.l_aux if b.n_experts > 0 else 0.0
-                    return out, aux
+                    # aux loss leaves the checkpoint as an explicit output;
+                    # dense blocks return only x (no spurious tracer)
+                    return (out, b.mlp.l_aux) if moe else out
 
-                x, aux = jax.checkpoint(run)(x, bt_random.next_key())
-                aux_total = aux_total + aux
+                res = jax.checkpoint(run)(x, bt_random.next_key())
+                if moe:
+                    x, aux = res
+                    aux_total = aux_total + aux
+                else:
+                    x = res
             else:
                 x = blk(x)
                 if blk.n_experts > 0:
                     aux_total = aux_total + blk.mlp.l_aux
-        #: summed MoE load-balancing loss of this forward; read it inside
-        #: the same trace (add ``model.l_aux`` to the objective). Valid in
-        #: both remat modes — unlike block.mlp.l_aux, which holds a dead
-        #: inner tracer under remat.
-        self.l_aux = aux_total
+        if self.n_experts > 0:
+            # summed MoE load-balancing loss of this forward; read it inside
+            # the same trace (add ``model.l_aux`` to the objective). Valid in
+            # both remat modes — unlike block.mlp.l_aux, which holds a dead
+            # inner tracer under remat.
+            self.l_aux = aux_total
         x = self.ln_f(x)
         if self.tie_embeddings:
             logits = jnp.einsum("btc,vc->btv", x, self.tok_embed)

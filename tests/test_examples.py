@@ -70,3 +70,15 @@ def test_mlpipeline_example():
 
     acc = main(["--rows", "96", "--epochs", "20"])
     assert acc > 0.7, acc
+
+
+def test_longcontext_example():
+    # tiny config: remat + MoE + 2-way sequence parallel on the CPU mesh
+    from bigdl_tpu.example.longcontext import train as lc
+
+    losses = lc.main(["--seq-len", "32", "--batch", "2", "--layers", "1",
+                      "--embed", "16", "--heads", "2", "--vocab", "32",
+                      "--steps", "3", "--experts", "2",
+                      "--seq-parallel", "2"])
+    assert len(losses) == 3
+    assert losses[-1] < losses[0]

@@ -196,3 +196,24 @@ def test_transformer_lm_with_moe_trains(remat):
         assert np.isfinite(np.asarray(leaf)).all()
     # gate gradient must be nonzero: the aux loss trains the router
     assert float(jnp.abs(g["block0"]["mlp"]["~params"]["gate_w"]).sum()) > 0
+
+
+def test_dense_remat_model_clean_after_jitted_forward():
+    # regression: the remat aux threading must not stash a dead tracer in
+    # l_aux for DENSE models (n_experts=0) — clone/pickle stay usable
+    import pickle
+
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.nn.module import pure_apply
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(0)
+    m = TransformerLM(32, embed_dim=16, num_heads=2, num_layers=1,
+                      max_len=8, remat=True)
+    assert float(m.l_aux) == 0.0  # readable before any forward
+    fn = pure_apply(m)
+    ids = jnp.arange(8)[None] % 32
+    jax.jit(lambda p: fn(p, {}, ids, rng=jax.random.PRNGKey(0),
+                         training=True)[0])(m.params_dict())
+    m.clone_module()
+    pickle.dumps(float(m.l_aux))
