@@ -136,6 +136,9 @@ def run_perf(model_name: str = None, batch_size: int = 32,
                 raise ValueError(
                     "the transformer bench fixes its own next-token CE loss; "
                     "custom criterion is not supported")
+            # format applies to conv models only; tokens have no layout
+            if format not in ("NCHW", None):
+                log(f"[perf] note: format={format!r} ignored for transformer")
             return _transformer_perf(batch_size, iterations, warmup, dtype,
                                      log, master_f32=master_f32,
                                      profile_dir=profile_dir)

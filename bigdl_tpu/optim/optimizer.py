@@ -290,10 +290,18 @@ class Optimizer:
         return self
 
     def set_checkpoint(self, path: str, trigger: Trigger,
-                       is_overwrite: bool = True) -> "Optimizer":
+                       is_overwrite: bool = True,
+                       async_write: bool = False) -> "Optimizer":
+        """``async_write=True`` snapshots synchronously (consistent model +
+        optim-method state) but performs serialization/IO in a background
+        thread, so the train loop is not stalled by checkpoint writes; at
+        most one write is in flight (the next checkpoint joins it first,
+        surfacing any write error), and ``optimize()`` joins before
+        returning."""
         self.checkpoint_path = path
         self.checkpoint_trigger = trigger
         self.checkpoint_overwrite = is_overwrite
+        self.checkpoint_async = async_write
         return self
 
     def set_train_summary(self, summary) -> "Optimizer":
@@ -359,6 +367,17 @@ class LocalOptimizer(Optimizer):
         data_iter = self._minibatches(self.dataset, self.batch_size)
         wall_start = time.time()
 
+        try:
+            return self._optimize_loop(
+                model, state, params, buffers, ts, slots, train_step,
+                num_samples, data_iter, wall_start)
+        finally:
+            # even on an exception mid-training, never abandon an in-flight
+            # async checkpoint write (the one run where it matters most)
+            self.join_pending_checkpoint()
+
+    def _optimize_loop(self, model, state, params, buffers, ts, slots,
+                       train_step, num_samples, data_iter, wall_start):
         while not self.end_when(state):
             try:
                 batch = next(data_iter)
@@ -416,7 +435,7 @@ class LocalOptimizer(Optimizer):
 
         model.load_params_dict(params)
         model.load_buffers_dict(buffers)
-        return model
+        return model  # caller's finally joins any pending checkpoint write
 
     # ------------------------------------------------------------- aux steps
     def _should_fire_aux(self, state) -> bool:
@@ -456,8 +475,50 @@ class LocalOptimizer(Optimizer):
         tag = f"{state['neval'] - 1}"
         from bigdl_tpu.utils import file as bt_file
 
-        bt_file.save_module(
-            self.model, os.path.join(self.checkpoint_path, f"model.{tag}"),
-            overwrite=True)
-        self.optim_method.save(
-            os.path.join(self.checkpoint_path, f"optimMethod.{tag}"), overwrite=True)
+        if not getattr(self, "checkpoint_async", False):
+            bt_file.save_module(
+                self.model,
+                os.path.join(self.checkpoint_path, f"model.{tag}"),
+                overwrite=True)
+            self.optim_method.save(
+                os.path.join(self.checkpoint_path, f"optimMethod.{tag}"),
+                overwrite=True)
+            return
+        import copy
+        import threading
+
+        self.join_pending_checkpoint()  # one in flight; surface write errors
+        # snapshot NOW (jax arrays are immutable, so deepcopy captures a
+        # consistent instant); the thread only serializes and writes
+        model_snap = self.model.clone_module()
+        method_snap = copy.deepcopy(self.optim_method)
+        path = self.checkpoint_path
+
+        def write():
+            # write-then-rename: a crash mid-write never leaves a torn
+            # model.{tag} as the newest checkpoint on disk
+            try:
+                mtmp = os.path.join(path, f".model.{tag}.tmp")
+                otmp = os.path.join(path, f".optimMethod.{tag}.tmp")
+                bt_file.save_module(model_snap, mtmp, overwrite=True)
+                method_snap.save(otmp, overwrite=True)
+                os.replace(mtmp, os.path.join(path, f"model.{tag}"))
+                os.replace(otmp, os.path.join(path, f"optimMethod.{tag}"))
+            except BaseException as e:  # re-raised at the next join
+                self._ckpt_error = e
+
+        t = threading.Thread(target=write, daemon=True, name=f"ckpt-{tag}")
+        t.start()
+        self._ckpt_thread = t
+
+    def join_pending_checkpoint(self):
+        """Wait for an in-flight async checkpoint write and re-raise any
+        error it hit (no-op when nothing is pending)."""
+        t = getattr(self, "_ckpt_thread", None)
+        if t is not None:
+            t.join()
+            self._ckpt_thread = None
+        err = getattr(self, "_ckpt_error", None)
+        if err is not None:
+            self._ckpt_error = None
+            raise RuntimeError("async checkpoint write failed") from err

@@ -291,6 +291,12 @@ class DistriOptimizer(LocalOptimizer):
                     raise
                 from bigdl_tpu.optim.optimizer import load_latest_checkpoint
 
+                # never read a checkpoint an async writer is still producing
+                try:
+                    self.join_pending_checkpoint()
+                except Exception:
+                    logger.warning("pending async checkpoint write failed; "
+                                   "restoring from the previous snapshot")
                 model, method, tag = load_latest_checkpoint(self.checkpoint_path)
                 if model is None:
                     raise
@@ -479,4 +485,5 @@ class DistriOptimizer(LocalOptimizer):
 
         model.load_params_dict(params)
         model.load_buffers_dict(buffers_for_model(buffers))
+        self.join_pending_checkpoint()
         return model

@@ -228,3 +228,54 @@ class TestEvaluatorPredictor:
         results = model.evaluate_on(samples, [Loss(nn.ClassNLLCriterion())], batch_size=8)
         val, count = results[0][1].result()
         assert count == 16 and val > 0
+
+
+def test_async_checkpoint_writes_and_resumes(tmp_path):
+    # async_write=True: snapshots are consistent, files land on disk, and
+    # load_latest_checkpoint can resume from them
+    import jax.numpy as jnp
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.optim.optimizer import (LocalOptimizer,
+                                           load_latest_checkpoint)
+    from bigdl_tpu.optim.optim_method import SGD
+    from bigdl_tpu.optim.trigger import Trigger
+
+    rng = np.random.RandomState(0)
+    samples = [Sample(rng.randn(4).astype(np.float32),
+                      np.array([1.0 + i % 2], np.float32)) for i in range(16)]
+    model = nn.Sequential(nn.Linear(4, 2), nn.LogSoftMax())
+    opt = LocalOptimizer(model=model, training_set=samples,
+                         criterion=nn.ClassNLLCriterion(), batch_size=8,
+                         end_when=Trigger.max_iteration(4))
+    opt.set_optim_method(SGD(learning_rate=0.1))
+    opt.set_checkpoint(str(tmp_path), Trigger.several_iteration(2),
+                       async_write=True)
+    opt.optimize()  # joins pending writes before returning
+    m2, method, tag = load_latest_checkpoint(str(tmp_path))
+    assert m2 is not None and tag >= 2
+    out = m2(jnp.ones((1, 4)))
+    assert out.shape == (1, 2)
+
+
+def test_async_checkpoint_error_surfaces_on_join(tmp_path, monkeypatch):
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim.optimizer import LocalOptimizer
+    from bigdl_tpu.optim.optim_method import SGD
+    from bigdl_tpu.optim.trigger import Trigger
+    from bigdl_tpu.utils import file as bt_file
+
+    opt = LocalOptimizer(model=nn.Linear(2, 2), training_set=[],
+                         criterion=nn.MSECriterion(), batch_size=1,
+                         end_when=Trigger.max_iteration(0))
+    opt.set_optim_method(SGD(learning_rate=0.1))
+    opt.set_checkpoint(str(tmp_path), Trigger.several_iteration(1),
+                       async_write=True)
+    opt._ckpt_now = True
+    monkeypatch.setattr(bt_file, "save_module",
+                        lambda *a, **k: (_ for _ in ()).throw(OSError("disk full")))
+    opt._run_checkpoint({"neval": 2})
+    with pytest.raises(RuntimeError, match="async checkpoint write failed"):
+        opt.join_pending_checkpoint()
+    opt.join_pending_checkpoint()  # error consumed; next join is clean
