@@ -36,20 +36,26 @@ def main():
     dev = jax.devices()[0]
     print(f"device: {dev.device_kind}", file=sys.stderr)
 
-    configs = [
-        dict(model="resnet50", batch=256, format="NHWC"),
-        dict(model="resnet50", batch=512, format="NHWC"),
-        dict(model="resnet50", batch=256, format="NCHW"),
-        dict(model="resnet50", batch=128, format="NHWC"),
-        dict(model="transformer", batch=8, format="NCHW"),
-    ]
-    if args.quick:
-        configs = configs[:2]
+    if dev.platform == "cpu":  # smoke-test shapes only
+        configs = [dict(model="lenet5", batch=8, format="NCHW")]
+        args.iters = min(args.iters, 2)
+    else:
+        configs = [
+            dict(model="resnet50", batch=256, format="NHWC"),
+            dict(model="resnet50", batch=512, format="NHWC"),
+            dict(model="resnet50", batch=256, format="NCHW"),
+            dict(model="resnet50", batch=128, format="NHWC"),
+            dict(model="transformer", batch=8, format="NCHW"),
+        ]
+        if args.quick:
+            configs = configs[:2]
 
     results = []
     with open(args.out, "a") as fh:
         for cfg in configs:
             t0 = time.perf_counter()
+            cfg = dict(cfg, device=str(getattr(dev, "device_kind",
+                                               dev.platform)))
             try:
                 s = run_perf(cfg["model"], batch_size=cfg["batch"],
                              iterations=args.iters, dtype=jnp.bfloat16,
