@@ -88,12 +88,12 @@ class TransformerLM(Module):
                 def run(t, kk, b=blk, moe=moe):
                     bt_random.RNG.push_key(kk)
                     try:
-                        out = b(t)
+                        # forward_with_aux: NO module-state stash inside the
+                        # checkpoint trace; aux leaves as an explicit output
+                        out, aux = b.forward_with_aux(t)
                     finally:
                         bt_random.RNG.pop_key()
-                    # aux loss leaves the checkpoint as an explicit output;
-                    # dense blocks return only x (no spurious tracer)
-                    return (out, b.mlp.l_aux) if moe else out
+                    return (out, aux) if moe else out
 
                 res = jax.checkpoint(run)(x, bt_random.next_key())
                 if moe:
@@ -102,9 +102,11 @@ class TransformerLM(Module):
                 else:
                     x = res
             else:
-                x = blk(x)
+                # same explicit aux routing as the remat path — one
+                # convention, no side-channel dependency
+                x, aux = blk.forward_with_aux(x)
                 if blk.n_experts > 0:
-                    aux_total = aux_total + blk.mlp.l_aux
+                    aux_total = aux_total + aux
         if self.n_experts > 0:
             # summed MoE load-balancing loss of this forward; read it inside
             # the same trace (add ``model.l_aux`` to the objective). Valid in

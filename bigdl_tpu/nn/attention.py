@@ -162,14 +162,29 @@ class TransformerBlock(Module):
         self.dropout_p = dropout
 
     def forward(self, input):
+        if self.n_experts > 0:
+            out, aux = self.forward_with_aux(input)
+            self.mlp.l_aux = aux
+            return out
+        return self._forward_impl(input)[0]
+
+    def forward_with_aux(self, input):
+        """(output, moe_aux_loss) with NO side-channel stash — the remat
+        path must route the aux loss through explicit outputs (a stash
+        inside jax.checkpoint leaves a dead tracer behind)."""
+        return self._forward_impl(input)
+
+    def _forward_impl(self, input):
         x = input + self.attn(self.ln1(input))
         b, t, c = x.shape
+        aux = 0.0
         if self.n_experts > 0:
-            h = self.mlp(self.ln2(x))  # MoEMLP flattens/restores internally
+            # MoEMLP flattens/restores internally
+            h, aux = self.mlp.forward_with_aux(self.ln2(x))
         else:
             h = self.fc1(self.ln2(x).reshape(b * t, c))
             h = jax.nn.gelu(h)
             h = self.fc2(h).reshape(b, t, c)
         if self.dropout_p > 0:
             h = self.drop(h)
-        return x + h
+        return x + h, aux

@@ -98,18 +98,21 @@ class MoEMLP(Module):
         shard these over the 'expert' axis for ``moe_spmd``."""
         return {"w1": self.w1, "b1": self.b1, "w2": self.w2, "b2": self.b2}
 
-    def forward(self, input):
+    def forward_with_aux(self, input):
+        """(output, l_aux) WITHOUT the ``self.l_aux`` side-channel stash —
+        use this inside ``jax.checkpoint``/remat regions, where a stashed
+        inner tracer would outlive its trace and break clone/save later."""
         x = input
         shp = x.shape
         x2 = x.reshape(-1, self.embed_dim)
         t = x2.shape[0]
         gates = jax.nn.softmax(
             (x2 @ self.gate_w.astype(x2.dtype)).astype(jnp.float32), axis=-1)
-        self.l_aux = self._aux_loss(gates)
+        aux = self._aux_loss(gates)
         if self.expert_parallel is not None:
             out = moe_spmd(self.expert_params(), x2, gates,
                            self.expert_parallel, self.capacity_factor)
-            return out.reshape(shp).astype(x.dtype)
+            return out.reshape(shp).astype(x.dtype), aux
         capacity = max(1, math.ceil(t / self.n_experts
                                     * self.capacity_factor))
         dispatch, combine = _top1_dispatch(gates, capacity)
@@ -118,7 +121,12 @@ class MoEMLP(Module):
         expert_out = _expert_fwd(self.expert_params(), expert_in)
         out = jnp.einsum("ecd,tec->td", expert_out,
                          combine.astype(expert_out.dtype))
-        return out.reshape(shp).astype(x.dtype)
+        return out.reshape(shp).astype(x.dtype), aux
+
+    def forward(self, input):
+        out, aux = self.forward_with_aux(input)
+        self.l_aux = aux
+        return out
 
 
 def _expert_fwd(p: dict, inp):

@@ -37,6 +37,8 @@ def main():
     print(f"device: {dev.device_kind}", file=sys.stderr)
 
     if dev.platform == "cpu":  # smoke-test shapes only
+        print("[sweep] CPU backend: smoke config only (lenet5, iters<=2); "
+              "--iters/--quick apply on TPU", file=sys.stderr)
         configs = [dict(model="lenet5", batch=8, format="NCHW")]
         args.iters = min(args.iters, 2)
     else:
@@ -63,7 +65,7 @@ def main():
                              log=lambda *a, **k: print(*a, file=sys.stderr))
                 row = {**cfg, "records_per_sec": s["records_per_sec"],
                        "ms_per_iter": s["ms_per_iter"],
-                       "compile_s": s["warmup_s"],
+                       "compile_s": s["warmup_s"], "iters": args.iters,
                        "wall_s": round(time.perf_counter() - t0, 1)}
             except Exception as e:
                 row = {**cfg, "error": f"{type(e).__name__}: {e}"}

@@ -217,3 +217,24 @@ def test_dense_remat_model_clean_after_jitted_forward():
                          training=True)[0])(m.params_dict())
     m.clone_module()
     pickle.dumps(float(m.l_aux))
+
+
+def test_moe_remat_model_saves_after_eager_forward(tmp_path):
+    # regression: an EAGER forward of TransformerLM(MoE, remat=True) runs
+    # the blocks inside jax.checkpoint; the mlp must not stash the inner
+    # tracer (forward_with_aux path), or save/clone breaks afterward
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils import file as bt_file
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(0)
+    m = TransformerLM(32, embed_dim=16, num_heads=2, num_layers=1,
+                      max_len=8, n_experts=2, remat=True)
+    ids = jnp.arange(8)[None] % 32
+    out = np.asarray(m(ids))
+    assert np.isfinite(float(m.l_aux))  # model-level aux stays readable
+    path = str(tmp_path / "tlm.bin")
+    bt_file.save_module(m, path, overwrite=True)
+    m2 = bt_file.load_module(path)
+    np.testing.assert_allclose(np.asarray(m2(ids)), out, rtol=1e-5,
+                               atol=1e-6)
