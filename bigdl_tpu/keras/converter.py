@@ -6,10 +6,13 @@ Keras 1.2.2 and walks ``model.get_config()``; here we parse the SAME json
 document directly (class_name/config tree) and the Keras-1.x hdf5 weight
 layout (root attr ``layer_names``, per-layer group attr ``weight_names``).
 
-Supported layer subset mirrors the reference's converter coverage for
-Sequential models: Dense, Activation, Dropout, Flatten, Reshape,
-Convolution2D (th dim-ordering), MaxPooling2D, AveragePooling2D,
-BatchNormalization, Embedding.
+Topology (json) import covers: Dense, Activation, Dropout, Flatten,
+Reshape, Convolution1D/2D (th dim-ordering), MaxPooling1D/2D,
+AveragePooling1D/2D, Global{Max,Average}Pooling1D/2D, ZeroPadding2D
+(symmetric), UpSampling2D, BatchNormalization, Embedding, LSTM, GRU,
+SimpleRNN. hdf5 WEIGHT loading covers Dense, Convolution2D,
+BatchNormalization, Embedding — load_keras with weights fails fast
+(before mutating anything) if the model contains other weighted layers.
 """
 
 from __future__ import annotations
@@ -117,6 +120,64 @@ class DefinitionLoader:
                                 input_shape=in_shape
                                 or ((c["input_length"],)
                                     if c.get("input_length") else None))
+        def _scalar(v):
+            return v[0] if isinstance(v, (list, tuple)) else v
+
+        def _pool1d_args():
+            return (_scalar(c.get("pool_length", c.get("pool_size", 2))),
+                    _scalar(c.get("stride", c.get("strides"))))
+
+        if cls in ("Convolution1D", "Conv1D"):
+            nb = c.get("nb_filter", c.get("filters"))
+            flen = c.get("filter_length",
+                         (c.get("kernel_size") or [None])[0])
+            sub = _scalar(c.get("subsample_length", c.get("strides", 1)))
+            return bk.Convolution1D(nb, flen, subsample_length=sub,
+                                    activation=c.get("activation") or None,
+                                    input_shape=in_shape)
+        if cls == "MaxPooling1D":
+            pl, st = _pool1d_args()
+            return bk.MaxPooling1D(pool_length=pl, stride=st,
+                                   input_shape=in_shape)
+        if cls == "AveragePooling1D":
+            pl, st = _pool1d_args()
+            return bk.AveragePooling1D(pool_length=pl, stride=st,
+                                       input_shape=in_shape)
+        if cls == "GlobalMaxPooling1D":
+            return bk.GlobalMaxPooling1D(input_shape=in_shape)
+        if cls == "GlobalAveragePooling1D":
+            return bk.GlobalAveragePooling1D(input_shape=in_shape)
+        if cls == "GlobalMaxPooling2D":
+            return bk.GlobalMaxPooling2D(input_shape=in_shape)
+        if cls == "GlobalAveragePooling2D":
+            return bk.GlobalAveragePooling2D(input_shape=in_shape)
+        if cls == "ZeroPadding2D":
+            pad = c.get("padding", (1, 1))
+            if isinstance(pad, (list, tuple)) and pad and \
+                    isinstance(pad[0], (list, tuple)):
+                (t, b), (l, r) = pad
+                if t != b or l != r:
+                    raise ValueError(
+                        "asymmetric ZeroPadding2D "
+                        f"{pad} is unsupported (symmetric only)")
+                pad = (t, l)
+            return bk.ZeroPadding2D(padding=_tuplify(pad),
+                                    input_shape=in_shape)
+        if cls == "UpSampling2D":
+            return bk.UpSampling2D(size=_tuplify(c.get("size", (2, 2))),
+                                   input_shape=in_shape)
+        if cls in ("LSTM", "GRU", "SimpleRNN"):
+            units = c.get("output_dim", c.get("units"))
+            kw = dict(
+                activation=c.get("activation") or None,
+                inner_activation=(c.get("inner_activation")
+                                  or c.get("recurrent_activation") or None),
+                return_sequences=c.get("return_sequences", False),
+                go_backwards=c.get("go_backwards", False),
+                input_shape=in_shape)
+            if cls == "SimpleRNN":
+                kw.pop("inner_activation")
+            return getattr(bk, cls)(units, **kw)
         raise ValueError(f"unsupported keras layer {cls!r}")
 
 
@@ -145,8 +206,24 @@ class WeightLoader:
                 raise ValueError(
                     f"weight/layer mismatch: {len(w_groups)} weighted hdf5 "
                     f"layers vs {len(weighted)} weighted model layers")
+            # fail fast BEFORE mutating: a missing mapping mid-loop would
+            # leave the model half-loaded
+            unmapped = [type(l).__name__ for l in weighted
+                        if not _has_weight_mapping(l)]
+            if unmapped:
+                raise ValueError(
+                    "no hdf5 weight mapping for layer(s) "
+                    f"{sorted(set(unmapped))}; these import topology-only "
+                    "(json) for now")
             for layer, weights in zip(weighted, w_groups):
                 _set_layer_weights(layer, weights)
+
+
+def _has_weight_mapping(klayer) -> bool:
+    from bigdl_tpu.keras import layers as kl
+
+    return isinstance(klayer, (kl.Dense, kl.Convolution2D,
+                               kl.BatchNormalization, kl.Embedding))
 
 
 def _set_layer_weights(klayer, weights: List[np.ndarray]):

@@ -132,9 +132,12 @@ class MultiHeadAttention(Module):
 class TransformerBlock(Module):
     """Pre-norm block: x + MHA(LN(x)); x + MLP(LN(x)). GELU MLP sized
     ``mlp_ratio``× embed. ``n_experts > 0`` swaps the dense MLP for a
-    top-1 mixture of experts (parallel/moe.py MoEMLP); read the summed
-    load-balancing loss from ``TransformerLM.l_aux`` (valid in both plain
-    and remat modes) — ``block.mlp.l_aux`` is only safe without remat."""
+    top-1 mixture of experts (parallel/moe.py MoEMLP). Read the summed
+    load-balancing loss from ``TransformerLM.l_aux`` (the model routes it
+    through explicit outputs in every mode); the ``block.mlp.l_aux`` stash
+    is populated only when the BLOCK itself is called standalone via
+    ``forward`` — ``forward_with_aux`` (what TransformerLM uses) returns
+    the aux value instead of stashing."""
 
     def __init__(self, embed_dim: int, num_heads: int, mlp_ratio: int = 4,
                  dropout: float = 0.0, causal: bool = True,

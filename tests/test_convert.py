@@ -86,6 +86,45 @@ def test_keras_import_conv_topology(tmp_path):
     assert out.shape == (2, 2)
 
 
+def test_keras_import_extended_layer_set(tmp_path):
+    # round-3 converter additions: 1-D conv/pool, global pooling, padding,
+    # upsampling, recurrent layers
+    from bigdl_tpu.keras.converter import DefinitionLoader
+
+    spec = {"class_name": "Sequential", "config": [
+        {"class_name": "Convolution1D", "config": {
+            "nb_filter": 6, "filter_length": 3, "activation": "relu",
+            "batch_input_shape": [None, 12, 4]}},
+        {"class_name": "MaxPooling1D", "config": {"pool_length": 2}},
+        {"class_name": "LSTM", "config": {"output_dim": 8,
+                                          "return_sequences": True}},
+        {"class_name": "GRU", "config": {"output_dim": 5}},
+        {"class_name": "Dense", "config": {"output_dim": 3}},
+    ]}
+    model = DefinitionLoader.from_json_str(json.dumps(spec))
+    model.evaluate()
+    out = model(jnp.zeros((2, 12, 4)))
+    assert out.shape == (2, 3)
+
+    spec2 = {"class_name": "Sequential", "config": [
+        {"class_name": "ZeroPadding2D", "config": {
+            "padding": [1, 1], "batch_input_shape": [None, 2, 4, 4]}},
+        {"class_name": "UpSampling2D", "config": {"size": [2, 2]}},
+        {"class_name": "GlobalAveragePooling2D", "config": {}},
+        {"class_name": "Dense", "config": {"output_dim": 2}},
+    ]}
+    m2 = DefinitionLoader.from_json_str(json.dumps(spec2))
+    m2.evaluate()
+    assert m2(jnp.zeros((1, 2, 4, 4))).shape == (1, 2)
+
+    spec3 = {"class_name": "Sequential", "config": [
+        {"class_name": "GlobalMaxPooling1D", "config": {
+            "batch_input_shape": [None, 7, 5]}},
+    ]}
+    m3 = DefinitionLoader.from_json_str(json.dumps(spec3))
+    assert m3(jnp.zeros((2, 7, 5))).shape == (2, 5)
+
+
 # ---------------------------------------------------------------- tf export
 tf = pytest.importorskip("tensorflow")
 
@@ -279,3 +318,48 @@ def test_caffe_export_rejects_multidim_reshape(tmp_path):
     with pytest.raises(ValueError, match="collapsing"):
         save_caffe(model, str(tmp_path / "a.prototxt"),
                    str(tmp_path / "a.caffemodel"))
+
+
+def test_keras_weight_loader_fails_fast_on_unmapped_layers(tmp_path):
+    # weighted layers without an hdf5 mapping must be rejected BEFORE any
+    # weights are applied (no half-loaded models)
+    h5py = pytest.importorskip("h5py")
+    from bigdl_tpu.keras.converter import DefinitionLoader, WeightLoader
+
+    spec = {"class_name": "Sequential", "config": [
+        {"class_name": "LSTM", "config": {
+            "output_dim": 2, "batch_input_shape": [None, 5, 3]}},
+        {"class_name": "Dense", "config": {"output_dim": 3}},
+    ]}
+    model = DefinitionLoader.from_json_str(json.dumps(spec))
+    # build a 2-group hdf5 so the count check passes and the mapping
+    # validation is what fires
+    hpath = str(tmp_path / "w.h5")
+    with h5py.File(hpath, "w") as f:
+        f.attrs["layer_names"] = [b"lstm_1", b"dense_1"]
+        g1 = f.create_group("lstm_1")
+        g1.attrs["weight_names"] = [b"W"]
+        g1.create_dataset("W", data=np.zeros((3, 8), np.float32))
+        g2 = f.create_group("dense_1")
+        g2.attrs["weight_names"] = [b"W", b"b"]
+        g2.create_dataset("W", data=np.zeros((2, 3), np.float32))
+        g2.create_dataset("b", data=np.zeros((3,), np.float32))
+    dense = model._layers[-1]
+    dense_before = np.asarray(
+        dense.layer.params_dict()["~params"]["weight"]).copy()
+    with pytest.raises(ValueError, match="topology-only"):
+        WeightLoader.load_weights(model, hpath)
+    dense_after = np.asarray(dense.layer.params_dict()["~params"]["weight"])
+    np.testing.assert_array_equal(dense_before, dense_after)  # untouched
+
+
+def test_keras_import_rejects_asymmetric_zero_padding():
+    from bigdl_tpu.keras.converter import DefinitionLoader
+
+    spec = {"class_name": "Sequential", "config": [
+        {"class_name": "ZeroPadding2D", "config": {
+            "padding": [[0, 1], [0, 1]],
+            "batch_input_shape": [None, 2, 4, 4]}},
+    ]}
+    with pytest.raises(ValueError, match="asymmetric"):
+        DefinitionLoader.from_json_str(json.dumps(spec))
