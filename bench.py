@@ -70,6 +70,7 @@ def main(argv=None):
                               stdout=subprocess.PIPE, timeout=tpu_timeout)
         if proc.returncode == 0 and proc.stdout.strip():
             sys.stdout.buffer.write(proc.stdout)
+            _append_history(here, proc.stdout)
             return
         print(f"[bench] primary attempt rc={proc.returncode}; "
               "falling back to CPU", file=sys.stderr)
@@ -77,11 +78,15 @@ def main(argv=None):
         print(f"[bench] primary attempt exceeded {tpu_timeout}s "
               "(wedged tunnel?); falling back to CPU", file=sys.stderr)
 
+    # Clean-CPU fallback: PYTHONPATH="" skips the axon sitecustomize so the
+    # child cannot wedge.  It runs the *real* smoke config (resnet18, batch 8,
+    # 10 iters, NHWC/bf16 — the same shape family as the TPU headline, scaled
+    # down) so tunnel-wedged rounds still yield comparable trend numbers.
     env = dict(os.environ, BIGDL_BENCH_CHILD="1", PYTHONPATH="",
-               JAX_PLATFORMS="cpu")
+               JAX_PLATFORMS="cpu", BIGDL_BENCH_BUDGET="600")
     fallback = []
     skip = False
-    for a in argv:  # strip any --model/-m flag (+value); fallback is lenet5
+    for a in argv:  # strip any --model/-m flag (+value); fallback is resnet18
         if skip:
             skip = False
             continue
@@ -91,11 +96,38 @@ def main(argv=None):
         if a.startswith("--model="):
             continue
         fallback.append(a)
-    proc = subprocess.run(
-        [sys.executable, me, "--model", "lenet5"] + fallback, env=env,
-        cwd=here, stdout=subprocess.PIPE, timeout=600)
-    sys.stdout.buffer.write(proc.stdout)
-    sys.exit(proc.returncode)
+    try:
+        proc = subprocess.run(
+            [sys.executable, me, "--model", "resnet18"] + fallback, env=env,
+            cwd=here, stdout=subprocess.PIPE, timeout=660)
+        out, rc = proc.stdout, proc.returncode
+    except subprocess.TimeoutExpired as e:
+        out, rc = b"", 1
+        print(f"[bench] CPU fallback exceeded 660s: {e}", file=sys.stderr)
+    if not out.strip():  # one JSON line in EVERY outcome
+        out = (json.dumps({"metric": "bench_failed", "value": 0.0,
+                           "unit": "imgs/sec/chip", "vs_baseline": None,
+                           "detail": {"error": f"fallback rc={rc}"}})
+               .encode() + b"\n")
+    sys.stdout.buffer.write(out)
+    _append_history(here, out)
+    sys.exit(rc)
+
+
+def _append_history(here, stdout_bytes):
+    """Append the emitted JSON line (+ UTC timestamp) to bench_history.jsonl
+    so trend data survives tunnel-wedged rounds."""
+    import datetime
+    import os
+
+    try:
+        rec = json.loads(stdout_bytes.decode().strip().splitlines()[-1])
+        rec["ts"] = datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds")
+        with open(os.path.join(here, "bench_history.jsonl"), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except Exception as e:
+        print(f"[bench] history append failed: {e}", file=sys.stderr)
 
 
 def _lenet_epoch_wallclock(log):
@@ -115,7 +147,8 @@ def bench_main(argv=None):
     import os
 
     t_start = time.perf_counter()
-    budget = float(os.environ.get("BIGDL_BENCH_TPU_TIMEOUT", "540"))
+    budget = float(os.environ.get("BIGDL_BENCH_BUDGET")
+                   or os.environ.get("BIGDL_BENCH_TPU_TIMEOUT", "540"))
 
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=None)
@@ -149,23 +182,28 @@ def bench_main(argv=None):
                 raise
             time.sleep(10.0 * attempt)
     on_tpu = "tpu" in dev.platform.lower() or dev.platform == "axon"
-    batch = args.batch or (int(os.environ.get("BIGDL_BENCH_BATCH", "256"))
-                           if on_tpu else 4)
-    iters = args.iters or (20 if on_tpu else 2)
-    model = args.model if on_tpu else "lenet5"
-    if args.model != "resnet50":
-        model = args.model
+    batch = args.batch or int(os.environ.get(
+        "BIGDL_BENCH_BATCH", "256" if on_tpu else "8"))
+    iters = args.iters or (20 if on_tpu else 10)
+    model = args.model
+    if not on_tpu and model == "resnet50":
+        # CPU backend in the primary child (no TPU visible): run the smoke
+        # config, not the 540s-eating TPU headline, and keep the metric name
+        # distinct so CPU rows never pollute the TPU trend line.
+        model = "resnet18"
 
     import jax.numpy as jnp
 
     from bigdl_tpu.models.perf import run_perf
 
     log = lambda *a, **k: print(*a, file=sys.stderr, **k)  # noqa: E731
-    fmt = args.format if model == "resnet50" else "NCHW"
+    # Same config family on CPU as on TPU (NHWC + bf16 compute, f32 masters)
+    # so tunnel-wedged rounds exercise — and time — the real code path.
+    fmt = args.format if model.startswith("resnet") else "NCHW"
     s = run_perf(model, batch_size=batch, iterations=iters,
-                 dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+                 dtype=jnp.bfloat16 if model != "lenet5" else jnp.float32,
                  format=fmt,
-                 master_f32=on_tpu,
+                 master_f32=model != "lenet5",
                  log=log)
 
     imgs_per_sec = s["records_per_sec"]
@@ -199,7 +237,7 @@ def bench_main(argv=None):
         metric = f"{model}_synthetic_train_throughput"
 
     lenet_epoch_s = None
-    if (on_tpu and not os.environ.get("BIGDL_BENCH_NOLENET")
+    if (not os.environ.get("BIGDL_BENCH_NOLENET")
             and time.perf_counter() - t_start < budget - 90):
         try:
             lenet_epoch_s = _lenet_epoch_wallclock(log)
@@ -213,7 +251,8 @@ def bench_main(argv=None):
         "vs_baseline": round(vs_baseline, 4) if vs_baseline is not None else None,
         "detail": {
             "device": str(getattr(dev, "device_kind", dev.platform)),
-            "batch": batch, "iters": iters, "dtype": "bf16" if on_tpu else "f32",
+            "batch": batch, "iters": iters,
+            "dtype": "f32" if model == "lenet5" else "bf16",
             "format": fmt, "ms_per_iter": s["ms_per_iter"],
             "mfu": round(mfu, 4),
             "ref_jax_mfu": round(ref_mfu, 4) if ref_mfu is not None else None,

@@ -1,0 +1,2 @@
+"""Operational CLIs shipped as console scripts (≙ the reference's
+``scripts/`` launchers, ref: scripts/spark-submit-with-bigdl.sh:1)."""
