@@ -7,11 +7,19 @@ document directly (class_name/config tree) and the Keras-1.x hdf5 weight
 layout (root attr ``layer_names``, per-layer group attr ``weight_names``).
 
 Topology (json) import covers: Dense, Activation, Dropout, Flatten,
-Reshape, Convolution1D/2D (th dim-ordering), MaxPooling1D/2D,
-AveragePooling1D/2D, Global{Max,Average}Pooling1D/2D, ZeroPadding2D
-(symmetric), UpSampling2D, BatchNormalization, Embedding, LSTM, GRU,
-SimpleRNN. hdf5 WEIGHT loading covers Dense, Convolution2D,
-BatchNormalization, Embedding — load_keras with weights fails fast
+Reshape, Convolution1D/2D, SeparableConvolution2D (th dim-ordering),
+MaxPooling1D/2D, AveragePooling1D/2D, Global{Max,Average}Pooling1D/2D,
+ZeroPadding2D (symmetric), UpSampling2D, BatchNormalization, Embedding,
+LSTM, GRU, SimpleRNN.
+
+hdf5 WEIGHT loading covers Dense, Convolution1D/2D,
+SeparableConvolution2D, BatchNormalization, Embedding, LSTM, GRU,
+SimpleRNN — in BOTH weight layouts: the Keras-1.2.2 per-gate arrays the
+reference pins (LSTM groups ordered i,c,f,o; GRU groups z,r,h — ≙
+WeightsConverter.convert_lstm/convert_gru, ref:
+pyspark/bigdl/keras/converter.py:218-241) and the fused kernels modern
+tf.keras/Keras-2+ writes (LSTM kernel gate order i,f,c,o; GRU z,r,h with
+``reset_after=False`` semantics). load_keras with weights fails fast
 (before mutating anything) if the model contains other weighted layers.
 """
 
@@ -29,6 +37,42 @@ from bigdl_tpu.nn.module import Module
 
 def _tuplify(v):
     return tuple(int(x) for x in v) if v is not None else None
+
+
+def _shape_from(batch_shape):
+    """Batch shape -> per-sample shape, or None when absent or carrying
+    variable (None) dims — variable-length models need an explicit
+    ``input_shape`` at load time."""
+    if not batch_shape:
+        return None
+    dims = batch_shape[1:]
+    if any(d is None for d in dims):
+        return None
+    return _tuplify(dims)
+
+
+def _conv2d_args(c: dict):
+    """nb_filter/nb_row/nb_col/subsample from either Keras-1 keys or the
+    filters/kernel_size/strides modern configs use (scalars accepted)."""
+    nb = c.get("nb_filter", c.get("filters"))
+    ks = c.get("kernel_size")
+    if isinstance(ks, int):
+        ks = (ks, ks)
+    row = c.get("nb_row", (ks or [None])[0])
+    col = c.get("nb_col", (ks or [None, None])[1])
+    sub = c.get("subsample", c.get("strides", (1, 1)))
+    if isinstance(sub, int):
+        sub = (sub, sub)
+    return nb, row, col, _tuplify(sub)
+
+
+def _require_th(cls: str, c: dict):
+    if c.get("dim_ordering", "th") != "th" or \
+            c.get("data_format") == "channels_last":
+        raise ValueError(
+            f"{cls}: only th (channels-first) dim_ordering is supported; "
+            "re-export the model channels-first (the reference is th-only "
+            "too, ref: pyspark/bigdl/keras/converter.py)")
 
 
 class DefinitionLoader:
@@ -59,7 +103,18 @@ class DefinitionLoader:
             layer_specs[0]["config"]["batch_input_shape"] = \
                 [None] + list(input_shape)
         model = bk.Sequential()
+        pending_shape = None  # from a preceding InputLayer (Keras-2+/3 json)
         for lspec in layer_specs:
+            if lspec["class_name"] == "InputLayer":
+                pending_shape = (
+                    _shape_from(lspec["config"].get("batch_input_shape"))
+                    or _shape_from(lspec["config"].get("batch_shape")))
+                continue
+            if pending_shape is not None and \
+                    not lspec["config"].get("batch_input_shape"):
+                lspec["config"]["batch_input_shape"] = \
+                    [None] + list(pending_shape)
+            pending_shape = None
             layer = DefinitionLoader._convert_layer(lspec)
             if layer is not None:
                 model.add(layer)  # Sequential builds + shape-infers here
@@ -69,9 +124,8 @@ class DefinitionLoader:
     def _convert_layer(lspec: dict):
         cls = lspec["class_name"]
         c = lspec["config"]
-        in_shape = None
-        if c.get("batch_input_shape"):
-            in_shape = _tuplify(c["batch_input_shape"][1:])
+        in_shape = (_shape_from(c.get("batch_input_shape"))
+                    or _shape_from(c.get("batch_shape")))
         if cls == "Dense":
             units = c.get("output_dim", c.get("units"))
             return bk.Dense(units, activation=c.get("activation") or None,
@@ -88,12 +142,8 @@ class DefinitionLoader:
             return bk.Reshape(_tuplify(c["target_shape"]),
                               input_shape=in_shape)
         if cls in ("Convolution2D", "Conv2D"):
-            if c.get("dim_ordering", "th") != "th":
-                raise ValueError("only th (channels-first) dim_ordering")
-            nb = c.get("nb_filter", c.get("filters"))
-            row = c.get("nb_row", (c.get("kernel_size") or [None])[0])
-            col = c.get("nb_col", (c.get("kernel_size") or [None, None])[1])
-            sub = _tuplify(c.get("subsample", c.get("strides", (1, 1))))
+            _require_th(cls, c)
+            nb, row, col, sub = _conv2d_args(c)
             return bk.Convolution2D(
                 nb, row, col, subsample=sub,
                 border_mode=c.get("border_mode", c.get("padding", "valid")),
@@ -166,7 +216,22 @@ class DefinitionLoader:
         if cls == "UpSampling2D":
             return bk.UpSampling2D(size=_tuplify(c.get("size", (2, 2))),
                                    input_shape=in_shape)
+        if cls in ("SeparableConvolution2D", "SeparableConv2D"):
+            _require_th(cls, c)
+            nb, row, col, sub = _conv2d_args(c)
+            return bk.SeparableConvolution2D(
+                nb, row, col,
+                depth_multiplier=c.get("depth_multiplier", 1),
+                subsample=sub,
+                activation=c.get("activation") or None,
+                bias=c.get("bias", c.get("use_bias", True)),
+                input_shape=in_shape)
         if cls in ("LSTM", "GRU", "SimpleRNN"):
+            if cls == "GRU" and c.get("reset_after", False):
+                raise ValueError(
+                    "GRU(reset_after=True) is unsupported: the Keras-1.2.2 "
+                    "recurrence the reference pins applies the reset gate "
+                    "before the hidden matmul (reset_after=False)")
             units = c.get("output_dim", c.get("units"))
             kw = dict(
                 activation=c.get("activation") or None,
@@ -222,8 +287,24 @@ class WeightLoader:
 def _has_weight_mapping(klayer) -> bool:
     from bigdl_tpu.keras import layers as kl
 
-    return isinstance(klayer, (kl.Dense, kl.Convolution2D,
-                               kl.BatchNormalization, kl.Embedding))
+    return isinstance(klayer, (kl.Dense, kl.Convolution2D, kl.Convolution1D,
+                               kl.SeparableConvolution2D,
+                               kl.BatchNormalization, kl.Embedding,
+                               kl.LSTM, kl.GRU, kl.SimpleRNN))
+
+
+def _conv2d_kernel(w: np.ndarray, expected) -> np.ndarray:
+    """Accept a 2-D conv kernel in either Keras-1 th OIHW layout or the
+    HWIO layout modern tf.keras hdf5 files carry; return OIHW."""
+    w = np.asarray(w)
+    expected = tuple(expected)
+    if w.shape == expected:  # OIHW
+        return w
+    o, i, kh, kw = expected
+    if w.shape == (kh, kw, i, o):  # HWIO
+        return w.transpose(3, 2, 0, 1)
+    raise ValueError(f"conv kernel shape {w.shape} matches neither OIHW "
+                     f"{expected} nor HWIO {(kh, kw, i, o)}")
 
 
 def _set_layer_weights(klayer, weights: List[np.ndarray]):
@@ -237,9 +318,95 @@ def _set_layer_weights(klayer, weights: List[np.ndarray]):
             lin._set_param("bias", jnp.asarray(weights[1]))
     elif isinstance(klayer, kl.Convolution2D):
         conv = _find(inner, "SpatialConvolution")
-        conv._set_param("weight", jnp.asarray(weights[0]))  # th: OIHW already
+        conv._set_param("weight", jnp.asarray(
+            _conv2d_kernel(weights[0], conv.weight.shape)))
         if len(weights) > 1:
             conv._set_param("bias", jnp.asarray(weights[1]))
+    elif isinstance(klayer, kl.Convolution1D):
+        conv = _find(inner, "TemporalConvolution")
+        w = np.asarray(weights[0])
+        out, cin, kw = conv.weight.shape
+        if w.shape == (kw, 1, cin, out):  # keras-1 stores conv1d as 4-D
+            w = w[:, 0]
+        if w.shape == (kw, cin, out):  # (kw,in,out) -> (out,in,kw)
+            w = w.transpose(2, 1, 0)
+        if w.shape != (out, cin, kw):
+            raise ValueError(f"conv1d kernel shape mismatch: {weights[0].shape}")
+        conv._set_param("weight", jnp.asarray(w))
+        if len(weights) > 1:
+            conv._set_param("bias", jnp.asarray(weights[1]))
+    elif isinstance(klayer, kl.SeparableConvolution2D):
+        sep = _find(inner, "SpatialSeparableConvolution")
+        dw, pw = sep.depthwise, sep.pointwise
+        d = np.asarray(weights[0])
+        exp = tuple(dw.weight.shape)  # (in*dm, 1, kh, kw) grouped OIHW
+        if d.shape != exp:
+            indm, _, kh, kw = exp
+            dm = klayer.depth_multiplier
+            cin = indm // dm
+            if d.shape == (kh, kw, cin, dm):  # tf.keras (kh,kw,in,dm)
+                d = d.transpose(2, 3, 0, 1).reshape(exp)
+            elif d.shape == (dm, cin, kh, kw):  # keras-1 th (dm,in,kh,kw)
+                d = d.transpose(1, 0, 2, 3).reshape(exp)
+            else:
+                raise ValueError(
+                    f"depthwise kernel shape {d.shape} matches none of "
+                    f"grouped-OIHW {exp}, (kh,kw,in,dm), (dm,in,kh,kw)")
+        dw._set_param("weight", jnp.asarray(d))
+        pw._set_param("weight", jnp.asarray(
+            _conv2d_kernel(weights[1], pw.weight.shape)))
+        if len(weights) > 2:
+            pw._set_param("bias", jnp.asarray(weights[2]))
+    elif isinstance(klayer, kl.LSTM):
+        cell = _find(inner, "LSTM")
+        if len(weights) == 12:
+            # Keras-1.2.2 per-gate arrays grouped [W,U,b] x [i,c,f,o]
+            # (≙ ref converter.py:222-226); our fused order is i,f,g(=c),o.
+            gi, gc, gf, go = 0, 3, 6, 9
+            i2g = np.concatenate([weights[g] for g in (gi, gf, gc, go)], 1)
+            h2g = np.concatenate([weights[g + 1] for g in (gi, gf, gc, go)], 1)
+            bias = np.concatenate([weights[g + 2] for g in (gi, gf, gc, go)])
+        elif len(weights) == 3:
+            # fused kernels (modern tf.keras): gate order i,f,c,o == ours
+            i2g, h2g, bias = weights
+        else:
+            raise ValueError(f"LSTM expects 3 or 12 arrays, got {len(weights)}")
+        cell._set_param("i2g", jnp.asarray(i2g))
+        cell._set_param("h2g", jnp.asarray(h2g))
+        cell._set_param("bias", jnp.asarray(bias))
+    elif isinstance(klayer, kl.GRU):
+        cell = _find(inner, "GRU")
+        h = cell.hidden_size
+        if len(weights) == 9:
+            # Keras-1.2.2 groups [W,U,b] x [z,r,h] (≙ ref converter.py:236-241)
+            W_z, U_z, b_z = weights[0:3]
+            W_r, U_r, b_r = weights[3:6]
+            W_h, U_h, b_h = weights[6:9]
+        elif len(weights) == 3:
+            # fused kernels, gate order z,r,h (reset_after=False layout)
+            K, U, b = (np.asarray(w) for w in weights)
+            if b.ndim == 2:
+                raise ValueError(
+                    "GRU hdf5 carries a (2, 3h) bias: the model was saved "
+                    "with reset_after=True, which is unsupported")
+            W_z, W_r, W_h = K[:, :h], K[:, h:2 * h], K[:, 2 * h:]
+            U_z, U_r, U_h = U[:, :h], U[:, h:2 * h], U[:, 2 * h:]
+            b_z, b_r, b_h = b[:h], b[h:2 * h], b[2 * h:]
+        else:
+            raise ValueError(f"GRU expects 3 or 9 arrays, got {len(weights)}")
+        # our fused gate order is r,z; candidate is separate
+        cell._set_param("i2g", jnp.asarray(np.concatenate([W_r, W_z], 1)))
+        cell._set_param("h2g", jnp.asarray(np.concatenate([U_r, U_z], 1)))
+        cell._set_param("gate_bias", jnp.asarray(np.concatenate([b_r, b_z])))
+        cell._set_param("i2c", jnp.asarray(W_h))
+        cell._set_param("h2c", jnp.asarray(U_h))
+        cell._set_param("cand_bias", jnp.asarray(b_h))
+    elif isinstance(klayer, kl.SimpleRNN):
+        cell = _find(inner, "RnnCell")
+        cell._set_param("i2h", jnp.asarray(weights[0]))
+        cell._set_param("h2h", jnp.asarray(weights[1]))
+        if len(weights) > 2:
+            cell._set_param("bias", jnp.asarray(weights[2]))
     elif isinstance(klayer, kl.BatchNormalization):
         bn = _find(inner, "BatchNormalization", startswith=True)
         gamma, beta, mean, var = weights[:4]

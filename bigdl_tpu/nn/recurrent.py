@@ -83,6 +83,27 @@ def _uniform_stdv(shape, hidden_size):
     return bt_init.RandomUniform(-stdv, stdv)(shape)
 
 
+class _UniformStdvInit:
+    """Picklable init thunk — a local lambda here would break save_module's
+    pickle of any model containing a recurrent cell."""
+
+    def __init__(self, shape, hidden_size):
+        self.shape, self.hidden_size = shape, hidden_size
+
+    def __call__(self):
+        return _uniform_stdv(self.shape, self.hidden_size)
+
+
+class _HeNormalInit:
+    """Picklable He-normal init thunk for conv-cell kernels."""
+
+    def __init__(self, shape, fan):
+        self.shape, self.fan = shape, fan
+
+    def __call__(self):
+        return bt_init.RandomNormal(0.0, (2.0 / self.fan) ** 0.5)(self.shape)
+
+
 def _cell_uses_rng(cell: "Cell") -> bool:
     """True when any (sub)cell will draw dropout masks this pass — the
     unroll then threads a split PRNG key through the scan carry so every
@@ -122,10 +143,10 @@ class RnnCell(Cell):
         self.hidden_size = hidden_size
         self.activation = activation if activation is not None else Tanh()
         self.register_random_parameter(
-            "i2h", lambda: _uniform_stdv((input_size, hidden_size), hidden_size),
+            "i2h", _UniformStdvInit((input_size, hidden_size), hidden_size),
             regularizer=w_regularizer)
         self.register_random_parameter(
-            "h2h", lambda: _uniform_stdv((hidden_size, hidden_size), hidden_size),
+            "h2h", _UniformStdvInit((hidden_size, hidden_size), hidden_size),
             regularizer=u_regularizer)
         if is_input_with_bias or is_hidden_with_bias:
             self.register_parameter("bias", jnp.zeros((hidden_size,)),
@@ -160,10 +181,10 @@ class LSTM(Cell):
         self._inner = inner_activation
         h = hidden_size
         self.register_random_parameter(
-            "i2g", lambda: _uniform_stdv((input_size, 4 * h), h),
+            "i2g", _UniformStdvInit((input_size, 4 * h), h),
             regularizer=w_regularizer)
         self.register_random_parameter(
-            "h2g", lambda: _uniform_stdv((h, 4 * h), h),
+            "h2g", _UniformStdvInit((h, 4 * h), h),
             regularizer=u_regularizer)
         # forget-gate bias 1.0 — standard trick, matches reference init of
         # the f-gate bias in nn/LSTM.scala's initial bias tensor
@@ -212,16 +233,16 @@ class LSTMPeephole(Cell):
         self.p = p
         h = hidden_size
         self.register_random_parameter(
-            "i2g", lambda: _uniform_stdv((input_size, 4 * h), h),
+            "i2g", _UniformStdvInit((input_size, 4 * h), h),
             regularizer=w_regularizer)
         self.register_random_parameter(
-            "h2g", lambda: _uniform_stdv((h, 4 * h), h),
+            "h2g", _UniformStdvInit((h, 4 * h), h),
             regularizer=u_regularizer)
         self.register_parameter("bias", jnp.zeros((4 * h,)).at[h:2 * h].set(1.0),
                                 regularizer=b_regularizer)
-        self.register_random_parameter("w_ci", lambda: _uniform_stdv((h,), h))
-        self.register_random_parameter("w_cf", lambda: _uniform_stdv((h,), h))
-        self.register_random_parameter("w_co", lambda: _uniform_stdv((h,), h))
+        self.register_random_parameter("w_ci", _UniformStdvInit((h,), h))
+        self.register_random_parameter("w_cf", _UniformStdvInit((h,), h))
+        self.register_random_parameter("w_co", _UniformStdvInit((h,), h))
 
     def init_state(self, batch, dtype=jnp.float32):
         return (jnp.zeros((batch, self.hidden_size), dtype),
@@ -262,17 +283,17 @@ class GRU(Cell):
         self._inner = inner_activation
         h = hidden_size
         self.register_random_parameter(
-            "i2g", lambda: _uniform_stdv((input_size, 2 * h), h),
+            "i2g", _UniformStdvInit((input_size, 2 * h), h),
             regularizer=w_regularizer)
         self.register_random_parameter(
-            "h2g", lambda: _uniform_stdv((h, 2 * h), h),
+            "h2g", _UniformStdvInit((h, 2 * h), h),
             regularizer=u_regularizer)
         self.register_parameter("gate_bias", jnp.zeros((2 * h,)), regularizer=b_regularizer)
         self.register_random_parameter(
-            "i2c", lambda: _uniform_stdv((input_size, h), h),
+            "i2c", _UniformStdvInit((input_size, h), h),
             regularizer=w_regularizer)
         self.register_random_parameter(
-            "h2c", lambda: _uniform_stdv((h, h), h),
+            "h2c", _UniformStdvInit((h, h), h),
             regularizer=u_regularizer)
         self.register_parameter("cand_bias", jnp.zeros((h,)), regularizer=b_regularizer)
 
@@ -320,12 +341,12 @@ class ConvLSTMPeephole(Cell):
         self.with_peephole = with_peephole
         fan = input_size * kernel_i * kernel_i
         self.register_random_parameter(
-            "w_in", lambda: bt_init.RandomNormal(0.0, (2.0 / fan) ** 0.5)(
-                (4 * output_size, input_size, kernel_i, kernel_i)))
+            "w_in", _HeNormalInit(
+                (4 * output_size, input_size, kernel_i, kernel_i), fan))
         fanh = output_size * kernel_c * kernel_c
         self.register_random_parameter(
-            "w_hid", lambda: bt_init.RandomNormal(0.0, (2.0 / fanh) ** 0.5)(
-                (4 * output_size, output_size, kernel_c, kernel_c)))
+            "w_hid", _HeNormalInit(
+                (4 * output_size, output_size, kernel_c, kernel_c), fanh))
         self.register_parameter("bias", jnp.zeros((4 * output_size,)))
         if with_peephole:
             self.register_parameter("w_ci", jnp.zeros((output_size, 1, 1)))
@@ -384,14 +405,14 @@ class ConvLSTMPeephole3D(ConvLSTMPeephole):
         self.with_peephole = with_peephole
         fan = input_size * kernel_i ** 3
         self.register_random_parameter(
-            "w_in", lambda: bt_init.RandomNormal(0.0, (2.0 / fan) ** 0.5)(
+            "w_in", _HeNormalInit(
                 (4 * output_size, input_size,
-                 kernel_i, kernel_i, kernel_i)))
+                 kernel_i, kernel_i, kernel_i), fan))
         fanh = output_size * kernel_c ** 3
         self.register_random_parameter(
-            "w_hid", lambda: bt_init.RandomNormal(0.0, (2.0 / fanh) ** 0.5)(
+            "w_hid", _HeNormalInit(
                 (4 * output_size, output_size,
-                 kernel_c, kernel_c, kernel_c)))
+                 kernel_c, kernel_c, kernel_c), fanh))
         self.register_parameter("bias", jnp.zeros((4 * output_size,)))
         if with_peephole:
             self.register_parameter("w_ci", jnp.zeros((output_size, 1, 1, 1)))

@@ -326,18 +326,20 @@ def test_keras_weight_loader_fails_fast_on_unmapped_layers(tmp_path):
     h5py = pytest.importorskip("h5py")
     from bigdl_tpu.keras.converter import DefinitionLoader, WeightLoader
 
-    spec = {"class_name": "Sequential", "config": [
-        {"class_name": "LSTM", "config": {
-            "output_dim": 2, "batch_input_shape": [None, 5, 3]}},
-        {"class_name": "Dense", "config": {"output_dim": 3}},
-    ]}
-    model = DefinitionLoader.from_json_str(json.dumps(spec))
+    # LSTM/GRU/Conv1D now have mappings (round 4), so use a weighted layer
+    # that is importable by constructor but has no hdf5 mapping yet
+    from bigdl_tpu import keras as bk
+
+    model = bk.Sequential()
+    model.add(bk.Deconvolution2D(2, 3, 3, input_shape=(3, 8, 8)))
+    model.add(bk.Flatten())
+    model.add(bk.Dense(3))
     # build a 2-group hdf5 so the count check passes and the mapping
     # validation is what fires
     hpath = str(tmp_path / "w.h5")
     with h5py.File(hpath, "w") as f:
-        f.attrs["layer_names"] = [b"lstm_1", b"dense_1"]
-        g1 = f.create_group("lstm_1")
+        f.attrs["layer_names"] = [b"deconv_1", b"dense_1"]
+        g1 = f.create_group("deconv_1")
         g1.attrs["weight_names"] = [b"W"]
         g1.create_dataset("W", data=np.zeros((3, 8), np.float32))
         g2 = f.create_group("dense_1")

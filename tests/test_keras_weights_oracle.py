@@ -1,0 +1,223 @@
+"""Keras hdf5 weight-import oracle tests.
+
+Real tf.keras (Keras 3) models are saved to legacy hdf5 and re-imported via
+``load_keras``; predictions must match keras' own. This covers the fused
+weight layout (kernel/recurrent_kernel/bias). The Keras-1.2.2 per-gate
+layout the reference pins (ref: pyspark/bigdl/keras/converter.py:218-241)
+is validated by writing the SAME weights in keras-1 form and asserting the
+two imports agree.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+keras = tf.keras
+
+import jax.numpy as jnp  # noqa: E402
+
+from bigdl_tpu.keras.converter import load_keras  # noqa: E402
+
+
+def _save(tmp_path, model, name):
+    h5 = str(tmp_path / f"{name}.h5")
+    model.save(h5)
+    return model.to_json(), h5
+
+
+def _forward(model, x):
+    model.evaluate()  # inference mode (dropout off, BN running stats)
+    return np.asarray(model.forward(jnp.asarray(x)))
+
+
+# ------------------------------------------------------------- fused layout
+def test_lstm_text_model_matches_keras(tmp_path):
+    np.random.seed(1)
+    km = keras.Sequential([
+        keras.layers.Embedding(50, 8),
+        keras.layers.LSTM(6),
+        keras.layers.Dense(3, activation="softmax"),
+    ])
+    km.build((None, 12))
+    js, h5 = _save(tmp_path, km, "lstm")
+    x = np.random.randint(0, 50, (4, 12))
+    want = km.predict(x, verbose=0)
+    m = load_keras(json_str=js, hdf5_path=h5, input_shape=(12,))
+    np.testing.assert_allclose(_forward(m, x), want, rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_return_sequences_matches_keras(tmp_path):
+    np.random.seed(2)
+    km = keras.Sequential([
+        keras.layers.Embedding(30, 5),
+        keras.layers.LSTM(4, return_sequences=True),
+    ])
+    km.build((None, 7))
+    js, h5 = _save(tmp_path, km, "lstm_seq")
+    x = np.random.randint(0, 30, (3, 7))
+    want = km.predict(x, verbose=0)
+    m = load_keras(json_str=js, hdf5_path=h5, input_shape=(7,))
+    np.testing.assert_allclose(_forward(m, x), want, rtol=1e-4, atol=1e-5)
+
+
+def test_gru_model_matches_keras(tmp_path):
+    np.random.seed(3)
+    km = keras.Sequential([
+        keras.layers.Embedding(40, 6),
+        keras.layers.GRU(5, reset_after=False),
+        keras.layers.Dense(2),
+    ])
+    km.build((None, 9))
+    js, h5 = _save(tmp_path, km, "gru")
+    x = np.random.randint(0, 40, (4, 9))
+    want = km.predict(x, verbose=0)
+    m = load_keras(json_str=js, hdf5_path=h5, input_shape=(9,))
+    np.testing.assert_allclose(_forward(m, x), want, rtol=1e-4, atol=1e-5)
+
+
+def test_gru_reset_after_is_rejected(tmp_path):
+    km = keras.Sequential([
+        keras.layers.Embedding(10, 4),
+        keras.layers.GRU(3, reset_after=True),
+    ])
+    km.build((None, 5))
+    js, h5 = _save(tmp_path, km, "gru_ra")
+    with pytest.raises(ValueError, match="reset_after"):
+        load_keras(json_str=js, hdf5_path=h5, input_shape=(5,))
+
+
+def test_simplernn_model_matches_keras(tmp_path):
+    np.random.seed(4)
+    km = keras.Sequential([
+        keras.layers.Embedding(20, 4),
+        keras.layers.SimpleRNN(6),
+        keras.layers.Dense(2, activation="tanh"),
+    ])
+    km.build((None, 8))
+    js, h5 = _save(tmp_path, km, "rnn")
+    x = np.random.randint(0, 20, (3, 8))
+    want = km.predict(x, verbose=0)
+    m = load_keras(json_str=js, hdf5_path=h5, input_shape=(8,))
+    np.testing.assert_allclose(_forward(m, x), want, rtol=1e-4, atol=1e-5)
+
+
+def test_conv1d_model_matches_keras(tmp_path):
+    np.random.seed(5)
+    km = keras.Sequential([
+        keras.layers.Embedding(25, 6),
+        keras.layers.Conv1D(7, 3, activation="relu"),
+        keras.layers.GlobalMaxPooling1D(),
+        keras.layers.Dense(3),
+    ])
+    km.build((None, 10))
+    js, h5 = _save(tmp_path, km, "conv1d")
+    x = np.random.randint(0, 25, (4, 10))
+    want = km.predict(x, verbose=0)
+    m = load_keras(json_str=js, hdf5_path=h5, input_shape=(10,))
+    np.testing.assert_allclose(_forward(m, x), want, rtol=1e-4, atol=1e-5)
+
+
+def _to_th_json(js: str) -> str:
+    """Rewrite a channels_last keras json to the th (channels-first) layout
+    our importer pins (the reference is th-only too): drop data_format and
+    transpose any input shape from (..., C) to (C, ...)."""
+    spec = json.loads(js)
+    layers = spec["config"]["layers"] if isinstance(spec["config"], dict) \
+        else spec["config"]
+    for l in layers:
+        c = l["config"]
+        c.pop("data_format", None)
+        for key in ("batch_shape", "batch_input_shape"):
+            if c.get(key) and len(c[key]) == 4:
+                b, h, w, ch = c[key]
+                c[key] = [b, ch, h, w]
+    return json.dumps(spec)
+
+
+def test_conv2d_separable_model_matches_keras(tmp_path):
+    np.random.seed(6)
+    km = keras.Sequential([
+        keras.layers.Input((8, 8, 2)),
+        keras.layers.Conv2D(4, 3, activation="relu"),
+        keras.layers.SeparableConv2D(6, 3, depth_multiplier=2),
+        keras.layers.GlobalAveragePooling2D(),
+        keras.layers.Dense(3),
+    ])
+    js, h5 = _save(tmp_path, km, "conv2d")
+    x = np.random.randn(2, 8, 8, 2).astype(np.float32)
+    want = km.predict(x, verbose=0)
+    m = load_keras(json_str=_to_th_json(js), hdf5_path=h5)
+    got = _forward(m, x.transpose(0, 3, 1, 2))  # NHWC -> NCHW
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+# ----------------------------------------------- keras-1.2.2 per-gate layout
+def _write_k1_h5(path, groups):
+    """Write {layer_name: [arrays]} in the Keras-1 hdf5 layout."""
+    import h5py
+
+    with h5py.File(path, "w") as f:
+        f.attrs["layer_names"] = [n.encode() for n in groups]
+        for ln, arrs in groups.items():
+            g = f.create_group(ln)
+            names = [f"{ln}_W_{i}".encode() for i in range(len(arrs))]
+            g.attrs["weight_names"] = names
+            for n, a in zip(names, arrs):
+                g.create_dataset(n.decode(), data=a)
+
+
+K1_LSTM_JSON = json.dumps({"class_name": "Sequential", "config": [
+    {"class_name": "LSTM", "config": {
+        "output_dim": 4, "return_sequences": False,
+        "batch_input_shape": [None, 6, 3]}},
+]})
+
+
+def test_keras1_lstm_pergate_layout_equals_fused(tmp_path):
+    rng = np.random.RandomState(7)
+    h = 4
+    per = {g: (rng.randn(3, h).astype(np.float32),
+               rng.randn(h, h).astype(np.float32),
+               rng.randn(h).astype(np.float32))
+           for g in "icfo"}
+    # keras-1 group order i, c, f, o; fused (tf.keras) order i, f, c, o
+    k1 = [a for g in "icfo" for a in per[g]]
+    fused = [np.concatenate([per[g][0] for g in "ifco"], 1),
+             np.concatenate([per[g][1] for g in "ifco"], 1),
+             np.concatenate([per[g][2] for g in "ifco"])]
+    p1, p2 = str(tmp_path / "k1.h5"), str(tmp_path / "k2.h5")
+    _write_k1_h5(p1, {"lstm_1": k1})
+    _write_k1_h5(p2, {"lstm_1": fused})
+    x = rng.randn(2, 6, 3).astype(np.float32)
+    m1 = load_keras(json_str=K1_LSTM_JSON, hdf5_path=p1)
+    m2 = load_keras(json_str=K1_LSTM_JSON, hdf5_path=p2)
+    np.testing.assert_allclose(_forward(m1, x), _forward(m2, x), rtol=1e-6)
+
+
+K1_GRU_JSON = json.dumps({"class_name": "Sequential", "config": [
+    {"class_name": "GRU", "config": {
+        "output_dim": 4, "return_sequences": False,
+        "batch_input_shape": [None, 6, 3]}},
+]})
+
+
+def test_keras1_gru_pergate_layout_equals_fused(tmp_path):
+    rng = np.random.RandomState(8)
+    h = 4
+    per = {g: (rng.randn(3, h).astype(np.float32),
+               rng.randn(h, h).astype(np.float32),
+               rng.randn(h).astype(np.float32))
+           for g in "zrh"}
+    k1 = [a for g in "zrh" for a in per[g]]  # keras-1 groups z, r, h
+    fused = [np.concatenate([per[g][0] for g in "zrh"], 1),
+             np.concatenate([per[g][1] for g in "zrh"], 1),
+             np.concatenate([per[g][2] for g in "zrh"])]
+    p1, p2 = str(tmp_path / "k1.h5"), str(tmp_path / "k2.h5")
+    _write_k1_h5(p1, {"gru_1": k1})
+    _write_k1_h5(p2, {"gru_1": fused})
+    x = rng.randn(2, 6, 3).astype(np.float32)
+    m1 = load_keras(json_str=K1_GRU_JSON, hdf5_path=p1)
+    m2 = load_keras(json_str=K1_GRU_JSON, hdf5_path=p2)
+    np.testing.assert_allclose(_forward(m1, x), _forward(m2, x), rtol=1e-6)

@@ -147,3 +147,25 @@ def test_checkpoint_and_resume(tmp_path):
     opt2.set_optim_method(method2)
     trained = opt2.optimize()
     assert trained is m2
+
+
+def test_pickle_roundtrip_recurrent_model(tmp_path):
+    # regression: Cell init thunks were local lambdas, which broke the
+    # pickle path (utils/file.save_module) for any model with an RNN cell
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.utils import file as bf
+
+    m = nn.Sequential(
+        nn.Recurrent().add(nn.LSTM(3, 4)),
+        nn.Select(2, -1),
+        nn.Linear(4, 2),
+    )
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 5, 3).astype("float32"))
+    want = np.asarray(m.forward(x))
+    p = str(tmp_path / "rnn.bigdl")
+    bf.save_module(m, p)
+    loaded = bf.load_module(p)
+    np.testing.assert_allclose(np.asarray(loaded.forward(x)), want, rtol=1e-6)
+    loaded.reset()  # init thunks must survive the round trip
