@@ -26,6 +26,10 @@ class TransformerLM(Module):
     #: forward runs, and always 0.0 for dense models)
     l_aux = 0.0
 
+    #: Routing stats (drop_rate, expert_fraction) averaged over the MoE
+    #: blocks of the last forward — same trace-lifetime rules as l_aux.
+    last_moe_stats = None
+
     def __init__(self, vocab_size: int, embed_dim: int = 256,
                  num_heads: int = 8, num_layers: int = 4,
                  max_len: int = 1024, mlp_ratio: int = 4,
@@ -74,13 +78,14 @@ class TransformerLM(Module):
         pos = jax.lax.dynamic_slice_in_dim(self.pos_embed, pos0, t, axis=0)
         x = x + pos[None]
         aux_total = 0.0
+        moe_stats = []
         for i in range(self.num_layers):
             blk = getattr(self, f"block{i}")
             if self.remat:
                 # the block's RNG draws must cross the checkpoint boundary as
-                # an explicit ARGUMENT and the MoE aux loss as an explicit
-                # OUTPUT: stashing either through global/module state inside
-                # the remat trace would leak its tracers
+                # an explicit ARGUMENT and the MoE aux loss + routing stats
+                # as explicit OUTPUTS: stashing any of them through global/
+                # module state inside the remat trace would leak its tracers
                 from bigdl_tpu.utils import random as bt_random
 
                 moe = blk.n_experts > 0
@@ -88,31 +93,37 @@ class TransformerLM(Module):
                 def run(t, kk, b=blk, moe=moe):
                     bt_random.RNG.push_key(kk)
                     try:
-                        # forward_with_aux: NO module-state stash inside the
-                        # checkpoint trace; aux leaves as an explicit output
-                        out, aux = b.forward_with_aux(t)
+                        # NO module-state stash inside the checkpoint trace;
+                        # aux + stats leave as explicit outputs
+                        out, aux, stats = b.forward_with_aux_stats(t)
                     finally:
                         bt_random.RNG.pop_key()
-                    return (out, aux) if moe else out
+                    return (out, aux, stats) if moe else out
 
                 res = jax.checkpoint(run)(x, bt_random.next_key())
                 if moe:
-                    x, aux = res
+                    x, aux, stats = res
                     aux_total = aux_total + aux
+                    moe_stats.append(stats)
                 else:
                     x = res
             else:
                 # same explicit aux routing as the remat path — one
                 # convention, no side-channel dependency
-                x, aux = blk.forward_with_aux(x)
+                x, aux, stats = blk.forward_with_aux_stats(x)
                 if blk.n_experts > 0:
                     aux_total = aux_total + aux
+                    moe_stats.append(stats)
         if self.n_experts > 0:
             # summed MoE load-balancing loss of this forward; read it inside
             # the same trace (add ``model.l_aux`` to the objective). Valid in
             # both remat modes — unlike block.mlp.l_aux, which holds a dead
-            # inner tracer under remat.
+            # inner tracer under remat. Routing stats are averaged over the
+            # MoE blocks and stashed the same way (feed record_moe_metrics).
             self.l_aux = aux_total
+            n = len(moe_stats)
+            self.last_moe_stats = jax.tree.map(
+                lambda *leaves: sum(leaves) / n, *moe_stats)
         x = self.ln_f(x)
         if self.tie_embeddings:
             logits = jnp.einsum("btc,vc->btv", x, self.tok_embed)

@@ -166,8 +166,9 @@ class TransformerBlock(Module):
 
     def forward(self, input):
         if self.n_experts > 0:
-            out, aux = self.forward_with_aux(input)
+            out, aux, stats = self.forward_with_aux_stats(input)
             self.mlp.l_aux = aux
+            self.mlp.last_stats = stats
             return out
         return self._forward_impl(input)[0]
 
@@ -175,19 +176,26 @@ class TransformerBlock(Module):
         """(output, moe_aux_loss) with NO side-channel stash — the remat
         path must route the aux loss through explicit outputs (a stash
         inside jax.checkpoint leaves a dead tracer behind)."""
+        out, aux, _ = self.forward_with_aux_stats(input)
+        return out, aux
+
+    def forward_with_aux_stats(self, input):
+        """(output, moe_aux_loss, routing_stats_or_None) — stats follow the
+        same explicit-output convention as the aux loss so they survive
+        jax.checkpoint; see parallel/moe.py record_moe_metrics."""
         return self._forward_impl(input)
 
     def _forward_impl(self, input):
         x = input + self.attn(self.ln1(input))
         b, t, c = x.shape
-        aux = 0.0
+        aux, stats = 0.0, None
         if self.n_experts > 0:
             # MoEMLP flattens/restores internally
-            h, aux = self.mlp.forward_with_aux(self.ln2(x))
+            h, aux, stats = self.mlp.forward_with_stats(self.ln2(x))
         else:
             h = self.fc1(self.ln2(x).reshape(b * t, c))
             h = jax.nn.gelu(h)
             h = self.fc2(h).reshape(b, t, c)
         if self.dropout_p > 0:
             h = self.drop(h)
-        return x + h, aux
+        return x + h, aux, stats

@@ -238,3 +238,133 @@ def test_moe_remat_model_saves_after_eager_forward(tmp_path):
     m2 = bt_file.load_module(path)
     np.testing.assert_allclose(np.asarray(m2(ids)), out, rtol=1e-5,
                                atol=1e-6)
+
+
+# ------------------------------------------------------------- top-2 / stats
+def test_moe_top2_matches_per_token_reference():
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(0)
+    moe = MoEMLP(8, 16, 4, capacity_factor=4.0, n_top=2)  # ample capacity
+    x = jax.random.normal(jax.random.PRNGKey(5), (12, 8))
+    out = np.asarray(moe(x))
+
+    gates = jax.nn.softmax(x @ moe.gate_w, axis=-1)
+    ref = np.zeros_like(out)
+    for t in range(12):
+        order = np.argsort(-np.asarray(gates[t]))
+        e1, e2 = int(order[0]), int(order[1])
+        g1, g2 = float(gates[t, e1]), float(gates[t, e2])
+        acc = np.zeros(8, np.float32)
+        for e, g in ((e1, g1), (e2, g2)):
+            h = jax.nn.gelu(x[t] @ moe.w1[e] + moe.b1[e])
+            acc += np.asarray((h @ moe.w2[e] + moe.b2[e])) * (g / (g1 + g2))
+        ref[t] = acc
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_moe_stats_report_drops_and_load():
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(0)
+    # force total collapse: every token routes to expert 0, capacity 1
+    moe = MoEMLP(4, 8, 4, capacity_factor=0.01)
+    moe.gate_w = moe.gate_w.at[:].set(0.0).at[:, 0].set(10.0)
+    x = jnp.abs(jax.random.normal(jax.random.PRNGKey(1), (16, 4))) + 0.1
+    moe(x)
+    stats = moe.last_stats
+    assert float(stats["drop_rate"]) > 0.9  # capacity 1 of 16 kept
+    np.testing.assert_allclose(np.asarray(stats["expert_fraction"]),
+                               [1.0, 0, 0, 0], atol=1e-6)
+
+    from bigdl_tpu.optim.metrics import Metrics
+    from bigdl_tpu.parallel.moe import record_moe_metrics
+
+    m = Metrics()
+    record_moe_metrics(m, stats)
+    assert m.get("moe drop rate")[0] > 0.9
+    assert m.get("moe max expert fraction")[0] == pytest.approx(1.0)
+
+
+def test_moe_spmd_top2_matches_dense():
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(0)
+    n, e, d, h, t = 4, 8, 8, 16, 32
+    moe = MoEMLP(d, h, e, capacity_factor=float(e), n_top=2)
+    x = jax.random.normal(jax.random.PRNGKey(6), (t, d))
+    dense_out = np.asarray(moe(x))
+
+    mesh = Mesh(np.array(jax.devices()[:n]), ("expert",))
+    params = moe.expert_params()
+
+    def spmd(p, xx):
+        gates = jax.nn.softmax(xx @ moe.gate_w, axis=-1)
+        return moe_spmd(p, xx, gates, "expert", moe.capacity_factor, n_top=2)
+
+    fn = shard_map(spmd, mesh=mesh,
+                   in_specs=(jax.tree.map(lambda _: P("expert"), params),
+                             P("expert")),
+                   out_specs=P("expert"))
+    out = np.asarray(jax.jit(fn)(params, x))
+    np.testing.assert_allclose(out, dense_out, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.slow
+def test_moe_aux_loss_balances_experts_in_training():
+    """A few hundred steps with the aux loss on must keep expert utilization
+    near-uniform (GShard recipe); without it the router may collapse."""
+    from bigdl_tpu.nn.module import bind
+    from bigdl_tpu.utils import random as rnd
+
+    def train(aux_coef, seed=0, steps=300):
+        rnd.set_seed(seed)
+        moe = MoEMLP(4, 8, 4, capacity_factor=2.0, n_top=2)
+        params = moe.params_dict()
+        key = jax.random.PRNGKey(seed)
+        x = jax.random.normal(key, (64, 4))
+        y = jax.random.normal(jax.random.split(key)[0], (64, 4))
+
+        def loss_fn(p):
+            with bind(moe, p, {}, False, None):
+                out, aux, stats = moe.forward_with_stats(x)
+            return jnp.mean((out - y) ** 2) + aux_coef * aux, stats
+
+        @jax.jit
+        def step(p):
+            (l, stats), g = jax.value_and_grad(loss_fn, has_aux=True)(p)
+            p = jax.tree.map(lambda a, b: a - 0.05 * b, p, g)
+            return p, l, stats
+
+        for _ in range(steps):
+            params, l, stats = step(params)
+        return np.asarray(stats["expert_fraction"]), float(stats["drop_rate"])
+
+    frac, drop = train(aux_coef=0.01)
+    # near-uniform utilization: no expert above 1.5x its fair share
+    assert frac.max() < 1.5 / 4, frac
+    assert frac.min() > 0.05, frac
+    assert drop < 0.2, drop
+
+
+@pytest.mark.parametrize("remat", [False, True])
+def test_transformer_lm_exposes_moe_routing_stats(remat):
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.nn.module import pure_apply
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(0)
+    m = TransformerLM(32, embed_dim=16, num_heads=2, num_layers=2,
+                      max_len=8, n_experts=4, remat=remat)
+    fn = pure_apply(m)
+    ids = jnp.arange(8)[None] % 32
+
+    def stats_of(p):
+        fn(p, {}, ids, rng=jax.random.PRNGKey(0), training=True)
+        # readable inside the same trace, like m.l_aux
+        return m.last_moe_stats
+
+    stats = jax.jit(stats_of)(m.params_dict())
+    assert 0.0 <= float(stats["drop_rate"]) <= 1.0
+    frac = np.asarray(stats["expert_fraction"])
+    assert frac.shape == (4,) and frac.sum() == pytest.approx(1.0, abs=1e-5)
