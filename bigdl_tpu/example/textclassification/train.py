@@ -2,20 +2,27 @@
 textclassification/TextClassifier.scala: GloVe embeddings -> TemporalConv
 -> ReLU -> pooling stack -> Linear softmax over 20-newsgroup classes).
 
-Run: python -m bigdl_tpu.example.textclassification.train
-Without a corpus/GloVe on disk, trains on a synthetic keyword-separable
-corpus with random embeddings (the model/pipeline shape is the point).
+Run: python -m bigdl_tpu.example.textclassification.train \
+         [--data-dir ./data/news20]
+With --data-dir the real 20 Newsgroups corpus + GloVe vectors are used
+(dataset/news20.py, downloading if the environment has network access);
+without it, ``synthetic_news20`` provides an offline corpus with the same
+shape and the words get deterministic hashed embeddings — either way the
+SAME tokenize -> vectorize -> train pipeline runs.
 """
 
 from __future__ import annotations
 
 import argparse
+import zlib
 
 import numpy as np
 
 from bigdl_tpu import nn
 from bigdl_tpu.dataset.dataset import LocalDataSet
+from bigdl_tpu.dataset.news20 import get_glove_w2v, get_news20, synthetic_news20
 from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.text import SentenceTokenizer
 from bigdl_tpu.optim.optimizer import Optimizer
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import Top1Accuracy
@@ -33,39 +40,81 @@ def build_model(class_num: int, seq_len: int = 32, embed_dim: int = 20
             .add(nn.LogSoftMax()))
 
 
-def synthetic_corpus(n: int, seq_len: int, embed_dim: int, class_num: int):
-    """Each class plants a class-specific embedding direction at random
-    positions (synthetic stand-in for GloVe-mapped 20-newsgroups)."""
-    rng = np.random.RandomState(0)
-    protos = rng.randn(class_num, embed_dim).astype(np.float32) * 2.0
+def _hashed_vec(word: str, dim: int) -> np.ndarray:
+    """Deterministic per-word gaussian embedding (GloVe stand-in when no
+    pre-trained vectors are on disk); crc32 so it is stable across runs."""
+    rng = np.random.RandomState(zlib.crc32(word.encode()) & 0x7FFFFFFF)
+    return rng.randn(dim).astype(np.float32)
+
+
+def vectorize(texts, seq_len: int, embed_dim: int, w2v=None):
+    """[(text, label)] -> [Sample((seq_len, embed_dim), label)]: tokenize,
+    truncate/zero-pad to seq_len, map words to vectors (GloVe dict when
+    given — unknown words zero, like the reference example — else hashed
+    embeddings)."""
+    tok = SentenceTokenizer(add_markers=False)
+    cache = {}
+
+    def vec(w):
+        if w not in cache:
+            if w2v is not None:
+                v = w2v.get(w)
+                cache[w] = (np.asarray(v, np.float32)[:embed_dim]
+                            if v is not None
+                            else np.zeros(embed_dim, np.float32))
+            else:
+                cache[w] = _hashed_vec(w, embed_dim)
+        return cache[w]
+
     samples = []
-    for i in range(n):
-        cls = i % class_num
-        seq = rng.randn(seq_len, embed_dim).astype(np.float32) * 0.3
-        for pos in rng.randint(0, seq_len, 4):
-            seq[pos] += protos[cls]
-        samples.append(Sample(seq, np.asarray([cls + 1], np.float32)))
+    for text, label in texts:
+        # tokenize per document: the streaming tokenizer SKIPS empty docs,
+        # which would desynchronize tokens from labels under zip
+        tokens = next(iter(tok(iter([text]))), [])[:seq_len]
+        seq = np.zeros((seq_len, embed_dim), np.float32)
+        for i, w in enumerate(tokens):
+            seq[i] = vec(w)
+        samples.append(Sample(seq, np.asarray([label], np.float32)))
     return samples
 
 
 def main(argv=None):
     p = argparse.ArgumentParser()
-    p.add_argument("--class-num", type=int, default=4)
+    p.add_argument("--data-dir", default=None,
+                   help="20news/GloVe dir (downloads if absent); "
+                        "default: offline synthetic corpus")
+    p.add_argument("--class-num", type=int, default=4,
+                   help="classes for the synthetic corpus (real data: 20)")
     p.add_argument("--seq-len", type=int, default=32)
     p.add_argument("--embed-dim", type=int, default=20)
     p.add_argument("--batch-size", type=int, default=16)
     p.add_argument("--max-epoch", type=int, default=6)
-    p.add_argument("--samples", type=int, default=128)
+    p.add_argument("--samples", type=int, default=128,
+                   help="synthetic corpus size")
     args = p.parse_args(argv)
 
-    samples = synthetic_corpus(args.samples, args.seq_len, args.embed_dim,
-                               args.class_num)
+    if args.data_dir:
+        texts = get_news20(args.data_dir)
+        try:
+            w2v = get_glove_w2v(args.data_dir, dim=max(50, args.embed_dim))
+        except (RuntimeError, OSError) as e:  # no net / no glove.6B.<d>d.txt
+            print(f"GloVe unavailable ({e}); using hashed embeddings")
+            w2v = None
+        class_num = max(label for _, label in texts)
+    else:
+        texts = synthetic_news20(n=args.samples, class_num=args.class_num)
+        w2v, class_num = None, args.class_num
+
+    samples = vectorize(texts, args.seq_len, args.embed_dim, w2v)
     split = int(0.8 * len(samples))
-    model = build_model(args.class_num, args.seq_len, args.embed_dim)
+    model = build_model(class_num, args.seq_len, args.embed_dim)
     opt = Optimizer(model=model, dataset=LocalDataSet(samples[:split]),
                     criterion=nn.ClassNLLCriterion(),
                     batch_size=args.batch_size,
                     end_when=Trigger.max_epoch(args.max_epoch))
+    from bigdl_tpu.optim.optim_method import SGD
+
+    opt.set_optim_method(SGD(learning_rate=0.1))
     opt.set_validation(Trigger.every_epoch(), samples[split:],
                        [Top1Accuracy()], args.batch_size)
     trained = opt.optimize()

@@ -132,12 +132,14 @@ class MultiHeadAttention(Module):
 class TransformerBlock(Module):
     """Pre-norm block: x + MHA(LN(x)); x + MLP(LN(x)). GELU MLP sized
     ``mlp_ratio``× embed. ``n_experts > 0`` swaps the dense MLP for a
-    top-1 mixture of experts (parallel/moe.py MoEMLP). Read the summed
-    load-balancing loss from ``TransformerLM.l_aux`` (the model routes it
-    through explicit outputs in every mode); the ``block.mlp.l_aux`` stash
-    is populated only when the BLOCK itself is called standalone via
-    ``forward`` — ``forward_with_aux`` (what TransformerLM uses) returns
-    the aux value instead of stashing."""
+    top-k mixture of experts (parallel/moe.py MoEMLP). Read the summed
+    load-balancing loss from ``TransformerLM.l_aux`` and the routing stats
+    from ``TransformerLM.last_moe_stats`` (the model routes both through
+    explicit outputs in every mode); the ``block.mlp.l_aux``/``last_stats``
+    stashes are populated only when the BLOCK itself is called standalone
+    via ``forward`` — ``forward_with_aux_stats`` (what TransformerLM uses)
+    returns aux + stats instead of stashing, which is what keeps the remat
+    path free of side-channel tracers."""
 
     def __init__(self, embed_dim: int, num_heads: int, mlp_ratio: int = 4,
                  dropout: float = 0.0, causal: bool = True,

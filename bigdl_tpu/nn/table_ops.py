@@ -221,8 +221,11 @@ class PairwiseDistance(Module):
 
     def forward(self, input):
         a, b = input[1], input[2]
-        d = jnp.abs(a - b) ** self.norm
-        return jnp.sum(d, axis=-1) ** (1.0 / self.norm)
+        d = jnp.sum(jnp.abs(a - b) ** self.norm, axis=-1)
+        # clamp before the p-th root: its gradient is infinite at 0, so
+        # identical inputs would give NaN grads (torch uses an eps the
+        # same way)
+        return jnp.maximum(d, 1e-12) ** (1.0 / self.norm)
 
 
 class CrossProduct(Module):

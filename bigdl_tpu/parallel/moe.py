@@ -47,13 +47,20 @@ def _topk_dispatch(gates, capacity, k: int = 1):
     disps, weights = [], []
     kept_total = jnp.zeros((), gates.dtype)
     expert_fraction = jnp.zeros((e,), gates.dtype)
-    for _ in range(k):
+    for j in range(k):
         expert = jnp.argmax(remaining, axis=1)                 # (T,)
         onehot = jax.nn.one_hot(expert, e, dtype=gates.dtype)  # (T, E)
+        if j > 0:
+            # a saturated router can underflow every non-top gate to 0.0;
+            # argmax would then re-pick arbitrarily — void such phantom
+            # routes so they neither occupy capacity nor skew the stats
+            valid = jnp.sum(remaining * onehot, axis=1) > 0
+            onehot = onehot * valid[:, None].astype(gates.dtype)
         # position in the expert's buffer (exclusive cumsum + choice offset)
         pos = jnp.cumsum(onehot, axis=0) - onehot + counts[None, :]
         pos = jnp.sum(pos * onehot, axis=1).astype(jnp.int32)  # (T,)
-        keep = pos < capacity
+        routed = jnp.sum(onehot, axis=1) > 0                   # (T,)
+        keep = (pos < capacity) & routed
         pos_oh = jax.nn.one_hot(jnp.where(keep, pos, capacity),
                                 capacity, dtype=gates.dtype)   # (T, C)
         disps.append(onehot[:, :, None] * pos_oh[:, None, :])  # (T, E, C)

@@ -1,0 +1,114 @@
+"""Flash-vs-dense attention matrix on the current backend.
+
+VERDICT r3 item 2: on first TPU contact, prove the pallas kernel compiled
+(not interpret mode), check numerics vs the dense path ON DEVICE, and time
+fwd+bwd at T in {1k, 4k, 16k} plus a block-size sweep at T=4k. Appends
+JSON rows to flash_matrix.jsonl. On CPU it still runs (interpret mode,
+small T) so the harness itself stays tested.
+
+Run: python scripts/flash_matrix.py [--out flash_matrix.jsonl]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="flash_matrix.jsonl")
+    p.add_argument("--iters", type=int, default=10)
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    cache_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+
+    from bigdl_tpu.nn.attention import dot_product_attention
+    from bigdl_tpu.ops.flash_attention import flash_attention
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    print(f"device: {getattr(dev, 'device_kind', dev.platform)}",
+          file=sys.stderr)
+
+    b, h, d = (2, 8, 64) if on_tpu else (1, 2, 32)
+    seqs = [1024, 4096, 16384] if on_tpu else [256]
+    blocks = ([(128, 128), (128, 256), (256, 128), (256, 256)]
+              if on_tpu else [(128, 128)])
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+
+    def make(t, seed=0):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        shape = (b, h, t, d)
+        return tuple(jax.random.normal(k, shape, dtype) * 0.3 for k in ks)
+
+    def bench(fn, qkv, iters):
+        loss = lambda q, k, v: jnp.sum(fn(q, k, v))  # noqa: E731
+        step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        g = step(*qkv)
+        jax.block_until_ready(g)  # compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            g = step(*qkv)
+        jax.block_until_ready(g)
+        return (time.perf_counter() - t0) / iters
+
+    rows = []
+    with open(args.out, "a") as fh:
+        def emit(row):
+            row["device"] = str(getattr(dev, "device_kind", dev.platform))
+            rows.append(row)
+            fh.write(json.dumps(row) + "\n")
+            fh.flush()
+            print(json.dumps(row), file=sys.stderr)
+
+        # numerics: flash vs dense ON THIS BACKEND (compiled on TPU)
+        qkv = make(seqs[0])
+        dense_out = dot_product_attention(*qkv, causal=True)
+        flash_out = flash_attention(*qkv, causal=True)
+        err = float(jnp.max(jnp.abs(
+            dense_out.astype(jnp.float32) - flash_out.astype(jnp.float32))))
+        emit({"check": "allclose", "seq": seqs[0],
+              "max_abs_err": err, "ok": err < (5e-2 if on_tpu else 1e-4)})
+
+        for t in seqs:
+            qkv = make(t)
+            try:
+                ms_d = bench(lambda q, k, v: dot_product_attention(
+                    q, k, v, causal=True), qkv, args.iters) * 1e3
+            except Exception as e:  # dense may OOM at 16k
+                ms_d, err_d = None, f"{type(e).__name__}"
+                emit({"kind": "dense", "seq": t, "error": err_d})
+            else:
+                emit({"kind": "dense", "seq": t, "ms_per_iter": round(ms_d, 3),
+                      "tokens_per_sec": round(b * t / (ms_d / 1e3), 0)})
+            ms_f = bench(lambda q, k, v: flash_attention(
+                q, k, v, causal=True), qkv, args.iters) * 1e3
+            emit({"kind": "flash", "seq": t, "ms_per_iter": round(ms_f, 3),
+                  "tokens_per_sec": round(b * t / (ms_f / 1e3), 0),
+                  "speedup_vs_dense": (round(ms_d / ms_f, 3)
+                                       if ms_d else None)})
+
+        # block sweep at the middle size
+        t = seqs[min(1, len(seqs) - 1)]
+        qkv = make(t)
+        for bq, bk in blocks:
+            ms = bench(lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                q, k, v, causal=True, block_q=bq, block_k=bk),
+                qkv, args.iters) * 1e3
+            emit({"kind": "flash_block", "seq": t, "block_q": bq,
+                  "block_k": bk, "ms_per_iter": round(ms, 3)})
+
+    print(json.dumps(rows))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""One-shot TPU session: run EVERYTHING that needs real hardware, in
+priority order, appending results as it goes — designed for short tunnel
+windows (the axon tunnel wedges for hours; when it opens, run this).
+
+Order (VERDICT r3 priorities):
+  1. quick sweep (batch/format matrix)         -> tpu_sweep.jsonl
+  2. headline bench (resnet50 + measured ref)  -> BENCH line + history
+  3. flash-vs-dense transformer matrix         -> flash_matrix.jsonl
+  4. (optional, --profile) profiler trace      -> /tmp/tpu_trace
+
+Every stage is wrapped in its own subprocess + timeout so a wedge mid-way
+still leaves earlier results on disk.
+
+Run: python scripts/tpu_session.py [--skip-sweep] [--profile]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_stage(name, cmd, timeout, env=None):
+    print(f"\n=== [{name}] {' '.join(cmd)} (timeout {timeout}s)",
+          file=sys.stderr)
+    t0 = time.time()
+    try:
+        proc = subprocess.run(cmd, cwd=HERE, timeout=timeout,
+                              env=dict(os.environ, **(env or {})))
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        rc = "timeout"
+    print(f"=== [{name}] rc={rc} in {time.time() - t0:.0f}s",
+          file=sys.stderr)
+    return rc
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--skip-sweep", action="store_true")
+    p.add_argument("--profile", action="store_true")
+    p.add_argument("--probe-timeout", type=int, default=60)
+    args = p.parse_args(argv)
+
+    # 0. probe — bail fast if the tunnel is wedged
+    rc = run_stage("probe", [sys.executable, "-c",
+                             "import jax; d=jax.devices()[0]; "
+                             "print(d.platform, d.device_kind)"],
+                   args.probe_timeout)
+    if rc != 0:
+        print("tunnel wedged; nothing run", file=sys.stderr)
+        return 1
+
+    results = {}
+    if not args.skip_sweep:
+        results["sweep"] = run_stage(
+            "sweep", [sys.executable, "scripts/tpu_sweep.py", "--quick",
+                      "--iters", "10"], 900)
+
+    results["bench"] = run_stage("bench", [sys.executable, "bench.py"], 700)
+
+    results["flash"] = run_stage(
+        "flash-matrix", [sys.executable, "scripts/flash_matrix.py"], 1200)
+
+    if args.profile:
+        results["profile"] = run_stage(
+            "profile", [sys.executable, "-m", "bigdl_tpu.models.perf",
+                        "--model", "resnet50", "--batch-size", "256",
+                        "--iterations", "10", "--dtype", "bfloat16",
+                        "--format", "NHWC", "--master-f32",
+                        "--profile", "/tmp/tpu_trace"], 700)
+
+    print(json.dumps(results))
+    return 0 if all(r == 0 for r in results.values()) else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,97 @@
+"""news20 / movielens dataset helpers (≙ ref pyspark/bigdl/dataset/
+news20.py, movielens.py — parse layout and return shapes; download paths
+are exercised only as cache-hit short-circuits since this image is
+offline)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset import movielens, news20
+
+
+def test_get_news20_parses_extracted_tree(tmp_path):
+    # hand-build the 20news-18828 layout: download must short-circuit
+    root = tmp_path / "20news-18828"
+    for group, docs in [("alt.atheism", {"1001": "first doc text"}),
+                        ("sci.space", {"1002": "orbit talk", "1003": "x"})]:
+        d = root / group
+        d.mkdir(parents=True)
+        for name, body in docs.items():
+            (d / name).write_text(body)
+    texts = news20.get_news20(str(tmp_path))
+    assert len(texts) == 3
+    labels = sorted({l for _, l in texts})
+    assert labels == [1, 2]  # 1-based, directory order
+    assert ("first doc text", 1) in texts
+
+
+def test_news20_download_raises_clear_error_offline(tmp_path):
+    with pytest.raises(RuntimeError, match="synthetic_news20"):
+        news20._maybe_download("nope.tar.gz", str(tmp_path),
+                               "http://127.0.0.1:9/nope.tar.gz")
+
+
+def test_synthetic_news20_shape_and_separability():
+    texts = news20.synthetic_news20(n=40, class_num=4)
+    assert len(texts) == 40
+    assert sorted({l for _, l in texts}) == [1, 2, 3, 4]
+    # every class-c document contains its topic word; no other class's
+    for text, label in texts:
+        assert news20._TOPIC_WORDS[label - 1] in text
+        for other in range(4):
+            if other != label - 1:
+                assert news20._TOPIC_WORDS[other] not in text
+
+
+def test_movielens_parses_ratings_dat(tmp_path):
+    ml = tmp_path / "ml-1m"
+    ml.mkdir()
+    (ml / "ratings.dat").write_text(
+        "1::1193::5::978300760\n2::661::3::978302109\n")
+    data = movielens.read_data_sets(str(tmp_path))
+    assert data.shape == (2, 4)
+    np.testing.assert_array_equal(data[0], [1, 1193, 5, 978300760])
+    np.testing.assert_array_equal(movielens.get_id_pairs(str(tmp_path))[1],
+                                  [2, 661])
+    assert movielens.get_id_ratings(str(tmp_path)).shape == (2, 3)
+
+
+def test_synthetic_movielens_shape_and_scale():
+    data = movielens.synthetic_movielens(n_users=10, n_items=20,
+                                         n_ratings=200)
+    assert data.shape == (200, 4)
+    assert data[:, 0].min() >= 1 and data[:, 0].max() <= 10
+    assert data[:, 1].min() >= 1 and data[:, 1].max() <= 20
+    assert set(np.unique(data[:, 2])) <= {1, 2, 3, 4, 5}
+
+
+def test_textclassification_example_pipeline_learns():
+    """The example's tokenize -> vectorize -> train pipeline reaches high
+    accuracy on the synthetic corpus (keyword-separable by construction)."""
+    from bigdl_tpu.example.textclassification.train import main
+
+    _, acc = main(["--samples", "96", "--class-num", "3", "--max-epoch", "8"])
+    assert acc > 0.85, acc
+
+
+def test_get_news20_ignores_stray_files(tmp_path):
+    root = tmp_path / "20news-18828"
+    (root / "alt.atheism").mkdir(parents=True)
+    (root / "alt.atheism" / "1001").write_text("doc a")
+    (root / "README").parent.mkdir(exist_ok=True)
+    (root / "README").write_text("stray file must not shift labels")
+    (root / "sci.space").mkdir()
+    (root / "sci.space" / "1002").write_text("doc b")
+    texts = news20.get_news20(str(tmp_path))
+    assert sorted(texts) == [("doc a", 1), ("doc b", 2)]
+
+
+def test_vectorize_keeps_labels_aligned_with_empty_docs():
+    from bigdl_tpu.example.textclassification.train import vectorize
+
+    texts = [("hello world", 1), ("   ", 2), ("goodbye moon", 3)]
+    samples = vectorize(texts, 4, 8, None)
+    assert [int(s.label()) for s in samples] == [1, 2, 3]
+    assert np.abs(samples[1].feature()).sum() == 0  # empty doc -> zero seq

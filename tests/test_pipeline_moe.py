@@ -368,3 +368,24 @@ def test_transformer_lm_exposes_moe_routing_stats(remat):
     assert 0.0 <= float(stats["drop_rate"]) <= 1.0
     frac = np.asarray(stats["expert_fraction"])
     assert frac.shape == (4,) and frac.sum() == pytest.approx(1.0, abs=1e-5)
+
+
+def test_top2_saturated_router_has_no_phantom_routes():
+    """When every non-top gate underflows to exactly 0, the second choice
+    must be voided, not re-picked arbitrarily (which would both occupy
+    capacity and skew the stats toward expert 0)."""
+    from bigdl_tpu.parallel.moe import _topk_dispatch
+
+    t, e, cap = 6, 4, 8
+    gates = np.zeros((t, e), np.float32)
+    gates[:, 2] = 1.0  # fully saturated on expert 2
+    dispatch, combine, stats = _topk_dispatch(jnp.asarray(gates), cap, k=2)
+    d = np.asarray(dispatch)
+    # only expert 2 receives routes; especially NOT expert 0 (the argmax
+    # tie-break target of an all-zero row)
+    assert d[:, 0].sum() == 0 and d[:, 1].sum() == 0 and d[:, 3].sum() == 0
+    assert d[:, 2].sum() == t  # each token routed once
+    frac = np.asarray(stats["expert_fraction"])
+    assert frac[0] == 0 and frac[2] == pytest.approx(0.5)  # 1 of 2 choices
+    # second choices are unrouted -> reported as dropped
+    assert float(stats["drop_rate"]) == pytest.approx(0.5)
