@@ -119,8 +119,12 @@ class BCECriterion(Criterion):
         self.size_average = size_average
 
     def forward(self, input, target):
-        eps = 1e-12
-        x = jnp.clip(input, eps, 1.0 - eps)
+        # dtype-aware clamp: the reference's 1e-12 is fine in float64 but
+        # underflows in f32 (1.0 - 1e-12 == 1.0), making a saturated
+        # sigmoid produce 0 * log(0) = NaN
+        x = jnp.asarray(input)
+        eps = jnp.finfo(x.dtype).eps
+        x = jnp.clip(x, eps, 1.0 - eps)
         loss = -(target * jnp.log(x) + (1.0 - target) * jnp.log(1.0 - x))
         if self.weights is not None:
             loss = loss * self.weights

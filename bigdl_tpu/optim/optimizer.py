@@ -384,8 +384,11 @@ class LocalOptimizer(Optimizer):
             except StopIteration:
                 data_iter = self._minibatches(self.dataset, self.batch_size)
                 batch = next(data_iter)
-            x = jnp.asarray(batch.get_input())
-            y = jnp.asarray(batch.get_target())
+            # preserve Table structure for multi-input models (jnp.asarray
+            # on a Table would stack same-shaped features into one array
+            # and fail on heterogeneous ones; Table is a pytree)
+            x = jax.tree.map(jnp.asarray, batch.get_input())
+            y = jax.tree.map(jnp.asarray, batch.get_target())
             lrs = ts.current_lrs()
             lr = float(lrs[0])
             rng = bt_random.next_key()

@@ -95,3 +95,27 @@ def test_vectorize_keeps_labels_aligned_with_empty_docs():
     samples = vectorize(texts, 4, 8, None)
     assert [int(s.label()) for s in samples] == [1, 2, 3]
     assert np.abs(samples[1].feature()).sum() == 0  # empty doc -> zero seq
+
+
+def test_bce_criterion_finite_at_saturation():
+    # regression: eps=1e-12 underflowed in f32 (1.0 - 1e-12 == 1.0), so a
+    # saturated sigmoid output made BCE return NaN (found by the NCF
+    # example collapsing)
+    import jax.numpy as jnp
+
+    from bigdl_tpu import nn
+
+    crit = nn.BCECriterion()
+    x = jnp.asarray([[1.0], [0.0]], jnp.float32)
+    y = jnp.asarray([[1.0], [0.0]], jnp.float32)
+    assert np.isfinite(float(crit.forward(x, y)))
+    wrong = jnp.asarray([[0.0], [1.0]], jnp.float32)
+    assert np.isfinite(float(crit.forward(wrong, y)))
+
+
+@pytest.mark.slow
+def test_ncf_example_beats_majority_baseline():
+    from bigdl_tpu.example.recommendation.ncf import main
+
+    _, acc, base = main(["--ratings", "4096", "--max-epoch", "12"])
+    assert acc > base + 0.1, (acc, base)
