@@ -68,6 +68,9 @@ def main(argv=None):
     data = (read_data_sets(args.data_dir) if args.data_dir
             else synthetic_movielens(n_users=64, n_items=128,
                                      n_ratings=args.ratings))
+    # ml-1m's ratings.dat is user-sorted: shuffle before splitting or the
+    # held-out users would all have untrained embeddings
+    data = data[np.random.RandomState(0).permutation(len(data))]
     n_users, n_items = int(data[:, 0].max()), int(data[:, 1].max())
     samples = ratings_to_samples(data)
     split = int(0.9 * len(samples))

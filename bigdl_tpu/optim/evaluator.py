@@ -52,7 +52,9 @@ class Evaluator:
         else:
             it = iter(())
         for batch in it:
-            x = jnp.asarray(batch.get_input())
+            # preserve Table structure for multi-input models (pytree map;
+            # jnp.asarray on a Table would stack/fail)
+            x = jax.tree.map(jnp.asarray, batch.get_input())
             y = batch.get_target()
             out = fn(params, buffers, x)
             for i, m in enumerate(methods):

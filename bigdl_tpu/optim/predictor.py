@@ -11,6 +11,7 @@ from __future__ import annotations
 import threading
 from typing import List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -46,7 +47,9 @@ class LocalPredictor:
         buffers = self.model.buffers_dict()
         outs: List[np.ndarray] = []
         for batch in self._batches(dataset):
-            x = jnp.asarray(batch.get_input())
+            # preserve Table structure for multi-input models (pytree map;
+            # jnp.asarray on a Table would stack/fail)
+            x = jax.tree.map(jnp.asarray, batch.get_input())
             out = np.asarray(self._fn(params, buffers, x))
             outs.extend(out[i] for i in range(out.shape[0]))
         return outs

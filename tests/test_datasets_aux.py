@@ -119,3 +119,29 @@ def test_ncf_example_beats_majority_baseline():
 
     _, acc, base = main(["--ratings", "4096", "--max-epoch", "12"])
     assert acc > base + 0.1, (acc, base)
+
+
+def test_evaluator_and_predictor_handle_multi_input_samples():
+    # regression: Evaluator/LocalPredictor collapsed multi-input Tables
+    # with jnp.asarray (stacks same-shape features / fails on mixed ones)
+    import jax.numpy as jnp
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.optim import LocalPredictor
+    from bigdl_tpu.optim.evaluator import Evaluator
+    from bigdl_tpu.optim.validation import Loss
+
+    a, b = nn.Input(), nn.Input()
+    out = nn.Sigmoid().inputs(nn.Linear(4, 1).inputs(
+        nn.JoinTable(2).inputs(nn.Identity().inputs(a),
+                               nn.Identity().inputs(b))))
+    g = nn.Graph([a, b], out)
+    rng = np.random.RandomState(0)
+    samples = [Sample([rng.randn(2).astype(np.float32),
+                       rng.randn(2).astype(np.float32)],
+                      np.asarray([1.0], np.float32)) for _ in range(8)]
+    preds = LocalPredictor(g).predict(samples)
+    assert len(preds) == 8 and preds[0].shape == (1,)
+    res = Evaluator(g).test(samples, [Loss(nn.BCECriterion())], batch_size=4)
+    assert np.isfinite(res[0][1].result()[0])

@@ -82,3 +82,13 @@ def test_longcontext_example():
                       "--seq-parallel", "2"])
     assert len(losses) == 3
     assert losses[-1] < losses[0]
+
+
+def test_widedeep_example_feature_columns_learn():
+    """Wide&Deep over BucketizedCol/HashBucket/CrossCol/IndicatorCol: the
+    crossed wide feature must lift accuracy well above the majority class."""
+    from bigdl_tpu.example.widedeep.train import main
+
+    rnd.set_seed(3)
+    _, acc, base = main(["--samples", "1024", "--max-epoch", "8"])
+    assert acc > base + 0.08, (acc, base)
