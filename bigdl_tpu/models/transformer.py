@@ -37,7 +37,8 @@ class TransformerLM(Module):
                  sequence_parallel: Optional[str] = None,
                  tie_embeddings: bool = True, use_flash: bool = False,
                  remat: bool = False, n_experts: int = 0,
-                 expert_parallel: Optional[str] = None):
+                 expert_parallel: Optional[str] = None,
+                 num_kv_heads: Optional[int] = None):
         super().__init__()
         self.vocab_size = vocab_size
         self.embed_dim = embed_dim
@@ -53,7 +54,8 @@ class TransformerLM(Module):
                                      dropout=dropout, causal=causal,
                                      sequence_parallel=sequence_parallel,
                                      use_flash=use_flash, n_experts=n_experts,
-                                     expert_parallel=expert_parallel))
+                                     expert_parallel=expert_parallel,
+                                     num_kv_heads=num_kv_heads))
         self.ln_f = LayerNorm(embed_dim)
         if not tie_embeddings:
             self.head = nn.Linear(embed_dim, vocab_size, with_bias=False)

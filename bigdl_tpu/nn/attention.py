@@ -85,35 +85,60 @@ class MultiHeadAttention(Module):
     def __init__(self, embed_dim: int, num_heads: int, dropout: float = 0.0,
                  with_bias: bool = True, causal: bool = False,
                  sequence_parallel: Optional[str] = None,
-                 use_flash: bool = False):
+                 use_flash: bool = False,
+                 num_kv_heads: Optional[int] = None):
         super().__init__()
         assert embed_dim % num_heads == 0
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads
+        # grouped-query attention (GQA): fewer kv heads, each shared by
+        # num_heads/num_kv_heads consecutive query heads — shrinks the kv
+        # projection and (with use_flash) the kv HBM traffic
+        self.num_kv_heads = num_kv_heads or num_heads
+        if num_heads % self.num_kv_heads:
+            raise ValueError(f"num_heads {num_heads} not a multiple of "
+                             f"num_kv_heads {self.num_kv_heads}")
         self.causal = causal
         self.dropout_p = dropout
         self.sequence_parallel = sequence_parallel
         # opt-in pallas flash kernel (bigdl_tpu/ops/flash_attention.py):
         # O(T*D) memory instead of the dense (T,T) score matrix
         self.use_flash = use_flash
-        self.qkv = Linear(embed_dim, 3 * embed_dim, with_bias=with_bias)
+        kv_dim = self.num_kv_heads * self.head_dim
+        self.qkv = Linear(embed_dim, embed_dim + 2 * kv_dim,
+                          with_bias=with_bias)
         self.out_proj = Linear(embed_dim, embed_dim, with_bias=with_bias)
         if dropout > 0:
             self.drop = Dropout(dropout)
 
-    def _split_heads(self, x):
+    def _split_heads(self, x, n_heads=None):
         b, t, _ = x.shape
-        return x.reshape(b, t, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+        n = n_heads or self.num_heads
+        return x.reshape(b, t, n, self.head_dim).transpose(0, 2, 1, 3)
+
+    def _expand_kv(self, k, v):
+        """Materialize shared kv heads for the non-flash paths (the flash
+        kernel reads them via its BlockSpec index map instead)."""
+        if self.num_kv_heads == self.num_heads:
+            return k, v
+        rep = self.num_heads // self.num_kv_heads
+        return jnp.repeat(k, rep, 1), jnp.repeat(v, rep, 1)
 
     def forward(self, input):
         b, t, _ = input.shape
         qkv = self.qkv(input.reshape(b * t, self.embed_dim)).reshape(b, t, -1)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q, k, v = map(self._split_heads, (q, k, v))
+        kv_dim = self.num_kv_heads * self.head_dim
+        q = self._split_heads(qkv[..., :self.embed_dim])
+        k = self._split_heads(
+            qkv[..., self.embed_dim:self.embed_dim + kv_dim],
+            self.num_kv_heads)
+        v = self._split_heads(qkv[..., self.embed_dim + kv_dim:],
+                              self.num_kv_heads)
         if self.sequence_parallel is not None:
             from bigdl_tpu.parallel.ring_attention import ring_attention
 
+            k, v = self._expand_kv(k, v)
             o = ring_attention(q, k, v, axis_name=self.sequence_parallel,
                                causal=self.causal)
         elif self.use_flash:
@@ -121,6 +146,7 @@ class MultiHeadAttention(Module):
 
             o = flash_attention(q, k, v, causal=self.causal)
         else:
+            k, v = self._expand_kv(k, v)
             o = dot_product_attention(q, k, v, causal=self.causal)
         o = o.transpose(0, 2, 1, 3).reshape(b, t, self.embed_dim)
         o = self.out_proj(o.reshape(b * t, self.embed_dim)).reshape(b, t, -1)
@@ -145,11 +171,13 @@ class TransformerBlock(Module):
                  dropout: float = 0.0, causal: bool = True,
                  sequence_parallel: Optional[str] = None,
                  use_flash: bool = False, n_experts: int = 0,
-                 expert_parallel: Optional[str] = None):
+                 expert_parallel: Optional[str] = None,
+                 num_kv_heads: Optional[int] = None):
         super().__init__()
         self.ln1 = LayerNorm(embed_dim)
         self.attn = MultiHeadAttention(embed_dim, num_heads, dropout=dropout,
                                        causal=causal,
+                                       num_kv_heads=num_kv_heads,
                                        sequence_parallel=sequence_parallel,
                                        use_flash=use_flash)
         self.ln2 = LayerNorm(embed_dim)

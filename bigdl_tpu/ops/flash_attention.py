@@ -96,8 +96,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                                      _NEG_INF)
 
 
-def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
-    """Returns (out, lse); lse is the per-row score logsumexp (bh, t, 1)."""
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
+                   group=1):
+    """Returns (out, lse); lse is the per-row score logsumexp (bh, t, 1).
+
+    ``group`` > 1 is grouped-query attention: q is (bh, t, d) with
+    ``group`` consecutive q heads sharing the kv head at index
+    ``b // group`` — the kv BlockSpec index map reads the shared head
+    directly from HBM, no materialized repeat."""
     bh, t, d = q.shape
     tk = k.shape[1]
     grid = (bh, t // block_q, tk // block_k)
@@ -111,8 +117,10 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j: (b // group, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j: (b // group, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -127,20 +135,20 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     )(q, k, v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret, group=1):
     out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                            interpret)
+                            interpret, group)
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret, group=1):
     out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                              interpret)
+                              interpret, group)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _flash_bwd(causal, scale, block_q, block_k, interpret, group, res, g):
     """Blocked flash backward (pure XLA, lax.scan over kv blocks): memory
     O(T·block_k) instead of the dense O(T²) score matrix. Standard
     recurrence: with P = exp(S - lse) and D = rowsum(dO ∘ O),
@@ -149,6 +157,11 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
     """
     q, k, v, out, lse = res
     bh, t, d = q.shape
+    if group > 1:
+        # GQA backward: expand kv to per-q-head view, then sum dk/dv over
+        # each shared group (consecutive q heads share a kv head)
+        k = jnp.repeat(k, group, axis=0)
+        v = jnp.repeat(v, group, axis=0)
     tk = k.shape[1]
     kv_offset = tk - t
     qf = q.astype(jnp.float32)
@@ -184,6 +197,9 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
         (jnp.arange(n_kb), jnp.moveaxis(kb, 1, 0), jnp.moveaxis(vb, 1, 0)))
     dk = jnp.moveaxis(dk_b, 0, 1).reshape(bh, tk, d)
     dv = jnp.moveaxis(dv_b, 0, 1).reshape(bh, tk, d)
+    if group > 1:
+        dk = dk.reshape(bh // group, group, tk, d).sum(1)
+        dv = dv.reshape(bh // group, group, tk, d).sum(1)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -195,18 +211,30 @@ def flash_attention(q, k, v, causal: bool = False,
                     block_q: int = 128, block_k: int = 128,
                     interpret: Optional[bool] = None):
     """(B, H, T, D) flash attention. Falls back to the dense XLA path when
-    the sequence length doesn't tile into (block_q, block_k)."""
+    the sequence length doesn't tile into (block_q, block_k).
+
+    Grouped-query attention: k/v may carry fewer heads (B, H_kv, Tk, D)
+    with H % H_kv == 0 — consecutive groups of H/H_kv query heads share a
+    kv head. The kernel reads the shared head via its BlockSpec index map
+    (no materialized repeat in HBM)."""
     b, h, t, d = q.shape
-    tk = k.shape[2]
+    h_kv, tk = k.shape[1], k.shape[2]
+    if h % h_kv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
+    group = h // h_kv
     if interpret is None:
         interpret = jax.devices()[0].platform == "cpu"
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     if t % block_q or tk % block_k:
         from bigdl_tpu.nn.attention import dot_product_attention
 
+        if group > 1:
+            k = jnp.repeat(k, group, axis=1)
+            v = jnp.repeat(v, group, axis=1)
         return dot_product_attention(q, k, v, causal=causal, scale=scale)
     qf = q.reshape(b * h, t, d)
-    kf = k.reshape(b * h, tk, d)
-    vf = v.reshape(b * h, tk, d)
-    out = _flash(qf, kf, vf, causal, scale, block_q, block_k, interpret)
+    kf = k.reshape(b * h_kv, tk, d)
+    vf = v.reshape(b * h_kv, tk, d)
+    out = _flash(qf, kf, vf, causal, scale, block_q, block_k, interpret,
+                 group)
     return out.reshape(b, h, t, d)

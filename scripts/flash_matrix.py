@@ -107,6 +107,15 @@ def main(argv=None):
             emit({"kind": "flash_block", "seq": t, "block_q": bq,
                   "block_k": bk, "ms_per_iter": round(ms, 3)})
 
+        # GQA: kv heads / 4 via the kernel's index-mapped shared heads
+        if h % 4 == 0:
+            q, k, v = make(t)
+            k, v = k[:, :h // 4], v[:, :h // 4]
+            ms = bench(lambda q, k, v: flash_attention(q, k, v, causal=True),
+                       (q, k, v), args.iters) * 1e3
+            emit({"kind": "flash_gqa", "seq": t, "kv_heads": h // 4,
+                  "q_heads": h, "ms_per_iter": round(ms, 3)})
+
     print(json.dumps(rows))
 
 
