@@ -132,3 +132,98 @@ class TransformerLM(Module):
         else:
             logits = self.head(x.reshape(b * t, -1)).reshape(b, t, -1)
         return logits
+
+    # ------------------------------------------------- KV-cache decoding
+    def init_cache(self, batch: int, max_len: int, dtype=jnp.float32):
+        """Per-block attention KV caches for incremental decoding."""
+        return [getattr(self, f"block{i}").attn.init_cache(batch, max_len,
+                                                           dtype)
+                for i in range(self.num_layers)]
+
+    def prefill(self, ids, caches):
+        """Batched prompt prefill: one causal pass over ids (B, T0) that
+        populates every block's KV cache and returns the LAST position's
+        logits — O(T0²) once vs T0 masked full-cache steps."""
+        b, t = ids.shape
+        x = jnp.take(self.tok_embed, ids, axis=0)
+        x = x + self.pos_embed[:t][None]
+        new_caches = []
+        for i in range(self.num_layers):
+            x, c = getattr(self, f"block{i}").forward_prefill(x, caches[i], 0)
+            new_caches.append(c)
+        x = self.ln_f(x[:, -1:])
+        if self.tie_embeddings:
+            logits = jnp.einsum("btc,vc->btv", x, self.tok_embed)
+        else:
+            logits = self.head(x.reshape(b, -1))[:, None, :]
+        return logits[:, 0], new_caches
+
+    def decode_step(self, ids_t, pos, caches):
+        """One token in, next-token logits out. ids_t (B,) int, ``pos`` a
+        traced scalar position; caches from ``init_cache`` (static shapes —
+        the whole step jits once and is reused for every position)."""
+        x = jnp.take(self.tok_embed, ids_t, axis=0)[:, None, :]  # (B,1,C)
+        x = x + jax.lax.dynamic_slice_in_dim(self.pos_embed, pos, 1, 0)[None]
+        new_caches = []
+        for i in range(self.num_layers):
+            x, c = getattr(self, f"block{i}").forward_step(x, caches[i], pos)
+            new_caches.append(c)
+        x = self.ln_f(x)
+        if self.tie_embeddings:
+            logits = jnp.einsum("btc,vc->btv", x, self.tok_embed)
+        else:
+            logits = self.head(x.reshape(x.shape[0], -1))[:, None, :]
+        return logits[:, 0], new_caches
+
+    def generate(self, prompt_ids, max_new_tokens: int,
+                 temperature: float = 0.0, rng=None, max_len=None):
+        """Autoregressive generation with a KV cache (the transformer
+        analog of the reference's RecurrentDecoder, nn/RecurrentDecoder
+        .scala): prefill the prompt one jitted step at a time, then sample
+        greedily (``temperature == 0``) or from the tempered softmax.
+        Returns (B, len(prompt) + max_new_tokens) ids."""
+        from bigdl_tpu.nn.module import bind
+        from bigdl_tpu.utils import random as bt_random
+
+        prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+        if prompt_ids.ndim == 1:
+            prompt_ids = prompt_ids[None]
+        b, t0 = prompt_ids.shape
+        total = t0 + max_new_tokens
+        max_len = max_len or total
+        if total > max_len:
+            raise ValueError(
+                f"prompt ({t0}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_len {max_len}: the cache and positional "
+                "lookups would silently clamp")
+        if max_len > self.pos_embed.shape[0]:
+            raise ValueError(f"max_len {max_len} exceeds the model's "
+                             f"positional table {self.pos_embed.shape[0]}")
+        params, buffers = self.params_dict(), self.buffers_dict()
+
+        def step(p, ids_t, pos, caches):
+            with bind(self, p, buffers, False, None):
+                return self.decode_step(ids_t, pos, caches)
+
+        def prefill_fn(p, ids, caches):
+            with bind(self, p, buffers, False, None):
+                return self.prefill(ids, caches)
+
+        step_jit = jax.jit(step, donate_argnums=(3,))
+        caches = self.init_cache(b, max_len)
+        ids = [prompt_ids[:, i] for i in range(t0)]
+        logits, caches = jax.jit(prefill_fn, donate_argnums=(2,))(
+            params, prompt_ids, caches)
+        for i in range(max_new_tokens):
+            if temperature <= 0.0:
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            else:
+                rng = rng if rng is not None else bt_random.next_key()
+                rng, sub = jax.random.split(rng)
+                nxt = jax.random.categorical(
+                    sub, logits / temperature, axis=-1).astype(jnp.int32)
+            ids.append(nxt)
+            if i < max_new_tokens - 1:
+                logits, caches = step_jit(params, nxt,
+                                          jnp.int32(t0 + i), caches)
+        return jnp.stack(ids, axis=1)

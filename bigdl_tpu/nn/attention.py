@@ -125,6 +125,68 @@ class MultiHeadAttention(Module):
         rep = self.num_heads // self.num_kv_heads
         return jnp.repeat(k, rep, 1), jnp.repeat(v, rep, 1)
 
+    def init_cache(self, batch: int, max_len: int, dtype=jnp.float32):
+        """Zero KV cache for incremental decoding: (k, v) each
+        (B, H_kv, max_len, D)."""
+        shape = (batch, self.num_kv_heads, max_len, self.head_dim)
+        return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+    def _split_kv_step(self, qkv):
+        kv_dim = self.num_kv_heads * self.head_dim
+        q = self._split_heads(qkv[..., :self.embed_dim])
+        k = self._split_heads(qkv[..., self.embed_dim:self.embed_dim + kv_dim],
+                              self.num_kv_heads)
+        v = self._split_heads(qkv[..., self.embed_dim + kv_dim:],
+                              self.num_kv_heads)
+        return q, k, v
+
+    def forward_step(self, x_t, cache, pos):
+        """One decode step: x_t (B, 1, C) attends over the cache filled up
+        to ``pos`` (a traced scalar — static shapes, masked softmax over
+        the full cache length, the XLA-friendly form). GQA runs as a
+        grouped einsum against the UN-expanded cache (scores accumulated
+        in f32, matching dot_product_attention) — no per-step
+        num_heads-sized kv copy."""
+        b = x_t.shape[0]
+        qkv = self.qkv(x_t.reshape(b, self.embed_dim)).reshape(b, 1, -1)
+        q, k_t, v_t = self._split_kv_step(qkv)      # q (B,H,1,D)
+        k_cache, v_cache = cache
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k_t.astype(k_cache.dtype), (0, 0, pos, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v_t.astype(v_cache.dtype), (0, 0, pos, 0))
+        h_kv = self.num_kv_heads
+        rep = self.num_heads // h_kv
+        qg = q.reshape(b, h_kv, rep, self.head_dim)  # 1-token axis folded
+        scale = 1.0 / math.sqrt(self.head_dim)
+        s = jnp.einsum("bgrd,bgtd->bgrt", qg, k_cache,
+                       preferred_element_type=jnp.float32) * scale
+        live = jnp.arange(k_cache.shape[2]) <= pos
+        s = jnp.where(live[None, None, None, :], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
+        o = jnp.einsum("bgrt,bgtd->bgrd", p, v_cache)
+        o = o.reshape(b, self.embed_dim).astype(x_t.dtype)
+        o = self.out_proj(o).reshape(b, 1, -1)
+        return o, (k_cache, v_cache)
+
+    def forward_prefill(self, x, cache, pos0: int = 0):
+        """Batched prompt prefill: one causal pass over x (B, T0, C) that
+        both produces the outputs and writes K/V into the cache at
+        ``pos0`` — O(T0²) once instead of T0 masked steps over max_len."""
+        b, t, _ = x.shape
+        qkv = self.qkv(x.reshape(b * t, self.embed_dim)).reshape(b, t, -1)
+        q, k, v = self._split_kv_step(qkv)
+        k_cache, v_cache = cache
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, 0, pos0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, 0, pos0, 0))
+        kx, vx = self._expand_kv(k, v)  # prompt-only attention, one-time
+        o = dot_product_attention(q, kx, vx, causal=True)
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, self.embed_dim)
+        o = self.out_proj(o.reshape(b * t, self.embed_dim)).reshape(b, t, -1)
+        return o, (k_cache, v_cache)
+
     def forward(self, input):
         b, t, _ = input.shape
         qkv = self.qkv(input.reshape(b * t, self.embed_dim)).reshape(b, t, -1)
@@ -214,6 +276,28 @@ class TransformerBlock(Module):
         same explicit-output convention as the aux loss so they survive
         jax.checkpoint; see parallel/moe.py record_moe_metrics."""
         return self._forward_impl(input)
+
+    def forward_step(self, x_t, cache, pos):
+        """One decode step through the block with the attention KV cache
+        ((k, v) from ``self.attn.init_cache``); returns (out, new_cache).
+        Inference-time path: dropout off, MoE stats discarded."""
+        h, cache = self.attn.forward_step(self.ln1(x_t), cache, pos)
+        return self._mlp_residual(x_t + h), cache
+
+    def forward_prefill(self, x, cache, pos0: int = 0):
+        """Batched prompt pass writing the attention cache (see
+        MultiHeadAttention.forward_prefill)."""
+        h, cache = self.attn.forward_prefill(self.ln1(x), cache, pos0)
+        return self._mlp_residual(x + h), cache
+
+    def _mlp_residual(self, x):
+        b, t, c = x.shape
+        if self.n_experts > 0:
+            m, _, _ = self.mlp.forward_with_stats(self.ln2(x))
+        else:
+            m = self.fc2(jax.nn.gelu(
+                self.fc1(self.ln2(x).reshape(b * t, c)))).reshape(b, t, c)
+        return x + m
 
     def _forward_impl(self, input):
         x = input + self.attn(self.ln1(input))

@@ -178,3 +178,69 @@ def test_transformer_remat_grads_match():
     gd = grads(True, 0.1)
     for a in jax.tree.leaves(gd):
         assert np.isfinite(np.asarray(a)).all()
+
+
+# ------------------------------------------------------- KV-cache decoding
+def test_kv_cache_decode_matches_full_forward():
+    """Each incremental decode_step must reproduce the corresponding
+    column of the full causal forward (same params, eval mode)."""
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(0)
+    m = TransformerLM(32, embed_dim=16, num_heads=4, num_kv_heads=2,
+                      num_layers=2, max_len=16)
+    m.evaluate()
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 32, (2, 10)))
+    full = np.asarray(m.forward(ids))          # (2, 10, 32)
+    caches = m.init_cache(2, 10)
+    for i in range(10):
+        logits, caches = m.decode_step(ids[:, i], jnp.int32(i), caches)
+        np.testing.assert_allclose(np.asarray(logits), full[:, i],
+                                   rtol=2e-4, atol=2e-5, err_msg=f"pos {i}")
+
+
+def test_generate_greedy_extends_prompt():
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(1)
+    m = TransformerLM(32, embed_dim=16, num_heads=4, num_layers=1,
+                      max_len=16)
+    m.evaluate()
+    prompt = jnp.asarray(np.random.RandomState(1).randint(0, 32, (2, 4)))
+    out = m.generate(prompt, max_new_tokens=5)
+    assert out.shape == (2, 9)
+    np.testing.assert_array_equal(np.asarray(out[:, :4]), np.asarray(prompt))
+    # greedy continuation must match teacher-forced argmax of the full model
+    # token at output position 4+i is the argmax of the logits at input
+    # position 3+i of the teacher-forced forward over out[:, :8]
+    full = m.forward(out[:, :-1])
+    np.testing.assert_array_equal(
+        np.asarray(out[:, 4:]),
+        np.asarray(jnp.argmax(full[:, 3:], axis=-1)))
+
+
+def test_generate_sampling_deterministic_with_key():
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(2)
+    m = TransformerLM(32, embed_dim=16, num_heads=2, num_layers=1,
+                      max_len=12)
+    m.evaluate()
+    prompt = jnp.asarray([[1, 2, 3]])
+    a = m.generate(prompt, 4, temperature=0.8, rng=jax.random.PRNGKey(7))
+    b = m.generate(prompt, 4, temperature=0.8, rng=jax.random.PRNGKey(7))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert a.shape == (1, 7)
+
+
+def test_generate_rejects_prompt_plus_tokens_over_max_len():
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(3)
+    m = TransformerLM(16, embed_dim=8, num_heads=2, num_layers=1, max_len=16)
+    with pytest.raises(ValueError, match="max_len"):
+        m.generate(jnp.asarray([[1, 2, 3, 4]]), 10, max_len=8)
