@@ -38,16 +38,22 @@ class TransformerLM(Module):
                  tie_embeddings: bool = True, use_flash: bool = False,
                  remat: bool = False, n_experts: int = 0,
                  expert_parallel: Optional[str] = None,
-                 num_kv_heads: Optional[int] = None):
+                 num_kv_heads: Optional[int] = None,
+                 use_rope: bool = False):
         super().__init__()
         self.vocab_size = vocab_size
         self.embed_dim = embed_dim
         self.sequence_parallel = sequence_parallel
         self.tie_embeddings = tie_embeddings
+        # RoPE replaces the learned positional table (rotations happen
+        # inside each attention layer); max_len then only bounds caches
+        self.use_rope = use_rope
+        self.max_len = max_len
         self.register_parameter(
             "tok_embed", nn.init.RandomNormal(0.0, 0.02)((vocab_size, embed_dim)))
-        self.register_parameter(
-            "pos_embed", nn.init.RandomNormal(0.0, 0.02)((max_len, embed_dim)))
+        if not use_rope:
+            self.register_parameter(
+                "pos_embed", nn.init.RandomNormal(0.0, 0.02)((max_len, embed_dim)))
         for i in range(num_layers):
             setattr(self, f"block{i}",
                     TransformerBlock(embed_dim, num_heads, mlp_ratio=mlp_ratio,
@@ -55,7 +61,8 @@ class TransformerLM(Module):
                                      sequence_parallel=sequence_parallel,
                                      use_flash=use_flash, n_experts=n_experts,
                                      expert_parallel=expert_parallel,
-                                     num_kv_heads=num_kv_heads))
+                                     num_kv_heads=num_kv_heads,
+                                     rotary=use_rope))
         self.ln_f = LayerNorm(embed_dim)
         if not tie_embeddings:
             self.head = nn.Linear(embed_dim, vocab_size, with_bias=False)
@@ -71,14 +78,16 @@ class TransformerLM(Module):
         ids = input.astype(jnp.int32)
         b, t = ids.shape
         x = jnp.take(self.tok_embed, ids, axis=0)
-        if self.sequence_parallel is not None:
-            # each device holds sequence block `axis_index`: offset positions
-            idx = jax.lax.axis_index(self.sequence_parallel)
-            pos0 = idx * t
-        else:
-            pos0 = 0
-        pos = jax.lax.dynamic_slice_in_dim(self.pos_embed, pos0, t, axis=0)
-        x = x + pos[None]
+        if not self.use_rope:  # RoPE rotates inside each attention layer
+            if self.sequence_parallel is not None:
+                # each device holds sequence block axis_index: offset pos
+                idx = jax.lax.axis_index(self.sequence_parallel)
+                pos0 = idx * t
+            else:
+                pos0 = 0
+            pos = jax.lax.dynamic_slice_in_dim(self.pos_embed, pos0, t,
+                                               axis=0)
+            x = x + pos[None]
         aux_total = 0.0
         moe_stats = []
         for i in range(self.num_layers):
@@ -146,7 +155,8 @@ class TransformerLM(Module):
         logits — O(T0²) once vs T0 masked full-cache steps."""
         b, t = ids.shape
         x = jnp.take(self.tok_embed, ids, axis=0)
-        x = x + self.pos_embed[:t][None]
+        if not self.use_rope:
+            x = x + self.pos_embed[:t][None]
         new_caches = []
         for i in range(self.num_layers):
             x, c = getattr(self, f"block{i}").forward_prefill(x, caches[i], 0)
@@ -163,7 +173,9 @@ class TransformerLM(Module):
         traced scalar position; caches from ``init_cache`` (static shapes —
         the whole step jits once and is reused for every position)."""
         x = jnp.take(self.tok_embed, ids_t, axis=0)[:, None, :]  # (B,1,C)
-        x = x + jax.lax.dynamic_slice_in_dim(self.pos_embed, pos, 1, 0)[None]
+        if not self.use_rope:
+            x = x + jax.lax.dynamic_slice_in_dim(self.pos_embed, pos, 1,
+                                                 0)[None]
         new_caches = []
         for i in range(self.num_layers):
             x, c = getattr(self, f"block{i}").forward_step(x, caches[i], pos)
@@ -196,9 +208,11 @@ class TransformerLM(Module):
                 f"prompt ({t0}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds max_len {max_len}: the cache and positional "
                 "lookups would silently clamp")
-        if max_len > self.pos_embed.shape[0]:
+        if max_len > self.max_len:
+            # non-rope: the positional table has max_len rows; rope: the
+            # model was built (and trained) for this context bound
             raise ValueError(f"max_len {max_len} exceeds the model's "
-                             f"positional table {self.pos_embed.shape[0]}")
+                             f"context length {self.max_len}")
         params, buffers = self.params_dict(), self.buffers_dict()
 
         def step(p, ids_t, pos, caches):

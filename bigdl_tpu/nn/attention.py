@@ -75,20 +75,46 @@ def dot_product_attention(q, k, v, causal: bool = False, mask=None,
     return jnp.einsum("bhqk,bhkd->bhqd", w, v)
 
 
+def rotary_embedding(x, positions, base: float = 10000.0):
+    """RoPE: rotate interleaved feature pairs of x (..., T, D) by
+    per-position angles (RoFormer). ``positions`` is (T,) absolute
+    positions — correct under sequence/ring parallelism too, because the
+    rotation happens before K blocks travel."""
+    d = x.shape[-1]
+    inv = 1.0 / (base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    ang = positions.astype(jnp.float32)[:, None] * inv[None, :]  # (T, D/2)
+    sin, cos = jnp.sin(ang), jnp.cos(ang)
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
 class MultiHeadAttention(Module):
     """Fused-QKV multi-head self/cross attention.
 
     ``sequence_parallel`` names a mesh axis: inside a shard_map over that
     axis the layer switches to ring attention (each device holds a sequence
-    block; K/V blocks rotate over ICI via ppermute)."""
+    block; K/V blocks rotate over ICI via ppermute).
+
+    ``rotary=True`` applies RoPE to q/k after the projection (no learned
+    positional table needed upstream); composes with GQA, flash, ring
+    attention, and the KV cache (the cache stores rotated keys)."""
 
     def __init__(self, embed_dim: int, num_heads: int, dropout: float = 0.0,
                  with_bias: bool = True, causal: bool = False,
                  sequence_parallel: Optional[str] = None,
                  use_flash: bool = False,
-                 num_kv_heads: Optional[int] = None):
+                 num_kv_heads: Optional[int] = None,
+                 rotary: bool = False, rotary_base: float = 10000.0):
         super().__init__()
         assert embed_dim % num_heads == 0
+        if rotary and (embed_dim // num_heads) % 2:
+            raise ValueError(
+                f"rotary embeddings need an even head_dim, got "
+                f"{embed_dim // num_heads} (embed_dim {embed_dim} / "
+                f"{num_heads} heads): RoPE rotates feature PAIRS")
+        self.rotary = rotary
+        self.rotary_base = rotary_base
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads
@@ -150,6 +176,9 @@ class MultiHeadAttention(Module):
         b = x_t.shape[0]
         qkv = self.qkv(x_t.reshape(b, self.embed_dim)).reshape(b, 1, -1)
         q, k_t, v_t = self._split_kv_step(qkv)      # q (B,H,1,D)
+        if self.rotary:
+            positions = jnp.asarray(pos)[None]
+            q, k_t = self._rope(q, positions), self._rope(k_t, positions)
         k_cache, v_cache = cache
         k_cache = jax.lax.dynamic_update_slice(
             k_cache, k_t.astype(k_cache.dtype), (0, 0, pos, 0))
@@ -176,6 +205,9 @@ class MultiHeadAttention(Module):
         b, t, _ = x.shape
         qkv = self.qkv(x.reshape(b * t, self.embed_dim)).reshape(b, t, -1)
         q, k, v = self._split_kv_step(qkv)
+        if self.rotary:
+            positions = pos0 + jnp.arange(t)
+            q, k = self._rope(q, positions), self._rope(k, positions)
         k_cache, v_cache = cache
         k_cache = jax.lax.dynamic_update_slice(
             k_cache, k.astype(k_cache.dtype), (0, 0, pos0, 0))
@@ -187,16 +219,21 @@ class MultiHeadAttention(Module):
         o = self.out_proj(o.reshape(b * t, self.embed_dim)).reshape(b, t, -1)
         return o, (k_cache, v_cache)
 
+    def _rope(self, x, positions):
+        return rotary_embedding(x, positions, self.rotary_base) \
+            if self.rotary else x
+
     def forward(self, input):
         b, t, _ = input.shape
         qkv = self.qkv(input.reshape(b * t, self.embed_dim)).reshape(b, t, -1)
-        kv_dim = self.num_kv_heads * self.head_dim
-        q = self._split_heads(qkv[..., :self.embed_dim])
-        k = self._split_heads(
-            qkv[..., self.embed_dim:self.embed_dim + kv_dim],
-            self.num_kv_heads)
-        v = self._split_heads(qkv[..., self.embed_dim + kv_dim:],
-                              self.num_kv_heads)
+        q, k, v = self._split_kv_step(qkv)
+        if self.rotary:
+            pos0 = 0
+            if self.sequence_parallel is not None:
+                # absolute positions of this shard's sequence block
+                pos0 = jax.lax.axis_index(self.sequence_parallel) * t
+            positions = pos0 + jnp.arange(t)
+            q, k = self._rope(q, positions), self._rope(k, positions)
         if self.sequence_parallel is not None:
             from bigdl_tpu.parallel.ring_attention import ring_attention
 
@@ -234,12 +271,14 @@ class TransformerBlock(Module):
                  sequence_parallel: Optional[str] = None,
                  use_flash: bool = False, n_experts: int = 0,
                  expert_parallel: Optional[str] = None,
-                 num_kv_heads: Optional[int] = None):
+                 num_kv_heads: Optional[int] = None,
+                 rotary: bool = False):
         super().__init__()
         self.ln1 = LayerNorm(embed_dim)
         self.attn = MultiHeadAttention(embed_dim, num_heads, dropout=dropout,
                                        causal=causal,
                                        num_kv_heads=num_kv_heads,
+                                       rotary=rotary,
                                        sequence_parallel=sequence_parallel,
                                        use_flash=use_flash)
         self.ln2 = LayerNorm(embed_dim)

@@ -244,3 +244,94 @@ def test_generate_rejects_prompt_plus_tokens_over_max_len():
     m = TransformerLM(16, embed_dim=8, num_heads=2, num_layers=1, max_len=16)
     with pytest.raises(ValueError, match="max_len"):
         m.generate(jnp.asarray([[1, 2, 3, 4]]), 10, max_len=8)
+
+
+# --------------------------------------------------------------- RoPE
+def test_rotary_embedding_matches_manual_rotation():
+    from bigdl_tpu.nn.attention import rotary_embedding
+
+    x = jnp.asarray(np.random.RandomState(0).randn(1, 1, 3, 4), jnp.float32)
+    pos = jnp.arange(3)
+    got = np.asarray(rotary_embedding(x, pos))
+    base = 10000.0
+    want = np.zeros_like(got)
+    for t in range(3):
+        for j in range(2):  # feature pairs (0,1) and (2,3)
+            theta = t / base ** (2 * j / 4)
+            c, s = np.cos(theta), np.sin(theta)
+            x1, x2 = float(x[0, 0, t, 2 * j]), float(x[0, 0, t, 2 * j + 1])
+            want[0, 0, t, 2 * j] = x1 * c - x2 * s
+            want[0, 0, t, 2 * j + 1] = x1 * s + x2 * c
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_rope_attention_is_shift_invariant():
+    """RoPE scores depend only on relative positions: attention outputs of
+    a window are unchanged when the whole window shifts (causal within)."""
+    from bigdl_tpu.nn.attention import dot_product_attention, rotary_embedding
+
+    q, k, v = (jnp.asarray(np.random.RandomState(i).randn(1, 2, 6, 8),
+                           jnp.float32) for i in range(3))
+
+    def attend(shift):
+        pos = shift + jnp.arange(6)
+        return dot_product_attention(rotary_embedding(q, pos),
+                                     rotary_embedding(k, pos), v,
+                                     causal=True)
+
+    np.testing.assert_allclose(np.asarray(attend(0)), np.asarray(attend(5)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_rope_lm_decode_matches_full_forward():
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(4)
+    m = TransformerLM(32, embed_dim=16, num_heads=4, num_kv_heads=2,
+                      num_layers=2, max_len=16, use_rope=True)
+    m.evaluate()
+    assert "pos_embed" not in m.params_dict()  # no learned table
+    ids = jnp.asarray(np.random.RandomState(4).randint(0, 32, (2, 9)))
+    full = np.asarray(m.forward(ids))
+    caches = m.init_cache(2, 9)
+    for i in range(9):
+        logits, caches = m.decode_step(ids[:, i], jnp.int32(i), caches)
+        np.testing.assert_allclose(np.asarray(logits), full[:, i],
+                                   rtol=2e-4, atol=2e-5, err_msg=f"pos {i}")
+    out = m.generate(ids[:, :3], 4)
+    assert out.shape == (2, 7)
+
+
+def test_rope_lm_sequence_parallel_matches_single_device(mesh):
+    from bigdl_tpu.nn.module import pure_apply
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(5)
+    m_sp = models.TransformerLM(32, embed_dim=16, num_heads=4, num_layers=1,
+                                max_len=64, causal=True, use_rope=True,
+                                sequence_parallel="seq")
+    params, buffers = m_sp.params_dict(), m_sp.buffers_dict()
+    m_ref = models.TransformerLM(32, embed_dim=16, num_heads=4, num_layers=1,
+                                 max_len=64, causal=True, use_rope=True)
+    m_ref.load_params_dict(params)
+    ids = jnp.asarray(np.random.RandomState(5).randint(0, 32, (2, 64)))
+    want = m_ref(ids)
+    apply_fn = pure_apply(m_sp)
+
+    def body(ids):
+        out, _ = apply_fn(params, buffers, ids, rng=None, training=False)
+        return out
+
+    got = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=P(None, "seq"),
+        out_specs=P(None, "seq", None), check_vma=False))(ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_rotary_rejects_odd_head_dim():
+    from bigdl_tpu.nn.attention import MultiHeadAttention
+
+    with pytest.raises(ValueError, match="even head_dim"):
+        MultiHeadAttention(6, num_heads=2, rotary=True)
