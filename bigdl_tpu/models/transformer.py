@@ -187,15 +187,13 @@ class TransformerLM(Module):
             logits = self.head(x.reshape(x.shape[0], -1))[:, None, :]
         return logits[:, 0], new_caches
 
-    def generate(self, prompt_ids, max_new_tokens: int,
-                 temperature: float = 0.0, rng=None, max_len=None):
-        """Autoregressive generation with a KV cache (the transformer
-        analog of the reference's RecurrentDecoder, nn/RecurrentDecoder
-        .scala): prefill the prompt one jitted step at a time, then sample
-        greedily (``temperature == 0``) or from the tempered softmax.
-        Returns (B, len(prompt) + max_new_tokens) ids."""
+    def _decode_setup(self, prompt_ids, max_new_tokens, max_len):
+        """Shared decoding preamble for generate/beam_search: coerce +
+        validate the prompt, bind-closure step/prefill fns, run the
+        batched prefill. Returns (prompt_ids, b, t0, params, step_fn,
+        last_logits, caches); logits/caches are None when no new tokens
+        are requested (prefill skipped)."""
         from bigdl_tpu.nn.module import bind
-        from bigdl_tpu.utils import random as bt_random
 
         prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
         if prompt_ids.ndim == 1:
@@ -223,11 +221,29 @@ class TransformerLM(Module):
             with bind(self, p, buffers, False, None):
                 return self.prefill(ids, caches)
 
-        step_jit = jax.jit(step, donate_argnums=(3,))
+        if max_new_tokens == 0:
+            return prompt_ids, b, t0, params, step, None, None
         caches = self.init_cache(b, max_len)
-        ids = [prompt_ids[:, i] for i in range(t0)]
         logits, caches = jax.jit(prefill_fn, donate_argnums=(2,))(
             params, prompt_ids, caches)
+        return prompt_ids, b, t0, params, step, logits, caches
+
+    def generate(self, prompt_ids, max_new_tokens: int,
+                 temperature: float = 0.0, rng=None, max_len=None):
+        """Autoregressive generation with a KV cache (the transformer
+        analog of the reference's RecurrentDecoder, nn/RecurrentDecoder
+        .scala): prefill the prompt one jitted step at a time, then sample
+        greedily (``temperature == 0``) or from the tempered softmax.
+        Returns (B, len(prompt) + max_new_tokens) ids."""
+        from bigdl_tpu.utils import random as bt_random
+
+        (prompt_ids, b, t0, params, step,
+         logits, caches) = self._decode_setup(prompt_ids, max_new_tokens,
+                                              max_len)
+        if max_new_tokens == 0:
+            return prompt_ids
+        step_jit = jax.jit(step, donate_argnums=(3,))
+        ids = [prompt_ids[:, i] for i in range(t0)]
         for i in range(max_new_tokens):
             if temperature <= 0.0:
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -241,3 +257,72 @@ class TransformerLM(Module):
                 logits, caches = step_jit(params, nxt,
                                           jnp.int32(t0 + i), caches)
         return jnp.stack(ids, axis=1)
+
+    def beam_search(self, prompt_ids, max_new_tokens: int,
+                    num_beams: int = 4, length_penalty: float = 1.0,
+                    eos_id: Optional[int] = None, max_len=None):
+        """Deterministic beam search over the KV-cache decoder. Returns
+        (B, t0 + max_new_tokens) ids of the best beam per batch row
+        (finished beams — after ``eos_id`` — are frozen and padded with
+        eos). Ranking: summed token log-probs / L**length_penalty where L
+        is each beam's OWN generated length (eos and its padding excluded
+        from both sum and length)."""
+        (prompt_ids, b, t0, params, step,
+         logits, caches) = self._decode_setup(prompt_ids, max_new_tokens,
+                                              max_len)
+        if max_new_tokens == 0:
+            return prompt_ids
+        k = num_beams
+
+        def beam_step(p, tok, pos, caches, beam_idx):
+            # fold the surviving-beam gather into the donated jit so the
+            # cache copy happens on-device in the same program as the step
+            caches = jax.tree.map(
+                lambda c: jax.vmap(lambda cb, ix: cb[ix])(
+                    c.reshape(b, k, *c.shape[1:]), beam_idx
+                ).reshape(b * k, *c.shape[1:]),
+                caches)
+            return step(p, tok, pos, caches)
+
+        beam_step_jit = jax.jit(beam_step, donate_argnums=(3,))
+
+        v = logits.shape[-1]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))     # (B, V)
+        scores, first = jax.lax.top_k(logp, k)                    # (B, K)
+        # beams share the prompt cache: tile to (B*K, ...)
+        caches = jax.tree.map(lambda c: jnp.repeat(c, k, axis=0), caches)
+        beams = [jnp.repeat(prompt_ids[:, i], k).reshape(b, k)
+                 for i in range(t0)] + [first.astype(jnp.int32)]
+        alive = jnp.ones((b, k), bool) if eos_id is None else \
+            first != eos_id
+        lengths = jnp.ones((b, k), jnp.float32)  # scored tokens per beam
+        frozen = None
+        if eos_id is not None:  # finished beams may only emit eos, free
+            frozen = jnp.full((v,), -jnp.inf).at[eos_id].set(0.0)
+
+        for i in range(1, max_new_tokens):
+            beam_idx = jnp.broadcast_to(jnp.arange(k), (b, k)) if i == 1 \
+                else beam_idx  # first step: beams still in tile order
+            logits, caches = beam_step_jit(
+                params, beams[-1].reshape(b * k), jnp.int32(t0 + i - 1),
+                caches, beam_idx)
+            logp = jax.nn.log_softmax(
+                logits.astype(jnp.float32)).reshape(b, k, v)
+            if eos_id is not None:
+                logp = jnp.where(alive[..., None], logp, frozen)
+            cand = scores[..., None] + logp                       # (B, K, V)
+            scores, flat = jax.lax.top_k(cand.reshape(b, k * v), k)
+            beam_idx, tok = flat // v, (flat % v).astype(jnp.int32)
+            was_alive = jnp.take_along_axis(alive, beam_idx, axis=1)
+            lengths = jnp.take_along_axis(lengths, beam_idx, axis=1) \
+                + was_alive.astype(jnp.float32)
+            beams = [jnp.take_along_axis(t_, beam_idx, axis=1)
+                     for t_ in beams] + [tok]
+            if eos_id is not None:
+                alive = was_alive & (tok != eos_id)
+
+        final = jnp.stack(beams, axis=2)                          # (B, K, T)
+        norm = scores / lengths ** length_penalty
+        best = jnp.argmax(norm, axis=1)
+        return jnp.take_along_axis(
+            final, best[:, None, None], axis=1)[:, 0]

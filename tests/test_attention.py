@@ -335,3 +335,55 @@ def test_rotary_rejects_odd_head_dim():
 
     with pytest.raises(ValueError, match="even head_dim"):
         MultiHeadAttention(6, num_heads=2, rotary=True)
+
+
+def test_beam_search_k1_equals_greedy():
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(6)
+    m = TransformerLM(32, embed_dim=16, num_heads=2, num_layers=1,
+                      max_len=16)
+    m.evaluate()
+    prompt = jnp.asarray(np.random.RandomState(6).randint(0, 32, (2, 4)))
+    greedy = m.generate(prompt, 6)
+    beam = m.beam_search(prompt, 6, num_beams=1)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(beam))
+
+
+def test_beam_search_improves_or_matches_sequence_logprob():
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils import random as rnd
+
+    def seq_logprob(m, seq, t0):
+        logp = jax.nn.log_softmax(m.forward(seq[:, :-1]).astype(jnp.float32))
+        tok = seq[:, 1:]
+        ll = jnp.take_along_axis(logp, tok[..., None], -1)[..., 0]
+        return np.asarray(ll[:, t0 - 1:].sum(axis=1))
+
+    rnd.set_seed(7)
+    m = TransformerLM(32, embed_dim=16, num_heads=2, num_layers=1,
+                      max_len=16)
+    m.evaluate()
+    prompt = jnp.asarray(np.random.RandomState(7).randint(0, 32, (3, 4)))
+    greedy = m.generate(prompt, 8)
+    beam = m.beam_search(prompt, 8, num_beams=4)
+    assert beam.shape == greedy.shape == (3, 12)
+    lg, lb = seq_logprob(m, greedy, 4), seq_logprob(m, beam, 4)
+    assert (lb >= lg - 1e-4).all(), (lb, lg)
+
+
+def test_beam_search_freezes_finished_beams_on_eos():
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(8)
+    m = TransformerLM(16, embed_dim=8, num_heads=2, num_layers=1,
+                      max_len=20)
+    m.evaluate()
+    prompt = jnp.asarray([[1, 2]])
+    out = np.asarray(m.beam_search(prompt, 10, num_beams=3, eos_id=0))
+    gen = out[0, 2:]
+    eos_pos = np.where(gen == 0)[0]
+    if len(eos_pos):  # everything after the first eos must stay eos
+        assert (gen[eos_pos[0]:] == 0).all(), gen
