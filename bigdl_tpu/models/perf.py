@@ -22,6 +22,13 @@ from bigdl_tpu.optim.optimizer import make_train_step
 from bigdl_tpu.utils import random as bt_random
 
 
+def _cast_floating(tree, dtype):
+    """Cast every floating leaf to ``dtype`` (ints/bools untouched)."""
+    return jax.tree.map(
+        lambda a: a.astype(dtype)
+        if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
+
+
 def build_model(name: str, class_num: int = 1000, format: str = "NCHW"):
     """Model + (input shape sans batch, target kind) by name
     (≙ DistriOptimizerPerf's --model flag). ``format="NHWC"`` builds the
@@ -77,10 +84,8 @@ def _transformer_perf(batch_size, iterations, warmup, dtype, log,
     params = jax.tree.map(jnp.copy, model.params_dict())
     buffers = jax.tree.map(jnp.copy, model.buffers_dict())
     if not master_f32:  # store params directly at the compute dtype
-        cast = lambda t: jax.tree.map(  # noqa: E731
-            lambda a: a.astype(dtype)
-            if jnp.issubdtype(a.dtype, jnp.floating) else a, t)
-        params, buffers = cast(params), cast(buffers)
+        params = _cast_floating(params, dtype)
+        buffers = _cast_floating(buffers, dtype)
     slots = ts.init_slots(params)
     lrs = ts.current_lrs()
     step = jax.jit(ts.step, donate_argnums=(0, 1, 2))
@@ -160,10 +165,6 @@ def run_perf(model_name: str = None, batch_size: int = 32,
     x = jax.random.normal(key, (batch_size,) + tuple(input_shape), dtype)
     y = jnp.ones((batch_size,), jnp.int32)  # 1-based labels (Appendix B.1)
 
-    def to_dtype(t):
-        return jax.tree.map(
-            lambda a: a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a, t)
-
     method = SGD(learning_rate=0.01)
     ts = make_train_step(model, criterion, method,
                          compute_dtype=dtype if master_f32 else None)
@@ -172,7 +173,8 @@ def run_perf(model_name: str = None, batch_size: int = 32,
     params = jax.tree.map(jnp.copy, model.params_dict())
     buffers = jax.tree.map(jnp.copy, model.buffers_dict())
     if not master_f32:
-        params, buffers = to_dtype(params), to_dtype(buffers)
+        params = _cast_floating(params, dtype)
+        buffers = _cast_floating(buffers, dtype)
     slots = ts.init_slots(params)
     lrs = ts.current_lrs()
     step = jax.jit(ts.step, donate_argnums=(0, 1, 2))
@@ -211,6 +213,58 @@ def run_perf(model_name: str = None, batch_size: int = 32,
     return summary
 
 
+def run_decode_perf(batch_size: int = 8, prompt_len: int = 128,
+                    new_tokens: int = 128, vocab: int = 32000,
+                    embed_dim: int = 512, layers: int = 8, heads: int = 8,
+                    num_kv_heads: Optional[int] = None,
+                    use_rope: bool = True, dtype=jnp.bfloat16,
+                    profile_dir: Optional[str] = None, log=print) -> dict:
+    """Serving-side throughput: KV-cache autoregressive decode tokens/sec.
+    generate() keeps its jitted prefill/step per model instance, so the
+    first call compiles and the timed second call is pure decode."""
+    from bigdl_tpu.models.transformer import TransformerLM
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:  # keep the CPU smoke tractable
+        vocab, embed_dim, layers, heads = 256, 64, 2, 4
+        prompt_len, new_tokens = min(prompt_len, 16), min(new_tokens, 16)
+    model = TransformerLM(vocab, embed_dim=embed_dim, num_heads=heads,
+                          num_layers=layers, num_kv_heads=num_kv_heads,
+                          max_len=prompt_len + new_tokens, use_rope=use_rope)
+    model.evaluate()
+    if dtype != jnp.float32:
+        # bf16 params ALSO give a bf16 KV cache (generate derives the
+        # cache dtype from the params) — the bandwidth that decode is
+        # actually bound by
+        model.load_params_dict(_cast_floating(model.params_dict(), dtype))
+    prompt = jax.random.randint(jax.random.PRNGKey(0),
+                                (batch_size, prompt_len), 0, vocab)
+    t0 = time.perf_counter()
+    out = model.generate(prompt, new_tokens)
+    jax.block_until_ready(out)
+    warm_s = time.perf_counter() - t0  # compiles prefill + step
+    import contextlib
+
+    prof = (jax.profiler.trace(profile_dir) if profile_dir
+            else contextlib.nullcontext())
+    with prof:
+        t0 = time.perf_counter()
+        out = model.generate(prompt, new_tokens)
+        jax.block_until_ready(out)
+        elapsed = time.perf_counter() - t0
+    tok_per_sec = batch_size * new_tokens / elapsed
+    s = {"model": "transformer_lm_decode", "batch_size": batch_size,
+         "prompt_len": prompt_len, "new_tokens": new_tokens,
+         "num_kv_heads": num_kv_heads or heads,
+         "warmup_s": round(warm_s, 3), "time_s": round(elapsed, 4),
+         "decode_tokens_per_sec": round(tok_per_sec, 2),
+         "ms_per_token": round(1000.0 * elapsed
+                               / (batch_size * new_tokens), 3)}
+    log(f"[perf] decode batch={batch_size} prompt={prompt_len} "
+        f"new={new_tokens}: {tok_per_sec:.0f} tokens/s")
+    return s
+
+
 def main(argv=None):
     import argparse
 
@@ -224,8 +278,20 @@ def main(argv=None):
                    help="f32 master params + compute-dtype cast in-step")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the timed loop")
+    p.add_argument("--decode", action="store_true",
+                   help="measure KV-cache decode tokens/sec instead of "
+                        "training throughput (transformer only)")
     args = p.parse_args(argv)
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    if args.decode:
+        if args.model not in ("resnet50", "transformer", "transformer_lm"):
+            p.error("--decode measures the transformer LM; --model does "
+                    "not apply")
+        if args.master_f32 or args.format != "NCHW":
+            p.error("--decode takes --batch-size/--dtype/--profile only")
+        run_decode_perf(batch_size=args.batch_size, dtype=dtype,
+                        profile_dir=args.profile)
+        return
     run_perf(args.model, args.batch_size, args.iterations, dtype=dtype,
              format=args.format, master_f32=args.master_f32,
              profile_dir=args.profile)

@@ -8,6 +8,7 @@ mesh. Decoder-only, pre-norm, GELU MLP, learned positions, weight-tied head.
 
 from __future__ import annotations
 
+import weakref
 from typing import Optional
 
 import jax
@@ -16,6 +17,11 @@ import jax.numpy as jnp
 from bigdl_tpu import nn
 from bigdl_tpu.nn.attention import LayerNorm, TransformerBlock
 from bigdl_tpu.nn.module import Module
+
+# jitted decode fns cached per live model instance (weak: a saved/cloned
+# model never carries a jit wrapper through pickle)
+_DECODE_JIT = weakref.WeakKeyDictionary()
+_BEAM_JIT = weakref.WeakKeyDictionary()
 
 
 class TransformerLM(Module):
@@ -187,14 +193,59 @@ class TransformerLM(Module):
             logits = self.head(x.reshape(x.shape[0], -1))[:, None, :]
         return logits[:, 0], new_caches
 
-    def _decode_setup(self, prompt_ids, max_new_tokens, max_len):
-        """Shared decoding preamble for generate/beam_search: coerce +
-        validate the prompt, bind-closure step/prefill fns, run the
-        batched prefill. Returns (prompt_ids, b, t0, params, step_fn,
-        last_logits, caches); logits/caches are None when no new tokens
-        are requested (prefill skipped)."""
+    def _beam_step_fn(self, b: int, k: int):
+        """Cached jitted beam step for this (model, batch, beams): the
+        surviving-beam cache gather is folded into the donated jit."""
+        per_model = _BEAM_JIT.setdefault(self, {})
+        fn = per_model.get((b, k))
+        if fn is not None:
+            return fn
         from bigdl_tpu.nn.module import bind
 
+        def beam_step(p, bufs, tok, pos, caches, beam_idx):
+            caches = jax.tree.map(
+                lambda c: jax.vmap(lambda cb, ix: cb[ix])(
+                    c.reshape(b, k, *c.shape[1:]), beam_idx
+                ).reshape(b * k, *c.shape[1:]),
+                caches)
+            with bind(self, p, bufs, False, None):
+                return self.decode_step(tok, pos, caches)
+
+        fn = jax.jit(beam_step, donate_argnums=(4,))
+        per_model[(b, k)] = fn
+        return fn
+
+    def _decode_fns(self):
+        """Per-model-instance jitted (step, prefill) pair, created ONCE and
+        cached in a module-level weak map — jax.jit caches compilations per
+        wrapper object, so rebuilding the closures every generate() call
+        would recompile every call. Kept off the module itself so
+        clone/pickle (save_module) never sees a jit wrapper. Buffers travel
+        as an argument so the cache never staleness-traps them."""
+        cached = _DECODE_JIT.get(self)
+        if cached is not None:
+            return cached
+        from bigdl_tpu.nn.module import bind
+
+        def step(p, bufs, ids_t, pos, caches):
+            with bind(self, p, bufs, False, None):
+                return self.decode_step(ids_t, pos, caches)
+
+        def prefill_fn(p, bufs, ids, caches):
+            with bind(self, p, bufs, False, None):
+                return self.prefill(ids, caches)
+
+        fns = (jax.jit(step, donate_argnums=(4,)),
+               jax.jit(prefill_fn, donate_argnums=(3,)))
+        _DECODE_JIT[self] = fns
+        return fns
+
+    def _decode_setup(self, prompt_ids, max_new_tokens, max_len):
+        """Shared decoding preamble for generate/beam_search: coerce +
+        validate the prompt, fetch the cached jitted fns, run the batched
+        prefill. Returns (prompt_ids, b, t0, params, buffers, step_jit,
+        last_logits, caches); logits/caches are None when no new tokens
+        are requested (prefill skipped)."""
         prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
         if prompt_ids.ndim == 1:
             prompt_ids = prompt_ids[None]
@@ -212,21 +263,13 @@ class TransformerLM(Module):
             raise ValueError(f"max_len {max_len} exceeds the model's "
                              f"context length {self.max_len}")
         params, buffers = self.params_dict(), self.buffers_dict()
-
-        def step(p, ids_t, pos, caches):
-            with bind(self, p, buffers, False, None):
-                return self.decode_step(ids_t, pos, caches)
-
-        def prefill_fn(p, ids, caches):
-            with bind(self, p, buffers, False, None):
-                return self.prefill(ids, caches)
-
+        step_jit, prefill_jit = self._decode_fns()
         if max_new_tokens == 0:
-            return prompt_ids, b, t0, params, step, None, None
-        caches = self.init_cache(b, max_len)
-        logits, caches = jax.jit(prefill_fn, donate_argnums=(2,))(
-            params, prompt_ids, caches)
-        return prompt_ids, b, t0, params, step, logits, caches
+            return prompt_ids, b, t0, params, buffers, step_jit, None, None
+        # cache dtype follows the params (bf16 serving -> bf16 kv cache)
+        caches = self.init_cache(b, max_len, dtype=self.tok_embed.dtype)
+        logits, caches = prefill_jit(params, buffers, prompt_ids, caches)
+        return prompt_ids, b, t0, params, buffers, step_jit, logits, caches
 
     def generate(self, prompt_ids, max_new_tokens: int,
                  temperature: float = 0.0, rng=None, max_len=None):
@@ -237,12 +280,11 @@ class TransformerLM(Module):
         Returns (B, len(prompt) + max_new_tokens) ids."""
         from bigdl_tpu.utils import random as bt_random
 
-        (prompt_ids, b, t0, params, step,
+        (prompt_ids, b, t0, params, buffers, step_jit,
          logits, caches) = self._decode_setup(prompt_ids, max_new_tokens,
                                               max_len)
         if max_new_tokens == 0:
             return prompt_ids
-        step_jit = jax.jit(step, donate_argnums=(3,))
         ids = [prompt_ids[:, i] for i in range(t0)]
         for i in range(max_new_tokens):
             if temperature <= 0.0:
@@ -254,7 +296,7 @@ class TransformerLM(Module):
                     sub, logits / temperature, axis=-1).astype(jnp.int32)
             ids.append(nxt)
             if i < max_new_tokens - 1:
-                logits, caches = step_jit(params, nxt,
+                logits, caches = step_jit(params, buffers, nxt,
                                           jnp.int32(t0 + i), caches)
         return jnp.stack(ids, axis=1)
 
@@ -267,24 +309,13 @@ class TransformerLM(Module):
         eos). Ranking: summed token log-probs / L**length_penalty where L
         is each beam's OWN generated length (eos and its padding excluded
         from both sum and length)."""
-        (prompt_ids, b, t0, params, step,
+        (prompt_ids, b, t0, params, buffers, step_jit,
          logits, caches) = self._decode_setup(prompt_ids, max_new_tokens,
                                               max_len)
         if max_new_tokens == 0:
             return prompt_ids
         k = num_beams
-
-        def beam_step(p, tok, pos, caches, beam_idx):
-            # fold the surviving-beam gather into the donated jit so the
-            # cache copy happens on-device in the same program as the step
-            caches = jax.tree.map(
-                lambda c: jax.vmap(lambda cb, ix: cb[ix])(
-                    c.reshape(b, k, *c.shape[1:]), beam_idx
-                ).reshape(b * k, *c.shape[1:]),
-                caches)
-            return step(p, tok, pos, caches)
-
-        beam_step_jit = jax.jit(beam_step, donate_argnums=(3,))
+        beam_step_jit = self._beam_step_fn(b, k)
 
         v = logits.shape[-1]
         logp = jax.nn.log_softmax(logits.astype(jnp.float32))     # (B, V)
@@ -304,8 +335,8 @@ class TransformerLM(Module):
             beam_idx = jnp.broadcast_to(jnp.arange(k), (b, k)) if i == 1 \
                 else beam_idx  # first step: beams still in tile order
             logits, caches = beam_step_jit(
-                params, beams[-1].reshape(b * k), jnp.int32(t0 + i - 1),
-                caches, beam_idx)
+                params, buffers, beams[-1].reshape(b * k),
+                jnp.int32(t0 + i - 1), caches, beam_idx)
             logp = jax.nn.log_softmax(
                 logits.astype(jnp.float32)).reshape(b, k, v)
             if eos_id is not None:

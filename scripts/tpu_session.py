@@ -66,6 +66,11 @@ def main(argv=None):
     results["flash"] = run_stage(
         "flash-matrix", [sys.executable, "scripts/flash_matrix.py"], 1200)
 
+    results["decode"] = run_stage(
+        "decode-throughput", [sys.executable, "-m", "bigdl_tpu.models.perf",
+                              "--decode", "--batch-size", "8",
+                              "--dtype", "bfloat16"], 600)
+
     if args.profile:
         results["profile"] = run_stage(
             "profile", [sys.executable, "-m", "bigdl_tpu.models.perf",

@@ -23,3 +23,30 @@ def test_transformer_perf_tiny():
     assert s["records_per_sec"] > 0
     # next-token CE on random tokens starts near ln(vocab)
     assert abs(s["loss"] - np.log(50)) < 1.0
+
+
+def test_decode_perf_smoke():
+    from bigdl_tpu.models.perf import run_decode_perf
+
+    s = run_decode_perf(batch_size=2, dtype=jnp.float32,
+                        log=lambda *a, **k: None)
+    assert s["decode_tokens_per_sec"] > 0
+    assert s["model"] == "transformer_lm_decode"
+
+
+def test_generate_reuses_jitted_step_across_calls():
+    # regression: generate() used to rebuild its jit wrappers per call,
+    # recompiling every time (decode benchmarks measured compilation)
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(0)
+    m = TransformerLM(32, embed_dim=16, num_heads=2, num_layers=1,
+                      max_len=16)
+    m.evaluate()
+    prompt = jnp.ones((1, 4), jnp.int32)
+    m.generate(prompt, 4)
+    m.generate(prompt, 4)
+    step_jit, prefill_jit = m._decode_fns()
+    assert step_jit._cache_size() == 1, step_jit._cache_size()
+    assert prefill_jit._cache_size() == 1

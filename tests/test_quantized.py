@@ -204,3 +204,31 @@ def test_quantized_conv_same_padding():
     q = QConv.from_float(m)
     x = jax.random.normal(jax.random.PRNGKey(0), (2, 3, 8, 8))
     assert q(x).shape == m(x).shape == (2, 4, 8, 8)
+
+
+def test_quantized_transformer_lm_serves():
+    """Post-training int8 quantization of the flagship LM: every Linear
+    swaps to the int8 version, forward logits stay close, and KV-cache
+    generation still runs end-to-end on the quantized clone."""
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.nn.quantized import Quantizer
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(0)
+    m = TransformerLM(32, embed_dim=16, num_heads=2, num_layers=2,
+                      max_len=16, tie_embeddings=False)
+    m.evaluate()
+    q = Quantizer.quantize(m)
+    swapped = [type(sub).__name__ for _, sub in q.named_modules()
+               if type(sub).__module__.endswith("quantized")]
+    assert len(swapped) >= 9  # qkv/out_proj/fc1/fc2 per block + head
+
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 32, (2, 8)))
+    want = np.asarray(m.forward(ids))
+    got = np.asarray(q.forward(ids))
+    # int8 tolerance: rankings should broadly agree, values be close
+    np.testing.assert_allclose(got, want, rtol=0.5, atol=0.5)
+
+    out = q.generate(ids[:, :3], 4)
+    assert out.shape == (2, 7)
+    assert np.isfinite(np.asarray(q.forward(out))).all()
