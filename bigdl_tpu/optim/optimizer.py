@@ -108,7 +108,9 @@ class TrainStep:
 
     def __init__(self, model: Module, criterion, optim_method: OptimMethod,
                  grad_clip: Optional[dict] = None, sub_methods=None,
-                 compute_dtype=None):
+                 compute_dtype=None, grad_accum: int = 1):
+        if grad_accum < 1:
+            raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
         apply_fn = pure_apply(model)
         trainable = model.trainable_dict()
         any_frozen = not all(
@@ -119,21 +121,72 @@ class TrainStep:
                           for k in range(n_groups)]
         self._idxs_per_group = idxs_per_group
 
-        def loss_fn(params, buffers, x, y, rng):
-            if compute_dtype is not None:
-                cparams = jax.tree.map(
-                    lambda a: a.astype(compute_dtype)
-                    if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
-            else:
-                cparams = params
+        def _compute_params(params):
+            if compute_dtype is None:
+                return params
+            return jax.tree.map(
+                lambda a: a.astype(compute_dtype)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
+
+        def data_loss_fn(params, buffers, x, y, rng):
+            cparams = _compute_params(params)
             out, new_buffers = apply_fn(cparams, buffers, x, rng=rng, training=True)
-            loss = criterion.forward(out, y)
-            loss = loss + model.regularization_loss(cparams)
-            return loss, new_buffers
+            return criterion.forward(out, y), new_buffers
+
+        def reg_loss_fn(params):
+            return model.regularization_loss(_compute_params(params))
+
+        def loss_fn(params, buffers, x, y, rng):
+            loss, new_buffers = data_loss_fn(params, buffers, x, y, rng)
+            return loss + reg_loss_fn(params), new_buffers
+
+        def grad_of_batch(params, buffers, x, y, rng):
+            """(loss, new_buffers, grads) — one shot, or accumulated over
+            ``grad_accum`` sequential micro-batches via lax.scan: peak
+            activation memory drops by the accumulation factor (the TPU
+            HBM trade for large effective batches); BN statistics update
+            per micro-batch, RNG keys split per micro-batch."""
+            if grad_accum == 1:
+                (loss, new_buffers), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, buffers, x, y, rng)
+                return loss, new_buffers, grads
+            batch = jax.tree.leaves(x)[0].shape[0]
+            if batch % grad_accum:
+                raise ValueError(f"batch size {batch} not divisible by "
+                                 f"grad_accum {grad_accum}")
+
+            def split(t):
+                return jax.tree.map(
+                    lambda a: a.reshape(grad_accum, batch // grad_accum,
+                                        *a.shape[1:]), t)
+
+            def micro(carry, xs):
+                bufs, g_acc, l_acc = carry
+                xm, ym, key = xs
+                (loss, nb), g = jax.value_and_grad(
+                    data_loss_fn, has_aux=True)(params, bufs, xm, ym, key)
+                g_acc = jax.tree.map(jnp.add, g_acc, g)
+                return (nb, g_acc, l_acc + loss), None
+
+            keys = (jax.random.split(rng, grad_accum) if rng is not None
+                    else jnp.zeros((grad_accum, 2), jnp.uint32))
+            zero_g = jax.tree.map(jnp.zeros_like, params)
+            (new_buffers, g_sum, l_sum), _ = jax.lax.scan(
+                micro, (buffers, zero_g, jnp.float32(0.0)),
+                (split(x), split(y), keys))
+            # reduction-aware combine: mean criteria (size_average, the
+            # default) average the micro results; sum criteria keep the
+            # sum. Regularization enters exactly ONCE either way.
+            if getattr(criterion, "size_average", True):
+                g_sum = jax.tree.map(lambda g: g / grad_accum, g_sum)
+                l_sum = l_sum / grad_accum
+            reg_val, reg_grads = jax.value_and_grad(reg_loss_fn)(params)
+            grads = jax.tree.map(jnp.add, g_sum, reg_grads)
+            return l_sum + reg_val, new_buffers, grads
 
         def step(params, buffers, slots, x, y, lrs, rng):
-            (loss, new_buffers), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                params, buffers, x, y, rng)
+            loss, new_buffers, grads = grad_of_batch(params, buffers, x, y,
+                                                     rng)
             if grad_clip:
                 if "constant" in grad_clip:
                     lo, hi = grad_clip["constant"]
@@ -181,9 +234,9 @@ class TrainStep:
 
 def make_train_step(model: Module, criterion, optim_method: OptimMethod,
                     grad_clip: Optional[dict] = None, sub_methods=None,
-                    compute_dtype=None) -> TrainStep:
+                    compute_dtype=None, grad_accum: int = 1) -> TrainStep:
     return TrainStep(model, criterion, optim_method, grad_clip, sub_methods,
-                     compute_dtype=compute_dtype)
+                     compute_dtype=compute_dtype, grad_accum=grad_accum)
 
 
 def _named_param_leaves(params):
@@ -304,6 +357,13 @@ class Optimizer:
         self.checkpoint_async = async_write
         return self
 
+    def set_gradient_accumulation(self, n_micro_batches: int) -> "Optimizer":
+        """Accumulate gradients over ``n_micro_batches`` sequential
+        micro-batches per step (batch_size must divide evenly): same
+        optimizer math as the full batch, 1/n the activation memory."""
+        self.grad_accum = int(n_micro_batches)
+        return self
+
     def set_train_summary(self, summary) -> "Optimizer":
         self.train_summary = summary
         return self
@@ -358,8 +418,14 @@ class LocalOptimizer(Optimizer):
 
         params = model.params_dict()
         buffers = model.buffers_dict()
+        ga = getattr(self, "grad_accum", 1)
+        if ga > 1 and self.batch_size % ga:
+            raise ValueError(
+                f"batch_size {self.batch_size} not divisible by gradient "
+                f"accumulation factor {ga} (checked up front: a ragged "
+                "batch would otherwise fail mid-training)")
         ts = make_train_step(model, criterion, method, self.grad_clip,
-                             self.sub_optim_methods)
+                             self.sub_optim_methods, grad_accum=ga)
         slots = ts.init_slots(params)
         train_step = jax.jit(ts.step)
 

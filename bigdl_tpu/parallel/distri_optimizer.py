@@ -53,6 +53,13 @@ logger = logging.getLogger("bigdl_tpu.optim")
 class DistriOptimizer(LocalOptimizer):
     """Data-parallel SPMD optimizer (reference: optim/DistriOptimizer.scala)."""
 
+    def set_gradient_accumulation(self, n_micro_batches: int):
+        raise NotImplementedError(
+            "gradient accumulation is local-optimizer only for now: the "
+            "distributed step's batch axis is mesh-sharded, and an in-step "
+            "micro-batch reshape would re-layout the shards; lower the "
+            "per-device batch or grow the mesh instead")
+
     def __init__(self, *args, mesh: Optional[Mesh] = None,
                  parameter_sync: str = "sharded",
                  compress_dtype=jnp.bfloat16,

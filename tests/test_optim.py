@@ -279,3 +279,121 @@ def test_async_checkpoint_error_surfaces_on_join(tmp_path, monkeypatch):
     with pytest.raises(RuntimeError, match="async checkpoint write failed"):
         opt.join_pending_checkpoint()
     opt.join_pending_checkpoint()  # error consumed; next join is clean
+
+
+# ----------------------------------------------------- gradient accumulation
+def test_grad_accum_matches_full_batch_step():
+    """grad_accum=4 must produce the same update as the one-shot step on
+    the same batch (mean-reduced criterion, no BN)."""
+    from bigdl_tpu.optim.optimizer import make_train_step
+    from bigdl_tpu.utils import random as rnd
+
+    def run(accum):
+        rnd.set_seed(11)
+        m = nn.Sequential(nn.Linear(6, 8), nn.Tanh(), nn.Linear(8, 3),
+                          nn.LogSoftMax())
+        ts = make_train_step(m, nn.ClassNLLCriterion(), SGD(learning_rate=0.1),
+                             grad_accum=accum)
+        params = m.params_dict()
+        slots = ts.init_slots(params)
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(16, 6), jnp.float32)
+        y = jnp.asarray(rng.randint(1, 4, (16,)), jnp.float32)
+        loss, params, _, _ = jax.jit(ts.step)(
+            params, {}, slots, x, y, ts.current_lrs(), jax.random.PRNGKey(0))
+        return float(loss), params
+
+    l1, p1 = run(1)
+    l4, p4 = run(4)
+    assert l1 == pytest.approx(l4, rel=1e-5)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p4)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_grad_accum_batch_divisibility_enforced():
+    from bigdl_tpu.optim.optimizer import make_train_step
+
+    m = nn.Sequential(nn.Linear(4, 2))
+    ts = make_train_step(m, nn.MSECriterion(), SGD(learning_rate=0.1),
+                         grad_accum=3)
+    params = m.params_dict()
+    with pytest.raises(ValueError, match="divisible"):
+        ts.step(params, {}, ts.init_slots(params), jnp.ones((8, 4)),
+                jnp.ones((8, 2)), ts.current_lrs(), jax.random.PRNGKey(0))
+
+
+def test_optimizer_gradient_accumulation_trains():
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(12)
+    rngs = np.random.RandomState(1)
+    xs = rngs.randn(64, 4).astype(np.float32)
+    ys = (xs.sum(1) > 0).astype(np.float32) + 1
+    samples = [Sample(x, np.asarray([y], np.float32)) for x, y in zip(xs, ys)]
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2),
+                      nn.LogSoftMax())
+    opt = Optimizer(model=m, dataset=samples,
+                    criterion=nn.ClassNLLCriterion(), batch_size=32,
+                    end_when=Trigger.max_epoch(12))
+    opt.set_optim_method(SGD(learning_rate=0.3))
+    opt.set_gradient_accumulation(4)
+    trained = opt.optimize()
+    trained.evaluate()
+    out = np.asarray(trained.forward(jnp.asarray(xs)))
+    acc = ((out.argmax(1) + 1) == ys).mean()
+    assert acc > 0.9, acc
+
+
+def test_distri_optimizer_rejects_grad_accum():
+    from bigdl_tpu.parallel import DistriOptimizer, Engine
+
+    mesh = Engine.create_mesh([("data", 8)])
+    opt = DistriOptimizer(model=nn.Sequential(nn.Linear(4, 2)),
+                          dataset=None, criterion=nn.MSECriterion(),
+                          batch_size=8, end_when=Trigger.max_iteration(1),
+                          mesh=mesh)
+    with pytest.raises(NotImplementedError, match="local-optimizer only"):
+        opt.set_gradient_accumulation(2)
+
+
+def test_grad_accum_matches_full_batch_sum_criterion():
+    """Sum-reduced criteria (size_average=False) must ALSO match: micro
+    results are summed, not averaged (regression: blind /n silently
+    shrank sum-criterion gradients)."""
+    from bigdl_tpu.optim.regularizer import L2Regularizer
+    from bigdl_tpu.optim.optimizer import make_train_step
+    from bigdl_tpu.utils import random as rnd
+
+    def run(accum):
+        rnd.set_seed(13)
+        m = nn.Sequential(nn.Linear(5, 4, w_regularizer=L2Regularizer(0.01)),
+                          nn.Tanh(), nn.Linear(4, 2))
+        ts = make_train_step(m, nn.MSECriterion(size_average=False),
+                             SGD(learning_rate=0.01), grad_accum=accum)
+        params = m.params_dict()
+        slots = ts.init_slots(params)
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.randn(12, 5), jnp.float32)
+        y = jnp.asarray(rng.randn(12, 2), jnp.float32)
+        loss, params, _, _ = jax.jit(ts.step)(
+            params, {}, slots, x, y, ts.current_lrs(), jax.random.PRNGKey(0))
+        return float(loss), params
+
+    l1, p1 = run(1)
+    l3, p3 = run(3)
+    assert l1 == pytest.approx(l3, rel=1e-5)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p3)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_optimizer_grad_accum_divisibility_checked_up_front():
+    m = nn.Sequential(nn.Linear(4, 2))
+    opt = Optimizer(model=m, dataset=[Sample(np.zeros(4, np.float32),
+                                             np.zeros(2, np.float32))] * 10,
+                    criterion=nn.MSECriterion(), batch_size=10,
+                    end_when=Trigger.max_iteration(1))
+    opt.set_gradient_accumulation(4)
+    with pytest.raises(ValueError, match="up front"):
+        opt.optimize()
