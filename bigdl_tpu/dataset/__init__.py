@@ -10,4 +10,4 @@ from bigdl_tpu.dataset.records import (
     RecordFileDataSet, write_record_shards, encode_sample, decode_sample,
 )
 from bigdl_tpu.dataset.prefetch import prefetch, device_prefetch
-from bigdl_tpu.dataset import mnist, cifar, image, text
+from bigdl_tpu.dataset import bpe, cifar, image, mnist, movielens, news20, text

@@ -145,3 +145,67 @@ def test_evaluator_and_predictor_handle_multi_input_samples():
     assert len(preds) == 8 and preds[0].shape == (1,)
     res = Evaluator(g).test(samples, [Loss(nn.BCECriterion())], batch_size=4)
     assert np.isfinite(res[0][1].result()[0])
+
+
+# --------------------------------------------------------------- BPE
+def test_bpe_roundtrip_and_subwords():
+    from bigdl_tpu.dataset.bpe import UNK, BPETokenizer
+
+    corpus = ["the lower the newer the lowest", "lower and lower, newest",
+              "low new lowest newest the the the"] * 5
+    tok = BPETokenizer.train(corpus, vocab_size=80)
+    assert tok.vocab_size <= 80
+    text = "the lowest newest lower"
+    ids = tok.encode(text)
+    assert tok.decode(ids) == text
+    # frequent words compress into few subwords; 'the' should be 1 token
+    assert len(tok.encode("the")) <= 2
+    # unseen characters -> <unk>, never a crash
+    ids2 = tok.encode("the zzz é")
+    assert UNK in ids2
+    assert "the" in tok.decode(ids2)
+
+
+def test_bpe_bos_eos_and_persistence(tmp_path):
+    from bigdl_tpu.dataset.bpe import BOS, EOS, BPETokenizer
+
+    tok = BPETokenizer.train(["a banana bandana and a band"] * 3,
+                             vocab_size=40)
+    ids = tok.encode("a band", add_bos=True, add_eos=True)
+    assert ids[0] == BOS and ids[-1] == EOS
+    assert tok.decode(ids) == "a band"
+    p = str(tmp_path / "bpe.json")
+    tok.save(p)
+    tok2 = BPETokenizer.load(p)
+    assert tok2.encode("a banana band") == tok.encode("a banana band")
+    assert tok2.vocab == tok.vocab
+
+
+def test_bpe_feeds_transformer_generate():
+    """End-to-end LM pipeline: BPE ids in, generated ids decode back."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.dataset.bpe import BPETokenizer
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils import random as rnd
+
+    tok = BPETokenizer.train(["hello world, small world"] * 3,
+                             vocab_size=48)
+    rnd.set_seed(0)
+    m = TransformerLM(tok.vocab_size, embed_dim=16, num_heads=2,
+                      num_layers=1, max_len=32, use_rope=True)
+    m.evaluate()
+    prompt = jnp.asarray([tok.encode("hello world", add_bos=True)])
+    out = m.generate(prompt, max_new_tokens=5)
+    text = tok.decode(np.asarray(out[0]).tolist())
+    assert isinstance(text, str) and text.startswith("hello world")
+
+
+def test_bpe_punctuation_and_vocab_cap():
+    from bigdl_tpu.dataset.bpe import BPETokenizer
+
+    tok = BPETokenizer.train(["hello, world. hello world!"] * 4,
+                             vocab_size=60)
+    assert tok.decode(tok.encode("hello, world.")) == "hello, world."
+    with pytest.raises(ValueError, match="vocab_size"):
+        BPETokenizer.train(["abcdefghijklmnopqrstuvwxyz"], vocab_size=10)
