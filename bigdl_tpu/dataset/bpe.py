@@ -146,7 +146,9 @@ class BPETokenizer:
                          else _SPECIALS[UNK])
         text = "".join(parts).replace(_WORD_END, " ")
         text = re.sub(r" +", " ", text).strip()
-        return re.sub(r"\s+([^\w\s])", r"\1", text)
+        # reattach punctuation, but never fuse '<' — that would glue
+        # "<unk>" placeholders onto the preceding word
+        return re.sub(r"\s+([^\w\s<])", r"\1", text)
 
     @property
     def vocab_size(self) -> int:

@@ -5,7 +5,9 @@ from bigdl_tpu.optim.optim_method import (
     LBFGS, ParallelAdam,
     LearningRateSchedule, Default, Poly, Step, MultiStep, EpochStep, EpochDecay,
     Exponential, Plateau, Warmup, SequentialSchedule, EpochSchedule, NaturalExp,
+    CosineDecay,
 )
+from bigdl_tpu.optim.ema import EMA
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import (
     ValidationMethod, ValidationResult, AccuracyResult, LossResult,

@@ -219,6 +219,36 @@ class EpochSchedule(LearningRateSchedule):
         return method.learning_rate
 
 
+class CosineDecay(LearningRateSchedule):
+    """Cosine annealing to ``min_lr`` over ``decay_iterations`` (the
+    modern transformer default; no reference analog — its newest schedule
+    era was Poly/MultiStep). Anneals from ``peak_lr`` when given, else
+    from the method's base LR. The canonical warmup+cosine::
+
+        peak, w = 1.0, 10
+        seq = (SequentialSchedule()
+               .add(Warmup((peak - base) / w), w)     # base -> peak
+               .add(CosineDecay(990, peak_lr=peak), 990))  # peak -> 0
+
+    (without peak_lr the decay would restart from the BASE lr — a cliff
+    at the warmup boundary)."""
+
+    def __init__(self, decay_iterations: int, min_lr: float = 0.0,
+                 peak_lr: Optional[float] = None):
+        if decay_iterations < 1:
+            raise ValueError("decay_iterations must be >= 1")
+        self.decay_iterations = decay_iterations
+        self.min_lr = min_lr
+        self.peak_lr = peak_lr
+
+    def rate(self, method, state):
+        n = min(state.get("neval", 1) - 1, self.decay_iterations)
+        cos = 0.5 * (1.0 + math.cos(math.pi * n / self.decay_iterations))
+        peak = self.peak_lr if self.peak_lr is not None \
+            else method.learning_rate
+        return self.min_lr + (peak - self.min_lr) * cos
+
+
 class NaturalExp(LearningRateSchedule):
     def __init__(self, decay_step: int, gamma: float):
         self.decay_step = decay_step

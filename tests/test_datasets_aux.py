@@ -209,3 +209,12 @@ def test_bpe_punctuation_and_vocab_cap():
     assert tok.decode(tok.encode("hello, world.")) == "hello, world."
     with pytest.raises(ValueError, match="vocab_size"):
         BPETokenizer.train(["abcdefghijklmnopqrstuvwxyz"], vocab_size=10)
+
+
+def test_bpe_unk_words_keep_their_spacing():
+    from bigdl_tpu.dataset.bpe import BPETokenizer
+
+    tok = BPETokenizer.train(["abc abc"] * 3, vocab_size=30)
+    # 'z' never seen: decodes to <unk> tokens but must stay a separate word
+    assert tok.decode(tok.encode("abc zz abc")).count("abc") == 2
+    assert "abc<unk>" not in tok.decode(tok.encode("abc zz abc"))

@@ -397,3 +397,56 @@ def test_optimizer_grad_accum_divisibility_checked_up_front():
     opt.set_gradient_accumulation(4)
     with pytest.raises(ValueError, match="up front"):
         opt.optimize()
+
+
+def test_cosine_decay_schedule():
+    from bigdl_tpu.optim import CosineDecay, SequentialSchedule, Warmup
+
+    sgd = SGD(learning_rate=1.0, learning_rate_schedule=CosineDecay(100))
+    rates = []
+    for n in [1, 51, 101, 200]:
+        sgd.state["neval"] = n
+        rates.append(sgd.get_current_rate())
+    assert rates[0] == pytest.approx(1.0)
+    assert rates[1] == pytest.approx(0.5, abs=0.02)  # halfway
+    assert rates[2] == pytest.approx(0.0, abs=1e-6)
+    assert rates[3] == pytest.approx(0.0, abs=1e-6)  # clamped past the end
+
+    # canonical warmup -> cosine: ramp base->peak, decay FROM the peak
+    peak, w = 1.0, 10
+    seq = (SequentialSchedule()
+           .add(Warmup((peak - 0.1) / w), w)
+           .add(CosineDecay(50, peak_lr=peak), 50))
+    sgd2 = SGD(learning_rate=0.1, learning_rate_schedule=seq)
+    sgd2.state["neval"] = 5
+    assert sgd2.get_current_rate() > 0.1  # ramping
+    sgd2.state["neval"] = 11  # first cosine iteration == the peak
+    assert sgd2.get_current_rate() == pytest.approx(peak, abs=0.01)
+    sgd2.state["neval"] = 61
+    assert sgd2.get_current_rate() == pytest.approx(0.0, abs=1e-6)
+
+
+def test_ema_tracks_and_serves():
+    import jax
+
+    from bigdl_tpu.optim import EMA
+
+    m = nn.Sequential(nn.Linear(3, 2))
+    params = m.params_dict()
+    ema = EMA.init(params, decay=0.9)
+    moved = jax.tree.map(lambda a: a + 1.0, params)
+    for _ in range(200):
+        ema = ema.update(moved)
+    for s, p in zip(jax.tree.leaves(ema.shadow), jax.tree.leaves(moved)):
+        np.testing.assert_allclose(np.asarray(s), np.asarray(p), atol=1e-3)
+    # jit-carryable
+    @jax.jit
+    def step(e, p):
+        return e.update(p)
+    e2 = step(ema, moved)
+    assert int(e2.step) == int(ema.step) + 1
+    # swap into a model for eval
+    ema.swap(m)
+    np.testing.assert_allclose(
+        np.asarray(jax.tree.leaves(m.params_dict())[0]),
+        np.asarray(jax.tree.leaves(ema.shadow)[0]))
