@@ -83,20 +83,21 @@ def main(argv=None):
     opt.set_optim_method(Adam(learning_rate=args.lr))
     trained = opt.optimize()
 
-    # threshold accuracy on held-out ratings
-    import jax.numpy as jnp
+    # held-out metrics through the standard Evaluator (BinaryAccuracy +
+    # histogram-merged AUC)
+    from bigdl_tpu.optim import AUC, BinaryAccuracy
+    from bigdl_tpu.optim.evaluator import Evaluator
 
     trained.evaluate()
-    val_rows = data[split:]
-    users = jnp.asarray(val_rows[:, 0], jnp.int32)
-    items = jnp.asarray(val_rows[:, 1], jnp.int32)
-    y = (val_rows[:, 2] >= 4).astype(np.float32)
-    from bigdl_tpu.utils.table import Table
-
-    p_hat = np.asarray(trained.forward(Table(users, items)))[:, 0]
-    acc = float(((p_hat > 0.5) == (y > 0.5)).mean())
+    results = Evaluator(trained).test(samples[split:],
+                                      [BinaryAccuracy(), AUC()],
+                                      batch_size=args.batch_size)
+    acc = results[0][1].result()[0]
+    auc = results[1][1].result()[0]
+    y = (data[split:, 2] >= 4).astype(np.float32)
     base = max(y.mean(), 1 - y.mean())  # majority-class baseline
-    print(f"held-out accuracy: {acc:.3f} (majority baseline {base:.3f})")
+    print(f"held-out accuracy: {acc:.3f} auc: {auc:.3f} "
+          f"(majority baseline {base:.3f})")
     return trained, acc, base
 
 

@@ -12,6 +12,7 @@ from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import (
     ValidationMethod, ValidationResult, AccuracyResult, LossResult,
     Top1Accuracy, Top5Accuracy, Loss, MAE, TreeNNAccuracy,
+    BinaryAccuracy, AUC,
 )
 from bigdl_tpu.optim.regularizer import (
     Regularizer, L1Regularizer, L2Regularizer, L1L2Regularizer,

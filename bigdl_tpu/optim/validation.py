@@ -138,3 +138,73 @@ class TreeNNAccuracy(ValidationMethod):
             pred = np.argmax(root, axis=-1) + 1  # 1-based
         return AccuracyResult(int(np.sum(pred == t.astype(np.int64))),
                               root.shape[0])
+
+
+class BinaryAccuracy(ValidationMethod):
+    """Thresholded accuracy for sigmoid outputs vs {0,1} targets (no
+    reference analog — its zoo is multiclass; added with the recommender
+    examples)."""
+
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = threshold
+
+    def __call__(self, output, target):
+        pred = np.asarray(output).reshape(-1) > self.threshold
+        # targets are {0,1} labels, not scores: binarize at a fixed 0.5
+        want = np.asarray(target).reshape(-1) > 0.5
+        return AccuracyResult(int((pred == want).sum()), pred.size)
+
+    def name(self):
+        return "BinaryAccuracy"
+
+
+class AUCResult(ValidationResult):
+    """ROC-AUC from score histograms — mergeable across batches/shards
+    (exact pairwise AUC is not; histograms of fixed binning are)."""
+
+    def __init__(self, pos_hist, neg_hist):
+        self.pos_hist = np.asarray(pos_hist, np.int64)
+        self.neg_hist = np.asarray(neg_hist, np.int64)
+
+    def result(self):
+        p, n = self.pos_hist.sum(), self.neg_hist.sum()
+        if p == 0 or n == 0:
+            return (0.5, int(p + n))
+        pos_above = p - np.cumsum(self.pos_hist)
+        # each negative in bin i is beaten by positives in higher bins,
+        # ties (same bin) count half
+        wins = (self.neg_hist * (pos_above + 0.5 * self.pos_hist)).sum()
+        return (float(wins / (p * n)), int(p + n))
+
+    def __add__(self, other):
+        return AUCResult(self.pos_hist + other.pos_hist,
+                         self.neg_hist + other.neg_hist)
+
+    def __repr__(self):
+        auc, n = self.result()
+        return f"AUC(auc: {auc:.4f}, count: {n})"
+
+
+class AUC(ValidationMethod):
+    """Area under the ROC curve for scores in [0, 1] (``n_bins``
+    histogram approximation; 1e3 bins ≈ 1e-3 resolution)."""
+
+    def __init__(self, n_bins: int = 1000):
+        self.n_bins = n_bins
+
+    def __call__(self, output, target):
+        scores = np.asarray(output, np.float64).reshape(-1)
+        if not np.isfinite(scores).all():
+            raise ValueError(
+                "AUC got non-finite scores (diverged model?); refusing to "
+                "bin NaN/inf")
+        scores = np.clip(scores, 0, 1)
+        labels = np.asarray(target).reshape(-1) > 0.5
+        bins = np.minimum((scores * self.n_bins).astype(np.int64),
+                          self.n_bins - 1)
+        pos = np.bincount(bins[labels], minlength=self.n_bins)
+        neg = np.bincount(bins[~labels], minlength=self.n_bins)
+        return AUCResult(pos, neg)
+
+    def name(self):
+        return "AUC"

@@ -218,3 +218,40 @@ def test_bpe_unk_words_keep_their_spacing():
     # 'z' never seen: decodes to <unk> tokens but must stay a separate word
     assert tok.decode(tok.encode("abc zz abc")).count("abc") == 2
     assert "abc<unk>" not in tok.decode(tok.encode("abc zz abc"))
+
+
+def test_auc_and_binary_accuracy_methods():
+    from bigdl_tpu.optim import AUC, BinaryAccuracy
+
+    # perfectly separable scores -> AUC 1.0
+    scores = np.asarray([[0.9], [0.8], [0.2], [0.1]])
+    labels = np.asarray([[1.0], [1.0], [0.0], [0.0]])
+    assert AUC()(scores, labels).result()[0] == pytest.approx(1.0)
+    assert BinaryAccuracy()(scores, labels).result() == (1.0, 4)
+    # anti-separable -> 0; random interleaved -> 0.5-ish
+    assert AUC()(scores, 1 - labels).result()[0] == pytest.approx(0.0)
+    rng = np.random.RandomState(0)
+    s = rng.rand(4000, 1)
+    l = (rng.rand(4000, 1) > 0.5).astype(np.float32)
+    assert AUC()(s, l).result()[0] == pytest.approx(0.5, abs=0.03)
+    # merge across batches == single batch
+    a = AUC()
+    merged = a(scores[:2], labels[:2]) + a(scores[2:], labels[2:])
+    assert merged.result() == a(scores, labels).result()
+    # oracle: sklearn-style exact AUC on a mixed case
+    s2 = np.asarray([0.1, 0.4, 0.35, 0.8])
+    l2 = np.asarray([0.0, 0.0, 1.0, 1.0])
+    # exact pairwise AUC = wins / (P*N) = (2 + 1) / 4
+    assert AUC()(s2, l2).result()[0] == pytest.approx(0.75, abs=1e-3)
+
+
+def test_auc_rejects_nan_and_binary_accuracy_threshold_only_on_preds():
+    from bigdl_tpu.optim import AUC, BinaryAccuracy
+
+    with pytest.raises(ValueError, match="non-finite"):
+        AUC()(np.asarray([[np.nan]]), np.asarray([[1.0]]))
+    # threshold applies to predictions only; labels binarize at 0.5
+    scores = np.asarray([[0.9], [0.7], [0.2]])
+    labels = np.asarray([[1.0], [0.0], [0.0]])
+    r = BinaryAccuracy(threshold=0.8)(scores, labels)
+    assert r.result() == (1.0, 3)
