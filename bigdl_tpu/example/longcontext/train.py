@@ -36,6 +36,10 @@ def main(argv=None):
     p.add_argument("--steps", type=int, default=5)
     p.add_argument("--experts", type=int, default=0)
     p.add_argument("--flash", action="store_true")
+    p.add_argument("--rope", action="store_true",
+                   help="rotary positions instead of the learned table")
+    p.add_argument("--kv-heads", type=int, default=None,
+                   help="GQA: fewer kv heads than query heads")
     p.add_argument("--no-remat", action="store_true")
     p.add_argument("--seq-parallel", type=int, default=0, metavar="N",
                    help="shard the sequence over an N-device 'seq' mesh axis")
@@ -55,7 +59,8 @@ def main(argv=None):
         args.vocab, embed_dim=args.embed, num_heads=args.heads,
         num_layers=args.layers, max_len=args.seq_len, causal=True,
         remat=not args.no_remat, use_flash=args.flash,
-        n_experts=args.experts,
+        n_experts=args.experts, use_rope=args.rope,
+        num_kv_heads=args.kv_heads,
         sequence_parallel="seq" if sp else None)
     apply_fn = pure_apply(model)
     params = model.params_dict()
@@ -115,6 +120,14 @@ def main(argv=None):
         print(f"step {i}: loss {loss:.4f} "
               f"({time.perf_counter() - t0:.2f}s)", flush=True)
     print(f"final loss {losses[-1]:.4f} (start {losses[0]:.4f})")
+
+    if not sp:  # KV-cache decoding demo on the trained weights
+        model.load_params_dict(params)
+        model.evaluate()
+        t0 = min(8, max(1, args.seq_len // 2))
+        new = min(8, args.seq_len - t0)
+        out = model.generate(ids[:1, :t0], max_new_tokens=new)
+        print(f"generated continuation: {np.asarray(out[0, t0:]).tolist()}")
     return losses
 
 
