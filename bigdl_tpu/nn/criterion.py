@@ -73,14 +73,43 @@ class ClassNLLCriterion(Criterion):
 
 
 class CrossEntropyCriterion(Criterion):
-    """LogSoftMax + ClassNLL fused (reference: nn/CrossEntropyCriterion.scala)."""
+    """LogSoftMax + ClassNLL fused (reference: nn/CrossEntropyCriterion.scala).
 
-    def __init__(self, weights=None, size_average: bool = True):
+    ``label_smoothing`` (no reference analog; the modern vision/LM
+    default) mixes the one-hot target with the uniform distribution:
+    loss = (1-eps) * NLL + eps * mean_c(-logp_c)."""
+
+    def __init__(self, weights=None, size_average: bool = True,
+                 label_smoothing: float = 0.0):
         super().__init__()
+        if not 0.0 <= label_smoothing < 1.0:
+            raise ValueError(f"label_smoothing must be in [0, 1), got "
+                             f"{label_smoothing}")
+        self.label_smoothing = label_smoothing
+        self.size_average = size_average
         self.nll = ClassNLLCriterion(weights, size_average, log_prob_as_input=True)
 
     def forward(self, input, target):
-        return self.nll.forward(jax.nn.log_softmax(input, axis=-1), target)
+        logp = jax.nn.log_softmax(input, axis=-1)
+        loss = self.nll.forward(logp, target)
+        if self.label_smoothing:
+            # the smoothing term shares the NLL's per-row weights and
+            # padding mask + the same normalizer, so padded rows stay
+            # inert and class weights apply to both terms (torch parity)
+            nll = self.nll
+            t = jnp.reshape(target, (-1,)).astype(jnp.int32)
+            logp2 = logp.reshape(t.shape[0], -1)
+            valid = t != nll.padding_value
+            idx = jnp.clip(t - 1, 0, logp2.shape[-1] - 1)
+            w = (jnp.ones(t.shape, logp2.dtype) if nll.weights is None
+                 else nll.weights[idx])
+            w = w * valid.astype(logp2.dtype)
+            uniform = -jnp.sum(w * jnp.mean(logp2, axis=-1))
+            if self.size_average:
+                uniform = uniform / jnp.maximum(jnp.sum(w), 1e-8)
+            loss = (1.0 - self.label_smoothing) * loss \
+                + self.label_smoothing * uniform
+        return loss
 
 
 class CategoricalCrossEntropy(Criterion):

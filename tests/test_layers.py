@@ -265,3 +265,35 @@ def test_save_load_roundtrip(tmp_path):
     m.save(p)
     m2 = bt_file.load_module(p)
     np.testing.assert_allclose(np.asarray(m2(x)), np.asarray(y))
+
+
+def test_cross_entropy_label_smoothing():
+    import jax
+
+    logits = jnp.asarray(np.random.RandomState(0).randn(4, 5), jnp.float32)
+    target = jnp.asarray([1.0, 3.0, 5.0, 2.0])
+    plain = nn.CrossEntropyCriterion()
+    assert float(plain.forward(logits, target)) == pytest.approx(
+        float(nn.CrossEntropyCriterion(label_smoothing=0.0)
+              .forward(logits, target)))
+    eps = 0.1
+    sm = nn.CrossEntropyCriterion(label_smoothing=eps)
+    logp = np.asarray(jax.nn.log_softmax(logits, axis=-1))
+    # manual smoothed CE: (1-eps)*nll + eps*uniform
+    nll = -np.mean([logp[i, int(t) - 1] for i, t in enumerate(np.asarray(target))])
+    uni = -logp.mean()
+    want = (1 - eps) * nll + eps * uni
+    assert float(sm.forward(logits, target)) == pytest.approx(want, rel=1e-5)
+    with pytest.raises(ValueError, match="label_smoothing"):
+        nn.CrossEntropyCriterion(label_smoothing=1.5)
+
+
+def test_cross_entropy_label_smoothing_respects_padding():
+    logits = jnp.asarray(np.random.RandomState(1).randn(3, 4), jnp.float32)
+    t_full = jnp.asarray([2.0, 1.0, -1.0])   # last row padded
+    t_valid = jnp.asarray([2.0, 1.0])
+    sm = nn.CrossEntropyCriterion(label_smoothing=0.2)
+    # padded row must contribute nothing: loss equals the 2-row loss
+    want = float(sm.forward(logits[:2], t_valid))
+    got = float(sm.forward(logits, t_full))
+    assert got == pytest.approx(want, rel=1e-5)
