@@ -16,3 +16,4 @@ from bigdl_tpu.models.inception import InceptionV1, InceptionV1NoAuxClassifier
 from bigdl_tpu.models.rnn import SimpleRNN
 from bigdl_tpu.models.autoencoder import Autoencoder
 from bigdl_tpu.models.transformer import TransformerLM
+from bigdl_tpu.models.mobilenet import MobileNetV1

@@ -1,0 +1,51 @@
+"""MobileNetV1 (Howard et al. 2017) — depthwise-separable CNN zoo model.
+
+Beyond-parity family (the reference zoo stops at LeNet/VGG/ResNet/
+Inception, models/ in SURVEY §2.10) chosen because it exercises the
+grouped/depthwise convolution stack at scale: every block is
+DWConv3x3 + BN + ReLU6 -> Conv1x1 + BN + ReLU6 (the un-fused form of
+nn/SpatialSeparableConvolution.scala's two stages), NHWC-capable end to
+end for the TPU-preferred layout.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu import nn
+
+# (out_channels, stride) per depthwise block after the stem
+_BLOCKS = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+           (512, 1), (512, 1), (512, 1), (512, 1), (512, 1),
+           (1024, 2), (1024, 1)]
+
+
+def _conv_bn(seq, cin, cout, k, stride, pad, format, n_group=1):
+    seq.add(nn.SpatialConvolution(cin, cout, k, k, stride, stride, pad, pad,
+                                  n_group=n_group, with_bias=False,
+                                  format=format))
+    seq.add(nn.SpatialBatchNormalization(cout, format=format))
+    seq.add(nn.ReLU6())
+    return seq
+
+
+def MobileNetV1(class_num: int = 1000, width: float = 1.0,
+                format: str = "NCHW") -> nn.Module:
+    """width multiplier scales every channel count (paper table 1);
+    input is (B, 3, 224, 224) NCHW or (B, 224, 224, 3) NHWC."""
+    def c(ch):
+        return max(8, int(ch * width))
+
+    seq = nn.Sequential()
+    _conv_bn(seq, 3, c(32), 3, 2, 1, format)          # stem
+    cin = c(32)
+    for cout, stride in _BLOCKS:
+        cout = c(cout)
+        # depthwise 3x3 (grouped conv, one group per channel)
+        _conv_bn(seq, cin, cin, 3, stride, 1, format, n_group=cin)
+        # pointwise 1x1
+        _conv_bn(seq, cin, cout, 1, 1, 0, format)
+        cin = cout
+    seq.add(nn.SpatialAveragePooling(7, 7, global_pooling=True,
+                                     format=format))
+    seq.add(nn.View(-1))
+    seq.add(nn.Linear(cin, class_num))
+    return seq

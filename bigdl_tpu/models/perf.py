@@ -47,6 +47,23 @@ def build_model(name: str, class_num: int = 1000, format: str = "NCHW"):
         return VggForCifar10(10), (3, 32, 32), 10
     if name in ("inception_v1", "inception"):
         return InceptionV1NoAuxClassifier(class_num), (3, 224, 224), class_num
+    if name.startswith("mobilenet"):
+        from bigdl_tpu.models.mobilenet import MobileNetV1
+
+        # accepted: mobilenet, mobilenet_v1, mobilenet_<width> (e.g. _0.5)
+        suffix = name[len("mobilenet"):].lstrip("_")
+        if suffix in ("", "v1"):
+            width = 1.0
+        else:
+            try:
+                width = float(suffix)
+            except ValueError:
+                raise ValueError(
+                    f"unknown mobilenet variant {name!r} (only V1 exists "
+                    "here; use mobilenet, mobilenet_v1, or mobilenet_<width>)")
+        shape = (224, 224, 3) if format == "NHWC" else (3, 224, 224)
+        return (MobileNetV1(class_num, width=width, format=format),
+                shape, class_num)
     if name.startswith("resnet"):
         depth = int(name[len("resnet"):] or 50)
         shape = (224, 224, 3) if format == "NHWC" else (3, 224, 224)

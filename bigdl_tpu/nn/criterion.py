@@ -55,17 +55,26 @@ class ClassNLLCriterion(Criterion):
         self.log_prob_as_input = log_prob_as_input
         self.padding_value = padding_value
 
-    def forward(self, input, target):
-        logp = input if self.log_prob_as_input else jnp.log(input + 1e-8)
+    def _target_mask_weights(self, logp, target):
+        """Shared 1-based-target bookkeeping: (logp2d, valid mask, class
+        index, per-row target weight * mask) — the single place that owns
+        the padding/weight contract (the label-smoothing term reuses it)."""
         if logp.ndim == 1:
             logp = logp[None]
             target = jnp.reshape(target, (1,))
         t = jnp.reshape(target, (-1,)).astype(jnp.int32)
+        logp2 = logp.reshape(t.shape[0], -1)
         valid = t != self.padding_value
-        idx = jnp.clip(t - 1, 0, logp.shape[-1] - 1)
-        picked = jnp.take_along_axis(logp, idx[:, None], axis=-1)[:, 0]
-        w = jnp.ones_like(picked) if self.weights is None else self.weights[idx]
-        w = w * valid.astype(picked.dtype)
+        idx = jnp.clip(t - 1, 0, logp2.shape[-1] - 1)
+        w = (jnp.ones(t.shape, logp2.dtype) if self.weights is None
+             else self.weights[idx])
+        w = w * valid.astype(logp2.dtype)
+        return logp2, valid, idx, w
+
+    def forward(self, input, target):
+        logp = input if self.log_prob_as_input else jnp.log(input + 1e-8)
+        logp2, valid, idx, w = self._target_mask_weights(logp, target)
+        picked = jnp.take_along_axis(logp2, idx[:, None], axis=-1)[:, 0]
         loss = -jnp.sum(w * picked)
         if self.size_average:
             loss = loss / jnp.maximum(jnp.sum(w), 1e-8)
@@ -93,20 +102,18 @@ class CrossEntropyCriterion(Criterion):
         logp = jax.nn.log_softmax(input, axis=-1)
         loss = self.nll.forward(logp, target)
         if self.label_smoothing:
-            # the smoothing term shares the NLL's per-row weights and
-            # padding mask + the same normalizer, so padded rows stay
-            # inert and class weights apply to both terms (torch parity)
+            # torch semantics: the eps/C mass on class c carries THAT
+            # class's weight, rows are padding-masked, and the normalizer
+            # is the NLL's (sum of target weights over valid rows)
             nll = self.nll
-            t = jnp.reshape(target, (-1,)).astype(jnp.int32)
-            logp2 = logp.reshape(t.shape[0], -1)
-            valid = t != nll.padding_value
-            idx = jnp.clip(t - 1, 0, logp2.shape[-1] - 1)
-            w = (jnp.ones(t.shape, logp2.dtype) if nll.weights is None
-                 else nll.weights[idx])
-            w = w * valid.astype(logp2.dtype)
-            uniform = -jnp.sum(w * jnp.mean(logp2, axis=-1))
+            logp2, valid, _, w_t = nll._target_mask_weights(logp, target)
+            n_cls = logp2.shape[-1]
+            class_w = (jnp.ones((n_cls,), logp2.dtype) if nll.weights is None
+                       else nll.weights.astype(logp2.dtype))
+            row = -jnp.sum(logp2 * class_w[None, :], axis=-1) / n_cls
+            uniform = jnp.sum(row * valid.astype(logp2.dtype))
             if self.size_average:
-                uniform = uniform / jnp.maximum(jnp.sum(w), 1e-8)
+                uniform = uniform / jnp.maximum(jnp.sum(w_t), 1e-8)
             loss = (1.0 - self.label_smoothing) * loss \
                 + self.label_smoothing * uniform
         return loss

@@ -297,3 +297,23 @@ def test_cross_entropy_label_smoothing_respects_padding():
     want = float(sm.forward(logits[:2], t_valid))
     got = float(sm.forward(logits, t_full))
     assert got == pytest.approx(want, rel=1e-5)
+
+
+def test_cross_entropy_label_smoothing_weighted_matches_torch():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    rng = np.random.RandomState(3)
+    logits = rng.randn(5, 4).astype(np.float32)
+    target = np.asarray([1, 3, 2, 4, 1])  # 1-based
+    w = np.asarray([0.5, 1.0, 2.0, 1.5], np.float32)
+    for eps in (0.0, 0.1, 0.3):
+        want = F.cross_entropy(torch.tensor(logits),
+                               torch.tensor(target - 1),
+                               weight=torch.tensor(w),
+                               label_smoothing=eps).item()
+        crit = nn.CrossEntropyCriterion(weights=jnp.asarray(w),
+                                        label_smoothing=eps)
+        got = float(crit.forward(jnp.asarray(logits),
+                                 jnp.asarray(target, jnp.float32)))
+        assert got == pytest.approx(want, rel=1e-4), (eps, got, want)

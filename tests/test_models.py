@@ -139,3 +139,50 @@ def test_train_step_master_f32_mixed_precision():
                                             bt_random.next_key())
     assert all(p.dtype == jnp.float32 for p in jax.tree.leaves(params))
     assert float(loss) < float(loss0)
+
+
+# ------------------------------------------------------------- MobileNetV1
+def test_mobilenet_v1_shapes_and_param_count():
+    m = models.MobileNetV1(1000)
+    x = jnp.asarray(np.random.RandomState(0).randn(1, 3, 224, 224), jnp.float32)
+    m.evaluate()
+    out = m(x)
+    assert out.shape == (1, 1000)
+    n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(m.params_dict()))
+    # paper: ~4.2M params at width 1.0 incl. the 1000-class head
+    assert 3.9e6 < n_params < 4.6e6, n_params
+
+
+def test_mobilenet_v1_nhwc_matches_nchw():
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(0)
+    m_nchw = models.MobileNetV1(10, width=0.25)
+    rnd.set_seed(0)
+    m_nhwc = models.MobileNetV1(10, width=0.25, format="NHWC")
+    m_nchw.evaluate(); m_nhwc.evaluate()
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 3, 64, 64), jnp.float32)
+    a = np.asarray(m_nchw(x))
+    b = np.asarray(m_nhwc(jnp.transpose(x, (0, 2, 3, 1))))
+    np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4)
+
+
+def test_mobilenet_v1_trains():
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.optim.optimizer import make_train_step
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(1)
+    m = models.MobileNetV1(4, width=0.25)
+    ts = make_train_step(m, nn.CrossEntropyCriterion(), SGD(learning_rate=0.1))
+    params = m.params_dict()
+    buffers = m.buffers_dict()
+    slots = ts.init_slots(params)
+    x = jnp.asarray(np.random.RandomState(2).randn(4, 3, 64, 64), jnp.float32)
+    y = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+    step = jax.jit(ts.step)
+    for i in range(15):
+        loss, params, buffers, slots = step(params, buffers, slots, x, y,
+                                            ts.current_lrs(),
+                                            jax.random.PRNGKey(i))
+    assert float(loss) < 0.5, float(loss)  # memorizes 4 samples
