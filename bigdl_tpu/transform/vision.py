@@ -488,3 +488,76 @@ class ColorJitter(FeatureTransformer):
         for i in self._rng.permutation(len(self.ops)):
             f = self.ops[i].transform(f)
         return f
+
+
+class RandomErasing(FeatureTransformer):
+    """Random-erasing augmentation (Zhong et al. 2020; beyond the
+    reference's augmentation set): with probability ``p`` replace a random
+    rectangle (relative area/aspect drawn from the given ranges) with
+    ``value``. HWC float images."""
+
+    def __init__(self, p: float = 0.5, area_range=(0.02, 0.33),
+                 aspect_range=(0.3, 3.3), value: float = 0.0, seed: int = 1):
+        self.p = p
+        self.area_range = area_range
+        self.aspect_range = aspect_range
+        self.value = value
+        self._rng = np.random.RandomState(seed)
+
+    def transform(self, f: ImageFeature) -> ImageFeature:
+        if self._rng.rand() >= self.p:
+            return f
+        img = f.image().copy()
+        h, w = img.shape[:2]
+        for _ in range(10):  # standard retry-until-it-fits
+            area = self._rng.uniform(*self.area_range) * h * w
+            aspect = self._rng.uniform(*self.aspect_range)
+            eh = int(round(np.sqrt(area * aspect)))
+            ew = int(round(np.sqrt(area / aspect)))
+            if 0 < eh < h and 0 < ew < w:
+                top = self._rng.randint(0, h - eh + 1)
+                left = self._rng.randint(0, w - ew + 1)
+                img[top:top + eh, left:left + ew] = self.value
+                f.set_image(img)
+                break
+        return f
+
+
+#: shared generator for the batch augments when no rng is passed
+_AUG_RNG = np.random.RandomState(1)
+
+
+def mixup_batch(x, y_onehot, alpha: float = 0.2, rng=None):
+    """Mixup (Zhang et al. 2018): convexly combine a batch with a shuffled
+    copy of itself; labels (one-hot/soft) mix with the same lambda.
+    Batch-level numpy op for the input pipeline (images (B, ...),
+    labels (B, C)); returns (x_mix, y_mix, lam). Without an explicit
+    ``rng`` a shared module-level generator advances across calls (a
+    per-call fresh seed would repeat the same lam/permutation forever)."""
+    rng = rng if rng is not None else _AUG_RNG
+    lam = float(rng.beta(alpha, alpha)) if alpha > 0 else 1.0
+    perm = rng.permutation(len(x))
+    x = np.asarray(x)
+    y = np.asarray(y_onehot)
+    return (lam * x + (1 - lam) * x[perm],
+            lam * y + (1 - lam) * y[perm], lam)
+
+
+def cutmix_batch(x, y_onehot, alpha: float = 1.0, rng=None):
+    """CutMix (Yun et al. 2019): paste a random box from a shuffled copy;
+    labels mix by the ACTUAL pasted-area fraction. Images (B, H, W, C)
+    HWC; returns (x_mix, y_mix, lam). See mixup_batch for rng semantics."""
+    rng = rng if rng is not None else _AUG_RNG
+    x = np.asarray(x).copy()
+    y = np.asarray(y_onehot)
+    lam = float(rng.beta(alpha, alpha)) if alpha > 0 else 1.0
+    perm = rng.permutation(len(x))
+    h, w = x.shape[1:3]
+    cut = np.sqrt(1.0 - lam)
+    ch, cw = int(h * cut), int(w * cut)
+    cy, cx = rng.randint(h), rng.randint(w)
+    t, b = np.clip([cy - ch // 2, cy + ch // 2], 0, h)
+    l, r = np.clip([cx - cw // 2, cx + cw // 2], 0, w)
+    x[:, t:b, l:r] = x[perm, t:b, l:r]
+    lam_adj = 1.0 - (b - t) * (r - l) / (h * w)  # actual area kept
+    return x, lam_adj * y + (1 - lam_adj) * y[perm], lam_adj

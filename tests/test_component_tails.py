@@ -149,3 +149,46 @@ def test_graph_check_duplicate():
     assert shared == [lin]
     with pytest.raises(ValueError, match="multiple nodes"):
         g.check_duplicate(raise_on_shared=True)
+
+
+# ------------------------------------------------- modern vision augments
+def test_random_erasing_erases_within_bounds():
+    from bigdl_tpu.transform.vision import ImageFeature, RandomErasing
+
+    img = np.ones((32, 40, 3), np.float32)
+    f = ImageFeature(image=img)
+    out = RandomErasing(p=1.0, value=0.0, seed=3).transform(f).image()
+    erased = (out == 0).all(axis=2)
+    frac = erased.mean()
+    assert 0.0 < frac < 0.5, frac
+    # erased region is one solid rectangle
+    rows, cols = np.where(erased)
+    assert erased[rows.min():rows.max() + 1, cols.min():cols.max() + 1].all()
+
+
+def test_mixup_and_cutmix_batches():
+    from bigdl_tpu.transform.vision import cutmix_batch, mixup_batch
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 16, 16, 3).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 8)]
+    xm, ym, lam = mixup_batch(x, y, alpha=0.4, rng=np.random.RandomState(1))
+    assert 0.0 <= lam <= 1.0
+    assert xm.shape == x.shape and ym.shape == y.shape
+    np.testing.assert_allclose(ym.sum(1), 1.0, rtol=1e-5)  # soft labels
+
+    xc, yc, lamc = cutmix_batch(x, y, rng=np.random.RandomState(2))
+    assert xc.shape == x.shape
+    np.testing.assert_allclose(yc.sum(1), 1.0, rtol=1e-5)
+    # pasted box comes from the permuted batch; label weight == kept area
+    changed = (xc != x).any(axis=(0, 3)).mean()
+    assert abs((1 - lamc) - changed) < 0.2  # box fraction ~ label weight
+
+
+def test_batch_augments_vary_across_calls_without_rng():
+    from bigdl_tpu.transform.vision import mixup_batch
+
+    x = np.random.RandomState(0).rand(6, 8, 8, 3).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[np.arange(6) % 3]
+    lams = {mixup_batch(x, y, alpha=0.4)[2] for _ in range(8)}
+    assert len(lams) > 1  # the shared generator must advance
