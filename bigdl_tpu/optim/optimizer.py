@@ -344,17 +344,25 @@ class Optimizer:
 
     def set_checkpoint(self, path: str, trigger: Trigger,
                        is_overwrite: bool = True,
-                       async_write: bool = False) -> "Optimizer":
+                       async_write: bool = False,
+                       slots_backend: str = "pickle") -> "Optimizer":
         """``async_write=True`` snapshots synchronously (consistent model +
         optim-method state) but performs serialization/IO in a background
         thread, so the train loop is not stalled by checkpoint writes; at
         most one write is in flight (the next checkpoint joins it first,
         surfacing any write error), and ``optimize()`` joins before
-        returning."""
+        returning.
+
+        ``slots_backend="orbax"`` (DistriOptimizer only) writes the
+        sharded optimizer slots via orbax — shard-wise from their owning
+        devices/processes, no host gather (utils/orbax_ckpt.py)."""
+        if slots_backend not in ("pickle", "orbax"):
+            raise ValueError(f"unknown slots_backend {slots_backend!r}")
         self.checkpoint_path = path
         self.checkpoint_trigger = trigger
         self.checkpoint_overwrite = is_overwrite
         self.checkpoint_async = async_write
+        self.checkpoint_slots_backend = slots_backend
         return self
 
     def set_gradient_accumulation(self, n_micro_batches: int) -> "Optimizer":

@@ -315,7 +315,22 @@ class DistriOptimizer(LocalOptimizer):
                 self.optim_method = method
                 self._restored_slots = self._load_slots_snapshot(tag)
 
+    def join_pending_checkpoint(self):
+        super().join_pending_checkpoint()
+        if getattr(self, "checkpoint_slots_backend", "pickle") == "orbax":
+            from bigdl_tpu.utils import orbax_ckpt
+
+            if orbax_ckpt._CKPTR is not None:  # in-flight async slot write
+                orbax_ckpt._CKPTR.wait_until_finished()
+
     def _load_slots_snapshot(self, tag):
+        opath = os.path.abspath(os.path.join(self.checkpoint_path,
+                                             f"optimSlots.{tag}.orbax"))
+        if os.path.exists(opath):
+            # deferred: restored later DIRECTLY into the live slot
+            # shardings (template built from the freshly-initialized
+            # slots), so no host ever materializes the full state
+            return ("__orbax__", opath)
         import pickle
 
         path = os.path.join(self.checkpoint_path, f"optimSlots.{tag}")
@@ -323,6 +338,17 @@ class DistriOptimizer(LocalOptimizer):
             return None
         with open(path, "rb") as f:
             return pickle.load(f)
+
+    @staticmethod
+    def _restore_orbax_slots(opath, like):
+        """Restore slots into the exact placements of ``like`` (the fresh
+        init_slots tree, already laid out on the mesh)."""
+        from bigdl_tpu.utils.orbax_ckpt import _checkpointer
+
+        target = jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                           sharding=s.sharding), like)
+        return _checkpointer().restore(opath, {"slots": target})["slots"]
 
     def _run_checkpoint(self, state):
         """Extends the base snapshot (model + optimMethod) with the
@@ -333,9 +359,23 @@ class DistriOptimizer(LocalOptimizer):
         if not self._ckpt_now or self.checkpoint_path is None:
             return
         if getattr(self, "_live_slots", None) is not None:
+            tag = f"{state['neval'] - 1}"
+            if getattr(self, "checkpoint_slots_backend", "pickle") == "orbax":
+                # shard-wise write from the owning devices — no host gather;
+                # async_write leaves the write in flight (joined by
+                # join_pending_checkpoint, which the retry path calls
+                # before any restore)
+                from bigdl_tpu.utils.orbax_ckpt import _checkpointer
+
+                ckptr = _checkpointer()
+                ckptr.save(os.path.join(os.path.abspath(self.checkpoint_path),
+                                        f"optimSlots.{tag}.orbax"),
+                           {"slots": self._live_slots}, force=True)
+                if not getattr(self, "checkpoint_async", False):
+                    ckptr.wait_until_finished()
+                return
             import pickle
 
-            tag = f"{state['neval'] - 1}"
             host = jax.tree.map(np.asarray, jax.device_get(self._live_slots))
             with open(os.path.join(self.checkpoint_path,
                                    f"optimSlots.{tag}"), "wb") as f:
@@ -389,17 +429,32 @@ class DistriOptimizer(LocalOptimizer):
                 model, criterion, method, self.grad_clip, slots)
             ts = None
             if self._restored_slots is not None:
-                slot_shardings = jax.tree.map(
-                    lambda s: (data_sharding if getattr(s, "ndim", 0) else repl),
-                    slots)
-                slots = jax.device_put(self._restored_slots, slot_shardings)
+                if (isinstance(self._restored_slots, tuple)
+                        and self._restored_slots
+                        and self._restored_slots[0] == "__orbax__"):
+                    slots = self._restore_orbax_slots(
+                        self._restored_slots[1], slots)
+                else:
+                    slot_shardings = jax.tree.map(
+                        lambda s: (data_sharding if getattr(s, "ndim", 0)
+                                   else repl),
+                        slots)
+                    slots = jax.device_put(self._restored_slots,
+                                           slot_shardings)
                 self._restored_slots = None
         else:
             step, ts = self._build_allreduce_step(
                 model, criterion, method, self.grad_clip)
-            slots = jax.device_put(
-                self._restored_slots if self._restored_slots is not None
-                else ts.init_slots(params), repl)
+            if (isinstance(self._restored_slots, tuple)
+                    and self._restored_slots
+                    and self._restored_slots[0] == "__orbax__"):
+                slots = self._restore_orbax_slots(
+                    self._restored_slots[1],
+                    jax.device_put(ts.init_slots(params), repl))
+            else:
+                slots = jax.device_put(
+                    self._restored_slots if self._restored_slots is not None
+                    else ts.init_slots(params), repl)
             self._restored_slots = None
             flat = None
 

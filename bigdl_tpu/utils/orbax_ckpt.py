@@ -60,14 +60,32 @@ def save_train_state(path: str, step: int, params, buffers, slots,
     kept = {k: v for k, v in (state or {}).items()
             if isinstance(v, (bool, int, float, str))}
     path = _norm(path)
+    meta = path + ".meta.json"
     # StandardCheckpointer stores arrays; step + driver-state scalars ride
-    # in a sidecar json (its keys vary run-to-run anyway)
+    # in a sidecar json (its keys vary run-to-run anyway). Remove any STALE
+    # meta first so a crash mid-overwrite is detected as incomplete rather
+    # than silently pairing new arrays with the old step.
+    if jax.process_index() == 0:
+        try:
+            if "://" in meta:
+                from etils import epath
+
+                epath.Path(meta).unlink()
+            else:
+                os.remove(meta)
+        except FileNotFoundError:
+            pass
     ckptr.save(path, {"params": params, "buffers": buffers, "slots": slots},
                force=True)
     ckptr.wait_until_finished()
     if jax.process_index() == 0:  # one writer on multi-host pods
-        with _open_meta(path + ".meta.json", "w") as f:
-            json.dump({"step": int(step), "state": kept}, f)
+        if "://" in meta:  # object stores have atomic single-shot puts
+            with _open_meta(meta, "w") as f:
+                json.dump({"step": int(step), "state": kept}, f)
+        else:  # local/NFS: write-then-rename, never a torn meta
+            with open(meta + ".tmp", "w") as f:
+                json.dump({"step": int(step), "state": kept}, f)
+            os.replace(meta + ".tmp", meta)
 
 
 def restore_train_state(path: str, like, shardings=None):

@@ -173,3 +173,44 @@ def test_config_property_tiers(monkeypatch):
     assert bt_config.get_int("bigdl.failure.retryTimes", 0) == 2  # override tier
     bt_config.clear_property("bigdl.failure.retryTimes")
     assert bt_config.get_int("bigdl.failure.retryTimes", 0) == 9
+
+
+def test_retry_restores_orbax_sharded_slots(tmp_path):
+    """slots_backend='orbax': slots checkpoint shard-wise (no host gather)
+    and restore through the same retry path as the pickle backend."""
+    import os
+
+    samples = linear_problem()
+    mesh = Engine.create_mesh([("data", 8)])
+    opt = DistriOptimizer(
+        model=mlp(), dataset=DataSet.array(samples),
+        criterion=nn.ClassNLLCriterion(), batch_size=16,
+        end_when=Trigger.max_iteration(30), mesh=mesh,
+        parameter_sync="sharded")
+    opt.set_optim_method(SGD(learning_rate=0.5, momentum=0.9, dampening=0.0))
+    opt.set_checkpoint(str(tmp_path), Trigger.several_iteration(5),
+                       slots_backend="orbax")
+
+    fired = []
+
+    def hook(state):
+        if state["neval"] >= 12 and not fired:
+            fired.append(state["neval"])
+            raise RuntimeError("injected executor failure")
+
+    opt._fault_hook = hook
+    bt_config.set_property("bigdl.failure.retryTimes", 3)
+    try:
+        model = opt.optimize()
+    finally:
+        bt_config.clear_property("bigdl.failure.retryTimes")
+
+    assert fired
+    assert opt.optim_method.state["neval"] >= 30
+    assert any(f.startswith("optimSlots.") and f.endswith(".orbax")
+               for f in os.listdir(tmp_path))
+    assert not any(f.startswith("optimSlots.") and not f.endswith(".orbax")
+                   for f in os.listdir(tmp_path))
+    from bigdl_tpu.optim import Evaluator, Top1Accuracy
+    res = Evaluator(model).test(samples, [Top1Accuracy()], batch_size=16)
+    assert res[0][1].result()[0] > 0.9
