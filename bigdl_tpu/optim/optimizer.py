@@ -438,7 +438,7 @@ class LocalOptimizer(Optimizer):
         train_step = jax.jit(ts.step)
 
         num_samples = self.dataset.size()
-        data_iter = self._minibatches(self.dataset, self.batch_size)
+        data_iter = self._prepared_batches()
         wall_start = time.time()
 
         try:
@@ -450,19 +450,53 @@ class LocalOptimizer(Optimizer):
             # async checkpoint write (the one run where it matters most)
             self.join_pending_checkpoint()
 
+    def _batch_stream(self):
+        """Infinite minibatch stream with PRODUCER-side epoch reshuffles.
+
+        The dataset iterators are deliberately infinite (dataset.py
+        ``data(train=True)``), so epochs are counted by records here —
+        the same accounting the train loop uses — and ``shuffle()`` fires
+        between epochs on this side of the prefetch queue, so the order
+        is settled before the next epoch's batches are staged. (The
+        iterator reads ``_index`` live; no restart needed.)"""
+        if self.dataset.size() == 0:
+            raise ValueError("dataset is empty")
+        local = getattr(self.dataset, "local_size", self.dataset.size)()
+        seen = 0
+        for b in self._minibatches(self.dataset, self.batch_size):
+            yield b
+            seen += b.size()
+            if seen >= local:
+                seen = 0
+                self.dataset.shuffle()
+
+    def _prepare_batch(self, batch):
+        """(x, y, n) with device-resident arrays; Table structure preserved
+        for multi-input models (jnp.asarray on a Table would stack
+        same-shaped features / fail on heterogeneous ones)."""
+        x = jax.tree.map(jnp.asarray, batch.get_input())
+        y = jax.tree.map(jnp.asarray, batch.get_target())
+        return x, y, batch.size()
+
+    def _prepared_batches(self, prepare=None):
+        """Host batch prep + H2D transfer moved onto a background thread
+        (``bigdl.prefetch.buffer`` batches deep, 0 disables) so the input
+        pipeline overlaps the device step — ≙ the reference's "io" thread
+        pool staging batches per executor (utils/Engine.scala:218-355)."""
+        from bigdl_tpu.dataset.prefetch import prefetch
+        from bigdl_tpu.utils import config as bt_config
+
+        prepare = prepare or self._prepare_batch
+        depth = bt_config.get_int("bigdl.prefetch.buffer", 2)
+        stream = self._batch_stream()
+        if depth <= 0:
+            return (prepare(b) for b in stream)
+        return prefetch(stream, buffer_size=depth, transfer=prepare)
+
     def _optimize_loop(self, model, state, params, buffers, ts, slots,
                        train_step, num_samples, data_iter, wall_start):
         while not self.end_when(state):
-            try:
-                batch = next(data_iter)
-            except StopIteration:
-                data_iter = self._minibatches(self.dataset, self.batch_size)
-                batch = next(data_iter)
-            # preserve Table structure for multi-input models (jnp.asarray
-            # on a Table would stack same-shaped features into one array
-            # and fail on heterogeneous ones; Table is a pytree)
-            x = jax.tree.map(jnp.asarray, batch.get_input())
-            y = jax.tree.map(jnp.asarray, batch.get_target())
+            x, y, n = next(data_iter)
             lrs = ts.current_lrs()
             lr = float(lrs[0])
             rng = bt_random.next_key()
@@ -470,7 +504,6 @@ class LocalOptimizer(Optimizer):
             loss, params, buffers, slots = train_step(params, buffers, slots, x, y, lrs, rng)
             loss = float(loss)
             dt = time.time() - t0
-            n = batch.size()
             state["recordsProcessedThisEpoch"] += n
             state["Loss"] = loss
             state["LearningRate"] = float(lr)
@@ -499,8 +532,8 @@ class LocalOptimizer(Optimizer):
             if state["recordsProcessedThisEpoch"] >= num_samples:
                 state["epoch"] += 1
                 state["recordsProcessedThisEpoch"] = 0
-                self.dataset.shuffle()
-                data_iter = self._minibatches(self.dataset, self.batch_size)
+                # reshuffle + restart happen inside _batch_stream (on the
+                # producer side, ordered ahead of the prefetched batches)
             ts.update_states(neval=state["neval"], epoch=state["epoch"], Loss=loss)
 
             # write updated weights back before validation/checkpoint

@@ -459,7 +459,21 @@ class DistriOptimizer(LocalOptimizer):
             flat = None
 
         num_samples = self.dataset.size()
-        data_iter = self._minibatches(self.dataset, self.batch_size)
+
+        def prepare(batch):
+            # host stack + divisibility check + sharded H2D, all on the
+            # prefetch thread so they overlap the device step
+            x = np.asarray(batch.get_input())
+            y = np.asarray(batch.get_target())
+            if (x.shape[0] * nproc) % n_data != 0:
+                raise ValueError(
+                    f"global batch {x.shape[0] * nproc} must divide mesh "
+                    f"data axis {n_data} (≙ batch divisibility invariant, "
+                    "SURVEY.md Appendix B.2)")
+            return (self._to_global(x, data_sharding),
+                    self._to_global(y, data_sharding), batch.size())
+
+        data_iter = self._prepared_batches(prepare)
         wall_start = time.time()
         # windowed throughput accounting: no per-step device→host sync —
         # loss is fetched only at log/aux points (VERDICT round-1 weak #3;
@@ -469,20 +483,7 @@ class DistriOptimizer(LocalOptimizer):
         loss = None
 
         while not self.end_when(state):
-            try:
-                batch = next(data_iter)
-            except StopIteration:
-                data_iter = self._minibatches(self.dataset, self.batch_size)
-                batch = next(data_iter)
-            x = np.asarray(batch.get_input())
-            y = np.asarray(batch.get_target())
-            if (x.shape[0] * nproc) % n_data != 0:
-                raise ValueError(
-                    f"global batch {x.shape[0] * nproc} must divide mesh data "
-                    f"axis {n_data} (≙ batch divisibility invariant, SURVEY.md "
-                    "Appendix B.2)")
-            x = self._to_global(x, data_sharding)
-            y = self._to_global(y, data_sharding)
+            x, y, n_local = next(data_iter)
             if ts is not None:
                 lrs = ts.current_lrs()
                 lr = float(lrs[0])
@@ -498,7 +499,7 @@ class DistriOptimizer(LocalOptimizer):
             self._live_slots = slots
             if self._fault_hook is not None:
                 self._fault_hook(state)
-            n = batch.size() * nproc  # global records this iteration
+            n = n_local * nproc  # global records this iteration
             state["recordsProcessedThisEpoch"] += n
             state["LearningRate"] = lr
             window_records += n
@@ -528,8 +529,8 @@ class DistriOptimizer(LocalOptimizer):
             if state["recordsProcessedThisEpoch"] >= num_samples:
                 state["epoch"] += 1
                 state["recordsProcessedThisEpoch"] = 0
-                self.dataset.shuffle()
-                data_iter = self._minibatches(self.dataset, self.batch_size)
+                # reshuffle + restart happen inside _batch_stream (producer
+                # side, ordered ahead of the prefetched batches)
             if ts is not None:
                 kv = dict(neval=state["neval"], epoch=state["epoch"])
                 if "Loss" in state:
