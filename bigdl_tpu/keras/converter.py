@@ -75,6 +75,79 @@ def _require_th(cls: str, c: dict):
             "too, ref: pyspark/bigdl/keras/converter.py)")
 
 
+_MERGE_CLASSES = {"Add", "Subtract", "Multiply", "Average", "Maximum",
+                  "Minimum", "Concatenate"}
+
+
+def _parse_inbound(lspec: dict) -> List[str]:
+    """Source layer names of a layer's call node, across formats:
+    Keras-1/2 ``[[["src", 0, 0, {}], ...]]`` and Keras-3's kwargs dicts
+    carrying ``keras_history`` triples. Shared layers (multiple call
+    nodes, or references to a call node other than the first) are
+    rejected — mapping every consumer to the first call would silently
+    compute the wrong graph."""
+    inbound = lspec.get("inbound_nodes") or []
+    if not inbound:
+        return []
+    name = lspec.get("name") or lspec.get("config", {}).get("name")
+    if len(inbound) > 1:
+        raise ValueError(
+            f"layer {name!r} is called {len(inbound)} times (shared "
+            "layer); functional import supports single-call layers only")
+    first = inbound[0]
+    srcs: List[str] = []
+
+    def add(src, node_index):
+        if node_index:
+            raise ValueError(
+                f"layer {name!r} consumes call node {node_index} of "
+                f"{src!r} (shared layer); only node 0 is supported")
+        srcs.append(src)
+
+    if isinstance(first, dict):  # keras 3
+        def walk(obj):
+            if isinstance(obj, dict):
+                if obj.get("class_name") == "__keras_tensor__":
+                    hist = obj["config"]["keras_history"]
+                    add(hist[0], hist[1])
+                    return
+                for v in obj.values():
+                    walk(v)
+            elif isinstance(obj, (list, tuple)):
+                for v in obj:
+                    walk(v)
+
+        walk(first)
+    else:
+        for entry in first:
+            add(entry[0], entry[1] if len(entry) > 1 else 0)
+    return srcs
+
+
+def _convert_merge(cls: str, c: dict, in_shapes):
+    """Keras merge layer -> nn table op + output shape (sans batch)."""
+    from bigdl_tpu import nn as bnn
+
+    if cls == "Concatenate":
+        rank = len(in_shapes[0])
+        axis = c.get("axis", -1)
+        # keras axes count batch as 0 and negatives from the end (incl.
+        # batch): rank+1 total dims
+        axis = rank + 1 + axis if axis < 0 else axis
+        if not 1 <= axis <= rank:
+            raise ValueError(f"Concatenate axis {c.get('axis')} out of "
+                             f"range for rank-{rank} inputs")
+        out = list(in_shapes[0])
+        out[axis - 1] = sum(s[axis - 1] for s in in_shapes)
+        return bnn.JoinTable(axis + 1), tuple(out)  # nn dims count batch=1
+    table = {"Add": bnn.CAddTable, "Subtract": bnn.CSubTable,
+             "Multiply": bnn.CMulTable, "Average": bnn.CAveTable,
+             "Maximum": bnn.CMaxTable, "Minimum": bnn.CMinTable}
+    if any(s != in_shapes[0] for s in in_shapes):
+        raise ValueError(f"{cls} inputs must share a shape, got {in_shapes}")
+    return table[cls](), tuple(in_shapes[0])
+
+
 class DefinitionLoader:
     """json -> un-weighted keras model (≙ converter.py DefinitionLoader)."""
 
@@ -92,10 +165,12 @@ class DefinitionLoader:
     @staticmethod
     def _convert_model(spec: dict, input_shape=None):
         cls = spec.get("class_name")
+        if cls in ("Model", "Functional"):
+            return DefinitionLoader._convert_functional(spec, input_shape)
         if cls != "Sequential":
             raise ValueError(
-                f"unsupported keras model class {cls!r} (Sequential only, "
-                "like the reference's Sequential-first coverage)")
+                f"unsupported keras model class {cls!r} (Sequential and "
+                "functional Model/Functional)")
         cfg = spec["config"]
         layer_specs = cfg["layers"] if isinstance(cfg, dict) else cfg
         if (input_shape is not None and layer_specs
@@ -118,6 +193,75 @@ class DefinitionLoader:
             layer = DefinitionLoader._convert_layer(lspec)
             if layer is not None:
                 model.add(layer)  # Sequential builds + shape-infers here
+        return model
+
+    @staticmethod
+    def _convert_functional(spec: dict, input_shape=None):
+        """Functional-API import: layers + inbound_nodes -> the nn Graph
+        engine via node wiring, shapes propagated with each KerasLayer's
+        ``build`` (≙ the reference DefinitionLoader walking a loaded
+        functional model's node graph). ``input_shape`` is the fallback
+        for an InputLayer whose json shape carries variable dims."""
+        from bigdl_tpu import nn as bnn
+
+        cfg = spec["config"]
+        pending = list(cfg["layers"])
+        nodes: Dict[str, object] = {}
+        shapes: Dict[str, tuple] = {}
+        klayers: Dict[str, object] = {}
+
+        def endpoint_names(entries):
+            # single endpoint may arrive FLAT: ['name', 0, 0] (keras 3)
+            if (isinstance(entries, (list, tuple)) and entries
+                    and isinstance(entries[0], str)):
+                return [entries[0]]
+            return [e[0] if isinstance(e, (list, tuple)) else e
+                    for e in entries]
+
+        while pending:
+            progressed = False
+            for lspec in list(pending):
+                name = lspec.get("name") or lspec["config"].get("name")
+                if lspec["class_name"] == "InputLayer":
+                    shp = (_shape_from(lspec["config"].get("batch_input_shape"))
+                           or _shape_from(lspec["config"].get("batch_shape"))
+                           or (tuple(input_shape) if input_shape else None))
+                    if shp is None:
+                        raise ValueError(
+                            f"InputLayer {name!r} needs a concrete shape "
+                            "(variable dims in the json: pass input_shape=)")
+                    nodes[name], shapes[name] = bnn.Input(), shp
+                    pending.remove(lspec)
+                    progressed = True
+                    continue
+                srcs = _parse_inbound(lspec)
+                if not srcs or any(s not in nodes for s in srcs):
+                    continue
+                in_nodes = [nodes[s] for s in srcs]
+                in_shapes = [shapes[s] for s in srcs]
+                cls = lspec["class_name"]
+                if cls in _MERGE_CLASSES:
+                    mod, out = _convert_merge(cls, lspec["config"], in_shapes)
+                    node = mod.inputs(*in_nodes)
+                else:
+                    kl = DefinitionLoader._convert_layer(lspec)
+                    out = kl.build(in_shapes[0])
+                    node = kl.inputs(in_nodes[0])
+                    klayers[name] = kl
+                nodes[name], shapes[name] = node, out
+                pending.remove(lspec)
+                progressed = True
+            if not progressed:
+                raise ValueError(
+                    "unresolvable functional graph (cycle or missing "
+                    f"sources): {[ls.get('name') for ls in pending]}")
+
+        ins = [nodes[n] for n in endpoint_names(cfg["input_layers"])]
+        outs = [nodes[n] for n in endpoint_names(cfg["output_layers"])]
+        model = bk.Model(ins if len(ins) > 1 else ins[0],
+                         outs if len(outs) > 1 else outs[0])
+        #: name -> KerasLayer, for name-matched hdf5 weight loading
+        model._klayers_by_name = klayers
         return model
 
     @staticmethod
@@ -246,6 +390,29 @@ class DefinitionLoader:
         raise ValueError(f"unsupported keras layer {cls!r}")
 
 
+def _read_weight_groups(root, layer_names):
+    """hdf5 -> ordered {layer_name: [arrays]} for layers CARRYING weights."""
+    named = {}
+    for ln in layer_names:
+        wn = [n.decode() if isinstance(n, bytes) else n
+              for n in root[ln].attrs.get("weight_names", [])]
+        if wn:
+            named[ln] = [np.asarray(root[ln][n]) for n in wn]
+    return named
+
+
+def _check_mapped(klayers):
+    """Fail fast BEFORE mutating: a missing mapping mid-loop would leave
+    the model half-loaded."""
+    unmapped = [type(kl).__name__ for kl in klayers
+                if not _has_weight_mapping(kl)]
+    if unmapped:
+        raise ValueError(
+            "no hdf5 weight mapping for layer(s) "
+            f"{sorted(set(unmapped))}; these import topology-only "
+            "(json) for now")
+
+
 class WeightLoader:
     """hdf5 -> weights into a built model (≙ converter.py WeightLoader)."""
 
@@ -257,29 +424,29 @@ class WeightLoader:
             root = f["model_weights"] if "model_weights" in f else f
             layer_names = [n.decode() if isinstance(n, bytes) else n
                            for n in root.attrs.get("layer_names", [])]
+            named = _read_weight_groups(root, layer_names)
+            klmap = getattr(model, "_klayers_by_name", None)
+            if klmap is not None:
+                # functional import: match hdf5 groups to layers BY NAME
+                weighted = {n: kl for n, kl in klmap.items()
+                            if kl.layer.params_dict()}
+                if set(named) != set(weighted):
+                    raise ValueError(
+                        "weight/layer name mismatch: hdf5 has "
+                        f"{sorted(named)} vs model {sorted(weighted)}")
+                _check_mapped(weighted.values())
+                for n, kl in weighted.items():
+                    _set_layer_weights(kl, named[n])
+                return
             weighted = [l for l in model._layers
                         if getattr(l, "layer", None) is not None
                         and l.layer.params_dict()]
-            w_groups = []
-            for ln in layer_names:
-                grp = root[ln]
-                wn = [n.decode() if isinstance(n, bytes) else n
-                      for n in grp.attrs.get("weight_names", [])]
-                if wn:
-                    w_groups.append([np.asarray(grp[n]) for n in wn])
+            w_groups = list(named.values())
             if len(w_groups) != len(weighted):
                 raise ValueError(
                     f"weight/layer mismatch: {len(w_groups)} weighted hdf5 "
                     f"layers vs {len(weighted)} weighted model layers")
-            # fail fast BEFORE mutating: a missing mapping mid-loop would
-            # leave the model half-loaded
-            unmapped = [type(l).__name__ for l in weighted
-                        if not _has_weight_mapping(l)]
-            if unmapped:
-                raise ValueError(
-                    "no hdf5 weight mapping for layer(s) "
-                    f"{sorted(set(unmapped))}; these import topology-only "
-                    "(json) for now")
+            _check_mapped(weighted)
             for layer, weights in zip(weighted, w_groups):
                 _set_layer_weights(layer, weights)
 

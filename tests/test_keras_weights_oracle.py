@@ -221,3 +221,60 @@ def test_keras1_gru_pergate_layout_equals_fused(tmp_path):
     m1 = load_keras(json_str=K1_GRU_JSON, hdf5_path=p1)
     m2 = load_keras(json_str=K1_GRU_JSON, hdf5_path=p2)
     np.testing.assert_allclose(_forward(m1, x), _forward(m2, x), rtol=1e-6)
+
+
+# ------------------------------------------------------- functional API
+def test_functional_model_with_merges_matches_keras(tmp_path):
+    """Functional import: residual Add + Concatenate wired through the nn
+    Graph engine, weights matched BY NAME from the hdf5."""
+    np.random.seed(9)
+    inp = keras.Input((6,))
+    a = keras.layers.Dense(8, activation="relu", name="da")(inp)
+    b = keras.layers.Dense(8, name="db")(inp)
+    added = keras.layers.Add()([a, b])
+    cat = keras.layers.Concatenate()([added, a])
+    out = keras.layers.Dense(3, name="head")(cat)
+    km = keras.Model(inp, out)
+    js, h5 = _save(tmp_path, km, "func")
+    x = np.random.randn(4, 6).astype(np.float32)
+    want = km.predict(x, verbose=0)
+    m = load_keras(json_str=js, hdf5_path=h5)
+    np.testing.assert_allclose(_forward(m, x), want, rtol=1e-4, atol=1e-5)
+
+
+def test_functional_lstm_matches_keras(tmp_path):
+    np.random.seed(10)
+    inp = keras.Input((7,))
+    e = keras.layers.Embedding(30, 5, name="emb")(inp)
+    h = keras.layers.LSTM(4, name="rnn")(e)
+    out = keras.layers.Dense(2, name="out")(h)
+    km = keras.Model(inp, out)
+    js, h5 = _save(tmp_path, km, "func_lstm")
+    x = np.random.randint(0, 30, (3, 7))
+    want = km.predict(x, verbose=0)
+    m = load_keras(json_str=js, hdf5_path=h5)
+    got = np.asarray(m.forward(jnp.asarray(x)))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_functional_shared_layer_rejected(tmp_path):
+    inp = keras.Input((4,))
+    d = keras.layers.Dense(4, name="shared")
+    out = keras.layers.Add()([d(inp), d(inp)])  # two call nodes
+    km = keras.Model(inp, out)
+    js, h5 = _save(tmp_path, km, "shared")
+    with pytest.raises(ValueError, match="shared"):
+        load_keras(json_str=js, hdf5_path=h5)
+
+
+def test_functional_variable_dim_input_uses_override(tmp_path):
+    inp = keras.Input((None, 5))  # variable time dim
+    h = keras.layers.LSTM(3, name="r")(inp)
+    km = keras.Model(inp, h)
+    js, h5 = _save(tmp_path, km, "vardim")
+    with pytest.raises(ValueError, match="input_shape"):
+        load_keras(json_str=js, hdf5_path=h5)
+    m = load_keras(json_str=js, hdf5_path=h5, input_shape=(6, 5))
+    x = np.random.RandomState(11).randn(2, 6, 5).astype(np.float32)
+    want = km.predict(x, verbose=0)
+    np.testing.assert_allclose(_forward(m, x), want, rtol=1e-4, atol=1e-5)
