@@ -603,10 +603,26 @@ def load_keras(json_path: Optional[str] = None,
                input_shape=None):
     """≙ the reference's Model.load_keras(json_path, hdf5_path). Builds the
     model (shape inference needs either batch_input_shape in the json or an
-    explicit ``input_shape``), then loads weights if given."""
-    if json_str is None:
+    explicit ``input_shape``), then loads weights if given.
+
+    With only ``hdf5_path``, the topology is read from the file's own
+    ``model_config`` attribute (keras ``model.save(...h5)`` embeds it)."""
+    if json_str is None and json_path is not None:
         with open(json_path) as f:
             json_str = f.read()
+    if json_str is None:
+        if hdf5_path is None:
+            raise ValueError("need json_path/json_str or an hdf5 with an "
+                             "embedded model_config")
+        import h5py
+
+        with h5py.File(hdf5_path, "r") as f:
+            mc = f.attrs.get("model_config")
+        if mc is None:
+            raise ValueError(
+                f"{hdf5_path} carries no model_config attribute (weights-"
+                "only file?); pass the topology json explicitly")
+        json_str = mc.decode() if isinstance(mc, bytes) else mc
     model = DefinitionLoader.from_json_str(json_str, input_shape)
     if hdf5_path:
         WeightLoader.load_weights(model, hdf5_path)

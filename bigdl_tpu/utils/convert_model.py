@@ -47,9 +47,8 @@ def load_model(fmt: str, path: str, prototxt: Optional[str] = None,
     if fmt == "keras":
         from bigdl_tpu.keras.converter import load_keras
 
-        if not keras_json:
-            raise ValueError("--keras-json is required for --from keras")
-        return load_keras(json_path=keras_json, hdf5_path=path,
+        # keras_json optional: model.save(...h5) embeds model_config
+        return load_keras(json_path=keras_json or None, hdf5_path=path,
                           input_shape=input_shape)
     raise ValueError(f"unknown source format {fmt!r}")
 

@@ -278,3 +278,21 @@ def test_functional_variable_dim_input_uses_override(tmp_path):
     x = np.random.RandomState(11).randn(2, 6, 5).astype(np.float32)
     want = km.predict(x, verbose=0)
     np.testing.assert_allclose(_forward(m, x), want, rtol=1e-4, atol=1e-5)
+
+
+def test_load_keras_from_h5_alone_uses_embedded_config(tmp_path):
+    """model.save(...h5) embeds the topology; load_keras(hdf5_path=...)
+    alone must reconstruct AND load weights from the one file."""
+    np.random.seed(12)
+    km = keras.Sequential([
+        keras.layers.Embedding(20, 4),
+        keras.layers.GRU(3, reset_after=False),
+        keras.layers.Dense(2),
+    ])
+    km.build((None, 6))
+    h5 = str(tmp_path / "solo.h5")
+    km.save(h5)
+    x = np.random.randint(0, 20, (3, 6))
+    want = km.predict(x, verbose=0)
+    m = load_keras(hdf5_path=h5, input_shape=(6,))
+    np.testing.assert_allclose(_forward(m, x), want, rtol=1e-4, atol=1e-5)
