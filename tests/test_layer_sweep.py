@@ -338,6 +338,21 @@ def test_layer_forward_grad_serialize(tag, tmp_path):
             assert np.isfinite(np.asarray(leaf)).all(), \
                 f"{tag}: non-finite gradient"
 
+        if "random" not in flags:
+            # jit == eager through the SHIPPED inference facade
+            # (jit_inference_fn is what LocalPredictor/PredictionService
+            # serve with); catches trace-time divergence
+            from bigdl_tpu.nn.module import jit_inference_fn
+
+            jit_out = jit_inference_fn(m)(params, buffers, x)
+            w_leaves, g_leaves = _leaves(out), _leaves(jit_out)
+            assert len(w_leaves) == len(g_leaves), \
+                f"{tag}: jit output structure != eager"
+            for w, g in zip(w_leaves, g_leaves):
+                np.testing.assert_allclose(
+                    g, w, rtol=1e-5, atol=1e-6,
+                    err_msg=f"{tag}: jit output != eager output")
+
     p = str(tmp_path / f"{tag}.bigdl")
     serializer.save_module(m, p)
     loaded = serializer.load_module(p)
