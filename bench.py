@@ -195,6 +195,7 @@ def bench_main(argv=None):
     import jax.numpy as jnp
 
     from bigdl_tpu.models.perf import run_perf
+    from bigdl_tpu.version import __version__
 
     log = lambda *a, **k: print(*a, file=sys.stderr, **k)  # noqa: E731
     # Same config family on CPU as on TPU (NHWC + bf16 compute, f32 masters)
@@ -250,6 +251,7 @@ def bench_main(argv=None):
         "unit": "imgs/sec/chip",
         "vs_baseline": round(vs_baseline, 4) if vs_baseline is not None else None,
         "detail": {
+            "version": __version__,
             "device": str(getattr(dev, "device_kind", dev.platform)),
             "batch": batch, "iters": iters,
             "dtype": "f32" if model == "lenet5" else "bf16",

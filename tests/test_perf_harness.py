@@ -3,6 +3,7 @@ throughput loop): the timed train step must run, report sane numbers, and
 keep the RNG stream healthy."""
 
 import jax.numpy as jnp
+import pytest
 import numpy as np
 
 from bigdl_tpu.models.perf import _transformer_perf, run_perf
@@ -25,13 +26,15 @@ def test_transformer_perf_tiny():
     assert abs(s["loss"] - np.log(50)) < 1.0
 
 
-def test_decode_perf_smoke():
+@pytest.mark.parametrize("kv_heads", [None, 2])
+def test_decode_perf_smoke(kv_heads):
     from bigdl_tpu.models.perf import run_decode_perf
 
-    s = run_decode_perf(batch_size=2, dtype=jnp.float32,
-                        log=lambda *a, **k: None)
+    s = run_decode_perf(batch_size=2, num_kv_heads=kv_heads,
+                        dtype=jnp.float32, log=lambda *a, **k: None)
     assert s["decode_tokens_per_sec"] > 0
     assert s["model"] == "transformer_lm_decode"
+    assert s["num_kv_heads"] == (kv_heads or 4)  # CPU smoke uses 4 heads
 
 
 def test_generate_reuses_jitted_step_across_calls():
