@@ -86,6 +86,12 @@ def save_train_state(path: str, step: int, params, buffers, slots,
             with open(meta + ".tmp", "w") as f:
                 json.dump({"step": int(step), "state": kept}, f)
             os.replace(meta + ".tmp", meta)
+    if jax.process_count() > 1:
+        # no process may return (and possibly restore) before process 0's
+        # meta hits storage
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("bigdl_tpu_ckpt_meta")
 
 
 def restore_train_state(path: str, like, shardings=None):

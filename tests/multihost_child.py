@@ -1,8 +1,10 @@
 """Child process for the 2-process jax.distributed data-path test.
 
-Usage: python multihost_child.py <port> <process_id> <mode>
-mode: "local" (non-sharded dataset -> auto-strided) or "sharded".
-Prints one line: SHARD <process_id> <sorted label list of its first batch>.
+Usage: python multihost_child.py <port> <process_id> <mode> [ckpt_path]
+mode: "local" (non-sharded dataset -> auto-strided), "sharded", or
+"orbax" (requires ckpt_path; also runs the sharded data path first).
+Prints: SHARD <process_id> <sorted label list of its first batch>, plus
+ORBAX <process_id> OK|FAIL for mode "orbax".
 """
 
 import sys
@@ -37,3 +39,27 @@ opt = DistriOptimizer(
 mb = next(iter(opt._minibatches(ds, 8)))
 ids = sorted(int(v) for v in np.asarray(mb.get_input())[:, 0])
 print(f"SHARD {pid} {ids}", flush=True)
+
+if mode == "orbax":
+    # real multi-process orbax round trip: every process writes ITS shards,
+    # process 0 alone writes the meta; restore lands back into the mesh
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from bigdl_tpu.utils.orbax_ckpt import (restore_train_state,
+                                            save_train_state)
+
+    path = sys.argv[4]
+    sh = NamedSharding(mesh, P("data"))
+    data = np.arange(32, dtype=np.float32)
+    arr = jax.make_array_from_callback((32,), sh, lambda idx: data[idx])
+    save_train_state(path, 3, {"w": arr}, {}, (), {"Loss": 0.5})
+    step, rp, _, _, st = restore_train_state(
+        path, like=({"w": arr}, {}, ()), shardings=({"w": sh}, {}, ()))
+    got = np.concatenate(
+        [np.asarray(s.data) for s in rp["w"].addressable_shards])
+    want = np.concatenate(
+        [np.asarray(s.data) for s in arr.addressable_shards])
+    ok = (step == 3 and st["Loss"] == 0.5 and np.array_equal(got, want)
+          and rp["w"].sharding.spec == P("data"))
+    print(f"ORBAX {pid} {'OK' if ok else 'FAIL'}", flush=True)
