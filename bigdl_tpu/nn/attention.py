@@ -237,9 +237,12 @@ class MultiHeadAttention(Module):
         if self.sequence_parallel is not None:
             from bigdl_tpu.parallel.ring_attention import ring_attention
 
-            k, v = self._expand_kv(k, v)
+            # ring_attention handles GQA itself: the flash path rotates
+            # the UN-expanded kv heads (group-factor less ICI traffic),
+            # the dense path materializes them
             o = ring_attention(q, k, v, axis_name=self.sequence_parallel,
-                               causal=self.causal)
+                               causal=self.causal,
+                               use_flash=self.use_flash)
         elif self.use_flash:
             from bigdl_tpu.ops.flash_attention import flash_attention
 

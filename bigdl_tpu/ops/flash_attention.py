@@ -148,12 +148,15 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret, group=1):
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, group, res, g):
+def _flash_bwd(causal, scale, block_q, block_k, interpret, group, res, g,
+               g_lse=None):
     """Blocked flash backward (pure XLA, lax.scan over kv blocks): memory
     O(T·block_k) instead of the dense O(T²) score matrix. Standard
     recurrence: with P = exp(S - lse) and D = rowsum(dO ∘ O),
       dS = P ∘ (dO Vᵀ − D) · scale,  dQ = Σ_j dS_j K_j,
       dK_j = dS_jᵀ Q,  dV_j = P_jᵀ dO.
+    ``g_lse`` (bh, t, 1), when given, adds the lse-output cotangent:
+    d lse / d S = P, so dS gains P ∘ g_lse (v is lse-independent).
     """
     q, k, v, out, lse = res
     bh, t, d = q.shape
@@ -185,7 +188,8 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, group, res, g):
             p = jnp.where(live[None], p, 0.0)
         p = jnp.where(dead, 0.0, p)
         dp = jnp.einsum("btd,bkd->btk", do, v_j)
-        ds = p * (dp - dD) * scale
+        extra = dD if g_lse is None else dD - g_lse.astype(jnp.float32)
+        ds = p * (dp - extra) * scale
         dq_acc = dq_acc + jnp.einsum("btk,bkd->btd", ds, k_j)
         dk_j = jnp.einsum("btk,btd->bkd", ds, qf)
         dv_j = jnp.einsum("btk,btd->bkd", p, do)
@@ -206,6 +210,41 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, group, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def flash_with_lse(q, k, v, causal, scale, block_q, block_k, interpret,
+                   group=1):
+    """(out, lse) with BOTH outputs differentiable — the building block
+    ring attention needs (the per-block lse drives its merge weights, so
+    its cotangent matters). Shapes (bh, t, d) / (bh, t, 1)."""
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                          interpret, group)
+
+
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+                   group=1):
+    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                              interpret, group)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, group, res,
+                   g):
+    """Extends the blocked backward with the lse cotangent: with
+    P = exp(S - lse), d lse_i / d S_ij = P_ij, so dS gains P * g_lse."""
+    g_out, g_lse = g
+    return _flash_bwd(causal, scale, block_q, block_k, interpret, group,
+                      res, g_out, g_lse=g_lse)
+
+
+flash_with_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def default_interpret() -> bool:
+    """Kernel interpret-mode default: interpret on CPU, compiled on TPU —
+    the single source of truth for every flash call site."""
+    return jax.devices()[0].platform == "cpu"
+
+
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
                     block_q: int = 128, block_k: int = 128,
@@ -223,7 +262,7 @@ def flash_attention(q, k, v, causal: bool = False,
         raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
     group = h // h_kv
     if interpret is None:
-        interpret = jax.devices()[0].platform == "cpu"
+        interpret = default_interpret()
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     if t % block_q or tk % block_k:
         from bigdl_tpu.nn.attention import dot_product_attention

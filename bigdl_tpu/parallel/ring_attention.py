@@ -41,14 +41,30 @@ def _block_attend(q, k, v, scale, mask):
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None, use_flash: bool = False):
     """Blockwise ring attention inside shard_map.
 
     Each device holds one sequence block of Q/K/V (B, H, T/n, D). K/V
     rotate n-1 times around the ring; output accumulates with running
     (max, denom) flash statistics so the result equals full softmax
     attention over the whole sequence.
-    """
+
+    ``use_flash=True`` computes each ring step's block attention with the
+    pallas flash kernel (O(T_blk·block) memory instead of the dense
+    (T_blk, T_blk) scores) and merges blocks in logsumexp space — the
+    composition for long context ON TOP of sequence sharding. Requires
+    the local block length to tile into the kernel blocks (otherwise the
+    dense ring below is used, mirroring flash_attention's own fallback);
+    gradients flow through a custom vjp carrying the lse cotangent. K/V
+    may carry fewer (grouped-query) heads — the flash path rotates them
+    UN-expanded (group-factor less ring traffic); the dense path expands."""
+    if use_flash:
+        blk = min(128, q.shape[2])
+        if q.shape[2] % blk == 0:
+            return _ring_attention_flash(q, k, v, axis_name, causal, scale)
+    if k.shape[1] != q.shape[1]:  # dense path needs materialized kv heads
+        rep = q.shape[1] // k.shape[1]
+        k, v = jnp.repeat(k, rep, 1), jnp.repeat(v, rep, 1)
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     d = q.shape[-1]
@@ -107,6 +123,73 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     o_acc, l_acc, m_acc, _, _ = carry
     denom = jnp.where(l_acc > 0, l_acc, 1.0)
     return (o_acc / denom[..., None]).astype(q.dtype)
+
+
+def _ring_attention_flash(q, k, v, axis_name: str, causal: bool,
+                          scale: Optional[float]):
+    """Flash-kernel ring steps merged in logsumexp space. Per step the
+    held K/V block is (relative to my Q block) strictly past -> full
+    attention, diagonal -> causal, strictly future -> skipped; the three
+    cases dispatch via lax.switch on the traced source-block id. GQA K/V
+    (fewer heads) rotate un-expanded; the kernel reads shared heads via
+    its group index map."""
+    from bigdl_tpu.ops.flash_attention import default_interpret, flash_with_lse
+
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, h, t, d = q.shape
+    h_kv = k.shape[1]
+    group = h // h_kv
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    block = min(128, t)
+    qf = q.reshape(b * h, t, d)
+    flash = partial(flash_with_lse, scale=scale, block_q=block,
+                    block_k=block, interpret=default_interpret(),
+                    group=group)
+
+    def attend_full(k_cur, v_cur):
+        o, lse = flash(qf, k_cur.reshape(b * h_kv, t, d),
+                       v_cur.reshape(b * h_kv, t, d), causal=False)
+        return o.astype(jnp.float32), lse[..., 0]
+
+    def attend_diag(k_cur, v_cur):
+        o, lse = flash(qf, k_cur.reshape(b * h_kv, t, d),
+                       v_cur.reshape(b * h_kv, t, d), causal=True)
+        return o.astype(jnp.float32), lse[..., 0]
+
+    def attend_skip(k_cur, v_cur):
+        return (jnp.zeros((b * h, t, d), jnp.float32),
+                jnp.full((b * h, t), -jnp.inf, jnp.float32))
+
+    o_acc = jnp.zeros((b * h, t, d), jnp.float32)
+    lse_acc = jnp.full((b * h, t), -jnp.inf, jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def merge(o_a, lse_a, o_b, lse_b):
+        m = jnp.maximum(lse_a, lse_b)
+        m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+        ca = jnp.where(jnp.isfinite(lse_a), jnp.exp(lse_a - m_safe), 0.0)
+        cb = jnp.where(jnp.isfinite(lse_b), jnp.exp(lse_b - m_safe), 0.0)
+        denom = ca + cb
+        safe = jnp.maximum(denom, 1e-37)
+        o = (o_a * ca[..., None] + o_b * cb[..., None]) / safe[..., None]
+        lse = jnp.where(denom > 0, m_safe + jnp.log(safe), -jnp.inf)
+        return o, lse
+
+    k_cur, v_cur = k, v
+    for step in range(n):
+        src = (my - step) % n
+        if causal:
+            branch = jnp.where(src < my, 0, jnp.where(src == my, 1, 2))
+            o_b, lse_b = lax.switch(branch,
+                                    [attend_full, attend_diag, attend_skip],
+                                    k_cur, v_cur)
+        else:
+            o_b, lse_b = attend_full(k_cur, v_cur)
+        o_acc, lse_acc = merge(o_acc, lse_acc, o_b, lse_b)
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+    return o_acc.reshape(b, h, t, d).astype(q.dtype)
 
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,

@@ -387,3 +387,108 @@ def test_beam_search_freezes_finished_beams_on_eos():
     eos_pos = np.where(gen == 0)[0]
     if len(eos_pos):  # everything after the first eos must stay eos
         assert (gen[eos_pos[0]:] == 0).all(), gen
+
+
+# ------------------------------------------------- ring + flash composition
+class TestRingFlash:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_full_attention_fwd_and_grads(self, mesh, causal):
+        """Flash-kernel ring steps (lse-space merge, custom vjp carrying
+        the lse cotangent) must equal full softmax attention — forward AND
+        gradients — with T/n = 128-wide local blocks."""
+        b, h, t, d = 1, 2, 1024, 16  # 8 devices -> 128-long local blocks
+        r = np.random.RandomState(0)
+        q, k, v = (jnp.asarray(r.randn(b, h, t, d) * 0.3, jnp.float32)
+                   for _ in range(3))
+
+        def full_sum(q, k, v):
+            return jnp.sum(dot_product_attention(q, k, v, causal=causal) ** 2)
+
+        def ring_sum(q, k, v):
+            def body(q, k, v):
+                return ring_attention(q, k, v, axis_name="seq",
+                                      causal=causal, use_flash=True)
+
+            out = jax.shard_map(
+                body, mesh=mesh,
+                in_specs=P(None, None, "seq", None),
+                out_specs=P(None, None, "seq", None), check_vma=False)(q, k, v)
+            return jnp.sum(out ** 2)
+
+        f = jax.jit(jax.value_and_grad(full_sum, argnums=(0, 1, 2)))
+        g = jax.jit(jax.value_and_grad(ring_sum, argnums=(0, 1, 2)))
+        want_v, want_g = f(q, k, v)
+        got_v, got_g = g(q, k, v)
+        np.testing.assert_allclose(float(got_v), float(want_v), rtol=2e-4)
+        for a, bb in zip(got_g, want_g):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                       rtol=5e-4, atol=5e-5)
+
+    def test_mha_seq_parallel_flash_matches_dense_ring(self, mesh):
+        from bigdl_tpu.nn.module import pure_apply
+        from bigdl_tpu.utils import random as rnd
+
+        rnd.set_seed(9)
+        m = models.TransformerLM(32, embed_dim=16, num_heads=4, num_layers=1,
+                                 max_len=1024, causal=True, use_rope=True,
+                                 sequence_parallel="seq", use_flash=True)
+        params, buffers = m.params_dict(), m.buffers_dict()
+        m_ref = models.TransformerLM(32, embed_dim=16, num_heads=4,
+                                     num_layers=1, max_len=1024, causal=True,
+                                     use_rope=True)
+        m_ref.load_params_dict(params)
+        ids = jnp.asarray(np.random.RandomState(9).randint(0, 32, (1, 1024)))
+        want = m_ref(ids)
+        apply_fn = pure_apply(m)
+
+        def body(ids):
+            out, _ = apply_fn(params, buffers, ids, rng=None, training=False)
+            return out
+
+        got = jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=P(None, "seq"),
+            out_specs=P(None, "seq", None), check_vma=False))(ids)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-3, atol=2e-4)
+
+
+    def test_ring_flash_gqa_rotates_unexpanded_kv(self, mesh):
+        """GQA through the flash ring (kv heads rotate un-expanded) must
+        equal dense ring attention over explicitly repeated kv heads."""
+        b, h, h_kv, t, d = 1, 4, 2, 1024, 16
+        r = np.random.RandomState(1)
+        q = jnp.asarray(r.randn(b, h, t, d) * 0.3, jnp.float32)
+        k = jnp.asarray(r.randn(b, h_kv, t, d) * 0.3, jnp.float32)
+        v = jnp.asarray(r.randn(b, h_kv, t, d) * 0.3, jnp.float32)
+        want = dot_product_attention(q, jnp.repeat(k, 2, 1),
+                                     jnp.repeat(v, 2, 1), causal=True)
+
+        def body(q, k, v):
+            return ring_attention(q, k, v, axis_name="seq", causal=True,
+                                  use_flash=True)
+
+        got = jax.jit(jax.shard_map(
+            body, mesh=mesh,
+            in_specs=P(None, None, "seq", None),
+            out_specs=P(None, None, "seq", None), check_vma=False))(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_ring_flash_falls_back_when_blocks_dont_tile(self, mesh):
+        """Non-tiling local block lengths silently use the dense ring."""
+        b, h, t, d = 1, 2, 1200, 8  # local t = 150, not a 128 multiple
+        r = np.random.RandomState(2)
+        q, k, v = (jnp.asarray(r.randn(b, h, t, d) * 0.3, jnp.float32)
+                   for _ in range(3))
+        want = dot_product_attention(q, k, v, causal=True)
+
+        def body(q, k, v):
+            return ring_attention(q, k, v, axis_name="seq", causal=True,
+                                  use_flash=True)
+
+        got = jax.jit(jax.shard_map(
+            body, mesh=mesh,
+            in_specs=P(None, None, "seq", None),
+            out_specs=P(None, None, "seq", None), check_vma=False))(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
