@@ -307,8 +307,10 @@ class TransformerLM(Module):
         (B, t0 + max_new_tokens) ids of the best beam per batch row
         (finished beams — after ``eos_id`` — are frozen and padded with
         eos). Ranking: summed token log-probs / L**length_penalty where L
-        is each beam's OWN generated length (eos and its padding excluded
-        from both sum and length)."""
+        is each beam's OWN generated length. The step that emits eos IS
+        scored (its log-prob joins the sum and it counts toward L, the
+        standard HF-style ranking); only the padding after it is
+        excluded."""
         (prompt_ids, b, t0, params, buffers, step_jit,
          logits, caches) = self._decode_setup(prompt_ids, max_new_tokens,
                                               max_len)

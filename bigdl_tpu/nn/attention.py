@@ -201,7 +201,15 @@ class MultiHeadAttention(Module):
     def forward_prefill(self, x, cache, pos0: int = 0):
         """Batched prompt prefill: one causal pass over x (B, T0, C) that
         both produces the outputs and writes K/V into the cache at
-        ``pos0`` — O(T0²) once instead of T0 masked steps over max_len."""
+        ``pos0`` — O(T0²) once instead of T0 masked steps over max_len.
+
+        ``pos0`` must be a static int. With ``pos0 > 0`` this is a
+        *continuation* prefill: the new block's queries also attend over
+        the cached prefix ``[0, pos0)`` (the cache stores rotated keys,
+        so the prefix is position-correct as stored)."""
+        if not isinstance(pos0, int):
+            raise TypeError("forward_prefill pos0 must be a static int "
+                            "(the cache prefix length is a shape)")
         b, t, _ = x.shape
         qkv = self.qkv(x.reshape(b * t, self.embed_dim)).reshape(b, t, -1)
         q, k, v = self._split_kv_step(qkv)
@@ -209,11 +217,25 @@ class MultiHeadAttention(Module):
             positions = pos0 + jnp.arange(t)
             q, k = self._rope(q, positions), self._rope(k, positions)
         k_cache, v_cache = cache
+        if pos0 + t > k_cache.shape[2]:
+            # dynamic_update_slice would silently CLAMP the write start,
+            # corrupting the prefix — fail at trace time instead
+            raise ValueError(
+                f"prefill of {t} tokens at pos0={pos0} overflows the "
+                f"{k_cache.shape[2]}-long KV cache")
         k_cache = jax.lax.dynamic_update_slice(
             k_cache, k.astype(k_cache.dtype), (0, 0, pos0, 0))
         v_cache = jax.lax.dynamic_update_slice(
             v_cache, v.astype(v_cache.dtype), (0, 0, pos0, 0))
-        kx, vx = self._expand_kv(k, v)  # prompt-only attention, one-time
+        if pos0:
+            # attend over cached prefix + new block; dot_product_attention's
+            # causal mask (tril offset tk - tq = pos0) lets query i see
+            # exactly keys [0, pos0 + i]
+            k = jax.lax.slice_in_dim(k_cache, 0, pos0 + t, axis=2) \
+                .astype(q.dtype)
+            v = jax.lax.slice_in_dim(v_cache, 0, pos0 + t, axis=2) \
+                .astype(q.dtype)
+        kx, vx = self._expand_kv(k, v)
         o = dot_product_attention(q, kx, vx, causal=True)
         o = o.transpose(0, 2, 1, 3).reshape(b, t, self.embed_dim)
         o = self.out_proj(o.reshape(b * t, self.embed_dim)).reshape(b, t, -1)

@@ -52,46 +52,130 @@ def _open_meta(path: str, mode: str):
     return open(path, mode)
 
 
+def _exists(path: str) -> bool:
+    if "://" in path:
+        from etils import epath
+
+        return epath.Path(path).exists()
+    return os.path.exists(path)
+
+
+def _remove(path: str) -> None:
+    """Remove a file or directory tree if present (no-op otherwise)."""
+    if "://" in path:
+        from etils import epath
+
+        p = epath.Path(path)
+        if p.exists():
+            p.rmtree() if p.is_dir() else p.unlink()
+        return
+    if os.path.isdir(path):
+        import shutil
+
+        shutil.rmtree(path)
+    elif os.path.exists(path):
+        os.remove(path)
+
+
+def _rename(src: str, dst: str) -> None:
+    # only the local-path swap protocol renames; object-store saves
+    # never do (a gs:// prefix can't be renamed atomically)
+    os.replace(src, dst)
+
+
+def _barrier(tag: str) -> None:
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+
+def _swap_in(path: str) -> None:
+    """Promote a COMPLETE ``path + ".tmp-save"`` pair to ``path``: retire
+    the live pair to ``.old`` (arrays first, then meta), rename tmp in
+    (arrays first, then meta), drop the retired pair. Every interruption
+    window leaves a complete pair under a name _resolve_restore_path
+    knows."""
+    tmp, old = path + ".tmp-save", path + ".old"
+    _remove(old)
+    _remove(old + ".meta.json")
+    if _exists(path):
+        _rename(path, old)
+    if _exists(path + ".meta.json"):
+        _rename(path + ".meta.json", old + ".meta.json")
+    _rename(tmp, path)
+    _rename(tmp + ".meta.json", path + ".meta.json")
+    _remove(old)
+    _remove(old + ".meta.json")
+
+
 def save_train_state(path: str, step: int, params, buffers, slots,
                      state: Optional[dict] = None) -> None:
-    """Write one checkpoint directory (overwrites). Sharded arrays are
-    written shard-by-shard from their owning devices/processes."""
+    """Write one checkpoint directory at ``path``. Local paths replace any
+    previous checkpoint ATOMICALLY: arrays land in ``path + ".tmp-save"``
+    first, then a rename dance promotes them — an interruption at any
+    point leaves the previous checkpoint or the new one fully restorable,
+    never neither (restore_train_state knows the fallback names, newest
+    first). Object-store paths (gs://, s3://) can't rename a prefix
+    atomically, so they keep the meta-last protocol instead: old meta
+    removed (marks the checkpoint detectably incomplete during the
+    overwrite), arrays rewritten in place, meta put in one shot last.
+    Sharded arrays are written shard-by-shard from their owning
+    devices/processes."""
     ckptr = _checkpointer()
     kept = {k: v for k, v in (state or {}).items()
             if isinstance(v, (bool, int, float, str))}
     path = _norm(path)
-    meta = path + ".meta.json"
-    # StandardCheckpointer stores arrays; step + driver-state scalars ride
-    # in a sidecar json (its keys vary run-to-run anyway). Remove any STALE
-    # meta first so a crash mid-overwrite is detected as incomplete rather
-    # than silently pairing new arrays with the old step.
-    if jax.process_index() == 0:
-        try:
-            if "://" in meta:
-                from etils import epath
 
-                epath.Path(meta).unlink()
-            else:
-                os.remove(meta)
-        except FileNotFoundError:
-            pass
-    ckptr.save(path, {"params": params, "buffers": buffers, "slots": slots},
+    if "://" in path:
+        meta = path + ".meta.json"
+        if jax.process_index() == 0:
+            _remove(meta)
+        _barrier("bigdl_tpu_ckpt_pre")
+        ckptr.save(path,
+                   {"params": params, "buffers": buffers, "slots": slots},
+                   force=True)
+        ckptr.wait_until_finished()
+        if jax.process_index() == 0:  # single-shot put: atomic on GCS/S3
+            with _open_meta(meta, "w") as f:
+                json.dump({"step": int(step), "state": kept}, f)
+        _barrier("bigdl_tpu_ckpt_meta")
+        return
+
+    tmp = path + ".tmp-save"
+    if jax.process_index() == 0:
+        if _exists(tmp) and _exists(tmp + ".meta.json"):
+            # a previous save crashed mid-swap AFTER fully writing the new
+            # checkpoint: finish its swap (it is the newest state — the one
+            # a restart restored from) rather than deleting it
+            _swap_in(path)
+        else:  # partial leftovers from a crash mid-write
+            _remove(tmp)
+            _remove(tmp + ".meta.json")
+        # orbax itself stages into sibling '<tmp>.orbax-checkpoint-tmp-<ts>'
+        # dirs and renames into place; a crash mid array-write orphans one
+        # (with no '<tmp>' dir at all) — sweep them or they leak a full
+        # checkpoint of disk per crashed save
+        import glob
+
+        for orphan in glob.glob(glob.escape(tmp) + ".orbax-checkpoint-tmp-*"):
+            _remove(orphan)
+    _barrier("bigdl_tpu_ckpt_pre")  # cleanup lands before shard writes
+    ckptr.save(tmp, {"params": params, "buffers": buffers, "slots": slots},
                force=True)
     ckptr.wait_until_finished()
     if jax.process_index() == 0:  # one writer on multi-host pods
-        if "://" in meta:  # object stores have atomic single-shot puts
-            with _open_meta(meta, "w") as f:
-                json.dump({"step": int(step), "state": kept}, f)
-        else:  # local/NFS: write-then-rename, never a torn meta
-            with open(meta + ".tmp", "w") as f:
-                json.dump({"step": int(step), "state": kept}, f)
-            os.replace(meta + ".tmp", meta)
-    if jax.process_count() > 1:
-        # no process may return (and possibly restore) before process 0's
-        # meta hits storage
-        from jax.experimental import multihost_utils
-
-        multihost_utils.sync_global_devices("bigdl_tpu_ckpt_meta")
+        # meta AFTER arrays: a (dir, meta) pair present => pair complete.
+        # Step + driver-state scalars ride in a sidecar json
+        # (StandardCheckpointer stores arrays; these keys vary run-to-run).
+        # Local/NFS: write-then-rename, never a torn meta.
+        with open(tmp + ".meta.json.part", "w") as f:
+            json.dump({"step": int(step), "state": kept}, f)
+        os.replace(tmp + ".meta.json.part", tmp + ".meta.json")
+        _swap_in(path)
+    # no process may return (and possibly restore) before process 0's
+    # swap completes
+    _barrier("bigdl_tpu_ckpt_meta")
 
 
 def restore_train_state(path: str, like, shardings=None):
@@ -114,16 +198,33 @@ def restore_train_state(path: str, like, shardings=None):
         sh_tree = shardings
     a_params, a_buffers, a_slots = jax.tree.map(
         as_abstract, (params, buffers, slots), sh_tree)
-    path = _norm(path)
+    path = _resolve_restore_path(_norm(path))
     tree = ckptr.restore(
         path, {"params": a_params, "buffers": a_buffers, "slots": a_slots})
-    try:
-        with _open_meta(path + ".meta.json", "r") as f:
-            meta = json.load(f)
-    except FileNotFoundError:
-        raise ValueError(
-            f"{path}.meta.json missing: the checkpoint is incomplete "
-            "(interrupted save?) — refusing to guess step 0 on trained "
-            "weights") from None
+    with _open_meta(path + ".meta.json", "r") as f:
+        meta = json.load(f)
     return (int(meta["step"]), tree["params"], tree["buffers"],
             tree["slots"], meta.get("state", {}))
+
+
+def _resolve_restore_path(path: str) -> str:
+    """Pick the newest COMPLETE (arrays dir, meta) pair among the primary
+    path and the atomic-swap leftovers a mid-save crash can leave.
+    ``.tmp-save`` wins over the primary: its meta is only written after
+    its arrays land, and the pair is renamed away the moment a swap
+    completes — so a complete ``.tmp-save`` pair is always a newer
+    checkpoint than whatever sits at ``path``. ``.old`` (previous
+    checkpoint retired but not yet deleted) is the last resort."""
+    for cand in (path + ".tmp-save", path, path + ".old"):
+        if _exists(cand) and _exists(cand + ".meta.json"):
+            if cand != path:
+                import logging
+
+                logging.getLogger("bigdl_tpu").warning(
+                    "checkpoint save at %s was interrupted; restoring "
+                    "the newest intact copy at %s", path, cand)
+            return cand
+    raise ValueError(
+        f"{path}: checkpoint incomplete — no complete (arrays, meta) pair "
+        "at the path or its .tmp-save/.old fallbacks; refusing to guess "
+        "step 0 on trained weights")

@@ -1,0 +1,15 @@
+#!/bin/bash
+# Probe the axon TPU tunnel every ~5 min; the moment it opens, run the
+# staged hardware session (sweep -> bench -> flash matrix -> profile).
+# Appends status to /tmp/tpu_status. Exits after a successful session.
+cd "$(dirname "$0")/.."
+while true; do
+    if timeout 45 python -c "import jax; d=jax.devices()[0]; assert d.platform != 'cpu'" 2>/dev/null; then
+        echo "$(date -u +%FT%TZ) ALIVE" >> /tmp/tpu_status
+        python scripts/tpu_session.py --profile >> /tmp/tpu_session.log 2>&1
+        echo "$(date -u +%FT%TZ) SESSION rc=$?" >> /tmp/tpu_status
+        exit 0
+    fi
+    echo "$(date -u +%FT%TZ) WEDGED" >> /tmp/tpu_status
+    sleep 300
+done

@@ -200,6 +200,28 @@ def test_kv_cache_decode_matches_full_forward():
                                    rtol=2e-4, atol=2e-5, err_msg=f"pos {i}")
 
 
+def test_continuation_prefill_attends_cached_prefix():
+    """forward_prefill(pos0 > 0) must attend over the cached [0, pos0)
+    prefix: chunked prefill == one-shot prefill (ADVICE r4 medium)."""
+    from bigdl_tpu.nn.attention import MultiHeadAttention
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(3)
+    m = MultiHeadAttention(16, 4, num_kv_heads=2, causal=True, rotary=True)
+    m.evaluate()
+    x = jnp.asarray(np.random.RandomState(3).randn(2, 8, 16), jnp.float32)
+    full, _ = m.forward_prefill(x, m.init_cache(2, 8))
+    cache = m.init_cache(2, 8)
+    o1, cache = m.forward_prefill(x[:, :5], cache, 0)
+    o2, _ = m.forward_prefill(x[:, 5:], cache, 5)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(full[:, :5]),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(o2), np.asarray(full[:, 5:]),
+                               rtol=2e-4, atol=2e-5)
+    with pytest.raises(TypeError):  # traced pos0 would silently be wrong
+        m.forward_prefill(x[:, 5:], cache, jnp.int32(5))
+
+
 def test_generate_greedy_extends_prompt():
     from bigdl_tpu.models.transformer import TransformerLM
     from bigdl_tpu.utils import random as rnd

@@ -66,3 +66,53 @@ def test_missing_meta_raises_and_state_roundtrips_types(tmp_path):
     os.remove(p + ".meta.json")
     with pytest.raises(ValueError, match="incomplete"):
         restore_train_state(p, like=(params, {}, ()))
+
+
+def test_interrupted_overwrite_preserves_prior_checkpoint(tmp_path):
+    """ADVICE r4: a crash mid-save must never destroy the previous
+    checkpoint. Simulate every swap crash window by reconstructing the
+    on-disk states the atomic rename dance can be interrupted in."""
+    import os
+    import shutil
+
+    m = nn.Sequential(nn.Linear(3, 2))
+    params = m.params_dict()
+    p = str(tmp_path / "ck")
+    save_train_state(p, 1, params, {}, ())
+
+    # window A: new arrays + meta fully written to .tmp-save, swap not
+    # started (crash between the tmp meta rename and retiring the live
+    # pair) — BOTH pairs complete; .tmp-save is newer and must win
+    shutil.copytree(p, p + ".tmp-save")
+    with open(p + ".tmp-save.meta.json", "w") as f:
+        f.write('{"step": 2, "state": {}}')
+    step, _, _, _, _ = restore_train_state(p, like=(params, {}, ()))
+    assert step == 2  # the NEW checkpoint was recovered
+
+    # ...and the NEXT save must finish that interrupted swap (promote
+    # step 2), not delete it — then land step 3 normally on top
+    save_train_state(p, 3, params, {}, ())
+    assert not os.path.exists(p + ".old")
+    assert not os.path.exists(p + ".tmp-save")
+    step, _, _, _, _ = restore_train_state(p, like=(params, {}, ()))
+    assert step == 3
+
+    # window B: live pair retired to .old, promotion never happened
+    # (tmp was promoted away mid-swap crash leaves old as last resort)
+    os.rename(p, p + ".old")
+    os.rename(p + ".meta.json", p + ".old.meta.json")
+    step, _, _, _, _ = restore_train_state(p, like=(params, {}, ()))
+    assert step == 3  # the PRIOR checkpoint survived
+
+    # a partial tmp (arrays, no meta — crash mid array write) is ignored
+    os.makedirs(p + ".tmp-save")
+    step, _, _, _, _ = restore_train_state(p, like=(params, {}, ()))
+    assert step == 3
+    # and the next save clears it and every leftover
+    os.rename(p + ".old", p)
+    os.rename(p + ".old.meta.json", p + ".meta.json")
+    save_train_state(p, 4, params, {}, ())
+    assert not os.path.exists(p + ".old")
+    assert not os.path.exists(p + ".tmp-save")
+    step, _, _, _, _ = restore_train_state(p, like=(params, {}, ()))
+    assert step == 4
