@@ -170,7 +170,11 @@ class DistriOptimizer(LocalOptimizer):
             in_specs=(P(), buf_spec, P("data"), slot_specs, P("data"), P("data"), P(), P()),
             out_specs=(P(), P(), buf_spec, P("data"), slot_specs),
             check_vma=False)
-        return jax.jit(mapped), param_spec, spec_size
+        # donate params/buffers/flat/slots: in-place buffer reuse instead
+        # of a full params+slots HBM copy per step (callers read only the
+        # post-step outputs, donated no earlier than the NEXT call)
+        return (jax.jit(mapped, donate_argnums=(0, 1, 2, 3)),
+                param_spec, spec_size)
 
     def _build_allreduce_step(self, model, criterion, method, grad_clip):
         from bigdl_tpu.optim.optimizer import make_train_step
@@ -182,7 +186,8 @@ class DistriOptimizer(LocalOptimizer):
         jitted = jax.jit(
             ts.step,
             in_shardings=(repl, repl, repl, data_sharding, data_sharding, repl, repl),
-            out_shardings=(repl, repl, repl, repl))
+            out_shardings=(repl, repl, repl, repl),
+            donate_argnums=(0, 1, 2))  # params/buffers/slots reuse in place
         return jitted, ts
 
     # ---------------------------------------------------------- data feeding
@@ -394,7 +399,11 @@ class DistriOptimizer(LocalOptimizer):
         data_sharding = NamedSharding(mesh, P("data"))
         repl = NamedSharding(mesh, P())
 
-        params = jax.device_put(model.params_dict(), repl)
+        # jnp.copy after device_put: placement can ALIAS the model's own
+        # arrays (same-device no-op), and step-1 donation must never
+        # invalidate them
+        params = jax.tree.map(jnp.copy,
+                              jax.device_put(model.params_dict(), repl))
         host_buffers = model.buffers_dict()
         stacked_buffers = (self.parameter_sync == "sharded"
                            and not self.sync_batch_norm)
@@ -407,7 +416,8 @@ class DistriOptimizer(LocalOptimizer):
                     host_buffers),
                 data_sharding)
         else:
-            buffers = jax.device_put(host_buffers, repl)
+            buffers = jax.tree.map(jnp.copy,
+                                   jax.device_put(host_buffers, repl))
 
         def buffers_for_model(bufs):
             """Host view for validation/checkpoint: replica 0's stats (≙

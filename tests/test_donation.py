@@ -1,0 +1,117 @@
+"""Buffer donation in the PRODUCTION train loops (VERDICT r4 #3).
+
+The bench path (models/perf.py) always donated; these tests pin down
+that LocalOptimizer.optimize() and DistriOptimizer.optimize() now run
+the same donated program: step inputs are invalidated (so XLA may reuse
+their buffers in place — on TPU that removes a full params+slots HBM
+copy per step and ~2x peak parameter memory), while numerics and the
+caller-visible model stay exactly as before."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset.dataset import DataSet
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.optim import SGD, Top1Accuracy, Trigger
+from bigdl_tpu.optim.optimizer import LocalOptimizer, make_train_step
+from bigdl_tpu.parallel import DistriOptimizer, Engine
+
+
+def _samples(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 2).astype(np.float32)
+    y = (x.sum(1) > 1.0).astype(np.float32) + 1.0
+    return [Sample(x[i], np.array([y[i]])) for i in range(n)]
+
+
+def _mlp(seed=7):
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(seed)
+    m = nn.Sequential(nn.Linear(2, 16), nn.Tanh(), nn.Linear(16, 2),
+                      nn.LogSoftMax())
+    return m
+
+
+def test_jitted_step_donates_inputs_on_cpu():
+    """The exact jit configuration the optimizers build must invalidate
+    the donated params/buffers/slots (CPU honors donation bookkeeping:
+    accessing a donated input raises)."""
+    m = _mlp()
+    ts = make_train_step(m, nn.ClassNLLCriterion(), SGD(learning_rate=0.1))
+    params = jax.tree.map(jnp.copy, m.params_dict())
+    slots = ts.init_slots(params)
+    step = jax.jit(ts.step, donate_argnums=(0, 1, 2))
+    x = jnp.ones((8, 2))
+    y = jnp.ones((8, 1))
+    _, new_params, _, _ = step(params, {}, slots, x, y, ts.current_lrs(),
+                               jax.random.PRNGKey(0))
+    leaf = jax.tree.leaves(params)[0]
+    with pytest.raises(RuntimeError, match="deleted"):
+        np.asarray(leaf)
+    assert np.isfinite(np.asarray(jax.tree.leaves(new_params)[0])).all()
+
+
+def test_local_optimizer_donation_preserves_numerics_and_model():
+    """optimize() (donated) must produce bit-identical weights to a
+    manual non-donated loop over the same make_train_step program, and
+    the model's own arrays must survive step-1 donation (the loop copies
+    them up front)."""
+    from bigdl_tpu.utils import random as rnd
+
+    samples = _samples(64)
+
+    model_a = _mlp(seed=11)
+    w_live = list(model_a._modules.values())[0]._parameters["weight"]
+    opt = LocalOptimizer(model=model_a, training_set=DataSet.array(samples),
+                         criterion=nn.ClassNLLCriterion(), batch_size=32,
+                         end_when=Trigger.max_iteration(4))
+    opt.set_optim_method(SGD(learning_rate=0.1))
+    rnd.set_seed(99)
+    trained = opt.optimize()
+    np.asarray(w_live)  # pre-training arrays must NOT have been donated
+
+    # identical manual loop, no donation, same data order + rng stream
+    model_b = _mlp(seed=11)
+    ts = make_train_step(model_b, nn.ClassNLLCriterion(),
+                         SGD(learning_rate=0.1))
+    params = model_b.params_dict()
+    slots = ts.init_slots(params)
+    step = jax.jit(ts.step)
+    rnd.set_seed(99)
+    batches = LocalOptimizer(
+        model=_mlp(), training_set=DataSet.array(samples),
+        criterion=nn.ClassNLLCriterion(), batch_size=32,
+        end_when=Trigger.max_iteration(4))._batch_stream()
+    for _ in range(4):
+        b = next(batches)
+        x = jnp.asarray(b.get_input())
+        y = jnp.asarray(b.get_target())
+        _, params, _, slots = step(params, {}, slots, x, y,
+                                   ts.current_lrs(), rnd.next_key())
+    for got, want in zip(jax.tree.leaves(trained.params_dict()),
+                         jax.tree.leaves(params)):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.parametrize("sync", ["sharded", "allreduce"])
+def test_distri_optimizer_trains_with_donation(sync):
+    """Both mesh step programs (ZeRO-1 sharded and allreduce) run
+    donated end-to-end: training completes, the returned model is
+    usable, and accuracy on the toy task is sane."""
+    Engine.create_mesh([("data", 8)])
+    samples = _samples(128)
+    model = _mlp(seed=5)
+    opt = DistriOptimizer(model=model, dataset=DataSet.array(samples),
+                          criterion=nn.ClassNLLCriterion(), batch_size=64,
+                          end_when=Trigger.max_iteration(15),
+                          parameter_sync=sync)
+    opt.set_optim_method(SGD(learning_rate=0.5))
+    trained = opt.optimize()
+    results = trained.evaluate_on(_samples(64, seed=1), [Top1Accuracy()],
+                                  batch_size=32)
+    acc, _ = results[0][1].result()
+    assert acc > 0.8
