@@ -41,7 +41,8 @@ def _block_attend(q, k, v, scale, mask):
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
-                   scale: Optional[float] = None, use_flash: bool = False):
+                   scale: Optional[float] = None, use_flash: bool = False,
+                   interpret: Optional[bool] = None):
     """Blockwise ring attention inside shard_map.
 
     Each device holds one sequence block of Q/K/V (B, H, T/n, D). K/V
@@ -61,7 +62,8 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     if use_flash:
         blk = min(128, q.shape[2])
         if q.shape[2] % blk == 0:
-            return _ring_attention_flash(q, k, v, axis_name, causal, scale)
+            return _ring_attention_flash(q, k, v, axis_name, causal, scale,
+                                         interpret)
     if k.shape[1] != q.shape[1]:  # dense path needs materialized kv heads
         rep = q.shape[1] // k.shape[1]
         k, v = jnp.repeat(k, rep, 1), jnp.repeat(v, rep, 1)
@@ -126,7 +128,8 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
 
 
 def _ring_attention_flash(q, k, v, axis_name: str, causal: bool,
-                          scale: Optional[float]):
+                          scale: Optional[float],
+                          interpret: Optional[bool] = None):
     """Flash-kernel ring steps merged in logsumexp space. Per step the
     held K/V block is (relative to my Q block) strictly past -> full
     attention, diagonal -> causal, strictly future -> skipped; the three
@@ -143,9 +146,12 @@ def _ring_attention_flash(q, k, v, axis_name: str, causal: bool,
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     block = min(128, t)
     qf = q.reshape(b * h, t, d)
+    if interpret is None:
+        # host-platform default; cross-lowering (jax.export for TPU from a
+        # CPU host) passes interpret=False explicitly for real Mosaic
+        interpret = default_interpret()
     flash = partial(flash_with_lse, scale=scale, block_q=block,
-                    block_k=block, interpret=default_interpret(),
-                    group=group)
+                    block_k=block, interpret=interpret, group=group)
 
     def attend_full(k_cur, v_cur):
         o, lse = flash(qf, k_cur.reshape(b * h_kv, t, d),

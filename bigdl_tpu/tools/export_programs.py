@@ -1,0 +1,221 @@
+"""Cross-lowering builders: the production programs packaged for
+``jax.export(platforms=["tpu"])``.
+
+Nothing here needs TPU hardware. ``jax.export`` runs the FULL TPU
+lowering pipeline from any host — including Mosaic for the pallas flash
+kernel, whose compiled payload lands in the module as a
+``tpu_custom_call`` — so Mosaic/layout/lowering breakage is caught
+offline instead of eating a live-hardware window (the axon tunnel can
+wedge for hours; see PERF.md). Consumers: ``tests/test_tpu_lowering.py``
+(fast shapes, every suite run) and ``scripts/tpu_export.py`` (flagship
+shapes, records artifact hashes in ``TPU_LOWERING.json``).
+
+Each builder returns ``(fn, args)`` where ``fn`` is the jitted program
+and ``args`` are ``ShapeDtypeStruct``s carrying the production
+shardings, ready for ``jax.export.export(fn, platforms=["tpu"])(*args)``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _abstract(tree):
+    """Concrete pytree -> ShapeDtypeStructs preserving shardings."""
+    return jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                       sharding=getattr(a, "sharding", None)),
+        tree)
+
+
+def flash_attention_program(b: int = 2, h: int = 8, h_kv: int = 4,
+                            t: int = 1024, d: int = 64,
+                            dtype=jnp.bfloat16, grad: bool = True):
+    """The pallas flash kernel at its shipped (128, 128) blocks with the
+    GQA BlockSpec index map, fwd (+bwd when ``grad``), single chip.
+    This is the program whose Mosaic lowering has never run on hardware —
+    the VERDICT r4 bar (``ops/flash_attention.py`` must survive real
+    Mosaic lowering, not just interpret mode)."""
+    from bigdl_tpu.ops.flash_attention import flash_attention
+
+    def fwd(q, k, v):
+        return flash_attention(q, k, v, causal=True, interpret=False)
+
+    if grad:
+        def loss(q, k, v):
+            return jnp.mean(fwd(q, k, v).astype(jnp.float32))
+
+        fn = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
+    else:
+        fn = jax.jit(fwd)
+    q = jax.ShapeDtypeStruct((b, h, t, d), dtype)
+    kv = jax.ShapeDtypeStruct((b, h_kv, t, d), dtype)
+    return fn, (q, kv, kv)
+
+
+def ring_flash_program(n_devices: int = 8, t_per_shard: int = 256,
+                       dtype=jnp.bfloat16):
+    """Ring attention composed with the flash kernel (trainable custom
+    vjp), sharded over a ('data', 'seq') mesh — K/V blocks rotate over
+    the 'seq' axis via ppermute, each ring step runs the Mosaic kernel."""
+    from bigdl_tpu.parallel import Engine
+    from bigdl_tpu.parallel.ring_attention import ring_attention
+
+    dp = 2 if n_devices % 2 == 0 else 1
+    sp = n_devices // dp
+    mesh = Engine.create_mesh([("data", dp), ("seq", sp)])
+    b, h, h_kv, d = 2 * dp, 8, 4, 64
+    t = t_per_shard * sp
+
+    def body(q, k, v):
+        def loss_fn(q, k, v):
+            o = ring_attention(q, k, v, axis_name="seq", causal=True,
+                               use_flash=True, interpret=False)
+            return jnp.mean(o.astype(jnp.float32))
+
+        loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(q, k, v)
+        return lax.pmean(loss, ("data", "seq")), grads
+
+    spec = P("data", None, "seq", None)
+    fn = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=(P(), (spec, spec, spec)), check_vma=False))
+    sh = NamedSharding(mesh, spec)
+    q = jax.ShapeDtypeStruct((b, h, t, d), dtype, sharding=sh)
+    kv = jax.ShapeDtypeStruct((b, h_kv, t, d), dtype, sharding=sh)
+    return fn, (q, kv, kv)
+
+
+def distri_sharded_step_program(model_name: str = "lenet5",
+                                n_devices: int = 8,
+                                global_batch: int = 32,
+                                format: str = "NCHW",
+                                mesh=None):
+    """The PRODUCTION DistriOptimizer ZeRO-1 sharded train step — the
+    exact program ``_build_sharded_step`` jits (reduce-scatter bf16 wire,
+    per-shard update, all-gather, donation), with abstract args laid out
+    exactly as ``_optimize_impl`` lays them out."""
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset.dataset import DataSet
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.models.perf import build_model
+    from bigdl_tpu.optim import SGD, Trigger
+    from bigdl_tpu.parallel import DistriOptimizer, Engine
+    from bigdl_tpu.parallel.all_reduce import flatten_params, pad_to_multiple
+    from bigdl_tpu.utils import random as bt_random
+
+    mesh = mesh or Engine.create_mesh([("data", n_devices)])
+    n_data = mesh.shape["data"]
+    model, input_shape, class_num = build_model(model_name, format=format)
+    criterion = (nn.CrossEntropyCriterion() if model_name.startswith("resnet")
+                 else nn.ClassNLLCriterion())
+    dummy = [Sample(np.zeros(input_shape, np.float32),
+                    np.array([1.0], np.float32))]
+    opt = DistriOptimizer(model=model, dataset=DataSet.array(dummy),
+                          criterion=criterion, batch_size=global_batch,
+                          end_when=Trigger.max_iteration(1), mesh=mesh,
+                          parameter_sync="sharded")
+    method = SGD(learning_rate=0.01)
+    opt.set_optim_method(method)
+
+    repl = NamedSharding(mesh, P())
+    data_sh = NamedSharding(mesh, P("data"))
+    params = jax.device_put(model.params_dict(), repl)
+    buffers = jax.device_put(
+        jax.tree.map(lambda bf: jnp.broadcast_to(bf[None],
+                                                 (n_data,) + bf.shape),
+                     model.buffers_dict()),
+        data_sh)
+    flat, _ = flatten_params(params)
+    flat, _ = pad_to_multiple(flat, n_data)
+    flat = jax.device_put(flat, data_sh)
+    slots = method.init_slots(flat)
+    step, _, _ = opt._build_sharded_step(model, criterion, method, None,
+                                         slots)
+    x = jax.ShapeDtypeStruct((global_batch,) + tuple(input_shape),
+                             jnp.float32, sharding=data_sh)
+    y = jax.ShapeDtypeStruct((global_batch, 1), jnp.float32,
+                             sharding=data_sh)
+    lrs = jax.ShapeDtypeStruct((), jnp.float32, sharding=repl)
+    rng = _abstract(jax.device_put(bt_random.next_key(), repl))
+    return step, (_abstract(params), _abstract(buffers), _abstract(flat),
+                  _abstract(slots), x, y, lrs, rng)
+
+
+def combined_3d_program(n_devices: int = 8):
+    """The combined dp x sp x ep train step from the driver dryrun
+    (``__graft_entry__._dryrun_combined_3d``): RoPE + GQA + ring
+    attention over 'seq' + MoE all_to_all over 'expert' in one shard_map,
+    per-axis-correct gradient reductions."""
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.nn.module import pure_apply
+    from bigdl_tpu.parallel import Engine
+
+    ep = 2 if n_devices % 2 == 0 else 1
+    rest = n_devices // ep
+    dp = 2 if rest % 2 == 0 and rest > 1 else 1
+    sp = rest // dp
+    mesh = Engine.create_mesh([("data", dp), ("seq", sp), ("expert", ep)])
+    seq_len = 8 * sp
+    model = TransformerLM(vocab_size=32, embed_dim=16, num_heads=4,
+                          num_kv_heads=2, use_rope=True,
+                          num_layers=1, max_len=seq_len, causal=True,
+                          sequence_parallel="seq", n_experts=2 * ep,
+                          expert_parallel="expert")
+    apply_fn = pure_apply(model)
+    params, buffers = model.params_dict(), model.buffers_dict()
+
+    EXPERT_LEAVES = {"w1", "b1", "w2", "b2"}
+
+    def spec_of(path, _leaf):
+        names = {getattr(k, "key", getattr(k, "name", None)) for k in path}
+        if names & {"mlp"} and names & EXPERT_LEAVES:
+            return P("expert")
+        return P()
+
+    pspec = jax.tree_util.tree_map_with_path(spec_of, params)
+
+    def step(p, ids, targets):
+        def loss_fn(p):
+            logits, _ = apply_fn(p, buffers, ids, rng=None, training=True)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
+            return -jnp.mean(ll) + 0.01 * model.l_aux
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        loss = lax.pmean(loss, ("data", "seq", "expert"))
+        # expert-sharded leaves average over the axes their tokens came
+        # from, never over 'expert' itself
+        grads = jax.tree.map(
+            lambda g, s: lax.pmean(
+                g, ("data", "seq") if s == P("expert")
+                else ("data", "seq", "expert")),
+            grads, pspec)
+        return loss, jax.tree.map(lambda a, g: a - 0.1 * g, p, grads)
+
+    fn = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(pspec, P(("data", "expert"), "seq"),
+                  P(("data", "expert"), "seq")),
+        out_specs=(P(), pspec), check_vma=False))
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 32, (2 * dp * ep, seq_len)).astype(np.int32)
+    targets = np.roll(ids, -1, axis=1).astype(np.int32)
+    params = jax.device_put(
+        params, jax.tree.map(lambda s: NamedSharding(mesh, s), pspec))
+    dsh = NamedSharding(mesh, P(("data", "expert"), "seq"))
+    ids = jax.device_put(ids, dsh)
+    targets = jax.device_put(targets, dsh)
+    return fn, (params, ids, targets)
+
+
+def export_for_tpu(fn, args):
+    """jax.export the program for platforms=["tpu"]; returns the Exported."""
+    from jax import export
+
+    return export.export(fn, platforms=["tpu"])(*args)

@@ -1,0 +1,80 @@
+"""Offline TPU-lowering validation (VERDICT r4 #2).
+
+``jax.export(platforms=["tpu"])`` runs the full TPU lowering pipeline
+from the CPU host — including Mosaic for the pallas flash kernel, whose
+compiled payload lands in the module as a ``tpu_custom_call`` — so this
+suite proves the production programs COMPILE for TPU without any
+hardware, protecting the first live tunnel window from lowering
+breakage. Flagship-shape exports + artifact hashes: scripts/tpu_export.py
+-> TPU_LOWERING.json."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu.tools import export_programs as ep
+
+
+def _export(fn, args):
+    exported = ep.export_for_tpu(fn, args)
+    assert exported.platforms == ("tpu",)
+    assert len(exported.mlir_module_serialized) > 0
+    return exported
+
+
+def test_flash_attention_fwd_lowers_for_tpu_mosaic():
+    """The shipped kernel (128x128 blocks, GQA index map, bf16, causal)
+    must survive REAL Mosaic lowering — interpret=False — and the module
+    must contain the Mosaic custom call, not an interpreter fallback."""
+    fn, args = ep.flash_attention_program(t=512, grad=False)
+    exported = _export(fn, args)
+    assert "tpu_custom_call" in exported.mlir_module()
+
+
+def test_flash_attention_grad_lowers_for_tpu():
+    fn, args = ep.flash_attention_program(t=512, grad=True)
+    exported = _export(fn, args)
+    assert "tpu_custom_call" in exported.mlir_module()
+
+
+def test_ring_flash_composed_lowers_for_tpu():
+    """Ring attention (ppermute over 'seq') composed with the Mosaic
+    flash kernel, with gradients through the custom vjp, on the 8-way
+    ('data','seq') mesh."""
+    fn, args = ep.ring_flash_program(n_devices=8, t_per_shard=128)
+    exported = _export(fn, args)
+    assert exported.nr_devices == 8
+    mod = exported.mlir_module()
+    assert "tpu_custom_call" in mod
+    assert "collective_permute" in mod  # the ring's ppermute
+
+
+def test_distri_sharded_train_step_lowers_for_tpu():
+    """The production ZeRO-1 sharded DistriOptimizer step (reduce-scatter
+    bf16 wire, per-shard update, all-gather, donation) exports for TPU
+    over the 8-device mesh."""
+    fn, args = ep.distri_sharded_step_program("lenet5", n_devices=8,
+                                              global_batch=32)
+    exported = _export(fn, args)
+    assert exported.nr_devices == 8
+
+
+def test_combined_3d_step_lowers_for_tpu():
+    """The driver-dryrun composed dp x sp x ep program (RoPE + GQA +
+    ring attention + MoE all_to_all) exports for TPU — the same fn the
+    dryrun executes (shared builder)."""
+    fn, args = ep.combined_3d_program(n_devices=8)
+    exported = _export(fn, args)
+    assert exported.nr_devices == 8
+
+
+@pytest.mark.slow
+def test_resnet50_sharded_step_lowers_for_tpu():
+    """Flagship: the full ResNet-50 NHWC sharded train step (bench
+    config) cross-lowers for TPU. Slow (~minutes of XLA lowering);
+    scripts/tpu_export.py records its artifact hash."""
+    fn, args = ep.distri_sharded_step_program("resnet50", n_devices=8,
+                                              global_batch=32,
+                                              format="NHWC")
+    exported = _export(fn, args)
+    assert exported.nr_devices == 8
