@@ -15,6 +15,16 @@ Runs hermetically on a synthetic token stream. Examples:
   python -m bigdl_tpu.example.longcontext.train                 # 1 device
   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python -m bigdl_tpu.example.longcontext.train --seq-parallel 4 --experts 4
+
+On a TPU pod slice, one command per host wires the whole cluster
+(coordinator/rank auto-discovered; ≙ ref scripts/spark-submit-with-bigdl.sh):
+
+  gcloud compute tpus tpu-vm ssh $TPU --worker=all --command \
+    "bigdl-tpu-launch -m bigdl_tpu.example.longcontext.train --seq-parallel 16"
+
+and the same flow is testable without hardware on a local grid:
+
+  bigdl-tpu-launch --procs 2 --cpu-devices 4 your_train.py
 """
 
 from __future__ import annotations

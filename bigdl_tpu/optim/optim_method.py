@@ -307,17 +307,19 @@ class OptimMethod(ConfigCaptured):
 
     # --------------------------------------------------------- persistence
     def save(self, path: str, overwrite: bool = False) -> "OptimMethod":
-        import os
+        from bigdl_tpu.utils import file as bt_file
 
-        if os.path.exists(path) and not overwrite:
+        if bt_file.exists(path) and not overwrite:
             raise FileExistsError(path)
-        with open(path, "wb") as f:
+        with bt_file.open_file(path, "wb") as f:
             pickle.dump(self, f)
         return self
 
     @staticmethod
     def load(path: str) -> "OptimMethod":
-        with open(path, "rb") as f:
+        from bigdl_tpu.utils import file as bt_file
+
+        with bt_file.open_file(path, "rb") as f:
             return pickle.load(f)
 
     def clear_history(self) -> None:

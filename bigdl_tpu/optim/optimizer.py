@@ -255,13 +255,17 @@ def load_latest_checkpoint(path: str):
     from bigdl_tpu.utils import file as bt_file
     from bigdl_tpu.optim.optim_method import OptimMethod
 
-    if not os.path.isdir(path):
+    if not bt_file.is_remote(path) and not os.path.isdir(path):
+        return None, None, None
+    try:
+        names = bt_file.listdir(path)
+    except (FileNotFoundError, NotADirectoryError, OSError):
         return None, None, None
     tags = []
-    for fname in os.listdir(path):
+    for fname in names:
         if fname.startswith("model."):
             suffix = fname[len("model."):]
-            if suffix.isdigit() and os.path.exists(
+            if suffix.isdigit() and bt_file.exists(
                     os.path.join(path, f"optimMethod.{suffix}")):
                 tags.append(int(suffix))
     if not tags:
@@ -590,9 +594,10 @@ class LocalOptimizer(Optimizer):
     def _run_checkpoint(self, state):
         if not self._ckpt_now or self.checkpoint_path is None:
             return
-        os.makedirs(self.checkpoint_path, exist_ok=True)
-        tag = f"{state['neval'] - 1}"
         from bigdl_tpu.utils import file as bt_file
+
+        bt_file.makedirs(self.checkpoint_path)
+        tag = f"{state['neval'] - 1}"
 
         if not getattr(self, "checkpoint_async", False):
             bt_file.save_module(
@@ -615,8 +620,18 @@ class LocalOptimizer(Optimizer):
 
         def write():
             # write-then-rename: a crash mid-write never leaves a torn
-            # model.{tag} as the newest checkpoint on disk
+            # model.{tag} as the newest checkpoint on disk. Object stores
+            # have atomic single-shot puts, so remote paths write the
+            # final names directly.
             try:
+                if bt_file.is_remote(path):
+                    bt_file.save_module(
+                        model_snap, os.path.join(path, f"model.{tag}"),
+                        overwrite=True)
+                    method_snap.save(
+                        os.path.join(path, f"optimMethod.{tag}"),
+                        overwrite=True)
+                    return
                 mtmp = os.path.join(path, f".model.{tag}.tmp")
                 otmp = os.path.join(path, f".optimMethod.{tag}.tmp")
                 bt_file.save_module(model_snap, mtmp, overwrite=True)

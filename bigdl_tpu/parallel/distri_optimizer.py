@@ -329,9 +329,12 @@ class DistriOptimizer(LocalOptimizer):
                 orbax_ckpt._CKPTR.wait_until_finished()
 
     def _load_slots_snapshot(self, tag):
-        opath = os.path.abspath(os.path.join(self.checkpoint_path,
-                                             f"optimSlots.{tag}.orbax"))
-        if os.path.exists(opath):
+        from bigdl_tpu.utils import file as bt_file
+
+        opath = os.path.join(self.checkpoint_path, f"optimSlots.{tag}.orbax")
+        if not bt_file.is_remote(opath):
+            opath = os.path.abspath(opath)
+        if bt_file.exists(opath):
             # deferred: restored later DIRECTLY into the live slot
             # shardings (template built from the freshly-initialized
             # slots), so no host ever materializes the full state
@@ -339,9 +342,9 @@ class DistriOptimizer(LocalOptimizer):
         import pickle
 
         path = os.path.join(self.checkpoint_path, f"optimSlots.{tag}")
-        if not os.path.exists(path):
+        if not bt_file.exists(path):
             return None
-        with open(path, "rb") as f:
+        with bt_file.open_file(path, "rb") as f:
             return pickle.load(f)
 
     @staticmethod
@@ -370,20 +373,26 @@ class DistriOptimizer(LocalOptimizer):
                 # async_write leaves the write in flight (joined by
                 # join_pending_checkpoint, which the retry path calls
                 # before any restore)
+                from bigdl_tpu.utils import file as bt_file
                 from bigdl_tpu.utils.orbax_ckpt import _checkpointer
 
+                base = self.checkpoint_path
+                if not bt_file.is_remote(base):
+                    base = os.path.abspath(base)
                 ckptr = _checkpointer()
-                ckptr.save(os.path.join(os.path.abspath(self.checkpoint_path),
-                                        f"optimSlots.{tag}.orbax"),
+                ckptr.save(os.path.join(base, f"optimSlots.{tag}.orbax"),
                            {"slots": self._live_slots}, force=True)
                 if not getattr(self, "checkpoint_async", False):
                     ckptr.wait_until_finished()
                 return
             import pickle
 
+            from bigdl_tpu.utils import file as bt_file
+
             host = jax.tree.map(np.asarray, jax.device_get(self._live_slots))
-            with open(os.path.join(self.checkpoint_path,
-                                   f"optimSlots.{tag}"), "wb") as f:
+            with bt_file.open_file(os.path.join(self.checkpoint_path,
+                                                f"optimSlots.{tag}"),
+                                   "wb") as f:
                 pickle.dump(host, f)
 
     def _optimize_impl(self) -> Module:

@@ -1,11 +1,18 @@
-"""Module save/load.
+"""Module save/load — local AND object-store paths.
 
 Reference: utils/File.scala:68-176 (Java-serialization save/load of any
-module). The pickle-based path is the analog of the reference's
-``save``/``Module.load``; the structured protobuf-style format
-(``saveModule``/``loadModule``) lives in bigdl_tpu.utils.serializer.
-Device arrays are converted to numpy on save and restored with jnp.asarray
-on load, so checkpoints are host-portable.
+module, transparently local/HDFS/S3). The pickle-based path is the
+analog of the reference's ``save``/``Module.load``; the structured
+protobuf-style format (``saveModule``/``loadModule``) lives in
+bigdl_tpu.utils.serializer. Device arrays are converted to numpy on save
+and restored with jnp.asarray on load, so checkpoints are host-portable.
+
+Remote paths: anything with a URL scheme (``gs://``, ``s3://``, ...) is
+routed through ``etils.epath`` (already a dependency via orbax) — the
+TPU-pod analog of the reference's Hadoop-FS indirection. The
+``open_file``/``exists``/``makedirs``/``listdir`` helpers below are the
+single IO seam; checkpoint triggers and TrainSummary event writers go
+through them, so both can target a bucket directly.
 """
 
 from __future__ import annotations
@@ -15,6 +22,44 @@ import pickle
 
 import jax.numpy as jnp
 import numpy as np
+
+
+def is_remote(path) -> bool:
+    """True for URL-style paths (gs://, s3://, ...) that must go through
+    epath instead of the local filesystem."""
+    return "://" in str(path)
+
+
+def _epath(path):
+    from etils import epath  # ships with orbax; object-store capable
+
+    return epath.Path(path)
+
+
+def open_file(path, mode: str = "rb"):
+    """open() that understands object-store URLs. Append mode on object
+    stores degrades to a single streaming write ('ab' -> 'wb'): buckets
+    have no append, and every writer here creates fresh files anyway."""
+    if is_remote(path):
+        return _epath(path).open(mode.replace("ab", "wb"))
+    return open(path, mode)
+
+
+def exists(path) -> bool:
+    return _epath(path).exists() if is_remote(path) else os.path.exists(path)
+
+
+def makedirs(path) -> None:
+    if is_remote(path):
+        _epath(path).mkdir(parents=True, exist_ok=True)
+    else:
+        os.makedirs(path, exist_ok=True)
+
+
+def listdir(path):
+    if is_remote(path):
+        return [p.name for p in _epath(path).iterdir()]
+    return os.listdir(path)
 
 
 def _to_host(module):
@@ -40,7 +85,7 @@ def _to_device(module):
 
 
 def save_module(module, path: str, overwrite: bool = False) -> None:
-    if os.path.exists(path) and not overwrite:
+    if exists(path) and not overwrite:
         raise FileExistsError(f"{path} exists; pass overwrite=True")
     for _, m in module.named_modules():
         # drop recorded activations before deepcopy — they may be large or
@@ -50,12 +95,12 @@ def save_module(module, path: str, overwrite: bool = False) -> None:
         m._forward_key = None
     clone = module.clone_module()
     _to_host(clone)
-    with open(path, "wb") as f:
+    with open_file(path, "wb") as f:
         pickle.dump(clone, f)
 
 
 def load_module(path: str):
-    with open(path, "rb") as f:
+    with open_file(path, "rb") as f:
         module = pickle.load(f)
     _to_device(module)
     return module
@@ -63,15 +108,15 @@ def load_module(path: str):
 
 def save(obj, path: str, overwrite: bool = False) -> None:
     """Generic save for optimizer state / tables (≙ File.save)."""
-    if os.path.exists(path) and not overwrite:
+    if exists(path) and not overwrite:
         raise FileExistsError(f"{path} exists; pass overwrite=True")
     import jax
 
     host = jax.tree.map(lambda x: np.asarray(x) if hasattr(x, "shape") else x, obj)
-    with open(path, "wb") as f:
+    with open_file(path, "wb") as f:
         pickle.dump(host, f)
 
 
 def load(path: str):
-    with open(path, "rb") as f:
+    with open_file(path, "rb") as f:
         return pickle.load(f)

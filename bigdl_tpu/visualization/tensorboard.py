@@ -23,8 +23,12 @@ class RecordWriter:
     """Append TFRecord-framed payloads to a file (≙ RecordWriter.scala)."""
 
     def __init__(self, path: str):
+        from bigdl_tpu.utils import file as bt_file
+
         self.path = path
-        self._f = open(path, "ab")
+        # fresh file per run (timestamped name): 'ab' locally, one
+        # streaming 'wb' on object stores (buckets have no append)
+        self._f = bt_file.open_file(path, "ab")
 
     def write(self, payload: bytes) -> None:
         self._f.write(native.tfrecord_frame(payload))
@@ -41,7 +45,9 @@ class EventWriter:
     (≙ EventWriter.scala). The first record is the file_version event."""
 
     def __init__(self, log_dir: str, flush_secs: float = 2.0):
-        os.makedirs(log_dir, exist_ok=True)
+        from bigdl_tpu.utils import file as bt_file
+
+        bt_file.makedirs(log_dir)
         fname = f"events.out.tfevents.{int(time.time())}.{socket.gethostname()}"
         self.path = os.path.join(log_dir, fname)
         self._writer = RecordWriter(self.path)
@@ -145,13 +151,19 @@ def _exp_bucket_limits() -> List[float]:
 def read_scalar(log_dir: str, tag: str):
     """Read back (step, wall_time, value) triples for a tag from all event
     files (≙ Summary.readScalar, visualization/Summary.scala:77)."""
+    from bigdl_tpu.utils import file as bt_file
+
     out = []
-    if not os.path.isdir(log_dir):
+    if not bt_file.is_remote(log_dir) and not os.path.isdir(log_dir):
         return out
-    for fname in sorted(os.listdir(log_dir)):
+    try:
+        names = sorted(bt_file.listdir(log_dir))
+    except (FileNotFoundError, NotADirectoryError, OSError):
+        return out
+    for fname in names:
         if ".tfevents." not in fname:
             continue
-        with open(os.path.join(log_dir, fname), "rb") as f:
+        with bt_file.open_file(os.path.join(log_dir, fname), "rb") as f:
             data = f.read()
         for payload in native.tfrecord_iter(data):
             ev = proto.parse_event(payload)

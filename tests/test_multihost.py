@@ -107,6 +107,49 @@ def test_mismatched_shard_count_raises(monkeypatch):
         next(iter(opt._minibatches(ds, 4)))
 
 
+_LAUNCH_TRAIN = '''
+"""LeNet e2e under bigdl-tpu-launch (written by the launcher test)."""
+import numpy as np
+import jax
+from bigdl_tpu import nn
+from bigdl_tpu.dataset.dataset import ShardedDataSet
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.models.lenet import LeNet5
+from bigdl_tpu.optim import SGD, Trigger
+from bigdl_tpu.parallel import DistriOptimizer, Engine
+
+rng = np.random.RandomState(jax.process_index())
+samples = [Sample(np.random.RandomState(i).randn(28, 28).astype(np.float32),
+                  np.array([1.0 + (i % 10)], np.float32)) for i in range(32)]
+opt = DistriOptimizer(model=LeNet5(10), dataset=ShardedDataSet(samples),
+                      criterion=nn.ClassNLLCriterion(), batch_size=16,
+                      end_when=Trigger.max_iteration(2),
+                      mesh=Engine.default_mesh())
+opt.set_optim_method(SGD(learning_rate=0.01))
+opt.optimize()
+print(f"LAUNCH OK {jax.process_index()} {jax.process_count()} "
+      f"{len(jax.devices())}", flush=True)
+'''
+
+
+def test_launcher_runs_lenet_on_local_grid(tmp_path):
+    """bigdl-tpu-launch --procs 2 --cpu-devices 4: two real
+    jax.distributed processes form an 8-device grid and train LeNet
+    end-to-end through DistriOptimizer (VERDICT r4 #5)."""
+    script = tmp_path / "train_lenet.py"
+    script.write_text(_LAUNCH_TRAIN)
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, PYTHONPATH=os.path.dirname(here), XLA_FLAGS="",
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.tools.launch", "--procs", "2",
+         "--cpu-devices", "4", str(script)],
+        capture_output=True, timeout=420, env=env)
+    out = proc.stdout.decode()
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+    assert "LAUNCH OK 0 2 8" in out and "LAUNCH OK 1 2 8" in out, out
+
+
 def test_orbax_checkpoint_across_two_processes(tmp_path):
     """Shard-wise orbax save/restore with REAL jax.distributed: each
     process writes its own shards, process 0 alone writes the sidecar
