@@ -9,11 +9,13 @@ device-resident synthetic batch (no host↔HBM transfer in the timed loop),
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from bigdl_tpu import nn
 from bigdl_tpu.nn.module import Module
@@ -282,6 +284,101 @@ def run_decode_perf(batch_size: int = 8, prompt_len: int = 128,
     return s
 
 
+def run_input_pipeline_perf(batch_size: int = 64, n_records: int = 512,
+                            image: int = 256, crop: int = 224,
+                            depths=(0, 2, 4), shards: int = 4,
+                            native_modes=(True, False), log=print) -> list:
+    """Host input-pipeline throughput (VERDICT r4 #4): records/sec through
+    ``RecordFileDataSet`` -> vision augment chain (RandomCrop + HFlip +
+    ChannelNormalize, the ImageNet train path) -> ``SampleToMiniBatch`` ->
+    sharded H2D staging, with and without the native C++ reader pool and
+    at prefetch depths {0, 2, 4}. No model step runs — this measures the
+    FEED side only, so compare records/sec against the device's measured
+    imgs/sec demand (bench.py) to decide whether the host can keep a chip
+    fed. Engineering intent ≙ ref: dataset/image/MTLabeledBGRImgToBatch
+    .scala:1 (the reference's multithreaded batch assembly)."""
+    import tempfile
+
+    import bigdl_tpu.native as native_mod
+    from bigdl_tpu.dataset.prefetch import prefetch
+    from bigdl_tpu.dataset.records import (RecordFileDataSet,
+                                           write_record_shards)
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+    from bigdl_tpu.parallel.engine import Engine
+    from bigdl_tpu.transform.vision import (ChannelNormalize, HFlip,
+                                            ImageFeature, RandomCrop)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = Engine.default_mesh()
+    sharding = (NamedSharding(mesh, P("data"))
+                if "data" in mesh.axis_names else None)
+    n_batches = n_records // batch_size
+    n_used = n_batches * batch_size
+    results = []
+    rng0 = np.random.RandomState(0)
+    with tempfile.TemporaryDirectory() as d:
+
+        def gen():
+            for i in range(n_records):
+                img = rng0.randint(0, 255, (image, image, 3), np.uint8)
+                yield Sample(img, np.array([1.0 + (i % 1000)], np.float32))
+
+        write_record_shards(gen(), d, num_shards=shards)
+
+        aug = (RandomCrop(crop, crop) >> HFlip()
+               >> ChannelNormalize([123.68, 116.779, 103.939],
+                                   [58.393, 57.12, 57.375]))
+
+        def sample_stream():
+            ds = RecordFileDataSet(d, num_shards=1, shard_id=0)
+            src = ds.data(train=True)  # infinite shuffled walk
+            feats = (ImageFeature(next(src).feature(), label=None)
+                     for _ in range(n_used))
+            for f in aug(feats):
+                yield Sample(f.image(), np.float32(1.0))
+
+        def to_device(mb):
+            x = np.asarray(mb.get_input())
+            if sharding is not None and x.shape[0] % mesh.shape["data"] == 0:
+                return jax.device_put(x, sharding)
+            return jnp.asarray(x)
+
+        for use_native in native_modes:
+            if use_native and not native_mod.native_available():
+                log("[pipeline] native reader unavailable; skipping")
+                continue
+            orig_get_lib = native_mod.get_lib
+            if not use_native:
+                native_mod.get_lib = lambda: None
+            try:
+                for depth in depths:
+                    batches = SampleToMiniBatch(batch_size)(sample_stream())
+                    it = (prefetch(batches, buffer_size=depth,
+                                   transfer=to_device) if depth > 0
+                          else (to_device(b) for b in batches))
+                    t0 = time.perf_counter()
+                    seen = 0
+                    for x in it:
+                        x.block_until_ready()
+                        seen += x.shape[0]
+                    elapsed = time.perf_counter() - t0
+                    row = {"mode": "input_pipeline",
+                           "native_reader": bool(use_native),
+                           "prefetch_depth": depth,
+                           "batch_size": batch_size,
+                           "records": seen,
+                           "image": image, "crop": crop,
+                           "records_per_sec": round(seen / elapsed, 1),
+                           "time_s": round(elapsed, 3)}
+                    results.append(row)
+                    log(f"[pipeline] native={use_native} depth={depth}: "
+                        f"{row['records_per_sec']:.0f} records/s")
+            finally:
+                native_mod.get_lib = orig_get_lib
+    return results
+
+
 def main(argv=None):
     import argparse
 
@@ -298,8 +395,29 @@ def main(argv=None):
     p.add_argument("--decode", action="store_true",
                    help="measure KV-cache decode tokens/sec instead of "
                         "training throughput (transformer only)")
+    p.add_argument("--input-pipeline", action="store_true",
+                   help="measure host feed records/sec (records -> "
+                        "augments -> minibatch -> sharded H2D), no model")
+    p.add_argument("--records", type=int, default=512,
+                   help="--input-pipeline: records per config")
     args = p.parse_args(argv)
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    if args.input_pipeline:
+        import json
+
+        rows = run_input_pipeline_perf(batch_size=args.batch_size,
+                                       n_records=args.records)
+        hist = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))),
+            "bench_history.jsonl")
+        try:
+            with open(hist, "a") as f:
+                for r in rows:
+                    f.write(json.dumps(dict(r, ts=time.time())) + "\n")
+        except OSError:
+            pass
+        print(json.dumps(rows))
+        return
     if args.decode:
         if args.model not in ("resnet50", "transformer", "transformer_lm"):
             p.error("--decode measures the transformer LM; --model does "

@@ -16,6 +16,21 @@ def test_run_perf_lenet_smoke():
     assert np.isfinite(s["loss"])
 
 
+def test_input_pipeline_perf_smoke():
+    """records -> augments -> minibatch -> H2D feed bench runs both
+    reader modes and reports sane records/sec (VERDICT r4 #4)."""
+    from bigdl_tpu.models.perf import run_input_pipeline_perf
+
+    rows = run_input_pipeline_perf(batch_size=8, n_records=32, image=64,
+                                   crop=56, depths=(0, 2),
+                                   log=lambda *a, **k: None)
+    assert len(rows) >= 2  # python fallback always runs; native if built
+    for r in rows:
+        assert r["records"] == 32
+        assert r["records_per_sec"] > 0
+    assert any(not r["native_reader"] for r in rows)
+
+
 def test_transformer_perf_tiny():
     s = _transformer_perf(batch_size=2, iterations=2, warmup=1,
                           dtype=jnp.float32, log=lambda *a, **k: None,
