@@ -1,0 +1,105 @@
+"""Generate docs/api/*.md API-reference stubs from docstrings.
+
+≙ the reference's APIGuide tree (ref: docs/docs/APIGuide/), but generated
+from the code so it cannot drift: one page per public subpackage, one
+entry per public class/function with its signature and the first
+paragraph of its docstring.
+
+Run: PYTHONPATH=. JAX_PLATFORMS=cpu python docs/gen_api.py
+(tests/test_docs.py asserts the committed pages are complete.)
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import sys
+
+PACKAGES = [
+    ("bigdl_tpu", "Top-level exports"),
+    ("bigdl_tpu.nn", "Layers, criterions, containers, Graph"),
+    ("bigdl_tpu.keras", "Keras-style API"),
+    ("bigdl_tpu.optim", "Optimizers, schedules, triggers, validation"),
+    ("bigdl_tpu.parallel", "Mesh runtime + distributed training"),
+    ("bigdl_tpu.dataset", "Data pipeline"),
+    ("bigdl_tpu.transform.vision", "Vision transforms"),
+    ("bigdl_tpu.dlframes", "DataFrame estimator layer"),
+    ("bigdl_tpu.models", "Model zoo"),
+    ("bigdl_tpu.visualization", "TrainSummary / ValidationSummary"),
+    ("bigdl_tpu.utils", "Serialization, import/export, config"),
+]
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def public_members(mod):
+    names = getattr(mod, "__all__", None)
+    if names is None:
+        names = [n for n in vars(mod) if not n.startswith("_")]
+    out = []
+    for n in sorted(set(names)):
+        obj = getattr(mod, n, None)
+        if obj is None or inspect.ismodule(obj):
+            continue
+        mod_name = getattr(obj, "__module__", "") or ""
+        if not mod_name.startswith("bigdl_tpu"):
+            continue
+        if inspect.isclass(obj) or inspect.isfunction(obj):
+            out.append((n, obj))
+    return out
+
+
+def first_paragraph(doc):
+    if not doc:
+        return "(undocumented)"
+    paras = inspect.cleandoc(doc).split("\n\n")
+    return paras[0].replace("\n", " ")
+
+
+def signature_of(obj):
+    try:
+        if inspect.isclass(obj):
+            return f"{obj.__name__}{inspect.signature(obj.__init__)}" \
+                .replace("(self, ", "(").replace("(self)", "()")
+        return f"{obj.__name__}{inspect.signature(obj)}"
+    except (ValueError, TypeError):
+        return obj.__name__
+
+
+def render(pkg_name, title):
+    import importlib
+
+    mod = importlib.import_module(pkg_name)
+    lines = [f"# `{pkg_name}` — {title}", ""]
+    members = public_members(mod)
+    if not members:
+        lines.append("_(no public members)_")
+    for name, obj in members:
+        kind = "class" if inspect.isclass(obj) else "function"
+        lines.append(f"## `{name}` ({kind})")
+        lines.append("")
+        lines.append(f"```python\n{signature_of(obj)}\n```")
+        lines.append("")
+        lines.append(first_paragraph(inspect.getdoc(obj)))
+        lines.append("")
+    return "\n".join(lines) + "\n"
+
+
+def main():
+    out_dir = os.path.join(HERE, "api")
+    os.makedirs(out_dir, exist_ok=True)
+    index = ["# API reference", "",
+             "Generated from docstrings by `docs/gen_api.py` — regenerate "
+             "after changing public APIs.", ""]
+    for pkg, title in PACKAGES:
+        fname = pkg.replace(".", "_") + ".md"
+        with open(os.path.join(out_dir, fname), "w") as f:
+            f.write(render(pkg, title))
+        index.append(f"- [`{pkg}`]({fname}) — {title}")
+        print(f"wrote api/{fname}")
+    with open(os.path.join(out_dir, "index.md"), "w") as f:
+        f.write("\n".join(index) + "\n")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
