@@ -71,6 +71,13 @@ def main(argv=None):
                               "--decode", "--batch-size", "8",
                               "--dtype", "bfloat16"], 600)
 
+    # host-side feed capacity on the REAL TPU host (cores >> this box);
+    # compare records/sec against the bench's measured imgs/sec
+    results["input_pipeline"] = run_stage(
+        "input-pipeline", [sys.executable, "-m", "bigdl_tpu.models.perf",
+                           "--input-pipeline", "--batch-size", "64",
+                           "--records", "1024"], 600)
+
     if args.profile:
         results["profile"] = run_stage(
             "profile", [sys.executable, "-m", "bigdl_tpu.models.perf",
