@@ -407,9 +407,9 @@ def main(argv=None):
 
         rows = run_input_pipeline_perf(batch_size=args.batch_size,
                                        n_records=args.records)
-        hist = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__)))),
-            "bench_history.jsonl")
+        # cwd-relative like the other bench writers (tpu_session runs
+        # with cwd=repo root; a wheel install must not litter the venv)
+        hist = os.path.join(os.getcwd(), "bench_history.jsonl")
         try:
             with open(hist, "a") as f:
                 for r in rows:

@@ -261,12 +261,12 @@ def load_latest_checkpoint(path: str):
         names = bt_file.listdir(path)
     except (FileNotFoundError, NotADirectoryError, OSError):
         return None, None, None
+    name_set = set(names)  # one listing answers all pairing checks
     tags = []
     for fname in names:
         if fname.startswith("model."):
             suffix = fname[len("model."):]
-            if suffix.isdigit() and bt_file.exists(
-                    os.path.join(path, f"optimMethod.{suffix}")):
+            if suffix.isdigit() and f"optimMethod.{suffix}" in name_set:
                 tags.append(int(suffix))
     if not tags:
         return None, None, None

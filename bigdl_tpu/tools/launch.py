@@ -68,17 +68,35 @@ def _run_user_main(script: str, script_args, as_module: bool) -> None:
 
 # Child bootstrap for the local grid, run via `python -c` so NOTHING
 # (not even this package, whose import touches jax) loads before
-# jax.distributed.initialize — the ordering jax requires.
-_BOOTSTRAP = (
-    "import os, runpy, sys, jax; "
-    f"jax.distributed.initialize(os.environ['{_ENV_COORD}'], "
-    f"num_processes=int(os.environ['{_ENV_NPROCS}']), "
-    f"process_id=int(os.environ['{_ENV_PID}'])); "
-    "tgt = sys.argv[1]; as_mod = sys.argv[2] == '1'; "
-    "sys.argv = [tgt] + sys.argv[3:]; "
-    "runpy.run_module(tgt, run_name='__main__', alter_sys=True) if as_mod "
-    "else runpy.run_path(tgt, run_name='__main__')"
-)
+# jax.distributed.initialize — the ordering jax requires. A FAILING rank
+# must os._exit: the normal exit path runs jax's atexit distributed
+# shutdown, which is a BARRIER over all ranks — a crashed rank would
+# block there forever waiting for peers that are stuck waiting for it.
+# Successful ranks exit normally (all reach the barrier; it completes).
+_BOOTSTRAP = f"""
+import os, runpy, sys, traceback
+import jax
+jax.distributed.initialize(os.environ['{_ENV_COORD}'],
+                           num_processes=int(os.environ['{_ENV_NPROCS}']),
+                           process_id=int(os.environ['{_ENV_PID}']))
+tgt = sys.argv[1]
+as_mod = sys.argv[2] == '1'
+sys.argv = [tgt] + sys.argv[3:]
+try:
+    if as_mod:
+        runpy.run_module(tgt, run_name='__main__', alter_sys=True)
+    else:
+        runpy.run_path(tgt, run_name='__main__')
+except SystemExit as e:
+    code = e.code if isinstance(e.code, int) else (0 if e.code is None else 1)
+    if code:
+        sys.stderr.flush(); sys.stdout.flush()
+        os._exit(code)
+except BaseException:
+    traceback.print_exc()
+    sys.stderr.flush(); sys.stdout.flush()
+    os._exit(1)
+"""
 
 
 def _spawn_local_grid(args) -> int:
@@ -106,14 +124,25 @@ def _spawn_local_grid(args) -> int:
         procs.append(subprocess.Popen(
             [sys.executable, "-c", _BOOTSTRAP, args.script,
              "1" if args.module else "0", *args.script_args], env=env))
+    # poll rather than wait sequentially: a crashed rank strands its
+    # peers inside collectives, so the FIRST failure must kill survivors
+    # or the launcher would hang on them forever
+    import time as _time
+
     rc = 0
-    for p in procs:
-        p.wait()
-        rc = rc or p.returncode
-    if rc:
-        for p in procs:  # a failed rank strands the others on collectives
-            if p.poll() is None:
-                p.kill()
+    live = list(procs)
+    while live:
+        for p in list(live):
+            code = p.poll()
+            if code is None:
+                continue
+            live.remove(p)
+            if code and not rc:
+                rc = code
+                for q in live:
+                    q.kill()
+        if live:
+            _time.sleep(0.2)
     return rc
 
 

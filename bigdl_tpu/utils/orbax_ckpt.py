@@ -39,30 +39,20 @@ def _checkpointer():
     return _CKPTR
 
 
+# remote-path dispatch rides the single IO seam in utils/file.py;
+# only _remove/_rename (swap-protocol specifics) live here
+from bigdl_tpu.utils.file import (exists as _exists, is_remote as _is_remote,
+                                  open_file as _open_meta)
+
+
 def _norm(path: str) -> str:
     # URL-style paths (gs://, s3://) must pass through untouched
-    return path if "://" in path else os.path.abspath(path)
-
-
-def _open_meta(path: str, mode: str):
-    if "://" in path:
-        from etils import epath  # ships with orbax; object-store capable
-
-        return epath.Path(path).open(mode)
-    return open(path, mode)
-
-
-def _exists(path: str) -> bool:
-    if "://" in path:
-        from etils import epath
-
-        return epath.Path(path).exists()
-    return os.path.exists(path)
+    return path if _is_remote(path) else os.path.abspath(path)
 
 
 def _remove(path: str) -> None:
     """Remove a file or directory tree if present (no-op otherwise)."""
-    if "://" in path:
+    if _is_remote(path):
         from etils import epath
 
         p = epath.Path(path)
@@ -127,7 +117,7 @@ def save_train_state(path: str, step: int, params, buffers, slots,
             if isinstance(v, (bool, int, float, str))}
     path = _norm(path)
 
-    if "://" in path:
+    if _is_remote(path):
         meta = path + ".meta.json"
         if jax.process_index() == 0:
             _remove(meta)

@@ -150,6 +150,26 @@ def test_launcher_runs_lenet_on_local_grid(tmp_path):
     assert "LAUNCH OK 0 2 8" in out and "LAUNCH OK 1 2 8" in out, out
 
 
+def test_launcher_failure_kills_stranded_ranks(tmp_path):
+    """A crashed rank must fail the whole launch promptly: survivors
+    (stuck sleeping/in collectives waiting for the dead peer) are killed
+    and the first failing exit code propagates — not a hang."""
+    script = tmp_path / "fail_rank.py"
+    script.write_text(
+        "import sys, time, jax\n"
+        "if jax.process_index() == 1:\n"
+        "    sys.exit(3)\n"
+        "time.sleep(600)   # rank 0 'stranded' waiting on its dead peer\n")
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, PYTHONPATH=os.path.dirname(here), XLA_FLAGS="",
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.tools.launch", "--procs", "2",
+         str(script)],
+        capture_output=True, timeout=180, env=env)
+    assert proc.returncode == 3, (proc.returncode, proc.stderr.decode()[-500:])
+
+
 def test_orbax_checkpoint_across_two_processes(tmp_path):
     """Shard-wise orbax save/restore with REAL jax.distributed: each
     process writes its own shards, process 0 alone writes the sidecar
