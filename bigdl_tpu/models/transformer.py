@@ -155,17 +155,45 @@ class TransformerLM(Module):
                                                            dtype)
                 for i in range(self.num_layers)]
 
-    def prefill(self, ids, caches):
+    def prefill(self, ids, caches, pos0: int = 0):
         """Batched prompt prefill: one causal pass over ids (B, T0) that
         populates every block's KV cache and returns the LAST position's
-        logits — O(T0²) once vs T0 masked full-cache steps."""
+        logits — O(T0²) once vs T0 masked full-cache steps.
+
+        ``pos0`` (static int) makes it a CONTINUATION prefill: the chunk
+        attends over the cached ``[0, pos0)`` prefix too — the building
+        block for chunked long-prompt prefill (bounded O(chunk·T) score
+        memory) and multi-turn serving (feed each turn as a chunk)."""
         b, t = ids.shape
         x = jnp.take(self.tok_embed, ids, axis=0)
         if not self.use_rope:
-            x = x + self.pos_embed[:t][None]
+            x = x + self.pos_embed[pos0:pos0 + t][None]
         new_caches = []
         for i in range(self.num_layers):
-            x, c = getattr(self, f"block{i}").forward_prefill(x, caches[i], 0)
+            x, c = getattr(self, f"block{i}").forward_prefill(x, caches[i],
+                                                              pos0)
+            new_caches.append(c)
+        x = self.ln_f(x[:, -1:])
+        if self.tie_embeddings:
+            logits = jnp.einsum("btc,vc->btv", x, self.tok_embed)
+        else:
+            logits = self.head(x.reshape(b, -1))[:, None, :]
+        return logits[:, 0], new_caches
+
+    def prefill_chunk(self, ids, caches, pos0):
+        """One fixed-length chunk of a chunked prefill (TRACED ``pos0`` —
+        one compilation serves every offset). Returns the chunk's last
+        position's logits + updated caches; see
+        MultiHeadAttention.forward_chunk."""
+        b, t = ids.shape
+        x = jnp.take(self.tok_embed, ids, axis=0)
+        if not self.use_rope:
+            x = x + jax.lax.dynamic_slice_in_dim(self.pos_embed, pos0, t,
+                                                 0)[None]
+        new_caches = []
+        for i in range(self.num_layers):
+            x, c = getattr(self, f"block{i}").forward_chunk(x, caches[i],
+                                                            pos0)
             new_caches.append(c)
         x = self.ln_f(x[:, -1:])
         if self.tie_embeddings:
@@ -231,21 +259,33 @@ class TransformerLM(Module):
             with bind(self, p, bufs, False, None):
                 return self.decode_step(ids_t, pos, caches)
 
-        def prefill_fn(p, bufs, ids, caches):
+        def prefill_fn(p, bufs, ids, caches, pos0=0):
             with bind(self, p, bufs, False, None):
-                return self.prefill(ids, caches)
+                return self.prefill(ids, caches, pos0)
+
+        def chunk_fn(p, bufs, ids, caches, pos0):
+            with bind(self, p, bufs, False, None):
+                return self.prefill_chunk(ids, caches, pos0)
 
         fns = (jax.jit(step, donate_argnums=(4,)),
-               jax.jit(prefill_fn, donate_argnums=(3,)))
+               jax.jit(prefill_fn, donate_argnums=(3,),
+                       static_argnums=(4,)),
+               jax.jit(chunk_fn, donate_argnums=(3,)))
         _DECODE_JIT[self] = fns
         return fns
 
-    def _decode_setup(self, prompt_ids, max_new_tokens, max_len):
+    def _decode_setup(self, prompt_ids, max_new_tokens, max_len,
+                      prefill_chunk=None):
         """Shared decoding preamble for generate/beam_search: coerce +
         validate the prompt, fetch the cached jitted fns, run the batched
         prefill. Returns (prompt_ids, b, t0, params, buffers, step_jit,
         last_logits, caches); logits/caches are None when no new tokens
-        are requested (prefill skipped)."""
+        are requested (prefill skipped).
+
+        ``prefill_chunk`` bounds the prefill's score memory: the prompt
+        feeds in fixed-length chunks through the traced-offset chunk fn
+        (one compile per chunk length; a leading remainder chunk goes
+        through the one-shot prefill — at most two compilations)."""
         prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
         if prompt_ids.ndim == 1:
             prompt_ids = prompt_ids[None]
@@ -263,26 +303,42 @@ class TransformerLM(Module):
             raise ValueError(f"max_len {max_len} exceeds the model's "
                              f"context length {self.max_len}")
         params, buffers = self.params_dict(), self.buffers_dict()
-        step_jit, prefill_jit = self._decode_fns()
+        step_jit, prefill_jit, chunk_jit = self._decode_fns()
         if max_new_tokens == 0:
             return prompt_ids, b, t0, params, buffers, step_jit, None, None
         # cache dtype follows the params (bf16 serving -> bf16 kv cache)
         caches = self.init_cache(b, max_len, dtype=self.tok_embed.dtype)
-        logits, caches = prefill_jit(params, buffers, prompt_ids, caches)
+        if prefill_chunk and t0 > prefill_chunk:
+            rem = t0 % prefill_chunk
+            pos = 0
+            if rem:  # leading remainder: one-shot prefill at offset 0
+                logits, caches = prefill_jit(params, buffers,
+                                             prompt_ids[:, :rem], caches)
+                pos = rem
+            while pos < t0:
+                logits, caches = chunk_jit(
+                    params, buffers,
+                    prompt_ids[:, pos:pos + prefill_chunk],
+                    caches, jnp.int32(pos))
+                pos += prefill_chunk
+        else:
+            logits, caches = prefill_jit(params, buffers, prompt_ids, caches)
         return prompt_ids, b, t0, params, buffers, step_jit, logits, caches
 
     def generate(self, prompt_ids, max_new_tokens: int,
-                 temperature: float = 0.0, rng=None, max_len=None):
+                 temperature: float = 0.0, rng=None, max_len=None,
+                 prefill_chunk=None):
         """Autoregressive generation with a KV cache (the transformer
         analog of the reference's RecurrentDecoder, nn/RecurrentDecoder
         .scala): prefill the prompt one jitted step at a time, then sample
         greedily (``temperature == 0``) or from the tempered softmax.
-        Returns (B, len(prompt) + max_new_tokens) ids."""
+        Returns (B, len(prompt) + max_new_tokens) ids. ``prefill_chunk``
+        bounds long-prompt prefill memory (see _decode_setup)."""
         from bigdl_tpu.utils import random as bt_random
 
         (prompt_ids, b, t0, params, buffers, step_jit,
          logits, caches) = self._decode_setup(prompt_ids, max_new_tokens,
-                                              max_len)
+                                              max_len, prefill_chunk)
         if max_new_tokens == 0:
             return prompt_ids
         ids = [prompt_ids[:, i] for i in range(t0)]

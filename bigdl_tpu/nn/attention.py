@@ -241,6 +241,40 @@ class MultiHeadAttention(Module):
         o = self.out_proj(o.reshape(b * t, self.embed_dim)).reshape(b, t, -1)
         return o, (k_cache, v_cache)
 
+    def forward_chunk(self, x, cache, pos0):
+        """Chunked continuation prefill with a TRACED ``pos0``: a fixed
+        chunk length compiles ONCE and serves every offset (unlike
+        forward_prefill, whose static pos0 is a shape and recompiles per
+        offset). The chunk's queries attend over the FULL cache under a
+        position mask — O(T_chunk · max_len) scores, the standard
+        chunked-prefill form; GQA runs grouped against the un-expanded
+        cache like forward_step."""
+        b, t, _ = x.shape
+        qkv = self.qkv(x.reshape(b * t, self.embed_dim)).reshape(b, t, -1)
+        q, k, v = self._split_kv_step(qkv)
+        if self.rotary:
+            positions = pos0 + jnp.arange(t)
+            q, k = self._rope(q, positions), self._rope(k, positions)
+        k_cache, v_cache = cache
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, 0, pos0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, 0, pos0, 0))
+        h_kv = self.num_kv_heads
+        rep = self.num_heads // h_kv
+        qg = q.reshape(b, h_kv, rep, t, self.head_dim)
+        scale = 1.0 / math.sqrt(self.head_dim)
+        s = jnp.einsum("bgrtd,bgTd->bgrtT", qg, k_cache,
+                       preferred_element_type=jnp.float32) * scale
+        ln = k_cache.shape[2]
+        live = jnp.arange(ln)[None, :] <= (pos0 + jnp.arange(t))[:, None]
+        s = jnp.where(live[None, None, None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
+        o = jnp.einsum("bgrtT,bgTd->bgrtd", p, v_cache)
+        o = o.transpose(0, 3, 1, 2, 4).reshape(b, t, self.embed_dim)
+        o = self.out_proj(o.reshape(b * t, self.embed_dim).astype(x.dtype))
+        return o.reshape(b, t, -1), (k_cache, v_cache)
+
     def _rope(self, x, positions):
         return rotary_embedding(x, positions, self.rotary_base) \
             if self.rotary else x
@@ -352,6 +386,12 @@ class TransformerBlock(Module):
         """Batched prompt pass writing the attention cache (see
         MultiHeadAttention.forward_prefill)."""
         h, cache = self.attn.forward_prefill(self.ln1(x), cache, pos0)
+        return self._mlp_residual(x + h), cache
+
+    def forward_chunk(self, x, cache, pos0):
+        """Traced-offset chunk pass (see
+        MultiHeadAttention.forward_chunk)."""
+        h, cache = self.attn.forward_chunk(self.ln1(x), cache, pos0)
         return self._mlp_residual(x + h), cache
 
     def _mlp_residual(self, x):

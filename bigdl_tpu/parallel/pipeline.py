@@ -37,7 +37,7 @@ def stack_stage_params(stage_params: List):
 
 
 def pipeline_spmd(stage_fn: Callable, my_params, x, axis_name: str,
-                  n_microbatches: int):
+                  n_microbatches: int, remat: bool = False):
     """Run the pipelined forward inside shard_map.
 
     ``stage_fn(params, x_micro) -> y_micro`` is one stage; ``my_params`` is
@@ -45,6 +45,13 @@ def pipeline_spmd(stage_fn: Callable, my_params, x, axis_name: str,
     not — a leading dim of 1 is squeezed here); ``x`` is the full
     (replicated) batch (B, ...); returns the full (B, ...) output, valid on
     every device (masked psum broadcast from the last stage).
+
+    ``remat=True`` wraps each tick's stage computation in
+    ``jax.checkpoint``: the pipelined backward then stores only the
+    per-tick carries and recomputes stage internals — the activation-
+    memory profile 1F1B schedules exist for (peak stage-activation
+    memory O(1) per live microbatch instead of every intermediate of
+    every tick), traded for one extra forward per tick.
     """
     s = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
@@ -60,6 +67,8 @@ def pipeline_spmd(stage_fn: Callable, my_params, x, axis_name: str,
     # ignores it (selects the fresh microbatch instead)
     perm = [(i, (i + 1) % s) for i in range(s)]
     ticks = m + s - 1
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
 
     def tick(carry, t):
         buf, ys = carry

@@ -222,6 +222,26 @@ def test_continuation_prefill_attends_cached_prefix():
         m.forward_prefill(x[:, 5:], cache, jnp.int32(5))
 
 
+def test_chunked_prefill_generate_matches_one_shot():
+    """generate(prefill_chunk=k) must produce the SAME tokens as the
+    one-shot prefill: the traced-offset chunk path (one compile per
+    chunk length) and the remainder-first split are exact."""
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(4)
+    m = TransformerLM(32, embed_dim=16, num_heads=4, num_kv_heads=2,
+                      num_layers=2, max_len=24, use_rope=True)
+    m.evaluate()
+    prompt = jnp.asarray(np.random.RandomState(4).randint(0, 32, (2, 11)))
+    want = m.generate(prompt, 5)
+    got = m.generate(prompt, 5, prefill_chunk=4)   # 3-token remainder + 2x4
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    got_even = m.generate(prompt[:, :8], 5, prefill_chunk=4)  # no remainder
+    want_even = m.generate(prompt[:, :8], 5)
+    np.testing.assert_array_equal(np.asarray(got_even), np.asarray(want_even))
+
+
 def test_generate_greedy_extends_prompt():
     from bigdl_tpu.models.transformer import TransformerLM
     from bigdl_tpu.utils import random as rnd
