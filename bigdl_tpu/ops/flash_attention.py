@@ -239,9 +239,34 @@ def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, group, res,
 flash_with_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
+_INTERPRET_OVERRIDE = []
+
+
+def force_interpret(value: bool):
+    """Context manager overriding the host-platform interpret default for
+    every flash call site traced inside it. Cross-lowering (jax.export
+    for TPU from a CPU host) uses ``force_interpret(False)`` so full
+    model programs trace the compiled Mosaic kernel, not the CPU
+    interpreter."""
+    from contextlib import contextmanager
+
+    @contextmanager
+    def _ctx():
+        _INTERPRET_OVERRIDE.append(bool(value))
+        try:
+            yield
+        finally:
+            _INTERPRET_OVERRIDE.pop()
+
+    return _ctx()
+
+
 def default_interpret() -> bool:
     """Kernel interpret-mode default: interpret on CPU, compiled on TPU —
-    the single source of truth for every flash call site."""
+    the single source of truth for every flash call site (subject to
+    ``force_interpret``)."""
+    if _INTERPRET_OVERRIDE:
+        return _INTERPRET_OVERRIDE[-1]
     return jax.devices()[0].platform == "cpu"
 
 

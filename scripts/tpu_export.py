@@ -47,6 +47,13 @@ def main():
          lambda: ep.ring_flash_program(n_devices=8, t_per_shard=512)),
         ("combined_3d_8dev",
          lambda: ep.combined_3d_program(n_devices=8)),
+        ("combined_3d_flash_8dev",
+         lambda: ep.combined_3d_flash_program(n_devices=8,
+                                              t_per_shard=512)),
+        ("decode_step_b8_l8_t2048",
+         lambda: ep.decode_step_program()),
+        ("chunked_prefill_c256_t2048",
+         lambda: ep.chunked_prefill_program()),
         ("resnet50_sharded_step_b256",
          lambda: ep.distri_sharded_step_program(
              "resnet50", n_devices=8, global_batch=256, format="NHWC")),
