@@ -79,49 +79,40 @@ _MERGE_CLASSES = {"Add", "Subtract", "Multiply", "Average", "Maximum",
                   "Minimum", "Concatenate"}
 
 
-def _parse_inbound(lspec: dict) -> List[str]:
-    """Source layer names of a layer's call node, across formats:
-    Keras-1/2 ``[[["src", 0, 0, {}], ...]]`` and Keras-3's kwargs dicts
-    carrying ``keras_history`` triples. Shared layers (multiple call
-    nodes, or references to a call node other than the first) are
-    rejected — mapping every consumer to the first call would silently
-    compute the wrong graph."""
+def _parse_inbound_nodes(lspec: dict) -> List[List[tuple]]:
+    """Per CALL NODE ``(source layer name, source call-node index)``
+    pairs, across formats: Keras-1/2 ``[[["src", 0, 0, {}], ...], ...]``
+    and Keras-3's kwargs dicts carrying ``keras_history`` triples.
+    SHARED layers are supported: a layer called k times yields k entries
+    here, and the functional importer wires each call node as its own
+    graph Node over the one weight-owning module."""
     inbound = lspec.get("inbound_nodes") or []
-    if not inbound:
-        return []
-    name = lspec.get("name") or lspec.get("config", {}).get("name")
-    if len(inbound) > 1:
-        raise ValueError(
-            f"layer {name!r} is called {len(inbound)} times (shared "
-            "layer); functional import supports single-call layers only")
-    first = inbound[0]
-    srcs: List[str] = []
+    out: List[List[tuple]] = []
+    for node_spec in inbound:
+        srcs: List[tuple] = []
 
-    def add(src, node_index):
-        if node_index:
-            raise ValueError(
-                f"layer {name!r} consumes call node {node_index} of "
-                f"{src!r} (shared layer); only node 0 is supported")
-        srcs.append(src)
+        def add(src, node_index):
+            srcs.append((src, int(node_index or 0)))
 
-    if isinstance(first, dict):  # keras 3
-        def walk(obj):
-            if isinstance(obj, dict):
-                if obj.get("class_name") == "__keras_tensor__":
-                    hist = obj["config"]["keras_history"]
-                    add(hist[0], hist[1])
-                    return
-                for v in obj.values():
-                    walk(v)
-            elif isinstance(obj, (list, tuple)):
-                for v in obj:
-                    walk(v)
+        if isinstance(node_spec, dict):  # keras 3
+            def walk(obj):
+                if isinstance(obj, dict):
+                    if obj.get("class_name") == "__keras_tensor__":
+                        hist = obj["config"]["keras_history"]
+                        add(hist[0], hist[1])
+                        return
+                    for v in obj.values():
+                        walk(v)
+                elif isinstance(obj, (list, tuple)):
+                    for v in obj:
+                        walk(v)
 
-        walk(first)
-    else:
-        for entry in first:
-            add(entry[0], entry[1] if len(entry) > 1 else 0)
-    return srcs
+            walk(node_spec)
+        else:
+            for entry in node_spec:
+                add(entry[0], entry[1] if len(entry) > 1 else 0)
+        out.append(srcs)
+    return out
 
 
 def _convert_merge(cls: str, c: dict, in_shapes):
@@ -206,17 +197,23 @@ class DefinitionLoader:
 
         cfg = spec["config"]
         pending = list(cfg["layers"])
-        nodes: Dict[str, object] = {}
-        shapes: Dict[str, tuple] = {}
+        nodes: Dict[tuple, object] = {}    # (layer name, call-node idx)
+        shapes: Dict[tuple, tuple] = {}
         klayers: Dict[str, object] = {}
+        next_call: Dict[str, int] = {}     # per-layer wiring progress
 
-        def endpoint_names(entries):
+        def endpoint_keys(entries):
             # single endpoint may arrive FLAT: ['name', 0, 0] (keras 3)
             if (isinstance(entries, (list, tuple)) and entries
                     and isinstance(entries[0], str)):
-                return [entries[0]]
-            return [e[0] if isinstance(e, (list, tuple)) else e
-                    for e in entries]
+                entries = [entries]
+            keys = []
+            for e in entries:
+                if isinstance(e, (list, tuple)):
+                    keys.append((e[0], int(e[1]) if len(e) > 1 else 0))
+                else:
+                    keys.append((e, 0))
+            return keys
 
         while pending:
             progressed = False
@@ -230,34 +227,54 @@ class DefinitionLoader:
                         raise ValueError(
                             f"InputLayer {name!r} needs a concrete shape "
                             "(variable dims in the json: pass input_shape=)")
-                    nodes[name], shapes[name] = bnn.Input(), shp
+                    nodes[(name, 0)], shapes[(name, 0)] = bnn.Input(), shp
                     pending.remove(lspec)
                     progressed = True
                     continue
-                srcs = _parse_inbound(lspec)
-                if not srcs or any(s not in nodes for s in srcs):
+                call_nodes = _parse_inbound_nodes(lspec)
+                if not call_nodes:
                     continue
-                in_nodes = [nodes[s] for s in srcs]
-                in_shapes = [shapes[s] for s in srcs]
                 cls = lspec["class_name"]
-                if cls in _MERGE_CLASSES:
-                    mod, out = _convert_merge(cls, lspec["config"], in_shapes)
-                    node = mod.inputs(*in_nodes)
-                else:
-                    kl = DefinitionLoader._convert_layer(lspec)
-                    out = kl.build(in_shapes[0])
-                    node = kl.inputs(in_nodes[0])
-                    klayers[name] = kl
-                nodes[name], shapes[name] = node, out
-                pending.remove(lspec)
-                progressed = True
+                # wire call nodes INCREMENTALLY: chained self-sharing
+                # (y = f(x); z = f(y)) makes node 1's source this layer's
+                # own node 0, so all-at-once readiness would deadlock
+                j = next_call.get(name, 0)
+                while (j < len(call_nodes)
+                       and all(k in nodes for k in call_nodes[j])):
+                    in_nodes = [nodes[k] for k in call_nodes[j]]
+                    in_shapes = [shapes[k] for k in call_nodes[j]]
+                    if cls in _MERGE_CLASSES:
+                        mod, out = _convert_merge(cls, lspec["config"],
+                                                  in_shapes)
+                        node = mod.inputs(*in_nodes)
+                    else:
+                        kl = klayers.get(name)
+                        if kl is None:
+                            kl = DefinitionLoader._convert_layer(lspec)
+                            out = kl.build(in_shapes[0])
+                            klayers[name] = kl
+                        else:
+                            # SHARED layer, call node j > 0: reuse the one
+                            # weight-owning module (Graph registers shared
+                            # modules once); re-infer the out shape only
+                            from bigdl_tpu.keras.engine import \
+                                _infer_output_shape
+                            out = _infer_output_shape(kl.layer, in_shapes[0],
+                                                      kl._infer_dtype)
+                        node = kl.inputs(in_nodes[0])
+                    nodes[(name, j)], shapes[(name, j)] = node, out
+                    j += 1
+                    progressed = True
+                next_call[name] = j
+                if j == len(call_nodes):
+                    pending.remove(lspec)
             if not progressed:
                 raise ValueError(
                     "unresolvable functional graph (cycle or missing "
                     f"sources): {[ls.get('name') for ls in pending]}")
 
-        ins = [nodes[n] for n in endpoint_names(cfg["input_layers"])]
-        outs = [nodes[n] for n in endpoint_names(cfg["output_layers"])]
+        ins = [nodes[k] for k in endpoint_keys(cfg["input_layers"])]
+        outs = [nodes[k] for k in endpoint_keys(cfg["output_layers"])]
         model = bk.Model(ins if len(ins) > 1 else ins[0],
                          outs if len(outs) > 1 else outs[0])
         #: name -> KerasLayer, for name-matched hdf5 weight loading

@@ -257,14 +257,42 @@ def test_functional_lstm_matches_keras(tmp_path):
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
 
-def test_functional_shared_layer_rejected(tmp_path):
-    inp = keras.Input((4,))
-    d = keras.layers.Dense(4, name="shared")
-    out = keras.layers.Add()([d(inp), d(inp)])  # two call nodes
-    km = keras.Model(inp, out)
+def test_functional_shared_layer_matches_keras(tmp_path):
+    """SHARED layers import: two branches through the same weight-owning
+    Dense (siamese shape), predictions match keras' own."""
+    np.random.seed(12)
+    inp_a = keras.Input((4,), name="ia")
+    inp_b = keras.Input((4,), name="ib")
+    d = keras.layers.Dense(6, activation="relu", name="shared")
+    out = keras.layers.Dense(2, name="head")(
+        keras.layers.Concatenate()([d(inp_a), d(inp_b)]))
+    km = keras.Model([inp_a, inp_b], out)
     js, h5 = _save(tmp_path, km, "shared")
-    with pytest.raises(ValueError, match="shared"):
-        load_keras(json_str=js, hdf5_path=h5)
+    xa = np.random.randn(3, 4).astype(np.float32)
+    xb = np.random.randn(3, 4).astype(np.float32)
+    want = km.predict([xa, xb], verbose=0)
+    m = load_keras(json_str=js, hdf5_path=h5)
+    m.evaluate()
+    from bigdl_tpu import T
+
+    got = np.asarray(m.forward(T(jnp.asarray(xa), jnp.asarray(xb))))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_functional_chained_self_share_matches_keras(tmp_path):
+    """z = f(f(x)): call node 1's source is the layer's OWN call node 0 —
+    the incremental wiring must resolve the chain, and both applications
+    share one weight set."""
+    np.random.seed(13)
+    inp = keras.Input((5,))
+    f = keras.layers.Dense(5, activation="tanh", name="f")
+    out = keras.layers.Dense(2, name="head")(f(f(inp)))
+    km = keras.Model(inp, out)
+    js, h5 = _save(tmp_path, km, "selfshare")
+    x = np.random.randn(4, 5).astype(np.float32)
+    want = km.predict(x, verbose=0)
+    m = load_keras(json_str=js, hdf5_path=h5)
+    np.testing.assert_allclose(_forward(m, x), want, rtol=1e-4, atol=1e-5)
 
 
 def test_functional_variable_dim_input_uses_override(tmp_path):
