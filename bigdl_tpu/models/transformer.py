@@ -164,36 +164,28 @@ class TransformerLM(Module):
         attends over the cached ``[0, pos0)`` prefix too — the building
         block for chunked long-prompt prefill (bounded O(chunk·T) score
         memory) and multi-turn serving (feed each turn as a chunk)."""
-        b, t = ids.shape
-        x = jnp.take(self.tok_embed, ids, axis=0)
-        if not self.use_rope:
-            x = x + self.pos_embed[pos0:pos0 + t][None]
-        new_caches = []
-        for i in range(self.num_layers):
-            x, c = getattr(self, f"block{i}").forward_prefill(x, caches[i],
-                                                              pos0)
-            new_caches.append(c)
-        x = self.ln_f(x[:, -1:])
-        if self.tie_embeddings:
-            logits = jnp.einsum("btc,vc->btv", x, self.tok_embed)
-        else:
-            logits = self.head(x.reshape(b, -1))[:, None, :]
-        return logits[:, 0], new_caches
+        return self._prefill_impl(ids, caches, pos0, chunked=False)
 
     def prefill_chunk(self, ids, caches, pos0):
         """One fixed-length chunk of a chunked prefill (TRACED ``pos0`` —
         one compilation serves every offset). Returns the chunk's last
-        position's logits + updated caches; see
-        MultiHeadAttention.forward_chunk."""
+        position's logits + updated caches. Caller contract: ``pos0 +
+        chunk <= cache length`` (see MultiHeadAttention.forward_chunk —
+        a traced offset cannot be bounds-checked at trace time)."""
+        return self._prefill_impl(ids, caches, pos0, chunked=True)
+
+    def _prefill_impl(self, ids, caches, pos0, chunked: bool):
         b, t = ids.shape
         x = jnp.take(self.tok_embed, ids, axis=0)
         if not self.use_rope:
-            x = x + jax.lax.dynamic_slice_in_dim(self.pos_embed, pos0, t,
-                                                 0)[None]
+            pe = (jax.lax.dynamic_slice_in_dim(self.pos_embed, pos0, t, 0)
+                  if chunked else self.pos_embed[pos0:pos0 + t])
+            x = x + pe[None]
         new_caches = []
         for i in range(self.num_layers):
-            x, c = getattr(self, f"block{i}").forward_chunk(x, caches[i],
-                                                            pos0)
+            blk = getattr(self, f"block{i}")
+            x, c = (blk.forward_chunk(x, caches[i], pos0) if chunked
+                    else blk.forward_prefill(x, caches[i], pos0))
             new_caches.append(c)
         x = self.ln_f(x[:, -1:])
         if self.tie_embeddings:

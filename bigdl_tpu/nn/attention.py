@@ -248,7 +248,15 @@ class MultiHeadAttention(Module):
         offset). The chunk's queries attend over the FULL cache under a
         position mask — O(T_chunk · max_len) scores, the standard
         chunked-prefill form; GQA runs grouped against the un-expanded
-        cache like forward_step."""
+        cache like forward_step.
+
+        CALLER CONTRACT: ``pos0 + T_chunk <= cache length`` must hold —
+        pos0 is traced, so it cannot be checked at trace time the way
+        forward_prefill checks its static offset, and an overflowing
+        write would be silently CLAMPED by dynamic_update_slice
+        (corrupting the prefix) while the mask still assumes positions
+        pos0..pos0+T. generate()'s _decode_setup validates this;
+        standalone users (e.g. the exported serving program) must too."""
         b, t, _ = x.shape
         qkv = self.qkv(x.reshape(b * t, self.embed_dim)).reshape(b, t, -1)
         q, k, v = self._split_kv_step(qkv)

@@ -19,6 +19,7 @@ MXU-aligned (128, 128) blocks.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import math
 from typing import Optional
@@ -242,23 +243,18 @@ flash_with_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 _INTERPRET_OVERRIDE = []
 
 
+@contextlib.contextmanager
 def force_interpret(value: bool):
     """Context manager overriding the host-platform interpret default for
     every flash call site traced inside it. Cross-lowering (jax.export
     for TPU from a CPU host) uses ``force_interpret(False)`` so full
     model programs trace the compiled Mosaic kernel, not the CPU
     interpreter."""
-    from contextlib import contextmanager
-
-    @contextmanager
-    def _ctx():
-        _INTERPRET_OVERRIDE.append(bool(value))
-        try:
-            yield
-        finally:
-            _INTERPRET_OVERRIDE.pop()
-
-    return _ctx()
+    _INTERPRET_OVERRIDE.append(bool(value))
+    try:
+        yield
+    finally:
+        _INTERPRET_OVERRIDE.pop()
 
 
 def default_interpret() -> bool:

@@ -146,11 +146,19 @@ def distri_sharded_step_program(model_name: str = "lenet5",
                   _abstract(slots), x, y, lrs, rng)
 
 
-def combined_3d_program(n_devices: int = 8):
+def combined_3d_program(n_devices: int = 8, t_per_shard: int = 8,
+                        embed_dim: int = 16, vocab: int = 32,
+                        use_flash: bool = False,
+                        abstract_args: bool = False):
     """The combined dp x sp x ep train step from the driver dryrun
     (``__graft_entry__._dryrun_combined_3d``): RoPE + GQA + ring
     attention over 'seq' + MoE all_to_all over 'expert' in one shard_map,
-    per-axis-correct gradient reductions."""
+    per-axis-correct gradient reductions.
+
+    ``use_flash=True`` + a 128-tileable ``t_per_shard`` makes the ring
+    run the pallas kernel, so the exported module carries the Mosaic
+    kernel inside the full composed program. ``abstract_args`` returns
+    ShapeDtypeStructs (export) instead of concrete arrays (dryrun)."""
     from bigdl_tpu.models.transformer import TransformerLM
     from bigdl_tpu.nn.module import pure_apply
     from bigdl_tpu.parallel import Engine
@@ -160,12 +168,12 @@ def combined_3d_program(n_devices: int = 8):
     dp = 2 if rest % 2 == 0 and rest > 1 else 1
     sp = rest // dp
     mesh = Engine.create_mesh([("data", dp), ("seq", sp), ("expert", ep)])
-    seq_len = 8 * sp
-    model = TransformerLM(vocab_size=32, embed_dim=16, num_heads=4,
-                          num_kv_heads=2, use_rope=True,
+    seq_len = t_per_shard * sp
+    model = TransformerLM(vocab_size=vocab, embed_dim=embed_dim,
+                          num_heads=4, num_kv_heads=2, use_rope=True,
                           num_layers=1, max_len=seq_len, causal=True,
-                          sequence_parallel="seq", n_experts=2 * ep,
-                          expert_parallel="expert")
+                          sequence_parallel="seq", use_flash=use_flash,
+                          n_experts=2 * ep, expert_parallel="expert")
     apply_fn = pure_apply(model)
     params, buffers = model.params_dict(), model.buffers_dict()
 
@@ -203,25 +211,30 @@ def combined_3d_program(n_devices: int = 8):
                   P(("data", "expert"), "seq")),
         out_specs=(P(), pspec), check_vma=False))
 
-    rng = np.random.RandomState(0)
-    ids = rng.randint(0, 32, (2 * dp * ep, seq_len)).astype(np.int32)
-    targets = np.roll(ids, -1, axis=1).astype(np.int32)
-    params = jax.device_put(
-        params, jax.tree.map(lambda s: NamedSharding(mesh, s), pspec))
     dsh = NamedSharding(mesh, P(("data", "expert"), "seq"))
+    psh = jax.tree.map(lambda s: NamedSharding(mesh, s), pspec)
+    if abstract_args:
+        params = jax.tree.map(
+            lambda a, sh: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                               sharding=sh),
+            params, psh)
+        ids = jax.ShapeDtypeStruct((2 * dp * ep, seq_len), jnp.int32,
+                                   sharding=dsh)
+        return fn, (params, ids, ids)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (2 * dp * ep, seq_len)).astype(np.int32)
+    targets = np.roll(ids, -1, axis=1).astype(np.int32)
+    params = jax.device_put(params, psh)
     ids = jax.device_put(ids, dsh)
     targets = jax.device_put(targets, dsh)
     return fn, (params, ids, targets)
 
 
-def decode_step_program(batch: int = 8, vocab: int = 32000,
-                        embed_dim: int = 512, layers: int = 8, heads: int = 8,
-                        kv_heads: int = 2, max_len: int = 2048,
-                        dtype=jnp.bfloat16):
-    """The serving flagship: one KV-cache decode step (GQA, RoPE, bf16
-    cache) — the program run per generated token."""
+def _serving_model(batch, vocab, embed_dim, layers, heads, kv_heads,
+                   max_len, dtype):
+    """Shared serving-program setup: the LM in eval mode with
+    dtype-cast params, plus abstract (params, buffers, caches)."""
     from bigdl_tpu.models.transformer import TransformerLM
-    from bigdl_tpu.nn.module import bind
 
     model = TransformerLM(vocab, embed_dim=embed_dim, num_heads=heads,
                           num_kv_heads=kv_heads, num_layers=layers,
@@ -231,17 +244,30 @@ def decode_step_program(batch: int = 8, vocab: int = 32000,
         lambda a: (a.astype(dtype)
                    if jnp.issubdtype(a.dtype, jnp.floating) else a),
         model.params_dict())
-    buffers = model.buffers_dict()
+    caches = _abstract(model.init_cache(batch, max_len, dtype=dtype))
+    return (model, _abstract(params), _abstract(model.buffers_dict()),
+            caches)
+
+
+def decode_step_program(batch: int = 8, vocab: int = 32000,
+                        embed_dim: int = 512, layers: int = 8, heads: int = 8,
+                        kv_heads: int = 2, max_len: int = 2048,
+                        dtype=jnp.bfloat16):
+    """The serving flagship: one KV-cache decode step (GQA, RoPE, bf16
+    cache) — the program run per generated token."""
+    from bigdl_tpu.nn.module import bind
+
+    model, params, buffers, caches = _serving_model(
+        batch, vocab, embed_dim, layers, heads, kv_heads, max_len, dtype)
 
     def step(p, bufs, ids_t, pos, caches):
         with bind(model, p, bufs, False, None):
             return model.decode_step(ids_t, pos, caches)
 
-    caches = _abstract(model.init_cache(batch, max_len, dtype=dtype))
     ids_t = jax.ShapeDtypeStruct((batch,), jnp.int32)
     pos = jax.ShapeDtypeStruct((), jnp.int32)
     return (jax.jit(step, donate_argnums=(4,)),
-            (_abstract(params), _abstract(buffers), ids_t, pos, caches))
+            (params, buffers, ids_t, pos, caches))
 
 
 def chunked_prefill_program(batch: int = 8, chunk: int = 256,
@@ -252,28 +278,19 @@ def chunked_prefill_program(batch: int = 8, chunk: int = 256,
     """One traced-offset prefill chunk (generate(prefill_chunk=...)) —
     the long-prompt serving path: fixed chunk length, full-cache masked
     attention, one compilation for every offset."""
-    from bigdl_tpu.models.transformer import TransformerLM
     from bigdl_tpu.nn.module import bind
 
-    model = TransformerLM(vocab, embed_dim=embed_dim, num_heads=heads,
-                          num_kv_heads=kv_heads, num_layers=layers,
-                          max_len=max_len, use_rope=True)
-    model.evaluate()
-    params = jax.tree.map(
-        lambda a: (a.astype(dtype)
-                   if jnp.issubdtype(a.dtype, jnp.floating) else a),
-        model.params_dict())
-    buffers = model.buffers_dict()
+    model, params, buffers, caches = _serving_model(
+        batch, vocab, embed_dim, layers, heads, kv_heads, max_len, dtype)
 
     def chunk_fn(p, bufs, ids, caches, pos0):
         with bind(model, p, bufs, False, None):
             return model.prefill_chunk(ids, caches, pos0)
 
-    caches = _abstract(model.init_cache(batch, max_len, dtype=dtype))
     ids = jax.ShapeDtypeStruct((batch, chunk), jnp.int32)
     pos0 = jax.ShapeDtypeStruct((), jnp.int32)
     return (jax.jit(chunk_fn, donate_argnums=(3,)),
-            (_abstract(params), _abstract(buffers), ids, caches, pos0))
+            (params, buffers, ids, caches, pos0))
 
 
 def combined_3d_flash_program(n_devices: int = 8, t_per_shard: int = 256,
@@ -282,64 +299,12 @@ def combined_3d_flash_program(n_devices: int = 8, t_per_shard: int = 256,
     sequence tiles into the pallas kernel's 128-blocks, so the exported
     module carries the Mosaic kernel INSIDE the full composed program
     (ring + MoE + RoPE + GQA), unlike the tiny-shape dryrun variant whose
-    ring falls back to the dense path."""
-    from bigdl_tpu.models.transformer import TransformerLM
-    from bigdl_tpu.nn.module import pure_apply
-    from bigdl_tpu.parallel import Engine
-
-    ep = 2 if n_devices % 2 == 0 else 1
-    rest = n_devices // ep
-    dp = 2 if rest % 2 == 0 and rest > 1 else 1
-    sp = rest // dp
-    mesh = Engine.create_mesh([("data", dp), ("seq", sp), ("expert", ep)])
-    seq_len = t_per_shard * sp
-    model = TransformerLM(vocab_size=128, embed_dim=embed_dim, num_heads=4,
-                          num_kv_heads=2, use_rope=True,
-                          num_layers=1, max_len=seq_len, causal=True,
-                          sequence_parallel="seq", use_flash=True,
-                          n_experts=2 * ep, expert_parallel="expert")
-    apply_fn = pure_apply(model)
-    params, buffers = model.params_dict(), model.buffers_dict()
-
-    EXPERT_LEAVES = {"w1", "b1", "w2", "b2"}
-
-    def spec_of(path, _leaf):
-        names = {getattr(k, "key", getattr(k, "name", None)) for k in path}
-        if names & {"mlp"} and names & EXPERT_LEAVES:
-            return P("expert")
-        return P()
-
-    pspec = jax.tree_util.tree_map_with_path(spec_of, params)
-
-    def step(p, ids, targets):
-        def loss_fn(p):
-            logits, _ = apply_fn(p, buffers, ids, rng=None, training=True)
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
-            ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
-            return -jnp.mean(ll) + 0.01 * model.l_aux
-
-        loss, grads = jax.value_and_grad(loss_fn)(p)
-        loss = lax.pmean(loss, ("data", "seq", "expert"))
-        grads = jax.tree.map(
-            lambda g, s: lax.pmean(
-                g, ("data", "seq") if s == P("expert")
-                else ("data", "seq", "expert")),
-            grads, pspec)
-        return loss, jax.tree.map(lambda a, g: a - 0.1 * g, p, grads)
-
-    fn = jax.jit(jax.shard_map(
-        step, mesh=mesh,
-        in_specs=(pspec, P(("data", "expert"), "seq"),
-                  P(("data", "expert"), "seq")),
-        out_specs=(P(), pspec), check_vma=False))
-    dsh = NamedSharding(mesh, P(("data", "expert"), "seq"))
-    psh = jax.tree.map(lambda s: NamedSharding(mesh, s), pspec)
-    params = jax.tree.map(
-        lambda a, sh: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh),
-        params, psh)
-    ids = jax.ShapeDtypeStruct((2 * dp * ep, seq_len), jnp.int32,
-                               sharding=dsh)
-    return fn, (params, ids, ids)
+    ring falls back to the dense path. (One parameterization of
+    combined_3d_program — the expert-gradient reduction rule lives in
+    exactly one place.)"""
+    return combined_3d_program(n_devices, t_per_shard=t_per_shard,
+                               embed_dim=embed_dim, vocab=128,
+                               use_flash=True, abstract_args=True)
 
 
 def export_for_tpu(fn, args):
