@@ -272,15 +272,25 @@ def run_decode_perf(batch_size: int = 8, prompt_len: int = 128,
         jax.block_until_ready(out)
         elapsed = time.perf_counter() - t0
     tok_per_sec = batch_size * new_tokens / elapsed
+    # prefill-side throughput: generate(prompt, 1) runs ONLY the batched
+    # prefill (no decode steps); max_len pins the cache to the warm
+    # call's shapes so the prefill jit is a cache hit, not a recompile
+    t0 = time.perf_counter()
+    jax.block_until_ready(model.generate(prompt, 1,
+                                         max_len=prompt_len + new_tokens))
+    prefill_s = time.perf_counter() - t0
     s = {"model": "transformer_lm_decode", "batch_size": batch_size,
          "prompt_len": prompt_len, "new_tokens": new_tokens,
          "num_kv_heads": num_kv_heads or heads,
          "warmup_s": round(warm_s, 3), "time_s": round(elapsed, 4),
          "decode_tokens_per_sec": round(tok_per_sec, 2),
+         "prefill_tokens_per_sec": round(
+             batch_size * prompt_len / max(prefill_s, 1e-9), 1),
          "ms_per_token": round(1000.0 * elapsed
                                / (batch_size * new_tokens), 3)}
     log(f"[perf] decode batch={batch_size} prompt={prompt_len} "
-        f"new={new_tokens}: {tok_per_sec:.0f} tokens/s")
+        f"new={new_tokens}: {tok_per_sec:.0f} tokens/s decode, "
+        f"{s['prefill_tokens_per_sec']:.0f} tokens/s prefill")
     return s
 
 
