@@ -150,6 +150,28 @@ def test_launcher_runs_lenet_on_local_grid(tmp_path):
     assert "LAUNCH OK 0 2 8" in out and "LAUNCH OK 1 2 8" in out, out
 
 
+def test_launcher_module_mode(tmp_path):
+    """bigdl-tpu-launch -m pkg.mod runs a module main (python -m style)
+    with distributed wired, on a 1-process grid."""
+    pkg = tmp_path / "launchmod"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "main.py").write_text(
+        "import jax, sys\n"
+        "print('MOD OK', jax.process_count(), sys.argv[1:], flush=True)\n")
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, XLA_FLAGS="", JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join([os.path.dirname(here),
+                                           str(tmp_path)]))
+    proc = subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.tools.launch", "--procs", "1",
+         "-m", "launchmod.main", "--flag"],
+        capture_output=True, timeout=180, env=env)
+    out = proc.stdout.decode()
+    assert proc.returncode == 0, proc.stderr.decode()[-1000:]
+    assert "MOD OK 1 ['--flag']" in out, out
+
+
 def test_launcher_failure_kills_stranded_ranks(tmp_path):
     """A crashed rank must fail the whole launch promptly: survivors
     (stuck sleeping/in collectives waiting for the dead peer) are killed
