@@ -237,6 +237,7 @@ def run_decode_perf(batch_size: int = 8, prompt_len: int = 128,
                     embed_dim: int = 512, layers: int = 8, heads: int = 8,
                     num_kv_heads: Optional[int] = None,
                     use_rope: bool = True, dtype=jnp.bfloat16,
+                    int8: bool = False,
                     profile_dir: Optional[str] = None, log=print) -> dict:
     """Serving-side throughput: KV-cache autoregressive decode tokens/sec.
     generate() keeps its jitted prefill/step per model instance, so the
@@ -256,6 +257,14 @@ def run_decode_perf(batch_size: int = 8, prompt_len: int = 128,
         # cache dtype from the params) — the bandwidth that decode is
         # actually bound by
         model.load_params_dict(_cast_floating(model.params_dict(), dtype))
+    if int8:
+        # post-training int8: every Linear swaps to the int8 kernel —
+        # weight HBM traffic halves vs bf16 (the term decode is bound
+        # by); token parity vs float is pinned in tests/test_quantized.py
+        from bigdl_tpu.nn.quantized import Quantizer
+
+        model = Quantizer.quantize(model)
+        model.evaluate()
     prompt = jax.random.randint(jax.random.PRNGKey(0),
                                 (batch_size, prompt_len), 0, vocab)
     t0 = time.perf_counter()
@@ -279,7 +288,8 @@ def run_decode_perf(batch_size: int = 8, prompt_len: int = 128,
     jax.block_until_ready(model.generate(prompt, 1,
                                          max_len=prompt_len + new_tokens))
     prefill_s = time.perf_counter() - t0
-    s = {"model": "transformer_lm_decode", "batch_size": batch_size,
+    s = {"model": "transformer_lm_decode", "int8": bool(int8),
+         "batch_size": batch_size,
          "prompt_len": prompt_len, "new_tokens": new_tokens,
          "num_kv_heads": num_kv_heads or heads,
          "warmup_s": round(warm_s, 3), "time_s": round(elapsed, 4),
@@ -408,6 +418,9 @@ def main(argv=None):
     p.add_argument("--input-pipeline", action="store_true",
                    help="measure host feed records/sec (records -> "
                         "augments -> minibatch -> sharded H2D), no model")
+    p.add_argument("--int8", action="store_true",
+                   help="--decode: post-training int8 weights (halved "
+                        "weight HBM traffic; token parity tested)")
     p.add_argument("--records", type=int, default=512,
                    help="--input-pipeline: records per config")
     args = p.parse_args(argv)
@@ -435,7 +448,7 @@ def main(argv=None):
         if args.master_f32 or args.format != "NCHW":
             p.error("--decode takes --batch-size/--dtype/--profile only")
         run_decode_perf(batch_size=args.batch_size, dtype=dtype,
-                        profile_dir=args.profile)
+                        int8=args.int8, profile_dir=args.profile)
         return
     run_perf(args.model, args.batch_size, args.iterations, dtype=dtype,
              format=args.format, master_f32=args.master_f32,

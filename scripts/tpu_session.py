@@ -71,6 +71,11 @@ def main(argv=None):
                               "--decode", "--batch-size", "8",
                               "--dtype", "bfloat16"], 600)
 
+    results["decode_int8"] = run_stage(
+        "decode-int8", [sys.executable, "-m", "bigdl_tpu.models.perf",
+                        "--decode", "--batch-size", "8",
+                        "--dtype", "bfloat16", "--int8"], 600)
+
     # host-side feed capacity on the REAL TPU host (cores >> this box);
     # compare records/sec against the bench's measured imgs/sec
     results["input_pipeline"] = run_stage(
