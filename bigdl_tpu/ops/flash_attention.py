@@ -14,7 +14,9 @@ the saved per-row logsumexp) — O(T·block) live memory, never the dense
 (T, T) matrix; residuals are (q, k, v, out, lse), all O(T·D).
 
 On CPU tests the kernel runs in interpret mode; on TPU it compiles with
-MXU-aligned (128, 128) blocks.
+MXU-aligned blocks — ``auto_block`` picks 256 when the sequence tiles
+into it (measured ~1.5x over 128x128 on v5e, flash_matrix.jsonl), else
+128.
 """
 
 from __future__ import annotations
@@ -266,9 +268,18 @@ def default_interpret() -> bool:
     return jax.devices()[0].platform == "cpu"
 
 
+def auto_block(t: int) -> int:
+    """Default kernel block size for a sequence length: the round-5
+    flash matrix on a real v5e measured 256x256 blocks ~1.5x faster than
+    128x128 at T=4096 (flash_matrix.jsonl), so prefer 256 whenever the
+    sequence tiles into it."""
+    return 256 if t % 256 == 0 else 128
+
+
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: Optional[bool] = None):
     """(B, H, T, D) flash attention. Falls back to the dense XLA path when
     the sequence length doesn't tile into (block_q, block_k).
@@ -285,6 +296,10 @@ def flash_attention(q, k, v, causal: bool = False,
     if interpret is None:
         interpret = default_interpret()
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    if block_q is None:
+        block_q = auto_block(t)
+    if block_k is None:
+        block_k = auto_block(tk)
     if t % block_q or tk % block_k:
         from bigdl_tpu.nn.attention import dot_product_attention
 

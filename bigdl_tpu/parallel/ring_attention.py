@@ -60,7 +60,9 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     may carry fewer (grouped-query) heads — the flash path rotates them
     UN-expanded (group-factor less ring traffic); the dense path expands."""
     if use_flash:
-        blk = min(128, q.shape[2])
+        from bigdl_tpu.ops.flash_attention import auto_block
+
+        blk = min(auto_block(q.shape[2]), q.shape[2])
         if q.shape[2] % blk == 0:
             return _ring_attention_flash(q, k, v, axis_name, causal, scale,
                                          interpret)
@@ -136,7 +138,8 @@ def _ring_attention_flash(q, k, v, axis_name: str, causal: bool,
     cases dispatch via lax.switch on the traced source-block id. GQA K/V
     (fewer heads) rotate un-expanded; the kernel reads shared heads via
     its group index map."""
-    from bigdl_tpu.ops.flash_attention import default_interpret, flash_with_lse
+    from bigdl_tpu.ops.flash_attention import (auto_block, default_interpret,
+                                               flash_with_lse)
 
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
@@ -144,7 +147,7 @@ def _ring_attention_flash(q, k, v, axis_name: str, causal: bool,
     h_kv = k.shape[1]
     group = h // h_kv
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
-    block = min(128, t)
+    block = min(auto_block(t), t)
     qf = q.reshape(b * h, t, d)
     if interpret is None:
         # host-platform default; cross-lowering (jax.export for TPU from a
