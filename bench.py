@@ -64,19 +64,48 @@ def main(argv=None):
     me = os.path.abspath(__file__)
     tpu_timeout = float(os.environ.get("BIGDL_BENCH_TPU_TIMEOUT", "540"))
 
-    env = dict(os.environ, BIGDL_BENCH_CHILD="1")
+    # PID-suffixed so concurrent bench invocations never clobber or recover
+    # each other's checkpoint; cleaned up in the finally below.
+    partial = os.path.join(here, f".bench_partial.{os.getpid()}.json")
+    env = dict(os.environ, BIGDL_BENCH_CHILD="1", BIGDL_BENCH_PARTIAL=partial)
     try:
-        proc = subprocess.run([sys.executable, me] + argv, env=env, cwd=here,
-                              stdout=subprocess.PIPE, timeout=tpu_timeout)
-        if proc.returncode == 0 and proc.stdout.strip():
-            sys.stdout.buffer.write(proc.stdout)
-            _append_history(here, proc.stdout)
-            return
-        print(f"[bench] primary attempt rc={proc.returncode}; "
-              "falling back to CPU", file=sys.stderr)
-    except subprocess.TimeoutExpired:
-        print(f"[bench] primary attempt exceeded {tpu_timeout}s "
-              "(wedged tunnel?); falling back to CPU", file=sys.stderr)
+        try:
+            proc = subprocess.run([sys.executable, me] + argv, env=env,
+                                  cwd=here, stdout=subprocess.PIPE,
+                                  timeout=tpu_timeout)
+            if proc.returncode == 0 and proc.stdout.strip():
+                sys.stdout.buffer.write(proc.stdout)
+                _append_history(here, proc.stdout)
+                return
+            print(f"[bench] primary attempt rc={proc.returncode}; "
+                  "falling back", file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"[bench] primary attempt exceeded {tpu_timeout}s "
+                  "(wedged tunnel?); falling back", file=sys.stderr)
+        # The child may have measured the headline and then wedged in a
+        # later stage — recover the checkpointed result before resorting
+        # to CPU.
+        try:
+            with open(partial, "rb") as f:
+                out = f.read()
+            if out.strip():
+                json.loads(out)  # refuse a torn/corrupt checkpoint
+                print("[bench] recovered measured headline from partial "
+                      "checkpoint", file=sys.stderr)
+                sys.stdout.buffer.write(out)
+                _append_history(here, out)
+                return
+        except OSError:
+            pass
+        except ValueError as e:
+            print(f"[bench] partial checkpoint unreadable: {e}",
+                  file=sys.stderr)
+    finally:
+        for p in (partial, partial + ".tmp"):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
 
     # Clean-CPU fallback: PYTHONPATH="" skips the axon sitecustomize so the
     # child cannot wedge.  It runs the *real* smoke config (resnet18, batch 8,
@@ -124,7 +153,9 @@ def _append_history(here, stdout_bytes):
         rec = json.loads(stdout_bytes.decode().strip().splitlines()[-1])
         rec["ts"] = datetime.datetime.now(datetime.timezone.utc).isoformat(
             timespec="seconds")
-        with open(os.path.join(here, "bench_history.jsonl"), "a") as f:
+        path = (os.environ.get("BIGDL_BENCH_HISTORY")
+                or os.path.join(here, "bench_history.jsonl"))
+        with open(path, "a") as f:
             f.write(json.dumps(rec) + "\n")
     except Exception as e:
         print(f"[bench] history append failed: {e}", file=sys.stderr)
@@ -207,27 +238,26 @@ def bench_main(argv=None):
                  master_f32=model != "lenet5",
                  log=log)
 
+    def checkpoint(result):
+        """Atomically persist the headline so the watchdog parent can
+        recover it if a later dispatch hard-wedges inside a C call (where
+        SIGALRM cannot preempt) — round-5 lesson: the first TPU window in
+        three rounds lost a measured headline to exactly this."""
+        path = os.environ.get("BIGDL_BENCH_PARTIAL")
+        if not path:
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(result) + "\n")
+        os.replace(tmp, path)
+
     imgs_per_sec = s["records_per_sec"]
     if model == "resnet50":
         achieved = imgs_per_sec * RESNET50_FWD_FLOPS_PER_IMG * TRAIN_FLOPS_MULT
         mfu = achieved / peak_flops(dev)
-        # Measured denominator: raw-JAX ResNet-50 on the same chip.
+        # Until the measured denominator lands: assumed 50%-MFU reference.
         ref_mfu, baseline_source = None, "assumed_0.50_mfu_ref"
         vs_baseline = mfu / TARGET_MFU
-        # leave >=180s of watchdog budget for the ref compile+run
-        if (not os.environ.get("BIGDL_BENCH_NOREF")
-                and time.perf_counter() - t_start < budget - 180):
-            try:
-                from bigdl_tpu.models.jax_resnet_ref import run_ref_perf
-                r = run_ref_perf(batch_size=batch, iterations=max(5, iters // 2),
-                                 log=log)
-                ref_achieved = (r["records_per_sec"] * RESNET50_FWD_FLOPS_PER_IMG
-                                * TRAIN_FLOPS_MULT)
-                ref_mfu = ref_achieved / peak_flops(dev)
-                vs_baseline = imgs_per_sec / (0.70 * r["records_per_sec"])
-                baseline_source = "measured_raw_jax_ref"
-            except Exception as e:
-                print(f"[bench] ref-jax denominator failed: {e}", file=sys.stderr)
         metric = "resnet50_synthetic_imagenet_train_throughput"
     else:
         # No MFU north-star applies to fallback models — report an honest
@@ -237,15 +267,7 @@ def bench_main(argv=None):
         vs_baseline = None
         metric = f"{model}_synthetic_train_throughput"
 
-    lenet_epoch_s = None
-    if (not os.environ.get("BIGDL_BENCH_NOLENET")
-            and time.perf_counter() - t_start < budget - 90):
-        try:
-            lenet_epoch_s = _lenet_epoch_wallclock(log)
-        except Exception as e:
-            print(f"[bench] lenet epoch metric failed: {e}", file=sys.stderr)
-
-    print(json.dumps({
+    result = {
         "metric": metric,
         "value": round(imgs_per_sec, 2),
         "unit": "imgs/sec/chip",
@@ -257,12 +279,58 @@ def bench_main(argv=None):
             "dtype": "f32" if model == "lenet5" else "bf16",
             "format": fmt, "ms_per_iter": s["ms_per_iter"],
             "mfu": round(mfu, 4),
-            "ref_jax_mfu": round(ref_mfu, 4) if ref_mfu is not None else None,
+            "ref_jax_mfu": None,
             "baseline_source": baseline_source,
             "target_mfu": TARGET_MFU,
-            "lenet_mnist_epoch_s": lenet_epoch_s,
+            "lenet_mnist_epoch_s": None,
         },
-    }))
+    }
+    checkpoint(result)  # headline measured — survives a wedge in ANY later stage
+
+    # Measured denominator: raw-JAX ResNet-50 on the same chip; leave
+    # >=180s of watchdog budget for its compile+run.
+    if (model == "resnet50" and not os.environ.get("BIGDL_BENCH_NOREF")
+            and time.perf_counter() - t_start < budget - 180):
+        try:
+            from bigdl_tpu.models.jax_resnet_ref import run_ref_perf
+            r = run_ref_perf(batch_size=batch, iterations=max(5, iters // 2),
+                             log=log)
+            ref_achieved = (r["records_per_sec"] * RESNET50_FWD_FLOPS_PER_IMG
+                            * TRAIN_FLOPS_MULT)
+            result["detail"]["ref_jax_mfu"] = round(
+                ref_achieved / peak_flops(dev), 4)
+            result["vs_baseline"] = round(
+                imgs_per_sec / (0.70 * r["records_per_sec"]), 4)
+            result["detail"]["baseline_source"] = "measured_raw_jax_ref"
+            checkpoint(result)
+        except Exception as e:
+            print(f"[bench] ref-jax denominator failed: {e}", file=sys.stderr)
+
+    if os.environ.get("BIGDL_BENCH_TEST_WEDGE"):
+        # fault injection (tests): simulate a hard tunnel wedge after the
+        # headline is measured — the watchdog must recover the partial
+        time.sleep(1e6)
+
+    remaining = budget - (time.perf_counter() - t_start)
+    if not os.environ.get("BIGDL_BENCH_NOLENET") and remaining > 90:
+        # Self-deadline for slow-but-returning dispatches; a hard wedge is
+        # covered by the partial-file checkpoint above.
+        import signal
+
+        def _deadline(signum, frame):
+            raise TimeoutError("lenet epoch stage deadline")
+
+        old = signal.signal(signal.SIGALRM, _deadline)
+        signal.alarm(max(30, int(remaining - 60)))
+        try:
+            result["detail"]["lenet_mnist_epoch_s"] = _lenet_epoch_wallclock(log)
+        except Exception as e:
+            print(f"[bench] lenet epoch metric failed: {e}", file=sys.stderr)
+        finally:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old)
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -65,7 +65,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         blk = min(auto_block(q.shape[2]), q.shape[2])
         if q.shape[2] % blk == 0:
             return _ring_attention_flash(q, k, v, axis_name, causal, scale,
-                                         interpret)
+                                         interpret, block=blk)
     if k.shape[1] != q.shape[1]:  # dense path needs materialized kv heads
         rep = q.shape[1] // k.shape[1]
         k, v = jnp.repeat(k, rep, 1), jnp.repeat(v, rep, 1)
@@ -131,13 +131,15 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
 
 def _ring_attention_flash(q, k, v, axis_name: str, causal: bool,
                           scale: Optional[float],
-                          interpret: Optional[bool] = None):
+                          interpret: Optional[bool] = None,
+                          block: Optional[int] = None):
     """Flash-kernel ring steps merged in logsumexp space. Per step the
     held K/V block is (relative to my Q block) strictly past -> full
     attention, diagonal -> causal, strictly future -> skipped; the three
     cases dispatch via lax.switch on the traced source-block id. GQA K/V
     (fewer heads) rotate un-expanded; the kernel reads shared heads via
-    its group index map."""
+    its group index map. ``block`` is the kernel block size the caller's
+    tiling gate validated (ring_attention computes it via auto_block)."""
     from bigdl_tpu.ops.flash_attention import (auto_block, default_interpret,
                                                flash_with_lse)
 
@@ -147,7 +149,8 @@ def _ring_attention_flash(q, k, v, axis_name: str, causal: bool,
     h_kv = k.shape[1]
     group = h // h_kv
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
-    block = min(auto_block(t), t)
+    if block is None:
+        block = min(auto_block(t), t)
     qf = q.reshape(b * h, t, d)
     if interpret is None:
         # host-platform default; cross-lowering (jax.export for TPU from a

@@ -68,3 +68,29 @@ def test_generate_reuses_jitted_step_across_calls():
     step_jit, prefill_jit, _chunk_jit = m._decode_fns()
     assert step_jit._cache_size() == 1, step_jit._cache_size()
     assert prefill_jit._cache_size() == 1
+
+
+def test_bench_watchdog_recovers_partial_on_wedge(tmp_path):
+    """bench.py's watchdog must emit the measured headline even when the
+    child wedges hard (blocked in a C call, SIGALRM useless) after the
+    measurement — the round-5 TPU window lost its headline to this."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu",
+               BIGDL_BENCH_TEST_WEDGE="1", BIGDL_BENCH_NOLENET="1",
+               BIGDL_BENCH_TPU_TIMEOUT="90",
+               BIGDL_BENCH_HISTORY=str(tmp_path / "history.jsonl"))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"),
+         "--model", "lenet5", "--batch", "32", "--iters", "2"],
+        env=env, cwd=repo, capture_output=True, timeout=150)
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+    line = proc.stdout.decode().strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert rec["metric"] == "lenet5_synthetic_train_throughput"
+    assert rec["value"] > 0
+    assert b"recovered measured headline" in proc.stderr
