@@ -138,6 +138,31 @@ def main(argv=None):
                            "unit": "imgs/sec/chip", "vs_baseline": None,
                            "detail": {"error": f"fallback rc={rc}"}})
                .encode() + b"\n")
+    # A CPU row means the tunnel was wedged NOW — but hardware numbers may
+    # exist from an earlier window.  Surface the freshest TPU trend row so
+    # the fallback line still points at the measured result.
+    try:
+        rec = json.loads(out.decode().strip().splitlines()[-1])
+        last_tpu = None
+        hist = (os.environ.get("BIGDL_BENCH_HISTORY")
+                or os.path.join(here, "bench_history.jsonl"))
+        with open(hist) as f:
+            for ln in f:
+                try:
+                    row = json.loads(ln)
+                except ValueError:
+                    continue
+                if "TPU" in str(row.get("detail", {}).get("device", "")):
+                    last_tpu = row
+        if last_tpu is not None:
+            rec.setdefault("detail", {})["last_measured_tpu"] = {
+                k: last_tpu.get(k) for k in ("metric", "value", "vs_baseline",
+                                             "ts")}
+            rec["detail"]["last_measured_tpu"]["device"] = (
+                last_tpu.get("detail", {}).get("device"))
+            out = json.dumps(rec).encode() + b"\n"
+    except (OSError, ValueError, IndexError) as e:
+        print(f"[bench] last-TPU annotation skipped: {e}", file=sys.stderr)
     sys.stdout.buffer.write(out)
     _append_history(here, out)
     sys.exit(rc)

@@ -94,3 +94,38 @@ def test_bench_watchdog_recovers_partial_on_wedge(tmp_path):
     assert rec["metric"] == "lenet5_synthetic_train_throughput"
     assert rec["value"] > 0
     assert b"recovered measured headline" in proc.stderr
+
+
+def test_bench_fallback_carries_last_measured_tpu(tmp_path):
+    """When the tunnel is wedged and the CPU fallback runs, the emitted
+    line must surface the freshest TPU row from bench_history.jsonl so a
+    wedged round still points at the measured hardware result."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    hist = tmp_path / "history.jsonl"
+    hist.write_text(json.dumps({
+        "metric": "resnet50_synthetic_imagenet_train_throughput",
+        "value": 2072.1, "unit": "imgs/sec/chip", "vs_baseline": 1.37,
+        "detail": {"device": "TPU v5 lite"}, "ts": "2026-07-31T01:17:00+00:00",
+    }) + "\n")
+    env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu",
+               # the 1s deadline kills the primary attempt (TimeoutExpired
+               # path); no partial exists yet, so the CPU fallback runs
+               BIGDL_BENCH_TPU_TIMEOUT="1", BIGDL_BENCH_NOLENET="1",
+               BIGDL_BENCH_HISTORY=str(hist))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"),
+         "--batch", "8", "--iters", "2"],
+        env=env, cwd=repo, capture_output=True, timeout=400)
+    line = proc.stdout.decode().strip().splitlines()[-1]
+    rec = json.loads(line)
+    last = rec["detail"].get("last_measured_tpu")
+    assert last is not None and "TPU" in last["device"]
+    assert last["vs_baseline"] and last["vs_baseline"] > 1.0
+    # the fallback's own row must have been appended after the seeded one
+    rows = [json.loads(ln) for ln in hist.read_text().splitlines()]
+    assert len(rows) == 2 and rows[1]["detail"]["last_measured_tpu"]
