@@ -8,7 +8,7 @@ Mosaic-lower clean without hardware.
 
 Programs (builders shared with tests/test_tpu_lowering.py via
 bigdl_tpu.tools.export_programs):
-  1. flash fwd            T=4096, bf16, GQA 8q/4kv, 128x128 blocks
+  1. flash fwd            T=4096, bf16, GQA 8q/4kv, auto (256) blocks
   2. flash fwd+bwd        same shapes, custom-vjp backward
   3. ring-flash composed  8-dev (data,seq) mesh, grads through the ring
   4. combined 3-D step    dp x sp x ep dryrun program (same fn object)
