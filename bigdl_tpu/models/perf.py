@@ -9,6 +9,7 @@ device-resident synthetic batch (no host↔HBM transfer in the timed loop),
 
 from __future__ import annotations
 
+import json
 import os
 import time
 from typing import Optional
@@ -399,6 +400,21 @@ def run_input_pipeline_perf(batch_size: int = 64, n_records: int = 512,
     return results
 
 
+def _append_rows_to_history(rows) -> None:
+    """Append result rows to the bench trend file — cwd-relative like the
+    other bench writers (tpu_session runs with cwd=repo root; a wheel
+    install must not litter the venv), `BIGDL_BENCH_HISTORY` overrides
+    (same env contract as bench.py's writer)."""
+    hist = (os.environ.get("BIGDL_BENCH_HISTORY")
+            or os.path.join(os.getcwd(), "bench_history.jsonl"))
+    try:
+        with open(hist, "a") as f:
+            for r in rows:
+                f.write(json.dumps(dict(r, ts=time.time())) + "\n")
+    except OSError:
+        pass
+
+
 def main(argv=None):
     import argparse
 
@@ -423,22 +439,17 @@ def main(argv=None):
                         "weight HBM traffic; token parity tested)")
     p.add_argument("--records", type=int, default=512,
                    help="--input-pipeline: records per config")
+    p.add_argument("--prompt-len", type=int, default=128,
+                   help="--decode: prompt length")
+    p.add_argument("--new-tokens", type=int, default=128,
+                   help="--decode: generated tokens per pass (lower it on "
+                        "the axon tunnel — each token is one round-trip)")
     args = p.parse_args(argv)
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     if args.input_pipeline:
-        import json
-
         rows = run_input_pipeline_perf(batch_size=args.batch_size,
                                        n_records=args.records)
-        # cwd-relative like the other bench writers (tpu_session runs
-        # with cwd=repo root; a wheel install must not litter the venv)
-        hist = os.path.join(os.getcwd(), "bench_history.jsonl")
-        try:
-            with open(hist, "a") as f:
-                for r in rows:
-                    f.write(json.dumps(dict(r, ts=time.time())) + "\n")
-        except OSError:
-            pass
+        _append_rows_to_history(rows)
         print(json.dumps(rows))
         return
     if args.decode:
@@ -446,9 +457,18 @@ def main(argv=None):
             p.error("--decode measures the transformer LM; --model does "
                     "not apply")
         if args.master_f32 or args.format != "NCHW":
-            p.error("--decode takes --batch-size/--dtype/--profile only")
-        run_decode_perf(batch_size=args.batch_size, dtype=dtype,
-                        int8=args.int8, profile_dir=args.profile)
+            p.error("--decode takes --batch-size/--dtype/--prompt-len/"
+                    "--new-tokens/--int8/--profile only")
+        if args.new_tokens < 1 or args.prompt_len < 1:
+            p.error("--prompt-len/--new-tokens must be >= 1")
+        s = run_decode_perf(batch_size=args.batch_size, dtype=dtype,
+                            prompt_len=args.prompt_len,
+                            new_tokens=args.new_tokens,
+                            int8=args.int8, profile_dir=args.profile)
+        s["device"] = str(getattr(jax.devices()[0], "device_kind",
+                                  jax.devices()[0].platform))
+        _append_rows_to_history([s])
+        print(json.dumps(s))
         return
     run_perf(args.model, args.batch_size, args.iterations, dtype=dtype,
              format=args.format, master_f32=args.master_f32,

@@ -92,15 +92,19 @@ def main(argv=None):
     # Decode LAST: token-at-a-time dispatch rides the tunnel's per-call
     # latency — the round-5 window saw both decode stages eat their full
     # 600s with no output while higher-value stages waited.
+    # --new-tokens 32: each decode token is a tunnel round-trip; 32 is
+    # enough for a stable ms/token after the jitted-step warmup.
     results["decode"] = run_stage(
         "decode-throughput", [sys.executable, "-m", "bigdl_tpu.models.perf",
                               "--decode", "--batch-size", "8",
-                              "--dtype", "bfloat16"], 900)
+                              "--dtype", "bfloat16", "--new-tokens", "32"],
+        900)
 
     results["decode_int8"] = run_stage(
         "decode-int8", [sys.executable, "-m", "bigdl_tpu.models.perf",
                         "--decode", "--batch-size", "8",
-                        "--dtype", "bfloat16", "--int8"], 900)
+                        "--dtype", "bfloat16", "--int8",
+                        "--new-tokens", "32"], 900)
 
     print(json.dumps(results))
     return 0 if all(r == 0 for r in results.values()) else 2
