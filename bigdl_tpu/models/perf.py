@@ -418,6 +418,10 @@ def _append_rows_to_history(rows) -> None:
 def main(argv=None):
     import argparse
 
+    from bigdl_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
     p = argparse.ArgumentParser(description="bigdl_tpu training perf (≙ DistriOptimizerPerf)")
     p.add_argument("--model", default="resnet50")
     p.add_argument("--batch-size", type=int, default=32)

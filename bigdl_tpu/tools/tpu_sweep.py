@@ -20,20 +20,11 @@ def main(argv=None):
     p.add_argument("--out", default="tpu_sweep.jsonl")
     args = p.parse_args(argv)
 
+    from bigdl_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
     import jax
-
-    # Anchor the persistent compile cache to the repo checkout when running
-    # from one (keeps the warmed cache regardless of cwd); fall back to cwd
-    # for installed-package runs. BIGDL_TPU_COMPILE_CACHE overrides.
-    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    default_cache = (os.path.join(repo_root, ".jax_cache")
-                     if os.path.exists(os.path.join(repo_root, "bench.py"))
-                     else os.path.join(os.getcwd(), ".jax_cache"))
-    cache_dir = os.environ.get("BIGDL_TPU_COMPILE_CACHE", default_cache)
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-
     import jax.numpy as jnp
 
     from bigdl_tpu.models.perf import run_perf

@@ -1,0 +1,34 @@
+"""Persistent XLA compile cache — one policy for every perf/bench tool.
+
+Over the axon tunnel a ResNet-50 or decode-loop compile can eat a
+minute-plus of a short hardware window; a prior run (same code, same
+shapes) turns it into a cache hit. Policy: ``BIGDL_TPU_COMPILE_CACHE``
+overrides; otherwise anchor to the repo checkout (keeps the warmed cache
+regardless of cwd — bench.py, tpu_sweep, flash_matrix and the perf CLI
+all share one cache); fall back to cwd for installed-package runs.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def enable_persistent_cache() -> str | None:
+    """Point jax at the shared on-disk compile cache. Returns the cache
+    dir, or None (with a stderr note) if the config couldn't be applied."""
+    import jax
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    default = (os.path.join(repo_root, ".jax_cache")
+               if os.path.exists(os.path.join(repo_root, "bench.py"))
+               else os.path.join(os.getcwd(), ".jax_cache"))
+    cache_dir = os.environ.get("BIGDL_TPU_COMPILE_CACHE", default)
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        return cache_dir
+    except Exception as e:
+        print(f"[bigdl_tpu] compile cache unavailable: {e}", file=sys.stderr)
+        return None

@@ -27,9 +27,9 @@ def main(argv=None):
     import jax
     import jax.numpy as jnp
 
-    cache_dir = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), ".jax_cache")
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    from bigdl_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
 
     from bigdl_tpu.nn.attention import dot_product_attention
     from bigdl_tpu.ops.flash_attention import flash_attention
