@@ -8,7 +8,6 @@ Run: bigdl-tpu-sweep [--quick]   (or python scripts/tpu_sweep.py)
 
 import argparse
 import json
-import os
 import sys
 import time
 

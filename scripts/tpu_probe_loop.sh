@@ -1,15 +1,36 @@
 #!/bin/bash
 # Probe the axon TPU tunnel every ~5 min; the moment it opens, run the
-# staged hardware session (sweep -> bench -> flash matrix -> profile).
-# Appends status to /tmp/tpu_status. Exits after a successful session.
+# staged hardware session (scripts/tpu_session.py). Appends status to
+# /tmp/tpu_status. Exits only after a session that produced results
+# (rc 0 = all stages ran; rc 2 = some stages ran). A session aborted by
+# a tunnel flap (rc 3 before anything ran) resumes probing — the
+# round-5 window at 03:15Z lasted ~2 min and would otherwise have
+# consumed the loop's single shot.
 cd "$(dirname "$0")/.."
+probe() {
+    timeout 45 python -c \
+        "import jax; d=jax.devices()[0]; assert d.platform != 'cpu'" \
+        2>/dev/null
+}
 while true; do
-    if timeout 45 python -c "import jax; d=jax.devices()[0]; assert d.platform != 'cpu'" 2>/dev/null; then
+    if probe; then
+        # Double-probe 45s apart: don't commit a full session (and its
+        # per-stage timeouts) to a tunnel that flaps within a minute.
+        sleep 45
+        if ! probe; then
+            echo "$(date -u +%FT%TZ) FLAPPED" >> /tmp/tpu_status
+            sleep 120
+            continue
+        fi
         echo "$(date -u +%FT%TZ) ALIVE" >> /tmp/tpu_status
         python scripts/tpu_session.py --profile >> /tmp/tpu_session.log 2>&1
-        echo "$(date -u +%FT%TZ) SESSION rc=$?" >> /tmp/tpu_status
-        exit 0
+        rc=$?
+        echo "$(date -u +%FT%TZ) SESSION rc=$rc" >> /tmp/tpu_status
+        if [ "$rc" != 1 ] && [ "$rc" != 3 ]; then
+            exit 0
+        fi
+    else
+        echo "$(date -u +%FT%TZ) WEDGED" >> /tmp/tpu_status
     fi
-    echo "$(date -u +%FT%TZ) WEDGED" >> /tmp/tpu_status
     sleep 300
 done
