@@ -271,7 +271,7 @@ def run_decode_perf(batch_size: int = 8, prompt_len: int = 128,
     t0 = time.perf_counter()
     out = model.generate(prompt, new_tokens)
     jax.block_until_ready(out)
-    warm_s = time.perf_counter() - t0  # compiles prefill + step
+    warm_s = time.perf_counter() - t0  # compiles prefill + decode scan
     import contextlib
 
     prof = (jax.profiler.trace(profile_dir) if profile_dir
@@ -282,12 +282,15 @@ def run_decode_perf(batch_size: int = 8, prompt_len: int = 128,
         jax.block_until_ready(out)
         elapsed = time.perf_counter() - t0
     tok_per_sec = batch_size * new_tokens / elapsed
-    # prefill-side throughput: generate(prompt, 1) runs ONLY the batched
-    # prefill (no decode steps); max_len pins the cache to the warm
-    # call's shapes so the prefill jit is a cache hit, not a recompile
+    # prefill-side throughput: generate(prompt, 1, host_loop=True) runs
+    # ONLY the batched prefill (the host loop samples token 1 straight
+    # from the prefill logits, zero decode steps — the scan path would
+    # add one); max_len pins the cache to the warm call's shapes so the
+    # prefill jit is a cache hit, not a recompile
     t0 = time.perf_counter()
     jax.block_until_ready(model.generate(prompt, 1,
-                                         max_len=prompt_len + new_tokens))
+                                         max_len=prompt_len + new_tokens,
+                                         host_loop=True))
     prefill_s = time.perf_counter() - t0
     s = {"model": "transformer_lm_decode", "int8": bool(int8),
          "batch_size": batch_size,

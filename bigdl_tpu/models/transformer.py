@@ -213,6 +213,41 @@ class TransformerLM(Module):
             logits = self.head(x.reshape(x.shape[0], -1))[:, None, :]
         return logits[:, 0], new_caches
 
+    def decode_scan(self, logits, pos0, caches, rng, temperature, n: int,
+                    sampled: bool = False):
+        """Generate ``n`` tokens ON DEVICE as one ``lax.scan`` over the KV
+        cache — one dispatch for the whole decode instead of n host
+        round-trips (the reference re-dispatched its RecurrentDecoder
+        host loop every timestep, nn/RecurrentDecoder.scala:48).
+        ``n``/``sampled`` must be trace-static; ``temperature`` may be
+        traced. Returns (n, B) int32 tokens. Callers jit this (see
+        _decode_fns) with the caches donated — the scan's in-place cache
+        updates then never copy.
+
+        Token 0 samples straight from the prefill ``logits``; the scan
+        then runs step->sample n-1 times — exactly n-1 decode steps for
+        n tokens (no wasted trailing step), with one key split per
+        sampled token in token order (bit-parity with the host loop)."""
+        def sample(logits, rng):
+            if sampled:
+                rng, sub = jax.random.split(rng)
+                return jax.random.categorical(
+                    sub, logits / temperature, axis=-1
+                ).astype(jnp.int32), rng
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), rng
+
+        tok0, rng = sample(logits, rng)
+
+        def body(carry, _):
+            tok, pos, caches, rng = carry
+            logits, caches = self.decode_step(tok, pos, caches)
+            nxt, rng = sample(logits, rng)
+            return (nxt, pos + 1, caches, rng), nxt
+
+        carry = (tok0, jnp.asarray(pos0, jnp.int32), caches, rng)
+        _, toks = jax.lax.scan(body, carry, None, length=n - 1)
+        return jnp.concatenate([tok0[None], toks], axis=0)
+
     def _beam_step_fn(self, b: int, k: int):
         """Cached jitted beam step for this (model, batch, beams): the
         surviving-beam cache gather is folded into the donated jit."""
@@ -259,10 +294,20 @@ class TransformerLM(Module):
             with bind(self, p, bufs, False, None):
                 return self.prefill_chunk(ids, caches, pos0)
 
+        def scan_fn(p, bufs, logits, pos0, caches, rng, temperature, n,
+                    sampled):
+            # the one-dispatch n-token decode loop (see decode_scan);
+            # n/sampled static -> one compile per decode length
+            with bind(self, p, bufs, False, None):
+                return self.decode_scan(logits, pos0, caches, rng,
+                                        temperature, n, sampled)
+
         fns = (jax.jit(step, donate_argnums=(4,)),
                jax.jit(prefill_fn, donate_argnums=(3,),
                        static_argnums=(4,)),
-               jax.jit(chunk_fn, donate_argnums=(3,)))
+               jax.jit(chunk_fn, donate_argnums=(3,)),
+               jax.jit(scan_fn, donate_argnums=(2, 4),
+                       static_argnums=(7, 8)))
         _DECODE_JIT[self] = fns
         return fns
 
@@ -295,7 +340,7 @@ class TransformerLM(Module):
             raise ValueError(f"max_len {max_len} exceeds the model's "
                              f"context length {self.max_len}")
         params, buffers = self.params_dict(), self.buffers_dict()
-        step_jit, prefill_jit, chunk_jit = self._decode_fns()
+        step_jit, prefill_jit, chunk_jit, _scan_jit = self._decode_fns()
         if max_new_tokens == 0:
             return prompt_ids, b, t0, params, buffers, step_jit, None, None
         # cache dtype follows the params (bf16 serving -> bf16 kv cache)
@@ -319,13 +364,18 @@ class TransformerLM(Module):
 
     def generate(self, prompt_ids, max_new_tokens: int,
                  temperature: float = 0.0, rng=None, max_len=None,
-                 prefill_chunk=None):
+                 prefill_chunk=None, host_loop: bool = False):
         """Autoregressive generation with a KV cache (the transformer
         analog of the reference's RecurrentDecoder, nn/RecurrentDecoder
-        .scala): prefill the prompt one jitted step at a time, then sample
-        greedily (``temperature == 0``) or from the tempered softmax.
-        Returns (B, len(prompt) + max_new_tokens) ids. ``prefill_chunk``
-        bounds long-prompt prefill memory (see _decode_setup)."""
+        .scala): batched prefill over the prompt, then the ENTIRE
+        sample->step decode loop runs on device as one ``lax.scan``
+        dispatch — throughput is set by the chip, not by
+        ``max_new_tokens`` host round-trips. Sampling is greedy
+        (``temperature == 0``) or from the tempered softmax. Returns
+        (B, len(prompt) + max_new_tokens) ids. ``prefill_chunk`` bounds
+        long-prompt prefill memory (see _decode_setup). ``host_loop=True``
+        forces the one-dispatch-per-token path (the scan parity oracle;
+        also what a caller streaming tokens as they land would use)."""
         from bigdl_tpu.utils import random as bt_random
 
         (prompt_ids, b, t0, params, buffers, step_jit,
@@ -333,12 +383,21 @@ class TransformerLM(Module):
                                               max_len, prefill_chunk)
         if max_new_tokens == 0:
             return prompt_ids
+        sampled = temperature > 0.0
+        if sampled and rng is None:
+            rng = bt_random.next_key()
+        if not host_loop:
+            scan_jit = self._decode_fns()[3]
+            toks = scan_jit(params, buffers, logits, jnp.int32(t0), caches,
+                            rng if sampled else jax.random.PRNGKey(0),
+                            jnp.float32(temperature if sampled else 1.0),
+                            max_new_tokens, sampled)
+            return jnp.concatenate([prompt_ids, toks.T], axis=1)
         ids = [prompt_ids[:, i] for i in range(t0)]
         for i in range(max_new_tokens):
-            if temperature <= 0.0:
+            if not sampled:
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             else:
-                rng = rng if rng is not None else bt_random.next_key()
                 rng, sub = jax.random.split(rng)
                 nxt = jax.random.categorical(
                     sub, logits / temperature, axis=-1).astype(jnp.int32)

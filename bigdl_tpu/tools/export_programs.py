@@ -272,6 +272,33 @@ def decode_step_program(batch: int = 8, vocab: int = 32000,
             (params, buffers, ids_t, pos, caches))
 
 
+def decode_scan_program(batch: int = 8, n_tokens: int = 32,
+                        vocab: int = 32000, embed_dim: int = 512,
+                        layers: int = 8, heads: int = 8,
+                        kv_heads: int = 2, max_len: int = 2048,
+                        dtype=jnp.bfloat16):
+    """The one-dispatch serving loop: n_tokens of sample->decode_step as a
+    single on-device ``lax.scan`` (TransformerLM.decode_scan) — what
+    generate() actually runs per batch, so its TPU lowering is the one
+    that matters for serving."""
+    from bigdl_tpu.nn.module import bind
+
+    model, params, buffers, caches = _serving_model(
+        batch, vocab, embed_dim, layers, heads, kv_heads, max_len, dtype)
+
+    def scan_fn(p, bufs, logits, pos0, caches, rng):
+        with bind(model, p, bufs, False, None):
+            return model.decode_scan(logits, pos0, caches, rng,
+                                     jnp.float32(0.8), n_tokens,
+                                     sampled=True)
+
+    logits = jax.ShapeDtypeStruct((batch, vocab), dtype)
+    pos0 = jax.ShapeDtypeStruct((), jnp.int32)
+    rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    return (jax.jit(scan_fn, donate_argnums=(2, 4)),
+            (params, buffers, logits, pos0, caches, rng))
+
+
 def chunked_prefill_program(batch: int = 8, chunk: int = 256,
                             vocab: int = 32000, embed_dim: int = 512,
                             layers: int = 8, heads: int = 8,

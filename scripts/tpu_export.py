@@ -52,6 +52,8 @@ def main():
                                               t_per_shard=512)),
         ("decode_step_b8_l8_t2048",
          lambda: ep.decode_step_program()),
+        ("decode_scan_b8_n32_l8_t2048",
+         lambda: ep.decode_scan_program()),
         ("chunked_prefill_c256_t2048",
          lambda: ep.chunked_prefill_program()),
         ("resnet50_sharded_step_b256",

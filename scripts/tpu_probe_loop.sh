@@ -1,17 +1,23 @@
 #!/bin/bash
 # Probe the axon TPU tunnel every ~5 min; the moment it opens, run the
 # staged hardware session (scripts/tpu_session.py). Appends status to
-# /tmp/tpu_status. Exits only after a session that produced results
-# (rc 0 = all stages ran; rc 2 = some stages ran). A session aborted by
-# a tunnel flap (rc 3 before anything ran) resumes probing — the
-# round-5 window at 03:15Z lasted ~2 min and would otherwise have
-# consumed the loop's single shot.
+# /tmp/tpu_status.
+#
+# Session exit-code contract (see tpu_session.py): 0 = all stages ok,
+# 4 = partial results, 3 = flap before any TPU result, 5 = wedged at
+# start. The loop stops once results exist (0/4), resumes probing on a
+# flap/wedge (3/5, capped so a flapping tunnel can't relaunch forever),
+# and ABORTS on anything else — an unexpected code (1 = crash, 2 =
+# argparse error) means the session script itself is broken and
+# relaunching it every 5 min would burn the machine without producing
+# results.
 cd "$(dirname "$0")/.."
 probe() {
     timeout 45 python -c \
         "import jax; d=jax.devices()[0]; assert d.platform != 'cpu'" \
         2>/dev/null
 }
+launches=0
 while true; do
     if probe; then
         # Double-probe 45s apart: don't commit a full session (and its
@@ -26,8 +32,18 @@ while true; do
         python scripts/tpu_session.py --profile >> /tmp/tpu_session.log 2>&1
         rc=$?
         echo "$(date -u +%FT%TZ) SESSION rc=$rc" >> /tmp/tpu_status
-        if [ "$rc" != 1 ] && [ "$rc" != 3 ]; then
-            exit 0
+        case "$rc" in
+            0|4) exit 0 ;;
+            3|5) ;;  # flap/wedge — keep probing
+            *)
+                echo "$(date -u +%FT%TZ) BROKEN rc=$rc" >> /tmp/tpu_status
+                exit 1 ;;
+        esac
+        launches=$((launches + 1))
+        if [ "$launches" -ge 6 ]; then
+            echo "$(date -u +%FT%TZ) GIVE-UP after $launches flapped" \
+                 "sessions" >> /tmp/tpu_status
+            exit 1
         fi
     else
         echo "$(date -u +%FT%TZ) WEDGED" >> /tmp/tpu_status

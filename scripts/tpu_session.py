@@ -15,9 +15,12 @@ still leaves earlier results on disk, and a ~5s tunnel probe runs before
 each expensive stage so a flapped tunnel aborts the remainder instead of
 burning every timeout in sequence.
 
-Exit codes: 0 = every stage ok; 1 = tunnel wedged at session start;
-2 = partial (some stage produced results); 3 = tunnel flapped before any
-stage produced results (probe loop should resume probing).
+Exit codes (deliberately avoiding 1/2, which Python reserves for crashes
+and argparse errors — the probe loop must distinguish "relaunch later"
+from "this script is broken"): 0 = every stage ok; 5 = tunnel wedged at
+session start; 4 = partial (some stage produced results); 3 = tunnel
+flapped before any TPU stage produced results.  The probe loop resumes
+probing on 3/5, stops with results on 0/4, and aborts on anything else.
 
 Run: python scripts/tpu_session.py [--skip-sweep] [--profile]
 """
@@ -79,7 +82,7 @@ def main(argv=None):
                    args.probe_timeout)
     if rc != 0:
         print("tunnel wedged; nothing run", file=sys.stderr)
-        return 1
+        return 5
 
     # (name, cmd, timeout, env) in priority order; a tunnel-loss probe
     # before each one aborts the remainder instead of burning timeouts.
@@ -113,21 +116,20 @@ def main(argv=None):
                          "--iterations", "10", "--dtype", "bfloat16",
                          "--format", "NHWC", "--master-f32",
                          "--profile", "/tmp/tpu_trace"], 700, None))
-    # Decode LAST: token-at-a-time dispatch rides the tunnel's per-call
-    # latency — the round-5 window saw both decode stages eat their full
-    # 600s with no output while higher-value stages waited.
-    # --new-tokens 32: each decode token is a tunnel round-trip; 32 is
-    # enough for a stable ms/token after the jitted-step warmup.
+    # Decode LAST (compile-heavy, lowest marginal value after the
+    # headline).  generate() now runs the whole decode as ONE lax.scan
+    # dispatch, so tunnel latency is paid twice per pass (prefill +
+    # scan), not per token — 128 tokens amortize the prefill share.
     stages.append(
         ("decode-throughput", [sys.executable, "-m", "bigdl_tpu.models.perf",
                                "--decode", "--batch-size", "8",
-                               "--dtype", "bfloat16", "--new-tokens", "32"],
+                               "--dtype", "bfloat16", "--new-tokens", "128"],
          900, None))
     stages.append(
         ("decode-int8", [sys.executable, "-m", "bigdl_tpu.models.perf",
                          "--decode", "--batch-size", "8",
                          "--dtype", "bfloat16", "--int8",
-                         "--new-tokens", "32"], 900, None))
+                         "--new-tokens", "128"], 900, None))
 
     results = {}
     tunnel_lost = False
@@ -150,11 +152,11 @@ def main(argv=None):
         return 0
     # rc 3 ONLY when the tunnel flapped away before any TPU stage produced
     # results — the probe loop resumes probing on 3.  Persistent stage
-    # failures on a live tunnel return 2 so the loop cannot re-launch a
-    # broken session forever.
+    # failures on a live tunnel return 4 (partial) so the loop cannot
+    # re-launch a broken session forever.
     tpu_produced = any(r == 0 for n, r in results.items()
                        if n != "input-pipeline")
-    return 2 if (tpu_produced or not tunnel_lost) else 3
+    return 4 if (tpu_produced or not tunnel_lost) else 3
 
 
 if __name__ == "__main__":

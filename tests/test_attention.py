@@ -278,6 +278,29 @@ def test_generate_sampling_deterministic_with_key():
     assert a.shape == (1, 7)
 
 
+def test_generate_scan_matches_host_loop():
+    """The default one-dispatch lax.scan decode must produce the SAME
+    tokens as the one-dispatch-per-token host loop (its parity oracle) —
+    greedy, and sampled under the same key (both paths split the key
+    once per generated token)."""
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(11)
+    m = TransformerLM(32, embed_dim=16, num_heads=4, num_kv_heads=2,
+                      num_layers=2, max_len=16, use_rope=True)
+    m.evaluate()
+    prompt = jnp.asarray(np.random.RandomState(4).randint(0, 32, (2, 5)))
+    np.testing.assert_array_equal(
+        np.asarray(m.generate(prompt, 6)),
+        np.asarray(m.generate(prompt, 6, host_loop=True)))
+    key = jax.random.PRNGKey(3)
+    np.testing.assert_array_equal(
+        np.asarray(m.generate(prompt, 6, temperature=0.7, rng=key)),
+        np.asarray(m.generate(prompt, 6, temperature=0.7, rng=key,
+                              host_loop=True)))
+
+
 def test_generate_rejects_prompt_plus_tokens_over_max_len():
     from bigdl_tpu.models.transformer import TransformerLM
     from bigdl_tpu.utils import random as rnd

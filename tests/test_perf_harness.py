@@ -65,7 +65,10 @@ def test_generate_reuses_jitted_step_across_calls():
     prompt = jnp.ones((1, 4), jnp.int32)
     m.generate(prompt, 4)
     m.generate(prompt, 4)
-    step_jit, prefill_jit, _chunk_jit = m._decode_fns()
+    m.generate(prompt, 4, host_loop=True)
+    m.generate(prompt, 4, host_loop=True)
+    step_jit, prefill_jit, _chunk_jit, scan_jit = m._decode_fns()
+    assert scan_jit._cache_size() == 1, scan_jit._cache_size()
     assert step_jit._cache_size() == 1, step_jit._cache_size()
     assert prefill_jit._cache_size() == 1
 

@@ -77,6 +77,16 @@ def test_decode_step_lowers_for_tpu():
     _export(fn, args)
 
 
+def test_decode_scan_lowers_for_tpu():
+    """The one-dispatch n-token decode loop (lax.scan over the KV cache,
+    tempered sampling inside) — what generate() actually runs —
+    cross-lowers for TPU."""
+    fn, args = ep.decode_scan_program(batch=2, n_tokens=8, vocab=256,
+                                      embed_dim=64, layers=2, heads=4,
+                                      kv_heads=2, max_len=128)
+    _export(fn, args)
+
+
 def test_chunked_prefill_lowers_for_tpu():
     """The traced-offset prefill chunk (long-prompt serving path)
     cross-lowers for TPU."""
