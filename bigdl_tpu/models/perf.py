@@ -283,10 +283,11 @@ def run_decode_perf(batch_size: int = 8, prompt_len: int = 128,
         elapsed = time.perf_counter() - t0
     tok_per_sec = batch_size * new_tokens / elapsed
     # prefill-side throughput: generate(prompt, 1, host_loop=True) runs
-    # ONLY the batched prefill (the host loop samples token 1 straight
-    # from the prefill logits, zero decode steps — the scan path would
-    # add one); max_len pins the cache to the warm call's shapes so the
-    # prefill jit is a cache hit, not a recompile
+    # ONLY the batched prefill (token 1 samples straight from the prefill
+    # logits, zero decode steps on either path; host_loop avoids
+    # compiling a fresh n=1 scan program, which would turn this timing
+    # into a compile benchmark); max_len pins the cache to the warm
+    # call's shapes so the prefill jit is a cache hit, not a recompile
     t0 = time.perf_counter()
     jax.block_until_ready(model.generate(prompt, 1,
                                          max_len=prompt_len + new_tokens,

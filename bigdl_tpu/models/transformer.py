@@ -22,6 +22,7 @@ from bigdl_tpu.nn.module import Module
 # model never carries a jit wrapper through pickle)
 _DECODE_JIT = weakref.WeakKeyDictionary()
 _BEAM_JIT = weakref.WeakKeyDictionary()
+_BEAM_SCAN_JIT = weakref.WeakKeyDictionary()
 
 
 class TransformerLM(Module):
@@ -248,6 +249,100 @@ class TransformerLM(Module):
         _, toks = jax.lax.scan(body, carry, None, length=n - 1)
         return jnp.concatenate([tok0[None], toks], axis=0)
 
+    def _beam_scan_fn(self, b: int, k: int, n: int, eos_id):
+        """Cached jitted ONE-DISPATCH beam search for this (model, batch,
+        beams, length, eos). One compile (and one retained executable)
+        per distinct key — length-varying beam callers should pick a
+        fixed serving ``max_new_tokens`` or use ``host_loop=True``."""
+        per_model = _BEAM_SCAN_JIT.setdefault(self, {})
+        key = (b, k, n, eos_id)
+        fn = per_model.get(key)
+        if fn is not None:
+            return fn
+        fn = jax.jit(self._beam_scan_closure(b, k, n, eos_id),
+                     donate_argnums=(4,))
+        per_model[key] = fn
+        return fn
+
+    def _beam_scan_closure(self, b: int, k: int, n: int, eos_id):
+        """The UNJITTED one-dispatch beam-search program (shared by
+        _beam_scan_fn and the TPU-lowering export): the whole
+        select->step loop is a ``lax.scan`` emitting (token, parent)
+        pairs, and the winning sequences are materialized afterwards by
+        a reverse scan over the parent pointers — O(n*k) backtracking
+        instead of the host loop's re-gather of every prefix token each
+        step (O(n^2*k))."""
+        from bigdl_tpu.nn.module import bind
+
+        def beam_scan(p, bufs, logits, pos0, caches, length_penalty):
+            with bind(self, p, bufs, False, None):
+                v = logits.shape[-1]
+                logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+                scores, first = jax.lax.top_k(logp, k)            # (B, K)
+                first = first.astype(jnp.int32)
+                # beams share the prompt cache: tile to (B*K, ...)
+                caches = jax.tree.map(lambda c: jnp.repeat(c, k, axis=0),
+                                      caches)
+                alive = jnp.ones((b, k), bool) if eos_id is None \
+                    else first != eos_id
+                lengths = jnp.ones((b, k), jnp.float32)
+                frozen = None
+                if eos_id is not None:  # finished beams emit eos, free
+                    frozen = jnp.full((v,), -jnp.inf).at[eos_id].set(0.0)
+                ident = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32),
+                                         (b, k))
+
+                def body(carry, _):
+                    tok, gidx, scores, alive, lengths, caches, pos = carry
+                    # gather each surviving beam's cache lineage
+                    caches = jax.tree.map(
+                        lambda c: jax.vmap(lambda cb, ix: cb[ix])(
+                            c.reshape(b, k, *c.shape[1:]), gidx
+                        ).reshape(b * k, *c.shape[1:]), caches)
+                    logits, caches = self.decode_step(
+                        tok.reshape(b * k), pos, caches)
+                    logp = jax.nn.log_softmax(
+                        logits.astype(jnp.float32)).reshape(b, k, v)
+                    if eos_id is not None:
+                        logp = jnp.where(alive[..., None], logp, frozen)
+                    cand = scores[..., None] + logp               # (B, K, V)
+                    scores, flat = jax.lax.top_k(cand.reshape(b, k * v), k)
+                    parent = (flat // v).astype(jnp.int32)
+                    tok = (flat % v).astype(jnp.int32)
+                    was_alive = jnp.take_along_axis(alive, parent, axis=1)
+                    lengths = jnp.take_along_axis(lengths, parent, axis=1) \
+                        + was_alive.astype(jnp.float32)
+                    if eos_id is not None:
+                        alive = was_alive & (tok != eos_id)
+                    else:
+                        alive = was_alive
+                    return (tok, parent, scores, alive, lengths, caches,
+                            pos + 1), (tok, parent)
+
+                carry = (first, ident, scores, alive, lengths, caches,
+                         jnp.asarray(pos0, jnp.int32))
+                (_, _, scores, _, lengths, _, _), ys = jax.lax.scan(
+                    body, carry, None, length=n - 1)
+
+                # Backtrack: walk parent pointers from the final beams to
+                # the first token (reverse scan aligns outputs with steps).
+                def back(idx, y):
+                    tok_row, parent_row = y
+                    return (jnp.take_along_axis(parent_row, idx, axis=1),
+                            jnp.take_along_axis(tok_row, idx, axis=1))
+
+                idx, rev_toks = jax.lax.scan(back, ident, ys, reverse=True)
+                first_tok = jnp.take_along_axis(first, idx, axis=1)
+                gen = jnp.concatenate([first_tok[None], rev_toks], axis=0)
+                norm = scores / lengths ** length_penalty
+                best = jnp.argmax(norm, axis=1)                   # (B,)
+                gen_best = jnp.take_along_axis(
+                    gen, jnp.broadcast_to(best[None, :, None], (n, b, 1)),
+                    axis=2)[..., 0]                               # (n, B)
+                return gen_best.T
+
+        return beam_scan
+
     def _beam_step_fn(self, b: int, k: int):
         """Cached jitted beam step for this (model, batch, beams): the
         surviving-beam cache gather is folded into the donated jit."""
@@ -364,7 +459,8 @@ class TransformerLM(Module):
 
     def generate(self, prompt_ids, max_new_tokens: int,
                  temperature: float = 0.0, rng=None, max_len=None,
-                 prefill_chunk=None, host_loop: bool = False):
+                 prefill_chunk=None, host_loop: bool = False,
+                 bucket_tokens=None):
         """Autoregressive generation with a KV cache (the transformer
         analog of the reference's RecurrentDecoder, nn/RecurrentDecoder
         .scala): batched prefill over the prompt, then the ENTIRE
@@ -375,7 +471,15 @@ class TransformerLM(Module):
         (B, len(prompt) + max_new_tokens) ids. ``prefill_chunk`` bounds
         long-prompt prefill memory (see _decode_setup). ``host_loop=True``
         forces the one-dispatch-per-token path (the scan parity oracle;
-        also what a caller streaming tokens as they land would use)."""
+        also what a caller streaming tokens as they land would use).
+
+        The scan compiles once per decode length; serving callers with
+        per-request lengths should set ``bucket_tokens=B`` to round the
+        compiled length up to a multiple of B (one program per bucket,
+        not per length). The first ``max_new_tokens`` tokens are
+        IDENTICAL either way — token i depends only on steps < i and the
+        key schedule splits in token order — the tail is computed and
+        discarded."""
         from bigdl_tpu.utils import random as bt_random
 
         (prompt_ids, b, t0, params, buffers, step_jit,
@@ -387,12 +491,16 @@ class TransformerLM(Module):
         if sampled and rng is None:
             rng = bt_random.next_key()
         if not host_loop:
+            n = max_new_tokens
+            if bucket_tokens:
+                n = -(-n // bucket_tokens) * bucket_tokens
             scan_jit = self._decode_fns()[3]
             toks = scan_jit(params, buffers, logits, jnp.int32(t0), caches,
                             rng if sampled else jax.random.PRNGKey(0),
                             jnp.float32(temperature if sampled else 1.0),
-                            max_new_tokens, sampled)
-            return jnp.concatenate([prompt_ids, toks.T], axis=1)
+                            n, sampled)
+            return jnp.concatenate([prompt_ids,
+                                    toks[:max_new_tokens].T], axis=1)
         ids = [prompt_ids[:, i] for i in range(t0)]
         for i in range(max_new_tokens):
             if not sampled:
@@ -409,7 +517,8 @@ class TransformerLM(Module):
 
     def beam_search(self, prompt_ids, max_new_tokens: int,
                     num_beams: int = 4, length_penalty: float = 1.0,
-                    eos_id: Optional[int] = None, max_len=None):
+                    eos_id: Optional[int] = None, max_len=None,
+                    host_loop: bool = False):
         """Deterministic beam search over the KV-cache decoder. Returns
         (B, t0 + max_new_tokens) ids of the best beam per batch row
         (finished beams — after ``eos_id`` — are frozen and padded with
@@ -417,13 +526,21 @@ class TransformerLM(Module):
         is each beam's OWN generated length. The step that emits eos IS
         scored (its log-prob joins the sum and it counts toward L, the
         standard HF-style ranking); only the padding after it is
-        excluded."""
+        excluded. The whole select->step loop runs on device as one
+        ``lax.scan`` dispatch with parent-pointer backtracking
+        (``host_loop=True`` keeps the per-step path, its parity
+        oracle)."""
         (prompt_ids, b, t0, params, buffers, step_jit,
          logits, caches) = self._decode_setup(prompt_ids, max_new_tokens,
                                               max_len)
         if max_new_tokens == 0:
             return prompt_ids
         k = num_beams
+        if not host_loop:
+            gen = self._beam_scan_fn(b, k, max_new_tokens, eos_id)(
+                params, buffers, logits, jnp.int32(t0), caches,
+                jnp.float32(length_penalty))
+            return jnp.concatenate([prompt_ids, gen], axis=1)
         beam_step_jit = self._beam_step_fn(b, k)
 
         v = logits.shape[-1]

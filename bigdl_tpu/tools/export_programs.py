@@ -299,6 +299,26 @@ def decode_scan_program(batch: int = 8, n_tokens: int = 32,
             (params, buffers, logits, pos0, caches, rng))
 
 
+def beam_scan_program(batch: int = 4, beams: int = 4, n_tokens: int = 32,
+                      vocab: int = 32000, embed_dim: int = 512,
+                      layers: int = 8, heads: int = 8, kv_heads: int = 2,
+                      max_len: int = 2048, dtype=jnp.bfloat16):
+    """The one-dispatch scanned beam search (select->step scan +
+    parent-pointer backtracking, TransformerLM._beam_scan_fn's program)
+    — beam serving's TPU lowering."""
+    from bigdl_tpu.nn.module import bind
+
+    model, params, buffers, caches = _serving_model(
+        batch, vocab, embed_dim, layers, heads, kv_heads, max_len, dtype)
+    inner = model._beam_scan_closure(batch, beams, n_tokens, eos_id=2)
+
+    logits = jax.ShapeDtypeStruct((batch, vocab), dtype)
+    pos0 = jax.ShapeDtypeStruct((), jnp.int32)
+    lp = jax.ShapeDtypeStruct((), jnp.float32)
+    return (jax.jit(inner, donate_argnums=(4,)),
+            (params, buffers, logits, pos0, caches, lp))
+
+
 def chunked_prefill_program(batch: int = 8, chunk: int = 256,
                             vocab: int = 32000, embed_dim: int = 512,
                             layers: int = 8, heads: int = 8,

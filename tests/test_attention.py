@@ -299,6 +299,14 @@ def test_generate_scan_matches_host_loop():
         np.asarray(m.generate(prompt, 6, temperature=0.7, rng=key)),
         np.asarray(m.generate(prompt, 6, temperature=0.7, rng=key,
                               host_loop=True)))
+    # bucketed compile length: same tokens, one program per bucket
+    np.testing.assert_array_equal(
+        np.asarray(m.generate(prompt, 6, bucket_tokens=4)),
+        np.asarray(m.generate(prompt, 6)))
+    np.testing.assert_array_equal(
+        np.asarray(m.generate(prompt, 6, temperature=0.7, rng=key,
+                              bucket_tokens=4)),
+        np.asarray(m.generate(prompt, 6, temperature=0.7, rng=key)))
 
 
 def test_generate_rejects_prompt_plus_tokens_over_max_len():
@@ -436,6 +444,28 @@ def test_beam_search_improves_or_matches_sequence_logprob():
     assert beam.shape == greedy.shape == (3, 12)
     lg, lb = seq_logprob(m, greedy, 4), seq_logprob(m, beam, 4)
     assert (lb >= lg - 1e-4).all(), (lb, lg)
+
+
+def test_beam_scan_matches_host_loop():
+    """The default one-dispatch scanned beam search (parent-pointer
+    backtracking) must match the per-step host loop exactly — with and
+    without eos freezing, and under length penalties."""
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(12)
+    m = TransformerLM(32, embed_dim=16, num_heads=2, num_layers=2,
+                      max_len=24)
+    m.evaluate()
+    prompt = jnp.asarray(np.random.RandomState(5).randint(0, 32, (3, 4)))
+    for kw in [dict(num_beams=4), dict(num_beams=3, eos_id=0),
+               dict(num_beams=4, length_penalty=0.7)]:
+        np.testing.assert_array_equal(
+            np.asarray(m.beam_search(prompt, 7, **kw)),
+            np.asarray(m.beam_search(prompt, 7, host_loop=True, **kw)))
+    np.testing.assert_array_equal(  # n=1: zero-length scan edge
+        np.asarray(m.beam_search(prompt, 1, num_beams=4)),
+        np.asarray(m.beam_search(prompt, 1, num_beams=4, host_loop=True)))
 
 
 def test_beam_search_freezes_finished_beams_on_eos():

@@ -87,6 +87,16 @@ def test_decode_scan_lowers_for_tpu():
     _export(fn, args)
 
 
+def test_beam_scan_lowers_for_tpu():
+    """The one-dispatch scanned beam search (top-k reselection + cache
+    lineage gathers + parent-pointer backtracking inside one scan)
+    cross-lowers for TPU."""
+    fn, args = ep.beam_scan_program(batch=2, beams=3, n_tokens=6,
+                                    vocab=64, embed_dim=32, layers=1,
+                                    heads=4, kv_heads=2, max_len=32)
+    _export(fn, args)
+
+
 def test_chunked_prefill_lowers_for_tpu():
     """The traced-offset prefill chunk (long-prompt serving path)
     cross-lowers for TPU."""
