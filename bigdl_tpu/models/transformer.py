@@ -25,6 +25,16 @@ _BEAM_JIT = weakref.WeakKeyDictionary()
 _BEAM_SCAN_JIT = weakref.WeakKeyDictionary()
 
 
+def _gather_beam_lineage(caches, idx, b, k):
+    """Reorder (B*K, ...) KV caches so row j follows beam j's surviving
+    lineage: ``idx[b, j]`` names the parent beam whose cache the new
+    beam j extends (shared by the scanned and per-step beam paths)."""
+    return jax.tree.map(
+        lambda c: jax.vmap(lambda cb, ix: cb[ix])(
+            c.reshape(b, k, *c.shape[1:]), idx
+        ).reshape(b * k, *c.shape[1:]), caches)
+
+
 class TransformerLM(Module):
     """Decoder-only LM. Input: (batch, time) int32 token ids (0-based).
     Output: (batch, time, vocab) logits."""
@@ -294,11 +304,7 @@ class TransformerLM(Module):
 
                 def body(carry, _):
                     tok, gidx, scores, alive, lengths, caches, pos = carry
-                    # gather each surviving beam's cache lineage
-                    caches = jax.tree.map(
-                        lambda c: jax.vmap(lambda cb, ix: cb[ix])(
-                            c.reshape(b, k, *c.shape[1:]), gidx
-                        ).reshape(b * k, *c.shape[1:]), caches)
+                    caches = _gather_beam_lineage(caches, gidx, b, k)
                     logits, caches = self.decode_step(
                         tok.reshape(b * k), pos, caches)
                     logp = jax.nn.log_softmax(
@@ -353,11 +359,7 @@ class TransformerLM(Module):
         from bigdl_tpu.nn.module import bind
 
         def beam_step(p, bufs, tok, pos, caches, beam_idx):
-            caches = jax.tree.map(
-                lambda c: jax.vmap(lambda cb, ix: cb[ix])(
-                    c.reshape(b, k, *c.shape[1:]), beam_idx
-                ).reshape(b * k, *c.shape[1:]),
-                caches)
+            caches = _gather_beam_lineage(caches, beam_idx, b, k)
             with bind(self, p, bufs, False, None):
                 return self.decode_step(tok, pos, caches)
 

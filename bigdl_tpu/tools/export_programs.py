@@ -306,8 +306,6 @@ def beam_scan_program(batch: int = 4, beams: int = 4, n_tokens: int = 32,
     """The one-dispatch scanned beam search (select->step scan +
     parent-pointer backtracking, TransformerLM._beam_scan_fn's program)
     — beam serving's TPU lowering."""
-    from bigdl_tpu.nn.module import bind
-
     model, params, buffers, caches = _serving_model(
         batch, vocab, embed_dim, layers, heads, kv_heads, max_len, dtype)
     inner = model._beam_scan_closure(batch, beams, n_tokens, eos_id=2)

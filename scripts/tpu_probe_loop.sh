@@ -1,7 +1,7 @@
 #!/bin/bash
 # Probe the axon TPU tunnel every ~5 min; the moment it opens, run the
 # staged hardware session (scripts/tpu_session.py). Appends status to
-# /tmp/tpu_status.
+# /tmp/tpu_status (override: TPU_STATUS_FILE).
 #
 # Session exit-code contract (see tpu_session.py): 0 = all stages ok,
 # 4 = partial results, 3 = flap before any TPU result, 5 = wedged at
@@ -11,42 +11,62 @@
 # argparse error) means the session script itself is broken and
 # relaunching it every 5 min would burn the machine without producing
 # results.
+#
+# TPU_PROBE_CMD / TPU_SESSION_CMD / TPU_PROBE_INTERVAL / TPU_DOUBLE_GAP
+# exist so tests/test_tpu_session.py can drive this control flow with
+# fakes; production runs use the defaults.
 cd "$(dirname "$0")/.."
+STATUS="${TPU_STATUS_FILE:-/tmp/tpu_status}"
+INTERVAL="${TPU_PROBE_INTERVAL:-300}"
+GAP="${TPU_DOUBLE_GAP:-45}"
+FLAP_BACKOFF="${TPU_FLAP_BACKOFF:-120}"
 probe() {
-    timeout 45 python -c \
-        "import jax; d=jax.devices()[0]; assert d.platform != 'cpu'" \
-        2>/dev/null
+    if [ -n "$TPU_PROBE_CMD" ]; then
+        "$TPU_PROBE_CMD"
+    else
+        timeout 45 python -c \
+            "import jax; d=jax.devices()[0]; assert d.platform != 'cpu'" \
+            2>/dev/null
+    fi
+}
+session() {
+    if [ -n "$TPU_SESSION_CMD" ]; then
+        "$TPU_SESSION_CMD"
+    else
+        python scripts/tpu_session.py --profile >> /tmp/tpu_session.log 2>&1
+    fi
 }
 launches=0
 while true; do
     if probe; then
-        # Double-probe 45s apart: don't commit a full session (and its
-        # per-stage timeouts) to a tunnel that flaps within a minute.
-        sleep 45
+        # Double-probe GAP seconds apart: don't commit a full session
+        # (and its per-stage timeouts) to a tunnel that flaps within a
+        # minute.
+        sleep "$GAP"
         if ! probe; then
-            echo "$(date -u +%FT%TZ) FLAPPED" >> /tmp/tpu_status
-            sleep 120
+            echo "$(date -u +%FT%TZ) FLAPPED" >> "$STATUS"
+            sleep "$FLAP_BACKOFF"
             continue
         fi
-        echo "$(date -u +%FT%TZ) ALIVE" >> /tmp/tpu_status
-        python scripts/tpu_session.py --profile >> /tmp/tpu_session.log 2>&1
+        echo "$(date -u +%FT%TZ) ALIVE" >> "$STATUS"
+        session
         rc=$?
-        echo "$(date -u +%FT%TZ) SESSION rc=$rc" >> /tmp/tpu_status
+        echo "$(date -u +%FT%TZ) SESSION rc=$rc" >> "$STATUS"
         case "$rc" in
             0|4) exit 0 ;;
             3|5) ;;  # flap/wedge — keep probing
             *)
-                echo "$(date -u +%FT%TZ) BROKEN rc=$rc" >> /tmp/tpu_status
+                echo "$(date -u +%FT%TZ) BROKEN rc=$rc" >> "$STATUS"
                 exit 1 ;;
         esac
         launches=$((launches + 1))
         if [ "$launches" -ge 6 ]; then
             echo "$(date -u +%FT%TZ) GIVE-UP after $launches flapped" \
-                 "sessions" >> /tmp/tpu_status
+                 "sessions" >> "$STATUS"
             exit 1
         fi
     else
-        echo "$(date -u +%FT%TZ) WEDGED" >> /tmp/tpu_status
+        echo "$(date -u +%FT%TZ) WEDGED" >> "$STATUS"
     fi
-    sleep 300
+    sleep "$INTERVAL"
 done

@@ -1,0 +1,149 @@
+"""The hardware-session tooling's control flow, pinned.
+
+A tunnel window is the scarcest resource in this environment (the axon
+tunnel stayed wedged for whole rounds and has flapped 2 minutes after
+opening), so the probe-loop/session exit-code contract is load-bearing:
+a mistake here either burns a real window against dead stages or
+relaunches a broken session forever.  Contract (scripts/tpu_session.py
+docstring): 0 = all ok, 4 = partial, 3 = flap before any TPU result,
+5 = wedged at start; the probe loop retries only on 3/5 (capped),
+stops with results on 0/4, aborts otherwise.
+"""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def session_mod():
+    spec = importlib.util.spec_from_file_location(
+        "tpu_session", os.path.join(HERE, "scripts", "tpu_session.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _stage_recorder(mod, results):
+    calls = []
+
+    def run_stage(name, cmd, timeout, env=None):
+        calls.append(name)
+        return results(name)
+
+    mod.run_stage = run_stage
+    return calls
+
+
+def test_all_stages_ok_returns_0_in_priority_order(session_mod):
+    calls = _stage_recorder(session_mod, lambda name: 0)
+    session_mod.tunnel_alive = lambda timeout=50: True
+    assert session_mod.main(["--profile"]) == 0
+    assert calls == ["probe", "bench", "sweep", "flash-matrix",
+                     "input-pipeline", "profile", "decode-throughput",
+                     "decode-int8"]
+
+
+def test_wedged_at_start_returns_5(session_mod):
+    _stage_recorder(session_mod, lambda name: "timeout")
+    assert session_mod.main([]) == 5
+
+
+def test_tunnel_loss_skips_tpu_stages_but_runs_host_only(session_mod):
+    """Bench lands, tunnel dies: remaining TPU stages are skipped (not
+    burned against their timeouts), the host-only input-pipeline stage
+    still runs, and rc 4 says results exist."""
+    calls = _stage_recorder(session_mod, lambda name: 0)
+    session_mod.tunnel_alive = lambda timeout=50: False
+    assert session_mod.main(["--profile"]) == 4
+    assert calls == ["probe", "bench", "input-pipeline"]
+
+
+def test_flap_before_any_tpu_result_returns_3(session_mod):
+    calls = _stage_recorder(
+        session_mod,
+        lambda name: 0 if name in ("probe", "input-pipeline") else "timeout")
+    session_mod.tunnel_alive = lambda timeout=50: False
+    assert session_mod.main(["--skip-sweep"]) == 3
+    assert calls == ["probe", "bench", "input-pipeline"]
+
+
+def test_live_tunnel_with_failing_stages_returns_4_not_3(session_mod):
+    """Persistent stage failures on a LIVE tunnel must not read as a
+    flap — rc 3 would make the probe loop relaunch the broken session
+    forever."""
+    _stage_recorder(session_mod,
+                    lambda name: 0 if name == "probe" else 1)
+    session_mod.tunnel_alive = lambda timeout=50: True
+    assert session_mod.main(["--skip-sweep"]) == 4
+
+
+# ------------------------------------------------------- probe loop (bash)
+def _run_loop(tmp_path, probe_script, session_script, timeout=30):
+    probe = tmp_path / "probe.sh"
+    probe.write_text(probe_script)
+    probe.chmod(0o755)
+    session = tmp_path / "session.sh"
+    session.write_text(session_script)
+    session.chmod(0o755)
+    status = tmp_path / "status"
+    env = dict(os.environ, TPU_PROBE_CMD=str(probe),
+               TPU_SESSION_CMD=str(session), TPU_STATUS_FILE=str(status),
+               TPU_PROBE_INTERVAL="0.1", TPU_DOUBLE_GAP="0.1",
+               TPU_FLAP_BACKOFF="0.1", TMPDIR=str(tmp_path))
+    proc = subprocess.run(
+        ["bash", os.path.join(HERE, "scripts", "tpu_probe_loop.sh")],
+        env=env, timeout=timeout, capture_output=True)
+    lines = [ln.split(" ", 1)[1] for ln in
+             status.read_text().splitlines()] if status.exists() else []
+    return proc.returncode, lines
+
+
+def _counter_script(tmp_path, name, body):
+    """A script whose behavior depends on an invocation counter file."""
+    return f"""#!/bin/bash
+n=$(cat {tmp_path}/{name} 2>/dev/null || echo 0); n=$((n+1))
+echo $n > {tmp_path}/{name}
+{body}
+"""
+
+
+def test_probe_loop_survives_flap_and_failed_session(tmp_path):
+    """wedged -> flap (alive, dead) -> stable window whose session rc=3
+    -> next stable window rc=4: the loop must keep going through all of
+    it and stop only when results exist."""
+    probe = _counter_script(
+        tmp_path, "p",
+        # dead, alive, dead (flap), then alive forever
+        'case $n in 1|3) exit 1;; *) exit 0;; esac')
+    session = _counter_script(
+        tmp_path, "s", '[ "$n" -ge 2 ] && exit 4 || exit 3')
+    rc, lines = _run_loop(tmp_path, probe, session)
+    assert rc == 0
+    assert lines == ["WEDGED", "FLAPPED", "ALIVE", "SESSION rc=3",
+                     "ALIVE", "SESSION rc=4"]
+
+
+def test_probe_loop_aborts_on_unexpected_session_rc(tmp_path):
+    """rc 1 (python crash) / 2 (argparse error) mean the session script
+    itself is broken: relaunching it every 5 minutes forever would burn
+    the machine without results."""
+    rc, lines = _run_loop(tmp_path, "#!/bin/bash\nexit 0\n",
+                          "#!/bin/bash\nexit 1\n")
+    assert rc == 1
+    assert lines == ["ALIVE", "SESSION rc=1", "BROKEN rc=1"]
+
+
+def test_probe_loop_caps_flapped_session_relaunches(tmp_path):
+    """A tunnel that always flaps mid-session (every session exits 3)
+    must not relaunch unboundedly."""
+    rc, lines = _run_loop(tmp_path, "#!/bin/bash\nexit 0\n",
+                          "#!/bin/bash\nexit 3\n")
+    assert rc == 1
+    assert lines.count("SESSION rc=3") == 6
+    assert lines[-1].startswith("GIVE-UP")
