@@ -25,6 +25,46 @@ _BEAM_JIT = weakref.WeakKeyDictionary()
 _BEAM_SCAN_JIT = weakref.WeakKeyDictionary()
 
 
+def _filter_logits(logits, temperature, top_k, top_p):
+    """Tempered logits with standard top-k / nucleus (top-p) filtering
+    applied (in that order, HF-style) — disallowed tokens get -inf so
+    ``jax.random.categorical`` never samples them."""
+    x = logits.astype(jnp.float32) / temperature
+    v = x.shape[-1]
+    if top_k is not None and top_k < v:
+        kth = jax.lax.top_k(x, top_k)[0][..., -1:]
+        x = jnp.where(x < kth, -jnp.inf, x)
+    if top_p is not None and top_p < 1.0:
+        probs = jax.nn.softmax(x)
+        sp = jnp.sort(probs, axis=-1)[..., ::-1]
+        cum = jnp.cumsum(sp, axis=-1)
+        # smallest prefix whose mass reaches top_p; the top token is kept
+        # unconditionally (min_tokens_to_keep=1) so no top_p value can
+        # mask the whole vocabulary into a NaN distribution
+        keep = (cum - sp < top_p).at[..., 0].set(True)
+        thr = jnp.min(jnp.where(keep, sp, jnp.inf), axis=-1, keepdims=True)
+        x = jnp.where(probs < thr, -jnp.inf, x)
+    return x
+
+
+def _sample_next(logits, rng, done, sampled, temperature, eos_id,
+                 top_k, top_p):
+    """One sampling decision, shared by the scanned and host decode
+    loops (identical key schedule: exactly one split per sampled token).
+    Rows already ``done`` keep emitting ``eos_id``."""
+    if sampled:
+        rng, sub = jax.random.split(rng)
+        nxt = jax.random.categorical(
+            sub, _filter_logits(logits, temperature, top_k, top_p),
+            axis=-1).astype(jnp.int32)
+    else:
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if eos_id is not None:
+        nxt = jnp.where(done, eos_id, nxt)
+        done = done | (nxt == eos_id)
+    return nxt, rng, done
+
+
 def _gather_beam_lineage(caches, idx, b, k):
     """Reorder (B*K, ...) KV caches so row j follows beam j's surviving
     lineage: ``idx[b, j]`` names the parent beam whose cache the new
@@ -225,37 +265,49 @@ class TransformerLM(Module):
         return logits[:, 0], new_caches
 
     def decode_scan(self, logits, pos0, caches, rng, temperature, n: int,
-                    sampled: bool = False):
+                    sampled: bool = False, eos_id=None, top_k=None,
+                    top_p=None):
         """Generate ``n`` tokens ON DEVICE as one ``lax.scan`` over the KV
         cache — one dispatch for the whole decode instead of n host
         round-trips (the reference re-dispatched its RecurrentDecoder
         host loop every timestep, nn/RecurrentDecoder.scala:48).
-        ``n``/``sampled`` must be trace-static; ``temperature`` may be
-        traced. Returns (n, B) int32 tokens. Callers jit this (see
-        _decode_fns) with the caches donated — the scan's in-place cache
-        updates then never copy.
+        ``n``/``sampled``/``eos_id``/``top_k``/``top_p`` must be
+        trace-static; ``temperature`` may be traced. Returns (n, B) int32
+        tokens. Callers jit this (see _decode_fns) with the caches
+        donated — the scan's in-place cache updates then never copy.
 
         Token 0 samples straight from the prefill ``logits``; the scan
         then runs step->sample n-1 times — exactly n-1 decode steps for
         n tokens (no wasted trailing step), with one key split per
-        sampled token in token order (bit-parity with the host loop)."""
-        def sample(logits, rng):
-            if sampled:
-                rng, sub = jax.random.split(rng)
-                return jax.random.categorical(
-                    sub, logits / temperature, axis=-1
-                ).astype(jnp.int32), rng
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), rng
-
-        tok0, rng = sample(logits, rng)
+        sampled token in token order (bit-parity with the host loop).
+        With ``eos_id``, finished rows keep emitting eos and the decode
+        step is skipped entirely (``lax.cond``) once EVERY row has
+        finished — the scan still runs n-1 iterations but the remaining
+        ones cost a predicate, not a transformer forward."""
+        b, v = logits.shape
+        done = jnp.zeros((b,), bool)
+        tok0, rng, done = _sample_next(logits, rng, done, sampled,
+                                       temperature, eos_id, top_k, top_p)
 
         def body(carry, _):
-            tok, pos, caches, rng = carry
-            logits, caches = self.decode_step(tok, pos, caches)
-            nxt, rng = sample(logits, rng)
-            return (nxt, pos + 1, caches, rng), nxt
+            tok, pos, caches, rng, done = carry
+            if eos_id is not None:
+                logits, caches = jax.lax.cond(
+                    jnp.all(done),
+                    # all rows finished: skip the transformer forward;
+                    # the sampled token is overwritten with eos anyway
+                    lambda tok, pos, caches: (
+                        jnp.zeros((b, v), self.tok_embed.dtype), caches),
+                    lambda tok, pos, caches: self.decode_step(
+                        tok, pos, caches),
+                    tok, pos, caches)
+            else:
+                logits, caches = self.decode_step(tok, pos, caches)
+            nxt, rng, done = _sample_next(logits, rng, done, sampled,
+                                          temperature, eos_id, top_k, top_p)
+            return (nxt, pos + 1, caches, rng, done), nxt
 
-        carry = (tok0, jnp.asarray(pos0, jnp.int32), caches, rng)
+        carry = (tok0, jnp.asarray(pos0, jnp.int32), caches, rng, done)
         _, toks = jax.lax.scan(body, carry, None, length=n - 1)
         return jnp.concatenate([tok0[None], toks], axis=0)
 
@@ -392,19 +444,20 @@ class TransformerLM(Module):
                 return self.prefill_chunk(ids, caches, pos0)
 
         def scan_fn(p, bufs, logits, pos0, caches, rng, temperature, n,
-                    sampled):
+                    sampled, eos_id, top_k, top_p):
             # the one-dispatch n-token decode loop (see decode_scan);
-            # n/sampled static -> one compile per decode length
+            # n/sampled/eos/top-k/top-p static -> one compile per config
             with bind(self, p, bufs, False, None):
                 return self.decode_scan(logits, pos0, caches, rng,
-                                        temperature, n, sampled)
+                                        temperature, n, sampled, eos_id,
+                                        top_k, top_p)
 
         fns = (jax.jit(step, donate_argnums=(4,)),
                jax.jit(prefill_fn, donate_argnums=(3,),
                        static_argnums=(4,)),
                jax.jit(chunk_fn, donate_argnums=(3,)),
                jax.jit(scan_fn, donate_argnums=(2, 4),
-                       static_argnums=(7, 8)))
+                       static_argnums=(7, 8, 9, 10, 11)))
         _DECODE_JIT[self] = fns
         return fns
 
@@ -462,14 +515,19 @@ class TransformerLM(Module):
     def generate(self, prompt_ids, max_new_tokens: int,
                  temperature: float = 0.0, rng=None, max_len=None,
                  prefill_chunk=None, host_loop: bool = False,
-                 bucket_tokens=None):
+                 bucket_tokens=None, eos_id=None, top_k=None,
+                 top_p=None):
         """Autoregressive generation with a KV cache (the transformer
         analog of the reference's RecurrentDecoder, nn/RecurrentDecoder
         .scala): batched prefill over the prompt, then the ENTIRE
         sample->step decode loop runs on device as one ``lax.scan``
         dispatch — throughput is set by the chip, not by
         ``max_new_tokens`` host round-trips. Sampling is greedy
-        (``temperature == 0``) or from the tempered softmax. Returns
+        (``temperature == 0``) or from the tempered softmax, optionally
+        filtered by ``top_k`` and/or nucleus ``top_p`` (HF-style order).
+        With ``eos_id``, rows that emit eos keep emitting eos, and the
+        decode skips the transformer forward once every row finished
+        (the host loop breaks out entirely). Returns
         (B, len(prompt) + max_new_tokens) ids. ``prefill_chunk`` bounds
         long-prompt prefill memory (see _decode_setup). ``host_loop=True``
         forces the one-dispatch-per-token path (the scan parity oracle;
@@ -484,12 +542,21 @@ class TransformerLM(Module):
         discarded."""
         from bigdl_tpu.utils import random as bt_random
 
+        sampled = temperature > 0.0
+        if not sampled and (top_k is not None or top_p is not None):
+            raise ValueError(
+                "top_k/top_p filter the SAMPLED distribution; pass "
+                "temperature > 0 (greedy decoding would silently ignore "
+                "them)")
+        if top_k is not None and top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         (prompt_ids, b, t0, params, buffers, step_jit,
          logits, caches) = self._decode_setup(prompt_ids, max_new_tokens,
                                               max_len, prefill_chunk)
         if max_new_tokens == 0:
             return prompt_ids
-        sampled = temperature > 0.0
         if sampled and rng is None:
             rng = bt_random.next_key()
         if not host_loop:
@@ -500,18 +567,22 @@ class TransformerLM(Module):
             toks = scan_jit(params, buffers, logits, jnp.int32(t0), caches,
                             rng if sampled else jax.random.PRNGKey(0),
                             jnp.float32(temperature if sampled else 1.0),
-                            n, sampled)
+                            n, sampled, eos_id, top_k, top_p)
             return jnp.concatenate([prompt_ids,
                                     toks[:max_new_tokens].T], axis=1)
         ids = [prompt_ids[:, i] for i in range(t0)]
+        done = jnp.zeros((b,), bool)
         for i in range(max_new_tokens):
-            if not sampled:
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            else:
-                rng, sub = jax.random.split(rng)
-                nxt = jax.random.categorical(
-                    sub, logits / temperature, axis=-1).astype(jnp.int32)
+            nxt, rng, done = _sample_next(
+                logits, rng, done, sampled,
+                temperature if sampled else 1.0, eos_id, top_k, top_p)
             ids.append(nxt)
+            if eos_id is not None and bool(jnp.all(done)):
+                # every row finished: pad the rest with eos (what the
+                # scan path's done-masking emits) and stop dispatching
+                pad = jnp.full((b,), eos_id, jnp.int32)
+                ids.extend([pad] * (max_new_tokens - 1 - i))
+                break
             if i < max_new_tokens - 1:
                 logits, caches = step_jit(params, buffers, nxt,
                                           jnp.int32(t0 + i), caches)

@@ -288,9 +288,11 @@ def decode_scan_program(batch: int = 8, n_tokens: int = 32,
 
     def scan_fn(p, bufs, logits, pos0, caches, rng):
         with bind(model, p, bufs, False, None):
+            # eos + nucleus filtering included so the lowered module
+            # carries the cond-skip and the per-step vocab sort too
             return model.decode_scan(logits, pos0, caches, rng,
                                      jnp.float32(0.8), n_tokens,
-                                     sampled=True)
+                                     sampled=True, eos_id=2, top_p=0.95)
 
     logits = jax.ShapeDtypeStruct((batch, vocab), dtype)
     pos0 = jax.ShapeDtypeStruct((), jnp.int32)

@@ -309,6 +309,51 @@ def test_generate_scan_matches_host_loop():
         np.asarray(m.generate(prompt, 6, temperature=0.7, rng=key)))
 
 
+def test_generate_eos_and_sampling_filters():
+    """eos early-stop pads with eos identically on the scan and host
+    paths; top_k=1 sampling degenerates to greedy; top-k/top-p filtered
+    sampling stays scan==host bit-identical under one key."""
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(13)
+    m = TransformerLM(32, embed_dim=16, num_heads=4, num_kv_heads=2,
+                      num_layers=2, max_len=24, use_rope=True)
+    m.evaluate()
+    prompt = jnp.asarray(np.random.RandomState(7).randint(0, 32, (2, 5)))
+    greedy = m.generate(prompt, 8)
+    # pick the token every row emits first as "eos": terminates at once,
+    # so positions 1.. must all be eos on both paths
+    eos = int(np.asarray(greedy[0, 5]))
+    if not (np.asarray(greedy[:, 5]) == eos).all():
+        eos = None  # rows diverge: still exercise parity below
+    for kw in ([dict(eos_id=eos)] if eos is not None else []) + [
+            dict(temperature=0.9, top_k=4), dict(temperature=0.9, top_p=0.8),
+            dict(temperature=0.9, top_k=6, top_p=0.9, eos_id=0)]:
+        if kw.get("temperature"):
+            kw["rng"] = jax.random.PRNGKey(21)
+        a = np.asarray(m.generate(prompt, 8, **kw))
+        b_ = np.asarray(m.generate(prompt, 8, host_loop=True, **kw))
+        np.testing.assert_array_equal(a, b_), kw
+        if kw.get("eos_id") is not None:  # after first eos: all eos
+            for row in a[:, 5:]:
+                hits = np.where(row == kw["eos_id"])[0]
+                if len(hits):
+                    assert (row[hits[0]:] == kw["eos_id"]).all(), row
+    # top_k=1 == greedy regardless of temperature/key
+    np.testing.assert_array_equal(
+        np.asarray(m.generate(prompt, 8, temperature=1.3, top_k=1,
+                              rng=jax.random.PRNGKey(3))),
+        np.asarray(greedy))
+    # invalid filter configs fail loudly at the API boundary
+    with pytest.raises(ValueError, match="temperature"):
+        m.generate(prompt, 4, top_p=0.9)  # greedy would ignore the filter
+    with pytest.raises(ValueError, match="top_k"):
+        m.generate(prompt, 4, temperature=0.8, top_k=0)
+    with pytest.raises(ValueError, match="top_p"):
+        m.generate(prompt, 4, temperature=0.8, top_p=0.0)
+
+
 def test_generate_rejects_prompt_plus_tokens_over_max_len():
     from bigdl_tpu.models.transformer import TransformerLM
     from bigdl_tpu.utils import random as rnd
