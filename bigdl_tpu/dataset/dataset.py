@@ -18,11 +18,10 @@ On TPU the "executor" is a JAX process (one per TPU host): a
 
 from __future__ import annotations
 
-from typing import Iterator, List, Optional, Sequence
+from typing import Iterator, List, Sequence
 
 import numpy as np
 
-from bigdl_tpu.dataset.sample import Sample
 from bigdl_tpu.dataset.transformer import Transformer
 
 

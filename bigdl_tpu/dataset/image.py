@@ -17,7 +17,7 @@ terminal :class:`ImgToSample` emits CHW Samples.
 
 from __future__ import annotations
 
-from typing import Iterator, Optional, Sequence, Tuple
+from typing import Iterator, Optional, Sequence
 
 import numpy as np
 

@@ -8,7 +8,7 @@ constructs the wrapped nn module, and output shapes come from
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp

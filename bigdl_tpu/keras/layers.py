@@ -7,7 +7,7 @@ keras API uses). ``activation=`` strings map to nn activations.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Sequence
 
 import jax.numpy as jnp
 

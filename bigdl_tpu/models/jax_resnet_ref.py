@@ -15,7 +15,6 @@ framework-vs-raw-JAX, not framework-vs-itself.
 from __future__ import annotations
 
 import time
-from functools import partial
 
 import jax
 import jax.numpy as jnp

@@ -17,8 +17,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from bigdl_tpu.nn import init as bt_init
-from bigdl_tpu.nn.module import Module, in_pure_bind
+from bigdl_tpu.nn.module import Module
 from bigdl_tpu.nn.linear import Linear
 from bigdl_tpu.nn.dropout import Dropout
 

@@ -22,7 +22,7 @@ the reference's Scheduler/FrameManager.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Union
+from typing import Dict, List, Sequence, Union
 
 import jax
 

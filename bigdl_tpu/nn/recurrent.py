@@ -19,7 +19,7 @@ Batch-first layout ``(batch, time, ...)`` matches the reference's
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp

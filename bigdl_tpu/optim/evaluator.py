@@ -10,8 +10,7 @@ from typing import List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from bigdl_tpu.dataset.dataset import AbstractDataSet, LocalDataSet
-from bigdl_tpu.dataset.minibatch import MiniBatch
+from bigdl_tpu.dataset.dataset import LocalDataSet
 from bigdl_tpu.dataset.sample import Sample
 from bigdl_tpu.dataset.transformer import SampleToMiniBatch
 from bigdl_tpu.nn.module import Module, pure_apply

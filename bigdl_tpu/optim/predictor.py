@@ -8,8 +8,7 @@ returns 1-based indices (Appendix B.1)."""
 
 from __future__ import annotations
 
-import threading
-from typing import List, Optional
+from typing import List
 
 import jax
 import jax.numpy as jnp

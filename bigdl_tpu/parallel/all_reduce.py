@@ -15,7 +15,7 @@ truncation, TPU's native dtype — reproduced by ``compress_dtype=bfloat16``.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+from typing import Any, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -29,7 +29,6 @@ from __future__ import annotations
 import logging
 import os
 import time
-from functools import partial
 from typing import Optional
 
 import jax

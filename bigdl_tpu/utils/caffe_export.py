@@ -15,7 +15,6 @@ SpatialBatchNormalization (Scale with folded stats, inference-only).
 
 from __future__ import annotations
 
-import struct
 from typing import List
 
 import numpy as np

@@ -22,7 +22,7 @@ import io
 import json
 import os
 import zipfile
-from typing import Any, Dict
+from typing import Dict
 
 import jax.numpy as jnp
 import numpy as np

@@ -15,8 +15,7 @@ at export. Layout-free models (MLPs) are unaffected.
 
 from __future__ import annotations
 
-import struct
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 import numpy as np
 
