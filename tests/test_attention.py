@@ -382,6 +382,28 @@ def test_generate_data_parallel_on_mesh():
     assert got.sharding.spec == P("data", None)
 
 
+def test_generate_tensor_parallel_on_mesh():
+    """Megatron-style TP serving: load the LM's params back SHARDED over
+    the 8-way model axis (column/row split via transformer_tp_rules) and
+    generate() must still produce the single-device tokens — GSPMD
+    places the per-layer collectives; no decode-specific TP code."""
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(15)
+    m = TransformerLM(32, embed_dim=32, num_heads=8, num_layers=2,
+                      max_len=16, use_rope=True)
+    m.evaluate()
+    prompt = jnp.asarray(np.random.RandomState(9).randint(0, 32, (2, 5)))
+    want = np.asarray(m.generate(prompt, 6))
+
+    mesh = Engine.create_mesh([("model", 8)])
+    m.load_params_dict(shard_params(m.params_dict(), mesh,
+                                    transformer_tp_rules()))
+    got = m.generate(prompt, 6)
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
 def test_generate_rejects_prompt_plus_tokens_over_max_len():
     from bigdl_tpu.models.transformer import TransformerLM
     from bigdl_tpu.utils import random as rnd
