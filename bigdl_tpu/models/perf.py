@@ -238,7 +238,8 @@ def run_decode_perf(batch_size: int = 8, prompt_len: int = 128,
                     embed_dim: int = 512, layers: int = 8, heads: int = 8,
                     num_kv_heads: Optional[int] = None,
                     use_rope: bool = True, dtype=jnp.bfloat16,
-                    int8: bool = False,
+                    int8: bool = False, speculative: int = 0,
+                    spec_gamma: int = 4,
                     profile_dir: Optional[str] = None, log=print) -> dict:
     """Serving-side throughput: KV-cache autoregressive decode tokens/sec.
     generate() keeps its jitted prefill/step per model instance, so the
@@ -246,18 +247,40 @@ def run_decode_perf(batch_size: int = 8, prompt_len: int = 128,
     from bigdl_tpu.models.transformer import TransformerLM
 
     on_cpu = jax.devices()[0].platform == "cpu"
-    if on_cpu:  # keep the CPU smoke tractable
+    if on_cpu:  # keep the CPU smoke tractable (clamp EVERY knob, so any
+        # documented TPU invocation still runs as a smoke)
         vocab, embed_dim, layers, heads = 256, 64, 2, 4
         prompt_len, new_tokens = min(prompt_len, 16), min(new_tokens, 16)
+        if speculative:
+            speculative = min(speculative, layers - 1)
+    if speculative and int8:
+        raise ValueError("--speculative builds its draft from the float "
+                         "params; combine with --int8 is not supported")
+    if speculative and speculative >= layers:
+        raise ValueError(f"--speculative draft layers ({speculative}) must "
+                         f"be < target layers ({layers})")
+    max_len = prompt_len + new_tokens + (spec_gamma if speculative else 0)
     model = TransformerLM(vocab, embed_dim=embed_dim, num_heads=heads,
                           num_layers=layers, num_kv_heads=num_kv_heads,
-                          max_len=prompt_len + new_tokens, use_rope=use_rope)
+                          max_len=max_len, use_rope=use_rope)
     model.evaluate()
     if dtype != jnp.float32:
         # bf16 params ALSO give a bf16 KV cache (generate derives the
         # cache dtype from the params) — the bandwidth that decode is
         # actually bound by
         model.load_params_dict(_cast_floating(model.params_dict(), dtype))
+    draft = None
+    if speculative:
+        # truncated-depth draft sharing the target's first k blocks and
+        # embeddings (early-exit style): real acceptance rates without a
+        # separately trained draft
+        draft = TransformerLM(vocab, embed_dim=embed_dim, num_heads=heads,
+                              num_layers=speculative,
+                              num_kv_heads=num_kv_heads,
+                              max_len=max_len, use_rope=use_rope)
+        draft.evaluate()
+        tp = model.params_dict()
+        draft.load_params_dict({k: tp[k] for k in draft.params_dict()})
     if int8:
         # post-training int8: every Linear swaps to the int8 kernel —
         # weight HBM traffic halves vs bf16 (the term decode is bound
@@ -303,9 +326,31 @@ def run_decode_perf(batch_size: int = 8, prompt_len: int = 128,
              batch_size * prompt_len / max(prefill_s, 1e-9), 1),
          "ms_per_token": round(1000.0 * elapsed
                                / (batch_size * new_tokens), 3)}
+    if draft is not None:
+        # same tokens as plain greedy (exactness tested); what changes is
+        # how many target forwards it takes — report the measured ratio
+        jax.block_until_ready(model.speculative_generate(
+            prompt, new_tokens, draft=draft, gamma=spec_gamma))  # compile
+        t0 = time.perf_counter()
+        _, st = model.speculative_generate(prompt, new_tokens, draft=draft,
+                                           gamma=spec_gamma,
+                                           return_stats=True)
+        spec_s = time.perf_counter() - t0
+        s.update({
+            "speculative_draft_layers": speculative,
+            "spec_gamma": spec_gamma,
+            "spec_tokens_per_sec": round(
+                batch_size * new_tokens / spec_s, 2),
+            "spec_rounds": st["rounds"],
+            "spec_accept_rate": round(st["accept_rate"], 3),
+            "spec_vs_plain": round(elapsed / spec_s, 3),
+        })
     log(f"[perf] decode batch={batch_size} prompt={prompt_len} "
         f"new={new_tokens}: {tok_per_sec:.0f} tokens/s decode, "
-        f"{s['prefill_tokens_per_sec']:.0f} tokens/s prefill")
+        f"{s['prefill_tokens_per_sec']:.0f} tokens/s prefill"
+        + (f"; speculative {s['spec_tokens_per_sec']:.0f} tokens/s "
+           f"({s['spec_vs_plain']:.2f}x, accept "
+           f"{s['spec_accept_rate']:.0%})" if draft is not None else ""))
     return s
 
 
@@ -452,6 +497,12 @@ def main(argv=None):
     p.add_argument("--new-tokens", type=int, default=128,
                    help="--decode: generated tokens per pass (lower it on "
                         "the axon tunnel — each token is one round-trip)")
+    p.add_argument("--speculative", type=int, default=0, metavar="K",
+                   help="--decode: also time greedy speculative decoding "
+                        "with a K-layer truncated-depth draft (exact "
+                        "tokens; reports accept rate + speedup)")
+    p.add_argument("--spec-gamma", type=int, default=4,
+                   help="--speculative: draft proposals per round")
     args = p.parse_args(argv)
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     if args.input_pipeline:
@@ -472,7 +523,9 @@ def main(argv=None):
         s = run_decode_perf(batch_size=args.batch_size, dtype=dtype,
                             prompt_len=args.prompt_len,
                             new_tokens=args.new_tokens,
-                            int8=args.int8, profile_dir=args.profile)
+                            int8=args.int8, speculative=args.speculative,
+                            spec_gamma=args.spec_gamma,
+                            profile_dir=args.profile)
         s["device"] = str(getattr(jax.devices()[0], "device_kind",
                                   jax.devices()[0].platform))
         _append_rows_to_history([s])

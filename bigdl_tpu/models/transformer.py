@@ -23,6 +23,7 @@ from bigdl_tpu.nn.module import Module
 _DECODE_JIT = weakref.WeakKeyDictionary()
 _BEAM_JIT = weakref.WeakKeyDictionary()
 _BEAM_SCAN_JIT = weakref.WeakKeyDictionary()
+_SPEC_JIT = weakref.WeakKeyDictionary()
 
 
 def _filter_logits(logits, temperature, top_k, top_p):
@@ -225,7 +226,16 @@ class TransformerLM(Module):
         a traced offset cannot be bounds-checked at trace time)."""
         return self._prefill_impl(ids, caches, pos0, chunked=True)
 
-    def _prefill_impl(self, ids, caches, pos0, chunked: bool):
+    def verify_chunk(self, ids, caches, pos0):
+        """Chunked forward (traced ``pos0``) returning logits at EVERY
+        chunk position, (B, T, V) — the speculative-decoding verifier:
+        one pass scores all draft proposals at once. Writes the chunk
+        tokens' KV like prefill_chunk (same caller contract)."""
+        return self._prefill_impl(ids, caches, pos0, chunked=True,
+                                  all_logits=True)
+
+    def _prefill_impl(self, ids, caches, pos0, chunked: bool,
+                      all_logits: bool = False):
         b, t = ids.shape
         x = jnp.take(self.tok_embed, ids, axis=0)
         if not self.use_rope:
@@ -238,11 +248,14 @@ class TransformerLM(Module):
             x, c = (blk.forward_chunk(x, caches[i], pos0) if chunked
                     else blk.forward_prefill(x, caches[i], pos0))
             new_caches.append(c)
-        x = self.ln_f(x[:, -1:])
+        x = self.ln_f(x if all_logits else x[:, -1:])
         if self.tie_embeddings:
             logits = jnp.einsum("btc,vc->btv", x, self.tok_embed)
         else:
-            logits = self.head(x.reshape(b, -1))[:, None, :]
+            logits = self.head(x.reshape(-1, x.shape[-1])).reshape(
+                b, x.shape[1], -1)
+        if all_logits:
+            return logits, new_caches
         return logits[:, 0], new_caches
 
     def decode_step(self, ids_t, pos, caches):
@@ -587,6 +600,146 @@ class TransformerLM(Module):
                 logits, caches = step_jit(params, buffers, nxt,
                                           jnp.int32(t0 + i), caches)
         return jnp.stack(ids, axis=1)
+
+    def _propose_fn(self, b: int, gamma: int):
+        """Cached jitted draft proposer: gamma greedy step->argmax
+        iterations as ONE lax.scan dispatch, writing the input tokens' KV
+        as it goes. Returns ((gamma, B) proposals, caches)."""
+        per_model = _SPEC_JIT.setdefault(self, {})
+        key = ("propose", b, gamma)
+        fn = per_model.get(key)
+        if fn is not None:
+            return fn
+        from bigdl_tpu.nn.module import bind
+
+        def propose(p, bufs, tok, pos0, caches):
+            with bind(self, p, bufs, False, None):
+                def body(carry, _):
+                    tok, pos, caches = carry
+                    logits, caches = self.decode_step(tok, pos, caches)
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    return (nxt, pos + 1, caches), nxt
+
+                carry = (tok, jnp.asarray(pos0, jnp.int32), caches)
+                (_, _, caches), toks = jax.lax.scan(body, carry, None,
+                                                    length=gamma)
+                return toks, caches
+
+        fn = jax.jit(propose, donate_argnums=(4,))
+        per_model[key] = fn
+        return fn
+
+    def _verify_fn(self, b: int, chunk_len: int):
+        """Cached jitted speculative verifier for this (model, batch,
+        chunk): one chunked forward scoring every proposed position."""
+        per_model = _SPEC_JIT.setdefault(self, {})
+        fn = per_model.get((b, chunk_len))
+        if fn is not None:
+            return fn
+        from bigdl_tpu.nn.module import bind
+
+        def verify(p, bufs, chunk, caches, pos0):
+            with bind(self, p, bufs, False, None):
+                return self.verify_chunk(chunk, caches, pos0)
+
+        fn = jax.jit(verify, donate_argnums=(3,))
+        per_model[(b, chunk_len)] = fn
+        return fn
+
+    def speculative_generate(self, prompt_ids, max_new_tokens: int,
+                             draft, gamma: int = 4, max_len=None,
+                             return_stats: bool = False):
+        """Greedy speculative decoding: ``draft`` (a smaller, cheaper
+        TransformerLM over the same vocabulary — an int8-quantized clone
+        works) proposes ``gamma`` tokens per round with its own KV cache;
+        this model then scores ALL of them in ONE chunked verify forward
+        (``verify_chunk``, traced offset) and accepts the longest prefix
+        that matches its own greedy choice, taking its own token at the
+        first mismatch. Output is therefore EXACTLY this model's greedy
+        ``generate()`` — the draft only changes how many target forwards
+        it takes to get there: per round, 1 target chunk forward yields
+        accepted+1 tokens instead of 1.
+
+        Acceptance is conservative across the batch (min over rows), so
+        every returned row is still exact. Returns (B, t0 + n) ids, or
+        ``(ids, {"rounds", "accept_rate"})`` with ``return_stats=True``.
+
+        Reference analog: none (the reference has no speculative path);
+        technique per Leviathan et al. 2023, greedy specialization."""
+        prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+        if prompt_ids.ndim == 1:
+            prompt_ids = prompt_ids[None]
+        b, t0 = prompt_ids.shape
+        n = max_new_tokens
+        if n == 0:
+            return (prompt_ids, {"rounds": 0, "accept_rate": 0.0}) \
+                if return_stats else prompt_ids
+        ctx = min(self.max_len, draft.max_len)
+        if max_len is not None:
+            ctx = min(ctx, max_len)
+        # highest position any round writes: a round starts with pos <=
+        # t0+n-2 (the loop runs only while len(out) < n), and both the
+        # verify chunk and the full-acceptance fill-in write up to
+        # pos+gamma — so gamma <= ctx-t0-n+1 keeps every write in bounds
+        gamma = min(gamma, ctx - t0 - n + 1)
+        if t0 + n > ctx or gamma < 1:
+            ids = self.generate(prompt_ids, n, max_len=max_len)
+            return (ids, {"rounds": n, "accept_rate": 0.0}) \
+                if return_stats else ids
+
+        t_params, t_bufs = self.params_dict(), self.buffers_dict()
+        d_params, d_bufs = draft.params_dict(), draft.buffers_dict()
+        t_prefill = self._decode_fns()[1]
+        d_prefill = draft._decode_fns()[1]
+        d_step = draft._decode_fns()[0]
+        d_propose = draft._propose_fn(b, gamma)
+        verify = self._verify_fn(b, gamma + 1)
+
+        t_caches = self.init_cache(b, ctx, dtype=self.tok_embed.dtype)
+        d_caches = draft.init_cache(b, ctx, dtype=draft.tok_embed.dtype)
+        t_logits, t_caches = t_prefill(t_params, t_bufs, prompt_ids,
+                                       t_caches)
+        _, d_caches = d_prefill(d_params, d_bufs, prompt_ids, d_caches)
+
+        next_tok = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # @ t0
+        out = [next_tok]
+        pos = t0            # next_tok's position; its KV is not yet cached
+        rounds = accepted = 0
+        while len(out) < n:
+            # draft proposes gamma tokens in ONE dispatch (lax.scan),
+            # writing KV for positions pos .. pos+gamma-1 (its inputs)
+            toks, d_caches = d_propose(d_params, d_bufs, next_tok,
+                                       jnp.int32(pos), d_caches)
+            props = toks.T                                     # (B, g)
+            # one target forward scores positions pos .. pos+gamma:
+            # chunk token j sits at position pos+j; logits row j predicts
+            # the token AT position pos+j+1
+            chunk = jnp.concatenate([next_tok[:, None], props], axis=1)
+            v_logits, t_caches = verify(t_params, t_bufs, chunk, t_caches,
+                                        jnp.int32(pos))
+            v_tok = jnp.argmax(v_logits, axis=-1).astype(jnp.int32)
+            # longest prefix where the draft matched the target's greedy
+            # choice, conservative across rows (min) so rows stay exact
+            match = (props == v_tok[:, :gamma]).astype(jnp.int32)
+            a = int(jnp.min(jnp.sum(jnp.cumprod(match, axis=1), axis=1)))
+            out.extend(props[:, j] for j in range(a))
+            out.append(v_tok[:, a])     # target's token at pos+a+1 (bonus)
+            if a == gamma:
+                # full acceptance: proposals[-1] (position pos+gamma) was
+                # never fed through the draft — write its KV so the next
+                # round's draft attention sees a complete cache
+                _, d_caches = d_step(d_params, d_bufs, props[:, -1],
+                                     jnp.int32(pos + gamma), d_caches)
+            next_tok = v_tok[:, a]
+            pos += a + 1
+            rounds += 1
+            accepted += a
+        ids = jnp.concatenate(
+            [prompt_ids, jnp.stack(out[:n], axis=1)], axis=1)
+        if return_stats:
+            return ids, {"rounds": rounds,
+                         "accept_rate": accepted / max(rounds * gamma, 1)}
+        return ids
 
     def beam_search(self, prompt_ids, max_new_tokens: int,
                     num_beams: int = 4, length_penalty: float = 1.0,

@@ -404,6 +404,76 @@ def test_generate_tensor_parallel_on_mesh():
     np.testing.assert_array_equal(np.asarray(got), want)
 
 
+class TestSpeculativeDecoding:
+    """speculative_generate must equal target greedy generate() EXACTLY
+    regardless of the draft — the draft only changes the round count."""
+
+    def _target(self, seed=16, max_len=48):
+        from bigdl_tpu.models.transformer import TransformerLM
+        from bigdl_tpu.utils import random as rnd
+
+        rnd.set_seed(seed)
+        m = TransformerLM(32, embed_dim=16, num_heads=4, num_kv_heads=2,
+                          num_layers=2, max_len=max_len, use_rope=True)
+        m.evaluate()
+        return m
+
+    def test_self_draft_always_accepts(self):
+        m = self._target()
+        prompt = jnp.asarray(np.random.RandomState(10).randint(0, 32, (2, 5)))
+        want = np.asarray(m.generate(prompt, 12))
+        # count verify rounds: with draft == target every proposal is
+        # accepted, so each round yields gamma+1 tokens -> ceil(11/5)
+        # rounds instead of 12 sequential target steps
+        real = m._verify_fn(2, 5)
+        calls = []
+
+        def counting(*a):
+            calls.append(1)
+            return real(*a)
+
+        m._verify_fn = lambda b, c: counting
+        try:
+            got = np.asarray(m.speculative_generate(prompt, 12, draft=m,
+                                                    gamma=4))
+        finally:
+            del m._verify_fn  # restore the class method
+        np.testing.assert_array_equal(got, want)
+        assert len(calls) == 3, calls  # 1 prefill token + 3x(4+1) >= 12
+
+    def test_unrelated_draft_still_exact(self):
+        m = self._target()
+        d = self._target(seed=99)  # different weights: rarely accepts
+        prompt = jnp.asarray(np.random.RandomState(11).randint(0, 32, (3, 4)))
+        np.testing.assert_array_equal(
+            np.asarray(m.speculative_generate(prompt, 10, draft=d, gamma=3)),
+            np.asarray(m.generate(prompt, 10)))
+
+    def test_quantized_draft_exact(self):
+        from bigdl_tpu.nn.quantized import Quantizer
+
+        m = self._target(seed=17)
+        d = Quantizer.quantize(m)
+        d.evaluate()
+        prompt = jnp.asarray(np.random.RandomState(12).randint(0, 32, (2, 6)))
+        np.testing.assert_array_equal(
+            np.asarray(m.speculative_generate(prompt, 9, draft=d, gamma=4)),
+            np.asarray(m.generate(prompt, 9)))
+
+    def test_tight_context_shrinks_gamma_and_stays_exact(self):
+        m = self._target(max_len=12)
+        prompt = jnp.asarray([[1, 2, 3, 4]])
+        # t0 + n == max_len: one slack position left -> gamma shrinks to
+        # 1 (the cap is ctx - t0 - n + 1) and the output stays exact
+        np.testing.assert_array_equal(
+            np.asarray(m.speculative_generate(prompt, 8, draft=m, gamma=4)),
+            np.asarray(m.generate(prompt, 8)))
+        # explicit gamma=0 falls back to the plain greedy path
+        np.testing.assert_array_equal(
+            np.asarray(m.speculative_generate(prompt, 8, draft=m, gamma=0)),
+            np.asarray(m.generate(prompt, 8)))
+
+
 def test_generate_rejects_prompt_plus_tokens_over_max_len():
     from bigdl_tpu.models.transformer import TransformerLM
     from bigdl_tpu.utils import random as rnd
