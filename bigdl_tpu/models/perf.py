@@ -396,14 +396,16 @@ def run_input_pipeline_perf(batch_size: int = 64, n_records: int = 512,
 
         write_record_shards(gen(), d, num_shards=shards)
 
-        aug = (RandomCrop(crop, crop) >> HFlip()
-               >> ChannelNormalize([123.68, 116.779, 103.939],
-                                   [58.393, 57.12, 57.375]))
+        MEANS = [123.68, 116.779, 103.939]
+        STDS = [58.393, 57.12, 57.375]
+        composed_aug = (RandomCrop(crop, crop) >> HFlip()
+                        >> ChannelNormalize(MEANS, STDS))
 
-        def sample_stream():
+        def sample_stream(aug):
             ds = RecordFileDataSet(d, num_shards=1, shard_id=0)
             src = ds.data(train=True)  # infinite shuffled walk
-            feats = (ImageFeature(next(src).feature(), label=None)
+            feats = (ImageFeature(next(src).feature(), label=None,
+                                  preserve_dtype=True)
                      for _ in range(n_used))
             for f in aug(feats):
                 yield Sample(f.image(), np.float32(1.0))
@@ -414,6 +416,30 @@ def run_input_pipeline_perf(batch_size: int = 64, n_records: int = 512,
                 return jax.device_put(x, sharding)
             return jnp.asarray(x)
 
+        def run_config(aug, use_native, depth, fused):
+            batches = SampleToMiniBatch(batch_size)(sample_stream(aug))
+            it = (prefetch(batches, buffer_size=depth,
+                           transfer=to_device) if depth > 0
+                  else (to_device(b) for b in batches))
+            t0 = time.perf_counter()
+            seen = 0
+            for x in it:
+                x.block_until_ready()
+                seen += x.shape[0]
+            elapsed = time.perf_counter() - t0
+            row = {"mode": "input_pipeline",
+                   "native_reader": bool(use_native),
+                   "fused_augment": bool(fused),
+                   "prefetch_depth": depth,
+                   "batch_size": batch_size,
+                   "records": seen,
+                   "image": image, "crop": crop,
+                   "records_per_sec": round(seen / elapsed, 1),
+                   "time_s": round(elapsed, 3)}
+            results.append(row)
+            log(f"[pipeline] native={use_native} fused={fused} "
+                f"depth={depth}: {row['records_per_sec']:.0f} records/s")
+
         for use_native in native_modes:
             if use_native and not native_mod.native_available():
                 log("[pipeline] native reader unavailable; skipping")
@@ -423,29 +449,22 @@ def run_input_pipeline_perf(batch_size: int = 64, n_records: int = 512,
                 native_mod.get_lib = lambda: None
             try:
                 for depth in depths:
-                    batches = SampleToMiniBatch(batch_size)(sample_stream())
-                    it = (prefetch(batches, buffer_size=depth,
-                                   transfer=to_device) if depth > 0
-                          else (to_device(b) for b in batches))
-                    t0 = time.perf_counter()
-                    seen = 0
-                    for x in it:
-                        x.block_until_ready()
-                        seen += x.shape[0]
-                    elapsed = time.perf_counter() - t0
-                    row = {"mode": "input_pipeline",
-                           "native_reader": bool(use_native),
-                           "prefetch_depth": depth,
-                           "batch_size": batch_size,
-                           "records": seen,
-                           "image": image, "crop": crop,
-                           "records_per_sec": round(seen / elapsed, 1),
-                           "time_s": round(elapsed, 3)}
-                    results.append(row)
-                    log(f"[pipeline] native={use_native} depth={depth}: "
-                        f"{row['records_per_sec']:.0f} records/s")
+                    run_config(composed_aug, use_native, depth, fused=False)
             finally:
                 native_mod.get_lib = orig_get_lib
+
+        # the fused one-pass augment (native/augment.cc): same semantics
+        # as the composed chain (flip_prob=1.0 ≙ the always-flip HFlip),
+        # one pixel walk instead of three
+        if native_mod.fused_augment_available():
+            from bigdl_tpu.transform.vision import FusedCropFlipNormalize
+
+            fused_aug = FusedCropFlipNormalize(crop, crop, MEANS, STDS,
+                                               flip_prob=1.0)
+            for depth in depths:
+                run_config(fused_aug, True, depth, fused=True)
+        else:
+            log("[pipeline] fused augment unavailable; skipping")
     return results
 
 

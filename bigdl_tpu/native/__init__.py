@@ -46,9 +46,11 @@ def get_lib():
             return _lib
         _tried = True
         path = os.path.join(_HERE, _LIB_NAME)
-        if not os.path.exists(path) and not _build():
-            return None
-        if not os.path.exists(path):
+        # always offer make a chance: it is a no-op when the .so is newer
+        # than the sources, and it rebuilds a stale .so that predates a
+        # newly added entry point (the load below would otherwise bind a
+        # library missing symbols)
+        if not _build() and not os.path.exists(path):
             return None
         try:
             lib = ctypes.CDLL(path)
@@ -72,6 +74,19 @@ def get_lib():
             ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int)]
         lib.bigdl_loader_free.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.bigdl_loader_destroy.argtypes = [ctypes.c_void_p]
+        try:  # absent from .so files built before augment.cc existed
+            lib.bigdl_fused_augment.restype = None
+            lib.bigdl_fused_augment.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # h, w, c
+                ctypes.c_int64, ctypes.c_int64,                  # top, left
+                ctypes.c_int64, ctypes.c_int64,                  # ch, cw
+                ctypes.c_int,                                    # flip
+                ctypes.POINTER(ctypes.c_float),                  # mean
+                ctypes.POINTER(ctypes.c_float),                  # 1/std
+                ctypes.POINTER(ctypes.c_float)]                  # out
+        except AttributeError:
+            pass
         _lib = lib
         return _lib
 
@@ -213,3 +228,38 @@ class PrefetchReader:
             self.close()
         except Exception:
             pass
+
+
+# ---------------------------------------------------------- fused augment
+def fused_augment_available() -> bool:
+    lib = get_lib()
+    return lib is not None and hasattr(lib, "bigdl_fused_augment") \
+        and getattr(lib.bigdl_fused_augment, "argtypes", None) is not None
+
+
+def fused_augment(img, top: int, left: int, crop_h: int, crop_w: int,
+                  flip: bool, means, inv_stds):
+    """One-pass native crop+flip+normalize: (h, w, c) uint8 C-contiguous
+    -> (crop_h, crop_w, c) float32. Caller guarantees the crop window is
+    in bounds and len(means) == c. Returns None when the native kernel
+    is unavailable or the input does not qualify (caller falls back to
+    the composed numpy ops)."""
+    import numpy as np
+
+    lib = get_lib()
+    if not fused_augment_available():
+        return None
+    if (img.dtype != np.uint8 or img.ndim != 3
+            or not img.flags.c_contiguous):
+        return None
+    h, w, c = img.shape
+    out = np.empty((crop_h, crop_w, c), np.float32)
+    mean = np.ascontiguousarray(means, np.float32)
+    inv = np.ascontiguousarray(inv_stds, np.float32)
+    lib.bigdl_fused_augment(
+        img.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        h, w, c, top, left, crop_h, crop_w, int(bool(flip)),
+        mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        inv.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    return out

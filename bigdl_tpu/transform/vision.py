@@ -37,10 +37,17 @@ class ImageFeature(dict):
     classes = "classes"    # (n,) ROI labels
 
     def __init__(self, image: Optional[np.ndarray] = None, label=None,
-                 uri: str = None, **kw):
+                 uri: str = None, preserve_dtype: bool = False, **kw):
+        """``preserve_dtype=True`` keeps the source dtype (e.g. uint8
+        from a record shard) instead of the default float32 promotion —
+        the reference's OpenCVMat holds uint8 until MatToFloats, and the
+        native fused augment path needs the raw bytes: cropping 256²
+        uint8 then converting 224² beats converting 256² f32 up front
+        (4x the traffic) and slicing that."""
         super().__init__()
         if image is not None:
-            image = np.asarray(image, np.float32)
+            image = (np.asarray(image) if preserve_dtype
+                     else np.asarray(image, np.float32))
             self[self.mat] = image
             self[self.original_size] = image.shape
             self[self.size] = image.shape
@@ -398,6 +405,58 @@ class Crop(FeatureTransformer):
             b[:, 0::2] = np.clip(b[:, 0::2], 0, x2 - x1)
             b[:, 1::2] = np.clip(b[:, 1::2], 0, y2 - y1)
             f[ImageFeature.boxes] = b
+        return f
+
+
+class FusedCropFlipNormalize(FeatureTransformer):
+    """RandomCrop + random HFlip + ChannelNormalize as ONE pass over the
+    pixels via the native kernel (native/augment.cc): uint8 HWC in,
+    float32 HWC out, no intermediates. On a CPU-bound feed host the
+    augment chain is the pipeline bottleneck (PERF.md input-pipeline
+    table), so fusing it is the reference's MTLabeledBGRImgToBatch
+    engineering point (≙ dataset/image/MTLabeledBGRImgToBatch.scala)
+    applied to the hot path. Falls back to the composed numpy ops
+    (bit-identical, tested) without the native library or for
+    non-uint8/non-contiguous inputs."""
+
+    def __init__(self, crop_h: int, crop_w: int, means: Sequence[float],
+                 stds: Sequence[float] = None, flip_prob: float = 0.5,
+                 seed: int = 1):
+        self.crop_h, self.crop_w = crop_h, crop_w
+        self.means = np.asarray(means, np.float32)
+        self.stds = np.asarray(stds if stds is not None
+                               else [1.0] * len(means), np.float32)
+        # both paths multiply by the same f32 reciprocal, so the numpy
+        # fallback is bit-identical to the native kernel
+        self._inv_stds = (np.float32(1.0) / self.stds).astype(np.float32)
+        self.flip_prob = flip_prob
+        self._rng = np.random.RandomState(seed)
+
+    def transform(self, f: ImageFeature) -> ImageFeature:
+        from bigdl_tpu import native
+
+        img = f.image()
+        h, w = img.shape[:2]
+        top = self._rng.randint(0, max(1, h - self.crop_h + 1))
+        left = self._rng.randint(0, max(1, w - self.crop_w + 1))
+        # deterministic flip probs consume no randomness, so the crop rng
+        # stream stays aligned with a seed-matched RandomCrop chain
+        flip = (self.flip_prob >= 1.0 or
+                (self.flip_prob > 0.0 and self._rng.rand() < self.flip_prob))
+        out = None
+        if (img.ndim == 3 and img.shape[2] == len(self.means)
+                and h >= self.crop_h and w >= self.crop_w):
+            # undersized images fall through: the kernel trusts the crop
+            # window and would read past the buffer
+            out = native.fused_augment(img, top, left, self.crop_h,
+                                       self.crop_w, flip, self.means,
+                                       self._inv_stds)
+        if out is None:  # numpy fallback, bit-identical (same reciprocal)
+            crop = img[top:top + self.crop_h, left:left + self.crop_w]
+            if flip:
+                crop = crop[:, ::-1]
+            out = ((crop.astype(np.float32) - self.means) * self._inv_stds)
+        f.set_image(out)
         return f
 
 

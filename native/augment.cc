@@ -1,0 +1,39 @@
+// Fused crop + horizontal-flip + channel-normalize, one pass over the
+// pixels: uint8 HWC in, float32 HWC out. The Python augment chain
+// (RandomCrop -> HFlip -> ChannelNormalize, transform/vision.py) walks
+// the image three times and allocates two intermediates; on a CPU-bound
+// feed host the augment chain IS the pipeline (PERF.md input-pipeline
+// table), so this is the reference's MTLabeledBGRImgToBatch design point
+// (dataset/image/MTLabeledBGRImgToBatch.scala: decode+augment straight
+// into the batch slot) applied to the hot path.
+
+#include <cstdint>
+
+extern "C" {
+
+// img: (h, w, c) uint8, C-contiguous. Writes (ch, cw, c) float32 to out.
+// inv_std = 1/std (precomputed by the caller: multiply beats divide).
+void bigdl_fused_augment(const uint8_t* img, int64_t h, int64_t w,
+                         int64_t c, int64_t top, int64_t left, int64_t ch,
+                         int64_t cw, int flip, const float* mean,
+                         const float* inv_std, float* out) {
+  (void)h;
+  for (int64_t y = 0; y < ch; ++y) {
+    const uint8_t* row = img + ((top + y) * w + left) * c;
+    float* orow = out + y * cw * c;
+    if (!flip) {
+      for (int64_t x = 0; x < cw * c; x += c)
+        for (int64_t k = 0; k < c; ++k)
+          orow[x + k] = ((float)row[x + k] - mean[k]) * inv_std[k];
+    } else {
+      for (int64_t x = 0; x < cw; ++x) {
+        const uint8_t* px = row + (cw - 1 - x) * c;
+        float* opx = orow + x * c;
+        for (int64_t k = 0; k < c; ++k)
+          opx[k] = ((float)px[k] - mean[k]) * inv_std[k];
+      }
+    }
+  }
+}
+
+}  // extern "C"

@@ -9,6 +9,7 @@ ctypes loader falls back to pure Python if no usable .so exists at all.
 Metadata lives in pyproject.toml; this file only adds the native build step.
 """
 
+import glob
 import os
 import shutil
 import subprocess
@@ -23,13 +24,12 @@ class BuildPyWithNative(build_py):
     def run(self):
         super().run()
         here = os.path.dirname(os.path.abspath(__file__))
-        srcs = [os.path.join(here, "native", f)
-                for f in ("crc32c.cc", "dataloader.cc")]
+        srcs = sorted(glob.glob(os.path.join(here, "native", "*.cc")))
         rel = os.path.join("bigdl_tpu", "native", "libbigdl_native.so")
         out = os.path.join(self.build_lib, rel)
         os.makedirs(os.path.dirname(out), exist_ok=True)
         cxx = os.environ.get("CXX", "g++")
-        if all(os.path.exists(s) for s in srcs) and shutil.which(cxx):
+        if srcs and shutil.which(cxx):
             cmd = [cxx, "-O3", "-fPIC", "-std=c++17", "-shared", "-o", out,
                    *srcs, "-lpthread"]
             try:

@@ -323,3 +323,74 @@ def test_record_pipeline_feeds_distri_optimizer(tmp_path):
     from bigdl_tpu.optim import Evaluator, Top1Accuracy
     res = Evaluator(trained).test(samples, [Top1Accuracy()], batch_size=32)
     assert res[0][1].result()[0] > 0.85
+
+
+def test_fused_augment_matches_composed_chain():
+    """native/augment.cc's one-pass crop+flip+normalize must be
+    bit-equivalent (f32) to the composed RandomCrop>>HFlip>>
+    ChannelNormalize chain — same rng consumption, same output — and the
+    FeatureTransformer must fall back to numpy with identical results
+    when the native library is absent."""
+    import bigdl_tpu.native as native_mod
+    from bigdl_tpu.transform import vision as V
+
+    r = np.random.RandomState(3)
+    imgs = [r.randint(0, 255, (40, 48, 3), np.uint8) for _ in range(4)]
+    means, stds = [123.68, 116.779, 103.939], [58.393, 57.12, 57.375]
+
+    def run(flip_prob, force_fallback):
+        t = V.FusedCropFlipNormalize(32, 32, means, stds,
+                                     flip_prob=flip_prob, seed=11)
+        orig = native_mod.fused_augment
+        if force_fallback:
+            native_mod.fused_augment = lambda *a, **k: None
+        try:
+            return [np.asarray(
+                t.transform(V.ImageFeature(img.copy(), label=None,
+                                           preserve_dtype=True)).image())
+                for img in imgs]
+        finally:
+            native_mod.fused_augment = orig
+
+    if native_mod.fused_augment_available():
+        # the native path must actually engage on preserved-uint8 input
+        # (it silently falls back on f32 mats — the bug this test pins)
+        hits = []
+        orig = native_mod.fused_augment
+
+        def counting(*a, **k):
+            out = orig(*a, **k)
+            hits.append(out is not None)
+            return out
+
+        native_mod.fused_augment = counting
+        try:
+            run(1.0, force_fallback=False)
+        finally:
+            native_mod.fused_augment = orig
+        assert hits and all(hits), hits
+
+    for flip_prob in (0.0, 0.5, 1.0):
+        fast = run(flip_prob, force_fallback=False)
+        slow = run(flip_prob, force_fallback=True)
+        for a, b in zip(fast, slow):
+            assert a.shape == (32, 32, 3) and a.dtype == np.float32
+            # BIT-identical: both paths multiply by the same f32
+            # reciprocal (documented contract)
+            np.testing.assert_array_equal(a, b)
+
+    # undersized image: the guard must route around the native kernel
+    # (which trusts the crop window) instead of reading out of bounds
+    small = r.randint(0, 255, (20, 24, 3), np.uint8)
+    t = V.FusedCropFlipNormalize(32, 32, means, stds, flip_prob=0.0, seed=1)
+    out = t.transform(V.ImageFeature(small, label=None,
+                                     preserve_dtype=True)).image()
+    assert np.asarray(out).shape == (20, 24, 3)  # short crop, like numpy
+    # oracle vs the composed transformer chain (always-flip config)
+    chain = (V.RandomCrop(32, 32, seed=11) >> V.HFlip()
+             >> V.ChannelNormalize(means, stds))
+    feats = (V.ImageFeature(img.copy(), label=None) for img in imgs)
+    want = [np.asarray(f.image()) for f in chain(feats)]
+    got = run(1.0, force_fallback=False)
+    for a, b in zip(got, want):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-5)
