@@ -463,6 +463,16 @@ def run_input_pipeline_perf(batch_size: int = 64, n_records: int = 512,
                                                flip_prob=1.0)
             for depth in depths:
                 run_config(fused_aug, True, depth, fused=True)
+            # multithreaded apply (plans stay serial/deterministic): the
+            # ctypes kernel drops the GIL, so this row scales with host
+            # cores — flat on a 1-core box, the point on a real TPU host
+            workers = min(4, os.cpu_count() or 1)
+            if workers > 1:
+                par_aug = FusedCropFlipNormalize(crop, crop, MEANS, STDS,
+                                                 flip_prob=1.0,
+                                                 workers=workers)
+                run_config(par_aug, True, max(depths), fused=True)
+                results[-1]["augment_workers"] = workers
         else:
             log("[pipeline] fused augment unavailable; skipping")
     return results

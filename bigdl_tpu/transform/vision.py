@@ -421,7 +421,7 @@ class FusedCropFlipNormalize(FeatureTransformer):
 
     def __init__(self, crop_h: int, crop_w: int, means: Sequence[float],
                  stds: Sequence[float] = None, flip_prob: float = 0.5,
-                 seed: int = 1):
+                 seed: int = 1, workers: int = 1):
         self.crop_h, self.crop_w = crop_h, crop_w
         self.means = np.asarray(means, np.float32)
         self.stds = np.asarray(stds if stds is not None
@@ -430,19 +430,29 @@ class FusedCropFlipNormalize(FeatureTransformer):
         # fallback is bit-identical to the native kernel
         self._inv_stds = (np.float32(1.0) / self.stds).astype(np.float32)
         self.flip_prob = flip_prob
+        self.workers = workers
         self._rng = np.random.RandomState(seed)
 
-    def transform(self, f: ImageFeature) -> ImageFeature:
-        from bigdl_tpu import native
-
-        img = f.image()
-        h, w = img.shape[:2]
+    def _plan(self, h: int, w: int):
+        """Draw one image's (top, left, flip) — ALWAYS called serially in
+        stream order (RandomState is not thread-safe, and serial draws
+        keep the output independent of ``workers``)."""
         top = self._rng.randint(0, max(1, h - self.crop_h + 1))
         left = self._rng.randint(0, max(1, w - self.crop_w + 1))
         # deterministic flip probs consume no randomness, so the crop rng
         # stream stays aligned with a seed-matched RandomCrop chain
         flip = (self.flip_prob >= 1.0 or
                 (self.flip_prob > 0.0 and self._rng.rand() < self.flip_prob))
+        return top, left, flip
+
+    def _apply(self, f: ImageFeature, plan) -> ImageFeature:
+        """Thread-safe (no shared mutable state): the ctypes call drops
+        the GIL, so a worker pool scales this across cores."""
+        from bigdl_tpu import native
+
+        img = f.image()
+        h, w = img.shape[:2]
+        top, left, flip = plan
         out = None
         if (img.ndim == 3 and img.shape[2] == len(self.means)
                 and h >= self.crop_h and w >= self.crop_w):
@@ -458,6 +468,32 @@ class FusedCropFlipNormalize(FeatureTransformer):
             out = ((crop.astype(np.float32) - self.means) * self._inv_stds)
         f.set_image(out)
         return f
+
+    def transform(self, f: ImageFeature) -> ImageFeature:
+        img = f.image()
+        return self._apply(f, self._plan(*img.shape[:2]))
+
+    def __call__(self, it: Iterator) -> Iterator:
+        """``workers > 1``: plan serially (deterministic), apply on a
+        thread pool, yield in order — the reference's multithreaded
+        batch-assembly design (≙ MTLabeledBGRImgToBatch.scala). Output
+        is identical to ``workers=1`` (tested)."""
+        if self.workers <= 1:
+            yield from super().__call__(it)
+            return
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+
+        depth = self.workers * 4
+        with ThreadPoolExecutor(self.workers) as ex:
+            q = deque()
+            for f in it:
+                plan = self._plan(*f.image().shape[:2])
+                q.append(ex.submit(self._apply, f, plan))
+                if len(q) >= depth:
+                    yield q.popleft().result()
+            while q:
+                yield q.popleft().result()
 
 
 class RandomCrop(FeatureTransformer):

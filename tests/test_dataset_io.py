@@ -386,6 +386,20 @@ def test_fused_augment_matches_composed_chain():
     out = t.transform(V.ImageFeature(small, label=None,
                                      preserve_dtype=True)).image()
     assert np.asarray(out).shape == (20, 24, 3)  # short crop, like numpy
+
+    # workers > 1 (threaded apply, serial plans): identical stream, same
+    # order — the rng draws happen in the submitting thread
+    def stream(workers):
+        t = V.FusedCropFlipNormalize(32, 32, means, stds, flip_prob=0.5,
+                                     seed=7, workers=workers)
+        feats = (V.ImageFeature(img.copy(), label=None, preserve_dtype=True)
+                 for img in imgs * 4)
+        return [np.asarray(f.image()) for f in t(feats)]
+
+    serial, threaded = stream(1), stream(3)
+    assert len(serial) == len(threaded) == 16
+    for a, b in zip(serial, threaded):
+        np.testing.assert_array_equal(a, b)
     # oracle vs the composed transformer chain (always-flip config)
     chain = (V.RandomCrop(32, 32, seed=11) >> V.HFlip()
              >> V.ChannelNormalize(means, stds))
