@@ -475,7 +475,7 @@ class TransformerLM(Module):
         return fns
 
     def _decode_setup(self, prompt_ids, max_new_tokens, max_len,
-                      prefill_chunk=None):
+                      prefill_chunk=None, kv_cache_sharding=None):
         """Shared decoding preamble for generate/beam_search: coerce +
         validate the prompt, fetch the cached jitted fns, run the batched
         prefill. Returns (prompt_ids, b, t0, params, buffers, step_jit,
@@ -507,7 +507,19 @@ class TransformerLM(Module):
         if max_new_tokens == 0:
             return prompt_ids, b, t0, params, buffers, step_jit, None, None
         # cache dtype follows the params (bf16 serving -> bf16 kv cache)
-        caches = self.init_cache(b, max_len, dtype=self.tok_embed.dtype)
+        if kv_cache_sharding is not None:
+            # long-context serving: allocate the (B, H_kv, T, D) caches
+            # DIRECTLY sharded (typically along T over the mesh — a
+            # context larger than one chip's HBM must never materialize
+            # on one device); GSPMD partitions every downstream attention
+            # contraction + softmax reduction accordingly, so the
+            # sharding needs no decode-specific code
+            caches = jax.jit(
+                lambda: self.init_cache(b, max_len,
+                                        dtype=self.tok_embed.dtype),
+                out_shardings=kv_cache_sharding)()
+        else:
+            caches = self.init_cache(b, max_len, dtype=self.tok_embed.dtype)
         if prefill_chunk and t0 > prefill_chunk:
             rem = t0 % prefill_chunk
             pos = 0
@@ -529,7 +541,7 @@ class TransformerLM(Module):
                  temperature: float = 0.0, rng=None, max_len=None,
                  prefill_chunk=None, host_loop: bool = False,
                  bucket_tokens=None, eos_id=None, top_k=None,
-                 top_p=None):
+                 top_p=None, kv_cache_sharding=None):
         """Autoregressive generation with a KV cache (the transformer
         analog of the reference's RecurrentDecoder, nn/RecurrentDecoder
         .scala): batched prefill over the prompt, then the ENTIRE
@@ -552,7 +564,12 @@ class TransformerLM(Module):
         not per length). The first ``max_new_tokens`` tokens are
         IDENTICAL either way — token i depends only on steps < i and the
         key schedule splits in token order — the tail is computed and
-        discarded."""
+        discarded.
+
+        ``kv_cache_sharding``: a NamedSharding for the (B, H_kv, T, D)
+        caches — shard T over the mesh to decode with a context larger
+        than one chip's HBM (GSPMD partitions the attention and its
+        softmax reductions; tokens match the unsharded run, tested)."""
         from bigdl_tpu.utils import random as bt_random
 
         sampled = temperature > 0.0
@@ -567,7 +584,8 @@ class TransformerLM(Module):
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         (prompt_ids, b, t0, params, buffers, step_jit,
          logits, caches) = self._decode_setup(prompt_ids, max_new_tokens,
-                                              max_len, prefill_chunk)
+                                              max_len, prefill_chunk,
+                                              kv_cache_sharding)
         if max_new_tokens == 0:
             return prompt_ids
         if sampled and rng is None:

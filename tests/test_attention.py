@@ -382,6 +382,39 @@ def test_generate_data_parallel_on_mesh():
     assert got.sharding.spec == P("data", None)
 
 
+def test_generate_sequence_sharded_kv_cache_on_mesh():
+    """Long-context serving: the KV caches laid out SHARDED along the
+    sequence axis over the 8-device mesh (a context bigger than one
+    chip's HBM) must decode the exact single-device tokens — GSPMD
+    partitions the attention contractions and softmax reductions from
+    the cache sharding alone."""
+    from jax.sharding import NamedSharding
+
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(18)
+    m = TransformerLM(32, embed_dim=16, num_heads=4, num_kv_heads=2,
+                      num_layers=2, max_len=16, use_rope=True)
+    m.evaluate()
+    prompt = jnp.asarray(np.random.RandomState(13).randint(0, 32, (2, 5)))
+    want = np.asarray(m.generate(prompt, 6))
+
+    mesh = Engine.create_mesh([("seq", 8)])
+    sharding = NamedSharding(mesh, P(None, None, "seq", None))
+    got = m.generate(prompt, 6, max_len=16,  # 16 positions / 8 shards
+                     kv_cache_sharding=sharding)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    # the HBM property, not just the tokens: the caches must come out of
+    # the jitted prefill still sharded along T across all 8 devices (a
+    # GSPMD regression that gathers them would keep tokens identical)
+    *_, logits, caches = m._decode_setup(prompt, 6, 16,
+                                         kv_cache_sharding=sharding)
+    k0 = caches[0][0]
+    assert len(k0.sharding.device_set) == 8
+    assert k0.sharding.spec == P(None, None, "seq")
+
+
 def test_generate_tensor_parallel_on_mesh():
     """Megatron-style TP serving: load the LM's params back SHARDED over
     the 8-way model axis (column/row split via transformer_tp_rules) and
