@@ -201,10 +201,12 @@ class TransformerLM(Module):
         return logits
 
     # ------------------------------------------------- KV-cache decoding
-    def init_cache(self, batch: int, max_len: int, dtype=jnp.float32):
-        """Per-block attention KV caches for incremental decoding."""
-        return [getattr(self, f"block{i}").attn.init_cache(batch, max_len,
-                                                           dtype)
+    def init_cache(self, batch: int, max_len: int, dtype=jnp.float32,
+                   sharding=None):
+        """Per-block attention KV caches for incremental decoding;
+        ``sharding`` allocates each buffer directly with that layout."""
+        return [getattr(self, f"block{i}").attn.init_cache(
+                    batch, max_len, dtype, sharding=sharding)
                 for i in range(self.num_layers)]
 
     def prefill(self, ids, caches, pos0: int = 0):
@@ -506,20 +508,16 @@ class TransformerLM(Module):
         step_jit, prefill_jit, chunk_jit, _scan_jit = self._decode_fns()
         if max_new_tokens == 0:
             return prompt_ids, b, t0, params, buffers, step_jit, None, None
-        # cache dtype follows the params (bf16 serving -> bf16 kv cache)
-        if kv_cache_sharding is not None:
-            # long-context serving: allocate the (B, H_kv, T, D) caches
-            # DIRECTLY sharded (typically along T over the mesh — a
-            # context larger than one chip's HBM must never materialize
-            # on one device); GSPMD partitions every downstream attention
-            # contraction + softmax reduction accordingly, so the
-            # sharding needs no decode-specific code
-            caches = jax.jit(
-                lambda: self.init_cache(b, max_len,
-                                        dtype=self.tok_embed.dtype),
-                out_shardings=kv_cache_sharding)()
-        else:
-            caches = self.init_cache(b, max_len, dtype=self.tok_embed.dtype)
+        # cache dtype follows the params (bf16 serving -> bf16 kv cache);
+        # a kv_cache_sharding allocates the (B, H_kv, T, D) buffers
+        # DIRECTLY with that layout (long-context serving: a context
+        # larger than one chip's HBM must never materialize on one
+        # device, and the allocation is compile-free — jnp.zeros with a
+        # device=, not a traced program); GSPMD partitions every
+        # downstream attention contraction + softmax reduction from the
+        # sharding alone
+        caches = self.init_cache(b, max_len, dtype=self.tok_embed.dtype,
+                                 sharding=kv_cache_sharding)
         if prefill_chunk and t0 > prefill_chunk:
             rem = t0 % prefill_chunk
             pos = 0

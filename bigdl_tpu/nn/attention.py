@@ -150,11 +150,16 @@ class MultiHeadAttention(Module):
         rep = self.num_heads // self.num_kv_heads
         return jnp.repeat(k, rep, 1), jnp.repeat(v, rep, 1)
 
-    def init_cache(self, batch: int, max_len: int, dtype=jnp.float32):
+    def init_cache(self, batch: int, max_len: int, dtype=jnp.float32,
+                   sharding=None):
         """Zero KV cache for incremental decoding: (k, v) each
-        (B, H_kv, max_len, D)."""
+        (B, H_kv, max_len, D). ``sharding`` allocates the buffers
+        directly with that layout (no single-device materialization, no
+        tracing) — the long-context sharded-cache serving path."""
         shape = (batch, self.num_kv_heads, max_len, self.head_dim)
-        return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+        mk = (lambda: jnp.zeros(shape, dtype, device=sharding)) \
+            if sharding is not None else (lambda: jnp.zeros(shape, dtype))
+        return mk(), mk()
 
     def _split_kv_step(self, qkv):
         kv_dim = self.num_kv_heads * self.head_dim

@@ -301,6 +301,46 @@ def decode_scan_program(batch: int = 8, n_tokens: int = 32,
             (params, buffers, logits, pos0, caches, rng))
 
 
+def sharded_decode_scan_program(n_devices: int = 8, batch: int = 4,
+                                n_tokens: int = 16, vocab: int = 32000,
+                                embed_dim: int = 512, layers: int = 8,
+                                heads: int = 8, kv_heads: int = 2,
+                                max_len: int = 2048, dtype=jnp.bfloat16):
+    """The long-context serving lowering: the one-dispatch greedy decode
+    loop with the KV caches SHARDED along T over the mesh (params
+    replicated) — generate(kv_cache_sharding=...)'s program. GSPMD
+    partitions the per-step attention + softmax reductions across
+    devices (flash-decoding style)."""
+    from bigdl_tpu.nn.module import bind
+    from bigdl_tpu.parallel import Engine
+
+    mesh = Engine.create_mesh([("seq", n_devices)])
+    model, params, buffers, caches = _serving_model(
+        batch, vocab, embed_dim, layers, heads, kv_heads, max_len, dtype)
+    rep = NamedSharding(mesh, P())
+
+    def reshard(tree, sh):
+        return jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            tree)
+
+    params, buffers = reshard(params, rep), reshard(buffers, rep)
+    caches = reshard(caches, NamedSharding(mesh, P(None, None, "seq",
+                                                   None)))
+
+    def scan_fn(p, bufs, logits, pos0, caches, rng):
+        with bind(model, p, bufs, False, None):
+            return model.decode_scan(logits, pos0, caches, rng,
+                                     jnp.float32(1.0), n_tokens,
+                                     sampled=False, eos_id=2)
+
+    logits = jax.ShapeDtypeStruct((batch, vocab), dtype, sharding=rep)
+    pos0 = jax.ShapeDtypeStruct((), jnp.int32, sharding=rep)
+    rng = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=rep)
+    return (jax.jit(scan_fn, donate_argnums=(4,)),
+            (params, buffers, logits, pos0, caches, rng))
+
+
 def beam_scan_program(batch: int = 4, beams: int = 4, n_tokens: int = 32,
                       vocab: int = 32000, embed_dim: int = 512,
                       layers: int = 8, heads: int = 8, kv_heads: int = 2,

@@ -87,6 +87,17 @@ def test_decode_scan_lowers_for_tpu():
     _export(fn, args)
 
 
+def test_sharded_decode_scan_lowers_for_tpu():
+    """The sequence-sharded KV-cache decode loop (long-context serving,
+    generate(kv_cache_sharding=...)'s program) cross-lowers for TPU as
+    an 8-device module."""
+    fn, args = ep.sharded_decode_scan_program(
+        n_devices=8, batch=2, n_tokens=4, vocab=64, embed_dim=32,
+        layers=1, heads=4, kv_heads=2, max_len=64)
+    exported = _export(fn, args)
+    assert exported.nr_devices == 8
+
+
 def test_beam_scan_lowers_for_tpu():
     """The one-dispatch scanned beam search (top-k reselection + cache
     lineage gathers + parent-pointer backtracking inside one scan)
