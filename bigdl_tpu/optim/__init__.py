@@ -19,5 +19,6 @@ from bigdl_tpu.optim.regularizer import (
 )
 from bigdl_tpu.optim.optimizer import Optimizer, LocalOptimizer, make_train_step
 from bigdl_tpu.optim.evaluator import Evaluator
+from bigdl_tpu.optim.generation_service import GenerationService
 from bigdl_tpu.optim.predictor import LocalPredictor, PredictionService
 from bigdl_tpu.optim.metrics import Metrics

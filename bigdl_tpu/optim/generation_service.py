@@ -1,0 +1,124 @@
+"""Concurrent autoregressive LM serving.
+
+The LM analog of ``PredictionService`` (≙ optim/PredictionService.scala's
+instance-queue semantics — the reference has no generative serving, this
+is beyond-parity): concurrent ``generate()`` requests micro-batch into
+one scan-decode dispatch per (prompt-length, decode-bucket) group, which
+is how the MXU wants to be fed — a lone decode request strands it.
+
+Shape discipline (the TPU serving contract):
+- prompts group by EXACT length — the prefill is maskless (dense causal
+  attention), so different-length prompts never share a batch; callers
+  wanting cross-length batching pad client-side to shared lengths.
+- every request's ``max_new_tokens`` rounds UP to a multiple of
+  ``bucket_tokens``; requests in the same bucket share one compiled scan
+  program (see generate(bucket_tokens=...)) and each reply is trimmed
+  back to the tokens its caller asked for. Tokens are IDENTICAL to a
+  direct ``model.generate`` call — greedy decoding is batch-invariant
+  and length-invariant per row.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+from bigdl_tpu.optim.prediction_service import _MicroBatcher
+
+
+class GenerationService:
+    """Thread-safe generative serving over a ``TransformerLM``.
+
+    ``generate(prompt_ids, max_new_tokens)`` blocks until its batch
+    lands and returns the 1-D ``prompt + tokens`` row for this request.
+    Sampling config (temperature/top_k/top_p/eos_id) is fixed per
+    service — it is part of the compiled program."""
+
+    def __init__(self, model, max_batch: int = 8,
+                 batch_timeout_ms: float = 5.0, bucket_tokens: int = 32,
+                 eos_id=None, temperature: float = 0.0, top_k=None,
+                 top_p=None, max_len=None, seed: int = 0):
+        if bucket_tokens < 1:
+            raise ValueError(f"bucket_tokens must be >= 1, got "
+                             f"{bucket_tokens}")
+        if temperature <= 0.0 and (top_k is not None or top_p is not None):
+            # mirror model.generate's own guard — a greedy service must
+            # not silently drop the caller's sampling config
+            raise ValueError("top_k/top_p filter the SAMPLED distribution; "
+                             "pass temperature > 0")
+        self.model = model
+        self.max_batch = max_batch
+        self.batch_timeout_ms = batch_timeout_ms
+        self.bucket_tokens = bucket_tokens
+        self.eos_id = eos_id
+        self.temperature = temperature
+        self.top_k, self.top_p = top_k, top_p
+        self.max_len = max_len
+        self._key = jax.random.PRNGKey(seed)
+        self._lock = threading.Lock()
+        # one device dispatch at a time: tracing generate() binds state
+        # on the module (not thread-safe across concurrent traces), and
+        # the chip runs one program at a time anyway — concurrency value
+        # lives in the BATCHING, not in parallel dispatch
+        self._dispatch = threading.Lock()
+        self._batchers = {}  # bucketed n -> _MicroBatcher
+
+    def _next_key(self):
+        # generate()'s internal rng default reaches for the GLOBAL key
+        # stream, which concurrent drain threads would race; the service
+        # owns a lock-protected stream instead
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    def _batcher(self, bucket: int) -> _MicroBatcher:
+        with self._lock:
+            b = self._batchers.get(bucket)
+            if b is None:
+                def run_batch(stacked):
+                    # last column carries each request's max_new_tokens
+                    # (generate() is given the batch max and the bucket,
+                    # so its OWN bucketing applies — validation against
+                    # the requested length, clamp-safe tail). max_len is
+                    # pinned to (prompt + bucket, capped by the context)
+                    # so the KV-cache shape — and therefore the compiled
+                    # program — depends only on (prompt length, bucket),
+                    # never on this batch's particular max n.
+                    prompts = stacked[:, :-1]
+                    n_req = int(stacked[:, -1].max())
+                    cap = min(self.max_len or self.model.max_len,
+                              self.model.max_len)
+                    pinned = min(cap, prompts.shape[1] + bucket)
+                    kw = {}
+                    if self.temperature > 0.0:
+                        kw = dict(temperature=self.temperature,
+                                  top_k=self.top_k, top_p=self.top_p,
+                                  rng=self._next_key())
+                    with self._dispatch:
+                        return np.asarray(self.model.generate(
+                            prompts, n_req, eos_id=self.eos_id,
+                            max_len=pinned,
+                            bucket_tokens=self.bucket_tokens, **kw))
+
+                b = _MicroBatcher(run_batch, self.max_batch,
+                                  self.batch_timeout_ms)
+                self._batchers[bucket] = b
+            return b
+
+    def generate(self, prompt_ids, max_new_tokens: int) -> np.ndarray:
+        """One request: 1-D ``prompt_ids`` in, 1-D ``prompt + generated``
+        out (exactly ``max_new_tokens`` tokens; with ``eos_id`` the tail
+        after the first eos is eos padding, as in ``model.generate``)."""
+        prompt = np.asarray(prompt_ids, np.int32)
+        if prompt.ndim != 1:
+            raise ValueError("GenerationService.generate takes ONE request "
+                             f"(1-D prompt), got shape {prompt.shape}")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        bucket = -(-max_new_tokens // self.bucket_tokens) \
+            * self.bucket_tokens
+        row = self._batcher(bucket).submit(
+            np.append(prompt, np.int32(max_new_tokens)))
+        return np.asarray(row[:prompt.shape[0] + max_new_tokens])
