@@ -48,6 +48,20 @@ def _filter_logits(logits, temperature, top_k, top_p):
     return x
 
 
+def _validate_sampling(sampled: bool, top_k, top_p):
+    """The sampling-config API contract, shared by generate /
+    generate_ragged (and mirrored by GenerationService)."""
+    if not sampled and (top_k is not None or top_p is not None):
+        raise ValueError(
+            "top_k/top_p filter the SAMPLED distribution; pass "
+            "temperature > 0 (greedy decoding would silently ignore "
+            "them)")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+
 def _sample_next(logits, rng, done, sampled, temperature, eos_id,
                  top_k, top_p):
     """One sampling decision, shared by the scanned and host decode
@@ -237,7 +251,10 @@ class TransformerLM(Module):
                                   all_logits=True)
 
     def _prefill_impl(self, ids, caches, pos0, chunked: bool,
-                      all_logits: bool = False):
+                      all_logits: bool = False, gather_last=None):
+        """``gather_last`` (B,) selects ONE hidden state per row (before
+        the head — O(B) vocab projections, not O(B*T)): the ragged
+        prefill's per-row last-valid position."""
         b, t = ids.shape
         x = jnp.take(self.tok_embed, ids, axis=0)
         if not self.use_rope:
@@ -250,24 +267,34 @@ class TransformerLM(Module):
             x, c = (blk.forward_chunk(x, caches[i], pos0) if chunked
                     else blk.forward_prefill(x, caches[i], pos0))
             new_caches.append(c)
-        x = self.ln_f(x if all_logits else x[:, -1:])
+        if gather_last is not None:
+            x = jnp.take_along_axis(
+                x, gather_last[:, None, None].astype(jnp.int32), axis=1)
+        elif not all_logits:
+            x = x[:, -1:]
+        x = self.ln_f(x)
         if self.tie_embeddings:
             logits = jnp.einsum("btc,vc->btv", x, self.tok_embed)
         else:
             logits = self.head(x.reshape(-1, x.shape[-1])).reshape(
                 b, x.shape[1], -1)
-        if all_logits:
+        if all_logits and gather_last is None:
             return logits, new_caches
         return logits[:, 0], new_caches
 
     def decode_step(self, ids_t, pos, caches):
         """One token in, next-token logits out. ids_t (B,) int, ``pos`` a
-        traced scalar position; caches from ``init_cache`` (static shapes —
-        the whole step jits once and is reused for every position)."""
+        traced scalar position — or a (B,) vector for RAGGED batches
+        (each row at its own depth); caches from ``init_cache`` (static
+        shapes — the whole step jits once and is reused for every
+        position)."""
         x = jnp.take(self.tok_embed, ids_t, axis=0)[:, None, :]  # (B,1,C)
         if not self.use_rope:
-            x = x + jax.lax.dynamic_slice_in_dim(self.pos_embed, pos, 1,
-                                                 0)[None]
+            if jnp.ndim(pos) == 1:
+                x = x + jnp.take(self.pos_embed, pos, axis=0)[:, None]
+            else:
+                x = x + jax.lax.dynamic_slice_in_dim(self.pos_embed, pos,
+                                                     1, 0)[None]
         new_caches = []
         for i in range(self.num_layers):
             x, c = getattr(self, f"block{i}").forward_step(x, caches[i], pos)
@@ -461,18 +488,31 @@ class TransformerLM(Module):
         def scan_fn(p, bufs, logits, pos0, caches, rng, temperature, n,
                     sampled, eos_id, top_k, top_p):
             # the one-dispatch n-token decode loop (see decode_scan);
-            # n/sampled/eos/top-k/top-p static -> one compile per config
+            # n/sampled/eos/top-k/top-p static -> one compile per config.
+            # pos0 may be () or a (B,) per-row vector (ragged batches) —
+            # jax traces each shape once through the same wrapper
             with bind(self, p, bufs, False, None):
                 return self.decode_scan(logits, pos0, caches, rng,
                                         temperature, n, sampled, eos_id,
                                         top_k, top_p)
+
+        def ragged_prefill_fn(p, bufs, ids, lengths, caches):
+            # RIGHT-padded mixed-length prompts: one causal pass (pads
+            # sit at later positions than any valid query, so the causal
+            # mask already excludes them); per-row last-valid hidden
+            # state gathered BEFORE the head — O(B), not O(B*T), vocab
+            # projections
+            with bind(self, p, bufs, False, None):
+                return self._prefill_impl(ids, caches, 0, chunked=False,
+                                          gather_last=lengths - 1)
 
         fns = (jax.jit(step, donate_argnums=(4,)),
                jax.jit(prefill_fn, donate_argnums=(3,),
                        static_argnums=(4,)),
                jax.jit(chunk_fn, donate_argnums=(3,)),
                jax.jit(scan_fn, donate_argnums=(2, 4),
-                       static_argnums=(7, 8, 9, 10, 11)))
+                       static_argnums=(7, 8, 9, 10, 11)),
+               jax.jit(ragged_prefill_fn, donate_argnums=(4,)))
         _DECODE_JIT[self] = fns
         return fns
 
@@ -505,7 +545,7 @@ class TransformerLM(Module):
             raise ValueError(f"max_len {max_len} exceeds the model's "
                              f"context length {self.max_len}")
         params, buffers = self.params_dict(), self.buffers_dict()
-        step_jit, prefill_jit, chunk_jit, _scan_jit = self._decode_fns()
+        step_jit, prefill_jit, chunk_jit = self._decode_fns()[:3]
         if max_new_tokens == 0:
             return prompt_ids, b, t0, params, buffers, step_jit, None, None
         # cache dtype follows the params (bf16 serving -> bf16 kv cache);
@@ -571,15 +611,7 @@ class TransformerLM(Module):
         from bigdl_tpu.utils import random as bt_random
 
         sampled = temperature > 0.0
-        if not sampled and (top_k is not None or top_p is not None):
-            raise ValueError(
-                "top_k/top_p filter the SAMPLED distribution; pass "
-                "temperature > 0 (greedy decoding would silently ignore "
-                "them)")
-        if top_k is not None and top_k < 1:
-            raise ValueError(f"top_k must be >= 1, got {top_k}")
-        if top_p is not None and not 0.0 < top_p <= 1.0:
-            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        _validate_sampling(sampled, top_k, top_p)
         (prompt_ids, b, t0, params, buffers, step_jit,
          logits, caches) = self._decode_setup(prompt_ids, max_new_tokens,
                                               max_len, prefill_chunk,
@@ -756,6 +788,74 @@ class TransformerLM(Module):
             return ids, {"rounds": rounds,
                          "accept_rate": accepted / max(rounds * gamma, 1)}
         return ids
+
+    def generate_ragged(self, prompt_ids, prompt_lengths,
+                        max_new_tokens: int, temperature: float = 0.0,
+                        rng=None, eos_id=None, top_k=None, top_p=None,
+                        bucket_tokens=None, max_len=None):
+        """MIXED prompt lengths in ONE batch: ``prompt_ids`` (B, Tmax)
+        RIGHT-padded, ``prompt_lengths`` (B,) valid lengths. Returns
+        (B, max_new_tokens) generated tokens — row i continues its own
+        length-``t0_i`` prompt exactly as ``generate`` would on that row
+        alone (tested).
+
+        Why right padding works with no attention-mask machinery: valid
+        tokens keep their absolute positions (RoPE rotations and the
+        causal structure are row-independent), pads sit at LATER
+        positions than every valid query so the causal prefill never
+        attends them, each row's first decode step OVERWRITES its first
+        pad's KV slot, and decode masks/rotations take a (B,) per-row
+        position vector (the same one-dispatch scan — the carry just
+        holds a vector). Sampling/eos options match ``generate``."""
+        from bigdl_tpu.utils import random as bt_random
+
+        sampled = temperature > 0.0
+        _validate_sampling(sampled, top_k, top_p)
+        prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+        lengths = jnp.asarray(prompt_lengths, jnp.int32)
+        if prompt_ids.ndim != 2 or lengths.shape != prompt_ids.shape[:1]:
+            raise ValueError(
+                f"generate_ragged takes (B, Tmax) padded prompts + (B,) "
+                f"lengths, got {prompt_ids.shape} / {lengths.shape}")
+        b, tmax = prompt_ids.shape
+        n = max_new_tokens
+        if n < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        lmax = int(jnp.max(lengths))
+        lmin = int(jnp.min(lengths))
+        if lmin < 1 or lmax > tmax:
+            raise ValueError(f"prompt_lengths must be in [1, {tmax}], "
+                             f"got [{lmin}, {lmax}]")
+        window = min(self.max_len, max_len) if max_len else self.max_len
+        if lmax + n > window or tmax > window:
+            raise ValueError(
+                f"longest prompt ({lmax}) + max_new_tokens ({n}) or the "
+                f"padded width ({tmax}) exceeds the context "
+                f"length {window}")
+        if sampled and rng is None:
+            rng = bt_random.next_key()
+        params, buffers = self.params_dict(), self.buffers_dict()
+        fns = self._decode_fns()
+        scan_jit, ragged_prefill = fns[3], fns[4]
+        # cache covers the prefill's full padded width AND every row's
+        # decode span; bucketed scan tails clamp-write harmlessly past
+        # each row's own end (same argument as generate(bucket_tokens=)).
+        # An explicit max_len PINS the cache shape (serving: the compiled
+        # program then depends only on the padded width + max_len, not on
+        # this batch's particular n).
+        caches = self.init_cache(b, window if max_len
+                                 else min(window, tmax + n),
+                                 dtype=self.tok_embed.dtype)
+        logits, caches = ragged_prefill(params, buffers, prompt_ids,
+                                        lengths, caches)
+        n_c = n
+        if bucket_tokens:
+            n_c = -(-n // bucket_tokens) * bucket_tokens
+        toks = scan_jit(params, buffers, logits, lengths, caches,
+                        rng if sampled else jax.random.PRNGKey(0),
+                        jnp.float32(temperature if sampled else 1.0),
+                        n_c, sampled, eos_id, top_k, top_p)
+        return toks[:n].T
 
     def beam_search(self, prompt_ids, max_new_tokens: int,
                     num_beams: int = 4, length_penalty: float = 1.0,

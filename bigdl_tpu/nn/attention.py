@@ -88,6 +88,16 @@ def rotary_embedding(x, positions, base: float = 10000.0):
     return out.reshape(x.shape).astype(x.dtype)
 
 
+def rotary_embedding_rowwise(x, positions, base: float = 10000.0):
+    """RoPE for one decode step at PER-ROW positions: x (B, H, 1, D),
+    ``positions`` (B,) — each batch row rotated by its own absolute
+    position (the ragged-batch decode path, where rows sit at different
+    sequence depths). One formula: vmap of :func:`rotary_embedding` over
+    the batch, so the rotation math can never diverge between paths."""
+    return jax.vmap(
+        lambda xi, pi: rotary_embedding(xi, pi[None], base))(x, positions)
+
+
 class MultiHeadAttention(Module):
     """Fused-QKV multi-head self/cross attention.
 
@@ -176,26 +186,47 @@ class MultiHeadAttention(Module):
         the full cache length, the XLA-friendly form). GQA runs as a
         grouped einsum against the UN-expanded cache (scores accumulated
         in f32, matching dot_product_attention) — no per-step
-        num_heads-sized kv copy."""
+        num_heads-sized kv copy.
+
+        RAGGED batches: ``pos`` may be a (B,) vector of per-row positions
+        (rows at different sequence depths, the mixed-prompt-length
+        serving path) — each row writes its KV at, rotates by, and masks
+        against its OWN position."""
+        ragged = jnp.ndim(pos) == 1
         b = x_t.shape[0]
         qkv = self.qkv(x_t.reshape(b, self.embed_dim)).reshape(b, 1, -1)
         q, k_t, v_t = self._split_kv_step(qkv)      # q (B,H,1,D)
         if self.rotary:
-            positions = jnp.asarray(pos)[None]
-            q, k_t = self._rope(q, positions), self._rope(k_t, positions)
+            if ragged:
+                q = rotary_embedding_rowwise(q, pos, self.rotary_base)
+                k_t = rotary_embedding_rowwise(k_t, pos, self.rotary_base)
+            else:
+                positions = jnp.asarray(pos)[None]
+                q = self._rope(q, positions)
+                k_t = self._rope(k_t, positions)
         k_cache, v_cache = cache
-        k_cache = jax.lax.dynamic_update_slice(
-            k_cache, k_t.astype(k_cache.dtype), (0, 0, pos, 0))
-        v_cache = jax.lax.dynamic_update_slice(
-            v_cache, v_t.astype(v_cache.dtype), (0, 0, pos, 0))
+        if ragged:
+            write = jax.vmap(lambda c, t, p: jax.lax.dynamic_update_slice(
+                c, t, (0, p, 0)))
+            k_cache = write(k_cache, k_t.astype(k_cache.dtype), pos)
+            v_cache = write(v_cache, v_t.astype(v_cache.dtype), pos)
+        else:
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k_t.astype(k_cache.dtype), (0, 0, pos, 0))
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v_t.astype(v_cache.dtype), (0, 0, pos, 0))
         h_kv = self.num_kv_heads
         rep = self.num_heads // h_kv
         qg = q.reshape(b, h_kv, rep, self.head_dim)  # 1-token axis folded
         scale = 1.0 / math.sqrt(self.head_dim)
         s = jnp.einsum("bgrd,bgtd->bgrt", qg, k_cache,
                        preferred_element_type=jnp.float32) * scale
-        live = jnp.arange(k_cache.shape[2]) <= pos
-        s = jnp.where(live[None, None, None, :], s, -jnp.inf)
+        if ragged:
+            live = jnp.arange(k_cache.shape[2])[None, :] <= pos[:, None]
+            s = jnp.where(live[:, None, None, :], s, -jnp.inf)
+        else:
+            live = jnp.arange(k_cache.shape[2]) <= pos
+            s = jnp.where(live[None, None, None, :], s, -jnp.inf)
         p = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
         o = jnp.einsum("bgrt,bgtd->bgrd", p, v_cache)
         o = o.reshape(b, self.embed_dim).astype(x_t.dtype)

@@ -3,19 +3,22 @@
 The LM analog of ``PredictionService`` (≙ optim/PredictionService.scala's
 instance-queue semantics — the reference has no generative serving, this
 is beyond-parity): concurrent ``generate()`` requests micro-batch into
-one scan-decode dispatch per (prompt-length, decode-bucket) group, which
-is how the MXU wants to be fed — a lone decode request strands it.
+one ragged scan-decode dispatch per (prompt bucket, decode bucket)
+group, which is how the MXU wants to be fed — a lone decode request
+strands it.
 
 Shape discipline (the TPU serving contract):
-- prompts group by EXACT length — the prefill is maskless (dense causal
-  attention), so different-length prompts never share a batch; callers
-  wanting cross-length batching pad client-side to shared lengths.
+- prompts RIGHT-pad up to a multiple of ``prompt_bucket`` (capped by the
+  context); requests whose padded widths match share a batch even with
+  DIFFERENT true lengths — ``TransformerLM.generate_ragged`` decodes
+  each row at its own depth with per-row position vectors.
 - every request's ``max_new_tokens`` rounds UP to a multiple of
-  ``bucket_tokens``; requests in the same bucket share one compiled scan
-  program (see generate(bucket_tokens=...)) and each reply is trimmed
-  back to the tokens its caller asked for. Tokens are IDENTICAL to a
-  direct ``model.generate`` call — greedy decoding is batch-invariant
-  and length-invariant per row.
+  ``bucket_tokens``; ``max_len`` is pinned per group so the compiled
+  program depends only on the (prompt bucket, decode bucket) key, never
+  on a particular batch's max n.
+- tokens are IDENTICAL to a direct ``model.generate`` call on each
+  request alone (greedy decoding is batch-, padding-, and
+  length-invariant per row — tested).
 """
 
 from __future__ import annotations
@@ -38,20 +41,25 @@ class GenerationService:
 
     def __init__(self, model, max_batch: int = 8,
                  batch_timeout_ms: float = 5.0, bucket_tokens: int = 32,
-                 eos_id=None, temperature: float = 0.0, top_k=None,
-                 top_p=None, max_len=None, seed: int = 0):
+                 prompt_bucket: int = 32, eos_id=None,
+                 temperature: float = 0.0, top_k=None, top_p=None,
+                 max_len=None, seed: int = 0):
         if bucket_tokens < 1:
             raise ValueError(f"bucket_tokens must be >= 1, got "
                              f"{bucket_tokens}")
-        if temperature <= 0.0 and (top_k is not None or top_p is not None):
-            # mirror model.generate's own guard — a greedy service must
-            # not silently drop the caller's sampling config
-            raise ValueError("top_k/top_p filter the SAMPLED distribution; "
-                             "pass temperature > 0")
+        if prompt_bucket < 1:
+            raise ValueError(f"prompt_bucket must be >= 1, got "
+                             f"{prompt_bucket}")
+        from bigdl_tpu.models.transformer import _validate_sampling
+
+        # the model's own guard, applied at construction — a service must
+        # not silently drop or late-fail the caller's sampling config
+        _validate_sampling(temperature > 0.0, top_k, top_p)
         self.model = model
         self.max_batch = max_batch
         self.batch_timeout_ms = batch_timeout_ms
         self.bucket_tokens = bucket_tokens
+        self.prompt_bucket = prompt_bucket
         self.eos_id = eos_id
         self.temperature = temperature
         self.top_k, self.top_p = top_k, top_p
@@ -65,6 +73,9 @@ class GenerationService:
         self._dispatch = threading.Lock()
         self._batchers = {}  # bucketed n -> _MicroBatcher
 
+    def _cap(self) -> int:
+        return min(self.max_len or self.model.max_len, self.model.max_len)
+
     def _next_key(self):
         # generate()'s internal rng default reaches for the GLOBAL key
         # stream, which concurrent drain threads would race; the service
@@ -73,38 +84,31 @@ class GenerationService:
             self._key, sub = jax.random.split(self._key)
             return sub
 
-    def _batcher(self, bucket: int) -> _MicroBatcher:
+    def _batcher(self, key) -> _MicroBatcher:
+        bucket = key[0]
         with self._lock:
-            b = self._batchers.get(bucket)
+            b = self._batchers.get(key)
             if b is None:
                 def run_batch(stacked):
-                    # last column carries each request's max_new_tokens
-                    # (generate() is given the batch max and the bucket,
-                    # so its OWN bucketing applies — validation against
-                    # the requested length, clamp-safe tail). max_len is
-                    # pinned to (prompt + bucket, capped by the context)
-                    # so the KV-cache shape — and therefore the compiled
-                    # program — depends only on (prompt length, bucket),
-                    # never on this batch's particular max n.
-                    prompts = stacked[:, :-1]
+                    # layout per row: [padded prompt | true length | n]
+                    prompts = stacked[:, :-2]
+                    lengths = stacked[:, -2]
                     n_req = int(stacked[:, -1].max())
-                    cap = min(self.max_len or self.model.max_len,
-                              self.model.max_len)
-                    pinned = min(cap, prompts.shape[1] + bucket)
+                    pinned = min(self._cap(), prompts.shape[1] + bucket)
                     kw = {}
                     if self.temperature > 0.0:
                         kw = dict(temperature=self.temperature,
                                   top_k=self.top_k, top_p=self.top_p,
                                   rng=self._next_key())
                     with self._dispatch:
-                        return np.asarray(self.model.generate(
-                            prompts, n_req, eos_id=self.eos_id,
-                            max_len=pinned,
-                            bucket_tokens=self.bucket_tokens, **kw))
+                        return np.asarray(self.model.generate_ragged(
+                            prompts, lengths, n_req, eos_id=self.eos_id,
+                            bucket_tokens=self.bucket_tokens,
+                            max_len=pinned, **kw))
 
                 b = _MicroBatcher(run_batch, self.max_batch,
                                   self.batch_timeout_ms)
-                self._batchers[bucket] = b
+                self._batchers[key] = b
             return b
 
     def generate(self, prompt_ids, max_new_tokens: int) -> np.ndarray:
@@ -115,10 +119,26 @@ class GenerationService:
         if prompt.ndim != 1:
             raise ValueError("GenerationService.generate takes ONE request "
                              f"(1-D prompt), got shape {prompt.shape}")
-        if max_new_tokens < 1:
+        t0 = prompt.shape[0]
+        n = max_new_tokens
+        if n < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        bucket = -(-max_new_tokens // self.bucket_tokens) \
-            * self.bucket_tokens
-        row = self._batcher(bucket).submit(
-            np.append(prompt, np.int32(max_new_tokens)))
-        return np.asarray(row[:prompt.shape[0] + max_new_tokens])
+        cap = self._cap()
+        if t0 < 1 or t0 + n > cap:
+            raise ValueError(f"prompt ({t0}) + max_new_tokens ({n}) "
+                             f"exceeds the context length {cap}")
+        tpad = min(-(-t0 // self.prompt_bucket) * self.prompt_bucket, cap)
+        bucket = -(-n // self.bucket_tokens) * self.bucket_tokens
+        # Safe-coalescing key: normally lmax <= tpad and n_req <= bucket
+        # guarantee every batch fits the pinned window (tpad + bucket).
+        # In the TIGHT region (tpad + bucket > cap) that guarantee fails
+        # for MIXED n — two individually-valid requests could combine
+        # into lmax + n_req > cap — so tight requests group by their
+        # EXACT n: then lmax + n = max(t0_i + n) <= cap per the
+        # per-request check above.
+        key = (bucket,) if tpad + bucket <= cap else (bucket, "tight", n)
+        row = np.zeros((tpad + 2,), np.int32)
+        row[:t0] = prompt
+        row[-2], row[-1] = t0, n
+        toks = self._batcher(key).submit(row)
+        return np.concatenate([prompt, np.asarray(toks[:n])])

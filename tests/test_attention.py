@@ -437,6 +437,73 @@ def test_generate_tensor_parallel_on_mesh():
     np.testing.assert_array_equal(np.asarray(got), want)
 
 
+class TestRaggedGenerate:
+    """generate_ragged: mixed prompt lengths in one right-padded batch
+    must continue every row exactly as generate() would on that row
+    alone — the per-row position vector drives the same scan."""
+
+    def _models(self):
+        from bigdl_tpu.models.transformer import TransformerLM
+        from bigdl_tpu.utils import random as rnd
+
+        out = []
+        for seed, rope in ((19, True), (20, False)):
+            rnd.set_seed(seed)
+            m = TransformerLM(32, embed_dim=16, num_heads=4,
+                              num_kv_heads=2 if rope else None,
+                              num_layers=2, max_len=32, use_rope=rope)
+            m.evaluate()
+            out.append(m)
+        return out
+
+    def test_rows_match_per_row_generate(self):
+        r = np.random.RandomState(14)
+        lengths = np.asarray([3, 5, 7, 4])
+        tmax = 7
+        padded = np.zeros((4, tmax), np.int64)
+        rows = []
+        for i, L in enumerate(lengths):
+            p = r.randint(0, 32, (L,))
+            rows.append(p)
+            padded[i, :L] = p
+        for m in self._models():  # RoPE and learned-positions variants
+            got = np.asarray(m.generate_ragged(padded, lengths, 6))
+            assert got.shape == (4, 6)
+            for i, p in enumerate(rows):
+                want = np.asarray(m.generate(jnp.asarray(p)[None], 6))[0]
+                np.testing.assert_array_equal(got[i], want[len(p):])
+
+    def test_eos_bucket_and_validation(self):
+        m = self._models()[0]
+        r = np.random.RandomState(15)
+        lengths = np.asarray([2, 6])
+        padded = np.zeros((2, 6), np.int64)
+        for i, L in enumerate(lengths):
+            padded[i, :L] = r.randint(0, 32, (L,))
+        # bucketed scan: same tokens as exact length
+        np.testing.assert_array_equal(
+            np.asarray(m.generate_ragged(padded, lengths, 5,
+                                         bucket_tokens=4)),
+            np.asarray(m.generate_ragged(padded, lengths, 5)))
+        # eos: per-row tails freeze after the first eos
+        out = np.asarray(m.generate_ragged(padded, lengths, 8, eos_id=0))
+        for row in out:
+            hits = np.where(row == 0)[0]
+            if len(hits):
+                assert (row[hits[0]:] == 0).all(), row
+        # sampled mode: deterministic under one key
+        k = jax.random.PRNGKey(5)
+        np.testing.assert_array_equal(
+            np.asarray(m.generate_ragged(padded, lengths, 5,
+                                         temperature=0.8, rng=k)),
+            np.asarray(m.generate_ragged(padded, lengths, 5,
+                                         temperature=0.8, rng=k)))
+        with pytest.raises(ValueError, match="lengths"):
+            m.generate_ragged(padded, np.asarray([2, 9]), 4)
+        with pytest.raises(ValueError, match="context"):
+            m.generate_ragged(padded, lengths, 40)
+
+
 class TestSpeculativeDecoding:
     """speculative_generate must equal target greedy generate() EXACTLY
     regardless of the draft — the draft only changes the round count."""

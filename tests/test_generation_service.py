@@ -76,26 +76,28 @@ def test_mixed_decode_lengths_bucket_and_trim(lm):
         np.testing.assert_array_equal(row, want)
 
 
-def test_requests_actually_coalesce(lm):
+def test_requests_actually_coalesce_across_lengths(lm):
     calls = []
     svc = GenerationService(lm, max_batch=4, batch_timeout_ms=200.0,
-                            bucket_tokens=8)
-    real = lm.generate
+                            bucket_tokens=8, prompt_bucket=16)
+    real = lm.generate_ragged
 
-    def counting(stacked, n, **kw):
-        calls.append(np.asarray(stacked).shape[0])
-        return real(stacked, n, **kw)
+    def counting(prompts, lengths, n, **kw):
+        calls.append((np.asarray(prompts).shape[0],
+                      tuple(np.asarray(lengths))))
+        return real(prompts, lengths, n, **kw)
 
-    lm.generate = counting
+    lm.generate_ragged = counting
     try:
         r = np.random.RandomState(2)
-        p = [r.randint(0, 32, (6,)) for _ in range(4)]
-        _serve_all(svc, [(q, 4) for q in p])
+        # DIFFERENT true lengths, same 16-prompt-bucket + same decode
+        # bucket -> one ragged dispatch serves them all
+        reqs = [(r.randint(0, 32, (L,)), 4) for L in (6, 9, 3, 12)]
+        _serve_all(svc, reqs)
     finally:
-        del lm.generate
-    # 4 same-shape concurrent requests, window 200ms, cap 4 -> ONE
-    # dispatch (padded to max_batch by the micro-batcher)
-    assert calls == [4], calls
+        del lm.generate_ragged
+    assert len(calls) == 1 and calls[0][0] == 4, calls
+    assert sorted(calls[0][1]) == [3, 6, 9, 12]
 
 
 def test_eos_and_validation(lm):
@@ -130,6 +132,26 @@ def test_near_context_limit_request_fits(lm):
 def test_greedy_service_rejects_sampling_filters(lm):
     with pytest.raises(ValueError, match="temperature"):
         GenerationService(lm, top_k=50)
+    with pytest.raises(ValueError, match="top_p"):
+        GenerationService(lm, temperature=0.8, top_p=1.5)
+
+
+def test_tight_requests_with_mixed_n_never_jointly_overflow(lm):
+    """Two requests that each fit the context alone but whose COMBINED
+    (lmax, n_req) would exceed it must still both succeed: tight-region
+    requests group by exact n, so no batch can overflow (review
+    regression). max_len=48: A t0=40,n=8 and B t0=33,n=15 share the
+    prompt bucket and decode bucket but must not share a batch."""
+    svc = GenerationService(lm, max_batch=4, batch_timeout_ms=100.0,
+                            bucket_tokens=16, prompt_bucket=48)
+    r = np.random.RandomState(6)
+    a = r.randint(0, 32, (40,))
+    b = r.randint(0, 32, (33,))
+    rows = _serve_all(svc, [(a, 8), (b, 15)])
+    np.testing.assert_array_equal(
+        rows[0], np.asarray(lm.generate(jnp.asarray(a)[None], 8))[0])
+    np.testing.assert_array_equal(
+        rows[1], np.asarray(lm.generate(jnp.asarray(b)[None], 15))[0])
 
 
 def test_sampled_mode_serves(lm):

@@ -67,7 +67,7 @@ def test_generate_reuses_jitted_step_across_calls():
     m.generate(prompt, 4)
     m.generate(prompt, 4, host_loop=True)
     m.generate(prompt, 4, host_loop=True)
-    step_jit, prefill_jit, _chunk_jit, scan_jit = m._decode_fns()
+    step_jit, prefill_jit, _chunk_jit, scan_jit = m._decode_fns()[:4]
     assert scan_jit._cache_size() == 1, scan_jit._cache_size()
     assert step_jit._cache_size() == 1, step_jit._cache_size()
     assert prefill_jit._cache_size() == 1
