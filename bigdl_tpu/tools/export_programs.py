@@ -341,6 +341,35 @@ def sharded_decode_scan_program(n_devices: int = 8, batch: int = 4,
             (params, buffers, logits, pos0, caches, rng))
 
 
+def ragged_decode_program(batch: int = 8, n_tokens: int = 32,
+                          vocab: int = 32000, embed_dim: int = 512,
+                          layers: int = 8, heads: int = 8,
+                          kv_heads: int = 2, max_len: int = 2048,
+                          dtype=jnp.bfloat16):
+    """The ragged serving program (generate_ragged / GenerationService):
+    per-row last-valid prefill + the decode scan carrying a (B,) per-row
+    position vector — per-row cache writes, masks, and RoPE."""
+    from bigdl_tpu.nn.module import bind
+
+    model, params, buffers, caches = _serving_model(
+        batch, vocab, embed_dim, layers, heads, kv_heads, max_len, dtype)
+
+    def ragged(p, bufs, ids, lengths, caches, rng):
+        with bind(model, p, bufs, False, None):
+            logits, caches = model._prefill_impl(
+                ids, caches, 0, chunked=False, gather_last=lengths - 1)
+            return model.decode_scan(logits, lengths, caches, rng,
+                                     jnp.float32(0.8), n_tokens,
+                                     sampled=True, eos_id=2, top_p=0.95)
+
+    tmax = max_len - n_tokens
+    ids = jax.ShapeDtypeStruct((batch, tmax), jnp.int32)
+    lengths = jax.ShapeDtypeStruct((batch,), jnp.int32)
+    rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    return (jax.jit(ragged, donate_argnums=(4,)),
+            (params, buffers, ids, lengths, caches, rng))
+
+
 def beam_scan_program(batch: int = 4, beams: int = 4, n_tokens: int = 32,
                       vocab: int = 32000, embed_dim: int = 512,
                       layers: int = 8, heads: int = 8, kv_heads: int = 2,

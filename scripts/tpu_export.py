@@ -58,6 +58,8 @@ def main():
          lambda: ep.beam_scan_program()),
         ("sharded_decode_scan_8dev_t2048",
          lambda: ep.sharded_decode_scan_program()),
+        ("ragged_decode_b8_n32_l8_t2048",
+         lambda: ep.ragged_decode_program()),
         ("chunked_prefill_c256_t2048",
          lambda: ep.chunked_prefill_program()),
         ("resnet50_sharded_step_b256",

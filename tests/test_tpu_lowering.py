@@ -98,6 +98,15 @@ def test_sharded_decode_scan_lowers_for_tpu():
     assert exported.nr_devices == 8
 
 
+def test_ragged_decode_lowers_for_tpu():
+    """The ragged serving program (per-row last-valid prefill + the
+    decode scan over a (B,) position vector) cross-lowers for TPU."""
+    fn, args = ep.ragged_decode_program(batch=2, n_tokens=4, vocab=64,
+                                        embed_dim=32, layers=1, heads=4,
+                                        kv_heads=2, max_len=32)
+    _export(fn, args)
+
+
 def test_beam_scan_lowers_for_tpu():
     """The one-dispatch scanned beam search (top-k reselection + cache
     lineage gathers + parent-pointer backtracking inside one scan)
