@@ -66,6 +66,8 @@ class GenerationService:
         self.max_len = max_len
         self._key = jax.random.PRNGKey(seed)
         self._lock = threading.Lock()
+        self._served = 0
+        self._dispatches = 0
         # one device dispatch at a time: tracing generate() binds state
         # on the module (not thread-safe across concurrent traces), and
         # the chip runs one program at a time anyway — concurrency value
@@ -107,7 +109,8 @@ class GenerationService:
                             max_len=pinned, **kw))
 
                 b = _MicroBatcher(run_batch, self.max_batch,
-                                  self.batch_timeout_ms)
+                                  self.batch_timeout_ms,
+                                  on_batch=self._count_batch)
                 self._batchers[key] = b
             return b
 
@@ -142,3 +145,22 @@ class GenerationService:
         row[-2], row[-1] = t0, n
         toks = self._batcher(key).submit(row)
         return np.concatenate([prompt, np.asarray(toks[:n])])
+
+    def _count_batch(self, real_size: int):
+        # ONE counting point (as each batch launches, with its REAL
+        # pre-padding size): failed or in-flight batches can never skew
+        # the served/dispatch ratio
+        with self._lock:
+            self._served += real_size
+            self._dispatches += 1
+
+    def stats(self) -> dict:
+        """Operational counters: requests batched, device dispatches,
+        and mean real-requests-per-dispatch (how well the micro-batcher
+        is coalescing — 1.0 means every request paid its own dispatch,
+        ``max_batch`` means perfect occupancy)."""
+        with self._lock:
+            served, disp = self._served, self._dispatches
+        return {"served": served, "dispatches": disp,
+                "mean_batch_occupancy": round(served / disp, 3)
+                if disp else 0.0}

@@ -82,12 +82,16 @@ class _MicroBatcher:
     ``max_batch`` so XLA sees exactly ONE input signature (no per-load-level
     recompiles)."""
 
-    def __init__(self, run_batch, max_batch: int, timeout_ms: float):
+    def __init__(self, run_batch, max_batch: int, timeout_ms: float,
+                 on_batch=None):
         self._run = run_batch
         self.max_batch = max_batch
         self.timeout = timeout_ms / 1000.0
         self._lock = threading.Condition()
         self._pending = {}  # signature -> list of (array, event, slot)
+        #: optional callable(real_batch_size) invoked as each batch
+        #: launches — the REAL request count, before padding (telemetry)
+        self._on_batch = on_batch
 
     def submit(self, x):
         x = np.asarray(x)
@@ -124,6 +128,8 @@ class _MicroBatcher:
             else:
                 self._pending.pop(sig, None)
         xs = [b[0] for b in batch]
+        if self._on_batch is not None:
+            self._on_batch(len(xs))
         try:
             pad = self.max_batch - len(xs)  # fixed shape -> one compile
             stacked = np.stack(xs + [xs[-1]] * pad)

@@ -98,6 +98,9 @@ def test_requests_actually_coalesce_across_lengths(lm):
         del lm.generate_ragged
     assert len(calls) == 1 and calls[0][0] == 4, calls
     assert sorted(calls[0][1]) == [3, 6, 9, 12]
+    s = svc.stats()
+    assert s["served"] == 4 and s["dispatches"] == 1
+    assert s["mean_batch_occupancy"] == 4.0
 
 
 def test_eos_and_validation(lm):
