@@ -80,6 +80,41 @@ def _sample_next(logits, rng, done, sampled, temperature, eos_id,
     return nxt, rng, done
 
 
+@jax.jit
+def _spec_accept(p_logits, q_logits, props, temperature, rng):
+    """Speculative-sampling acceptance (Leviathan et al. 2023, Thm 1):
+    given target logits ``p_logits`` (B, g+1, V) at positions
+    pos..pos+g, draft logits ``q_logits`` (B, g, V) and sampled
+    proposals ``props`` (B, g), return per-proposal acceptance
+    (U < p(x)/q(x)), a residual sample from norm(max(p - q, 0)) for
+    every position (used at each row's first rejection), and a bonus
+    sample from p at position g (used on full acceptance). Taking the
+    proposal where accepted and the residual where rejected is
+    distributed EXACTLY as p — the identity a unit test pins
+    empirically."""
+    b, g = props.shape
+    p = jax.nn.softmax(p_logits.astype(jnp.float32) / temperature, axis=-1)
+    q = jax.nn.softmax(q_logits.astype(jnp.float32) / temperature, axis=-1)
+    p_at = jnp.take_along_axis(p[:, :g], props[..., None], axis=-1)[..., 0]
+    q_at = jnp.take_along_axis(q, props[..., None], axis=-1)[..., 0]
+    r_accept, r_resid, r_bonus = jax.random.split(rng, 3)
+    u = jax.random.uniform(r_accept, (b, g))
+    accept = u * q_at < p_at          # U < p/q without the 0/0 division
+    resid = jnp.maximum(p[:, :g] - q, 0.0)
+    mass = jnp.sum(resid, axis=-1, keepdims=True)
+    # p == q -> empty residual; that position always accepts, so the
+    # fallback (sample from p) is never USED, it just keeps gumbel finite
+    resid = jnp.where(mass > 0.0, resid / jnp.maximum(mass, 1e-30),
+                      p[:, :g])
+    resid_toks = jax.random.categorical(
+        r_resid, jnp.log(jnp.maximum(resid, 1e-30)), axis=-1
+    ).astype(jnp.int32)
+    bonus = jax.random.categorical(
+        r_bonus, jnp.log(jnp.maximum(p[:, g], 1e-30)), axis=-1
+    ).astype(jnp.int32)
+    return accept, resid_toks, bonus
+
+
 def _gather_beam_lineage(caches, idx, b, k):
     """Reorder (B*K, ...) KV caches so row j follows beam j's surviving
     lineage: ``idx[b, j]`` names the parent beam whose cache the new
@@ -649,29 +684,39 @@ class TransformerLM(Module):
                                           jnp.int32(t0 + i), caches)
         return jnp.stack(ids, axis=1)
 
-    def _propose_fn(self, b: int, gamma: int):
-        """Cached jitted draft proposer: gamma greedy step->argmax
-        iterations as ONE lax.scan dispatch, writing the input tokens' KV
-        as it goes. Returns ((gamma, B) proposals, caches)."""
+    def _propose_fn(self, b: int, gamma: int, sampled: bool = False):
+        """Cached jitted draft proposer: gamma step->choose iterations as
+        ONE lax.scan dispatch (argmax when greedy, tempered categorical
+        when ``sampled``), writing the input tokens' KV as it goes.
+        Returns ((gamma, B) proposals, (gamma, B, V) step logits — the
+        sampled verifier's q distributions, ignored by the greedy
+        caller — and the caches). One factory for both modes so the
+        proposal scan can never diverge between them."""
         per_model = _SPEC_JIT.setdefault(self, {})
-        key = ("propose", b, gamma)
+        key = ("propose", b, gamma, sampled)
         fn = per_model.get(key)
         if fn is not None:
             return fn
         from bigdl_tpu.nn.module import bind
 
-        def propose(p, bufs, tok, pos0, caches):
+        def propose(p, bufs, tok, pos0, caches, rng, temperature):
             with bind(self, p, bufs, False, None):
                 def body(carry, _):
-                    tok, pos, caches = carry
+                    tok, pos, caches, rng = carry
                     logits, caches = self.decode_step(tok, pos, caches)
-                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                    return (nxt, pos + 1, caches), nxt
+                    if sampled:
+                        rng, sub = jax.random.split(rng)
+                        nxt = jax.random.categorical(
+                            sub, logits.astype(jnp.float32) / temperature,
+                            axis=-1).astype(jnp.int32)
+                    else:
+                        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    return (nxt, pos + 1, caches, rng), (nxt, logits)
 
-                carry = (tok, jnp.asarray(pos0, jnp.int32), caches)
-                (_, _, caches), toks = jax.lax.scan(body, carry, None,
-                                                    length=gamma)
-                return toks, caches
+                carry = (tok, jnp.asarray(pos0, jnp.int32), caches, rng)
+                (_, _, caches, _), (toks, qlogits) = jax.lax.scan(
+                    body, carry, None, length=gamma)
+                return toks, qlogits, caches
 
         fn = jax.jit(propose, donate_argnums=(4,))
         per_model[key] = fn
@@ -696,24 +741,41 @@ class TransformerLM(Module):
 
     def speculative_generate(self, prompt_ids, max_new_tokens: int,
                              draft, gamma: int = 4, max_len=None,
-                             return_stats: bool = False):
-        """Greedy speculative decoding: ``draft`` (a smaller, cheaper
+                             return_stats: bool = False,
+                             temperature: float = 0.0, rng=None):
+        """Speculative decoding: ``draft`` (a smaller, cheaper
         TransformerLM over the same vocabulary — an int8-quantized clone
         works) proposes ``gamma`` tokens per round with its own KV cache;
         this model then scores ALL of them in ONE chunked verify forward
-        (``verify_chunk``, traced offset) and accepts the longest prefix
-        that matches its own greedy choice, taking its own token at the
-        first mismatch. Output is therefore EXACTLY this model's greedy
-        ``generate()`` — the draft only changes how many target forwards
-        it takes to get there: per round, 1 target chunk forward yields
-        accepted+1 tokens instead of 1.
+        (``verify_chunk``, traced offset).
 
-        Acceptance is conservative across the batch (min over rows), so
-        every returned row is still exact. Returns (B, t0 + n) ids, or
-        ``(ids, {"rounds", "accept_rate"})`` with ``return_stats=True``.
+        ``temperature == 0`` (default): greedy — accept the longest
+        prefix matching this model's argmax, take its own token at the
+        first mismatch. Output is EXACTLY greedy ``generate()``.
 
-        Reference analog: none (the reference has no speculative path);
-        technique per Leviathan et al. 2023, greedy specialization."""
+        ``temperature > 0``: full speculative SAMPLING (Leviathan et al.
+        2023) — the draft samples its proposals, each is accepted with
+        probability min(1, p/q), and the first rejected position draws
+        from the normalized residual max(p - q, 0); on full acceptance a
+        bonus token samples from p. The output is distributed EXACTLY as
+        tempered sampling from this model (the accept/residual identity
+        is pinned empirically in tests).
+
+        Either way the draft only changes how many target forwards it
+        takes: per round, 1 target chunk forward yields accepted+1
+        tokens instead of 1. Acceptance is conservative across the batch
+        (min over rows) — rows that would have accepted more simply lose
+        the extra proposals (wasted work, never wrong). Returns
+        (B, t0 + n) ids, or ``(ids, {"rounds", "accept_rate"})`` with
+        ``return_stats=True``.
+
+        Reference analog: none (the reference has no speculative
+        path)."""
+        from bigdl_tpu.utils import random as bt_random
+
+        sampled = temperature > 0.0
+        if sampled and rng is None:
+            rng = bt_random.next_key()
         prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
         if prompt_ids.ndim == 1:
             prompt_ids = prompt_ids[None]
@@ -731,7 +793,8 @@ class TransformerLM(Module):
         # pos+gamma — so gamma <= ctx-t0-n+1 keeps every write in bounds
         gamma = min(gamma, ctx - t0 - n + 1)
         if t0 + n > ctx or gamma < 1:
-            ids = self.generate(prompt_ids, n, max_len=max_len)
+            ids = self.generate(prompt_ids, n, max_len=max_len,
+                                temperature=temperature, rng=rng)
             return (ids, {"rounds": n, "accept_rate": 0.0}) \
                 if return_stats else ids
 
@@ -740,7 +803,7 @@ class TransformerLM(Module):
         t_prefill = self._decode_fns()[1]
         d_prefill = draft._decode_fns()[1]
         d_step = draft._decode_fns()[0]
-        d_propose = draft._propose_fn(b, gamma)
+        d_propose = draft._propose_fn(b, gamma, sampled=sampled)
         verify = self._verify_fn(b, gamma + 1)
 
         t_caches = self.init_cache(b, ctx, dtype=self.tok_embed.dtype)
@@ -749,15 +812,26 @@ class TransformerLM(Module):
                                        t_caches)
         _, d_caches = d_prefill(d_params, d_bufs, prompt_ids, d_caches)
 
-        next_tok = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # @ t0
+        if sampled:  # token @ t0 samples from the target prefill logits
+            rng, sub = jax.random.split(rng)
+            next_tok = jax.random.categorical(
+                sub, t_logits.astype(jnp.float32) / temperature,
+                axis=-1).astype(jnp.int32)
+        else:
+            next_tok = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
         out = [next_tok]
         pos = t0            # next_tok's position; its KV is not yet cached
         rounds = accepted = 0
         while len(out) < n:
             # draft proposes gamma tokens in ONE dispatch (lax.scan),
             # writing KV for positions pos .. pos+gamma-1 (its inputs)
-            toks, d_caches = d_propose(d_params, d_bufs, next_tok,
-                                       jnp.int32(pos), d_caches)
+            if sampled:
+                rng, r_draft, r_acc = jax.random.split(rng, 3)
+            else:
+                r_draft = jax.random.PRNGKey(0)  # greedy: rng unused
+            toks, qlogits, d_caches = d_propose(
+                d_params, d_bufs, next_tok, jnp.int32(pos), d_caches,
+                r_draft, jnp.float32(temperature if sampled else 1.0))
             props = toks.T                                     # (B, g)
             # one target forward scores positions pos .. pos+gamma:
             # chunk token j sits at position pos+j; logits row j predicts
@@ -765,20 +839,41 @@ class TransformerLM(Module):
             chunk = jnp.concatenate([next_tok[:, None], props], axis=1)
             v_logits, t_caches = verify(t_params, t_bufs, chunk, t_caches,
                                         jnp.int32(pos))
-            v_tok = jnp.argmax(v_logits, axis=-1).astype(jnp.int32)
-            # longest prefix where the draft matched the target's greedy
-            # choice, conservative across rows (min) so rows stay exact
-            match = (props == v_tok[:, :gamma]).astype(jnp.int32)
-            a = int(jnp.min(jnp.sum(jnp.cumprod(match, axis=1), axis=1)))
-            out.extend(props[:, j] for j in range(a))
-            out.append(v_tok[:, a])     # target's token at pos+a+1 (bonus)
+            if sampled:
+                accept, resid, bonus = _spec_accept(
+                    v_logits, jnp.swapaxes(qlogits, 0, 1), props,
+                    jnp.float32(temperature), r_acc)
+                acc = accept.astype(jnp.int32)
+                a = int(jnp.min(jnp.sum(jnp.cumprod(acc, axis=1),
+                                        axis=1)))
+                out.extend(props[:, j] for j in range(a))
+                if a == gamma:
+                    out.append(bonus)       # fresh sample from p @ pos+g+1
+                    next_tok = bonus
+                else:
+                    # rows still accepting at column a keep their
+                    # proposal; rows rejecting draw from the residual —
+                    # together distributed exactly as p (Thm 1)
+                    tok_a = jnp.where(accept[:, a], props[:, a],
+                                      resid[:, a])
+                    out.append(tok_a)
+                    next_tok = tok_a
+            else:
+                v_tok = jnp.argmax(v_logits, axis=-1).astype(jnp.int32)
+                # longest prefix where the draft matched the target's
+                # greedy choice, conservative across rows (min)
+                match = (props == v_tok[:, :gamma]).astype(jnp.int32)
+                a = int(jnp.min(jnp.sum(jnp.cumprod(match, axis=1),
+                                        axis=1)))
+                out.extend(props[:, j] for j in range(a))
+                out.append(v_tok[:, a])  # target's token at pos+a+1
+                next_tok = v_tok[:, a]
             if a == gamma:
                 # full acceptance: proposals[-1] (position pos+gamma) was
                 # never fed through the draft — write its KV so the next
                 # round's draft attention sees a complete cache
                 _, d_caches = d_step(d_params, d_bufs, props[:, -1],
                                      jnp.int32(pos + gamma), d_caches)
-            next_tok = v_tok[:, a]
             pos += a + 1
             rounds += 1
             accepted += a
