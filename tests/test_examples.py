@@ -92,3 +92,17 @@ def test_widedeep_example_feature_columns_learn():
     rnd.set_seed(3)
     _, acc, base = main(["--samples", "1024", "--max-epoch", "8"])
     assert acc > base + 0.08, (acc, base)
+
+
+def test_serving_example():
+    """The serving walkthrough (one-dispatch generate/beam, ragged,
+    int8-draft speculation, concurrent GenerationService) runs end to
+    end and returns the concurrently-served rows (exactly prompt + n
+    tokens each — the service contract)."""
+    from bigdl_tpu.example.serving.serve import main
+
+    rows = main(["--tokens", "8", "--vocab", "64"])
+    assert len(rows) == 4
+    for row, (t0, want_n) in zip(rows, ((5, 8), (9, 4), (12, 8), (7, 4))):
+        assert row is not None and row.ndim == 1
+        assert row.shape[0] == t0 + want_n
