@@ -45,8 +45,8 @@ def main(argv=None):
     r = np.random.RandomState(0)
     prompt = jnp.asarray(r.randint(0, args.vocab, (2, 12)))
 
-    out = model.generate(prompt, n)                      # ONE dispatch
-    print(f"[greedy]    {np.asarray(out[0, 12:12 + 8])}...")
+    greedy = model.generate(prompt, n)                   # ONE dispatch
+    print(f"[greedy]    {np.asarray(greedy[0, 12:12 + 8])}...")
     out = model.generate(prompt, n, temperature=0.8, top_k=40,
                          top_p=0.95, eos_id=0,
                          rng=jax.random.PRNGKey(1))
@@ -67,8 +67,7 @@ def main(argv=None):
     draft.evaluate()
     ids, st = model.speculative_generate(prompt, n, draft=draft, gamma=4,
                                          return_stats=True)
-    exact = bool((np.asarray(ids) == np.asarray(
-        model.generate(prompt, n))).all())
+    exact = bool((np.asarray(ids) == np.asarray(greedy)).all())
     print(f"[speculate] greedy: accept {st['accept_rate']:.0%} over "
           f"{st['rounds']} rounds; exact == generate(): {exact}")
     _, st = model.speculative_generate(prompt, n, draft=draft, gamma=4,

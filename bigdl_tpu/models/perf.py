@@ -239,7 +239,7 @@ def run_decode_perf(batch_size: int = 8, prompt_len: int = 128,
                     num_kv_heads: Optional[int] = None,
                     use_rope: bool = True, dtype=jnp.bfloat16,
                     int8: bool = False, speculative: int = 0,
-                    spec_gamma: int = 4,
+                    spec_gamma: int = 4, spec_int8_draft: bool = False,
                     profile_dir: Optional[str] = None, log=print) -> dict:
     """Serving-side throughput: KV-cache autoregressive decode tokens/sec.
     generate() keeps its jitted prefill/step per model instance, so the
@@ -253,13 +253,18 @@ def run_decode_perf(batch_size: int = 8, prompt_len: int = 128,
         prompt_len, new_tokens = min(prompt_len, 16), min(new_tokens, 16)
         if speculative:
             speculative = min(speculative, layers - 1)
-    if speculative and int8:
-        raise ValueError("--speculative builds its draft from the float "
-                         "params; combine with --int8 is not supported")
+    if (speculative or spec_int8_draft) and int8:
+        raise ValueError("speculative modes build their draft from the "
+                         "float target; combine with --int8 is not "
+                         "supported")
+    if speculative and spec_int8_draft:
+        raise ValueError("--speculative K and --speculative-int8 are "
+                         "alternative draft choices; pick one")
     if speculative and speculative >= layers:
         raise ValueError(f"--speculative draft layers ({speculative}) must "
                          f"be < target layers ({layers})")
-    max_len = prompt_len + new_tokens + (spec_gamma if speculative else 0)
+    spec = bool(speculative or spec_int8_draft)
+    max_len = prompt_len + new_tokens + (spec_gamma if spec else 0)
     model = TransformerLM(vocab, embed_dim=embed_dim, num_heads=heads,
                           num_layers=layers, num_kv_heads=num_kv_heads,
                           max_len=max_len, use_rope=use_rope)
@@ -281,6 +286,14 @@ def run_decode_perf(batch_size: int = 8, prompt_len: int = 128,
         draft.evaluate()
         tp = model.params_dict()
         draft.load_params_dict({k: tp[k] for k in draft.params_dict()})
+    elif spec_int8_draft:
+        # int8 clone of the FULL target as the draft: near-100% greedy
+        # acceptance (int8 rarely flips the argmax), so the measured
+        # speedup isolates the int8 weight-traffic saving per proposal
+        from bigdl_tpu.nn.quantized import Quantizer
+
+        draft = Quantizer.quantize(model)
+        draft.evaluate()
     if int8:
         # post-training int8: every Linear swaps to the int8 kernel —
         # weight HBM traffic halves vs bf16 (the term decode is bound
@@ -337,7 +350,7 @@ def run_decode_perf(batch_size: int = 8, prompt_len: int = 128,
                                            return_stats=True)
         spec_s = time.perf_counter() - t0
         s.update({
-            "speculative_draft_layers": speculative,
+            "speculative_draft_layers": speculative or "int8",
             "spec_gamma": spec_gamma,
             "spec_tokens_per_sec": round(
                 batch_size * new_tokens / spec_s, 2),
@@ -532,6 +545,11 @@ def main(argv=None):
                         "tokens; reports accept rate + speedup)")
     p.add_argument("--spec-gamma", type=int, default=4,
                    help="--speculative: draft proposals per round")
+    p.add_argument("--speculative-int8", action="store_true",
+                   help="--decode: speculative decoding with the int8 "
+                        "clone of the target as the draft (near-100%% "
+                        "greedy acceptance; isolates the int8 "
+                        "weight-traffic saving per proposal)")
     args = p.parse_args(argv)
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     if args.input_pipeline:
@@ -554,6 +572,7 @@ def main(argv=None):
                             new_tokens=args.new_tokens,
                             int8=args.int8, speculative=args.speculative,
                             spec_gamma=args.spec_gamma,
+                            spec_int8_draft=args.speculative_int8,
                             profile_dir=args.profile)
         s["device"] = str(getattr(jax.devices()[0], "device_kind",
                                   jax.devices()[0].platform))

@@ -8,7 +8,7 @@ Order (round-5 window lessons: headline first, latency-bound stages last):
   3. flash-vs-dense transformer matrix         -> flash_matrix.jsonl
   4. host input-pipeline throughput            -> bench_history.jsonl
   5. (optional, --profile) profiler trace      -> /tmp/tpu_trace
-  6. decode + int8 decode throughput           -> bench_history.jsonl
+  6. decode + int8 + speculative (int8-draft)  -> bench_history.jsonl
 
 Every stage is wrapped in its own subprocess + timeout so a wedge mid-way
 still leaves earlier results on disk, and a ~5s tunnel probe runs before
@@ -130,6 +130,14 @@ def main(argv=None):
                          "--decode", "--batch-size", "8",
                          "--dtype", "bfloat16", "--int8",
                          "--new-tokens", "128"], 900, None))
+    # int8-clone draft accepts ~100% greedy, so this measures the real
+    # speculative speedup even on random bench weights
+    stages.append(
+        ("decode-speculative", [sys.executable, "-m",
+                                "bigdl_tpu.models.perf", "--decode",
+                                "--batch-size", "8", "--dtype", "bfloat16",
+                                "--speculative-int8",
+                                "--new-tokens", "128"], 900, None))
 
     results = {}
     tunnel_lost = False

@@ -52,6 +52,27 @@ def test_decode_perf_smoke(kv_heads):
     assert s["num_kv_heads"] == (kv_heads or 4)  # CPU smoke uses 4 heads
 
 
+def test_decode_perf_speculative_int8_draft():
+    """The hardware session's decode-speculative stage must never crash
+    inside a scarce tunnel window: the int8-clone-draft path runs on CPU
+    and reports its rate fields."""
+    from bigdl_tpu.models.perf import run_decode_perf
+
+    s = run_decode_perf(batch_size=2, dtype=jnp.float32,
+                        spec_int8_draft=True, log=lambda *a, **k: None)
+    assert s["speculative_draft_layers"] == "int8"
+    assert s["spec_tokens_per_sec"] > 0
+    assert 0.0 <= s["spec_accept_rate"] <= 1.0
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="pick one"):
+        run_decode_perf(batch_size=2, speculative=1, spec_int8_draft=True,
+                        log=lambda *a, **k: None)
+    with _pytest.raises(ValueError, match="int8"):
+        run_decode_perf(batch_size=2, int8=True, spec_int8_draft=True,
+                        log=lambda *a, **k: None)
+
+
 def test_generate_reuses_jitted_step_across_calls():
     # regression: generate() used to rebuild its jit wrappers per call,
     # recompiling every time (decode benchmarks measured compilation)

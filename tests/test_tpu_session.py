@@ -46,7 +46,7 @@ def test_all_stages_ok_returns_0_in_priority_order(session_mod):
     assert session_mod.main(["--profile"]) == 0
     assert calls == ["probe", "bench", "sweep", "flash-matrix",
                      "input-pipeline", "profile", "decode-throughput",
-                     "decode-int8"]
+                     "decode-int8", "decode-speculative"]
 
 
 def test_wedged_at_start_returns_5(session_mod):
