@@ -909,3 +909,44 @@ class TestRingFlash:
             out_specs=P(None, None, "seq", None), check_vma=False))(q, k, v)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-4, atol=2e-5)
+
+
+def test_int8_beam_search_and_mesh_ragged_compose():
+    """Cross-products of the serving features: the int8-quantized model
+    serves through the scanned beam search (eos freezing intact), and
+    ragged decode runs SPMD with batch-sharded rows on the 8-device
+    mesh, matching the unsharded tokens exactly."""
+    from jax.sharding import NamedSharding
+
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.nn.quantized import Quantizer
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(33)
+    m = TransformerLM(32, embed_dim=16, num_heads=4, num_kv_heads=2,
+                      num_layers=2, max_len=24, use_rope=True)
+    m.evaluate()
+    prompt = jnp.asarray(np.random.RandomState(20).randint(0, 32, (2, 5)))
+
+    q = Quantizer.quantize(m)
+    q.evaluate()
+    out = np.asarray(q.beam_search(prompt, 8, num_beams=3, eos_id=0))
+    assert out.shape == (2, 13)
+    for row in out[:, 5:]:
+        hits = np.where(row == 0)[0]
+        if len(hits):
+            assert (row[hits[0]:] == 0).all()
+
+    mesh = Engine.create_mesh([("data", 8)])
+    lengths = np.asarray([3, 5, 7, 4, 6, 2, 5, 3])
+    padded = np.zeros((8, 7), np.int64)
+    r = np.random.RandomState(21)
+    for i, L in enumerate(lengths):
+        padded[i, :L] = r.randint(0, 32, (L,))
+    want = np.asarray(m.generate_ragged(padded, lengths, 6))
+    sp = jax.device_put(jnp.asarray(padded, jnp.int32),
+                        NamedSharding(mesh, P("data", None)))
+    sl = jax.device_put(jnp.asarray(lengths, jnp.int32),
+                        NamedSharding(mesh, P("data")))
+    np.testing.assert_array_equal(
+        np.asarray(m.generate_ragged(sp, sl, 6)), want)
