@@ -614,7 +614,7 @@ class TransformerLM(Module):
                  temperature: float = 0.0, rng=None, max_len=None,
                  prefill_chunk=None, host_loop: bool = False,
                  bucket_tokens=None, eos_id=None, top_k=None,
-                 top_p=None, kv_cache_sharding=None):
+                 top_p=None, kv_cache_sharding=None, on_token=None):
         """Autoregressive generation with a KV cache (the transformer
         analog of the reference's RecurrentDecoder, nn/RecurrentDecoder
         .scala): batched prefill over the prompt, then the ENTIRE
@@ -629,7 +629,10 @@ class TransformerLM(Module):
         (B, len(prompt) + max_new_tokens) ids. ``prefill_chunk`` bounds
         long-prompt prefill memory (see _decode_setup). ``host_loop=True``
         forces the one-dispatch-per-token path (the scan parity oracle;
-        also what a caller streaming tokens as they land would use).
+        also what a caller streaming tokens as they land would use;
+        ``on_token(step_tokens)`` fires per generated (B,) step there —
+        asking for streaming implies the host loop, so passing
+        ``on_token`` without ``host_loop=True`` raises).
 
         The scan compiles once per decode length; serving callers with
         per-request lengths should set ``bucket_tokens=B`` to round the
@@ -647,6 +650,10 @@ class TransformerLM(Module):
 
         sampled = temperature > 0.0
         _validate_sampling(sampled, top_k, top_p)
+        if on_token is not None and not host_loop:
+            raise ValueError("on_token streams per-step tokens, which "
+                             "only the host loop materializes; pass "
+                             "host_loop=True")
         (prompt_ids, b, t0, params, buffers, step_jit,
          logits, caches) = self._decode_setup(prompt_ids, max_new_tokens,
                                               max_len, prefill_chunk,
@@ -673,6 +680,8 @@ class TransformerLM(Module):
                 logits, rng, done, sampled,
                 temperature if sampled else 1.0, eos_id, top_k, top_p)
             ids.append(nxt)
+            if on_token is not None:
+                on_token(nxt)  # streaming: the (B,) tokens of step i
             if eos_id is not None and bool(jnp.all(done)):
                 # every row finished: pad the rest with eos (what the
                 # scan path's done-masking emits) and stop dispatching

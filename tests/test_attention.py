@@ -950,3 +950,38 @@ def test_int8_beam_search_and_mesh_ragged_compose():
                         NamedSharding(mesh, P("data")))
     np.testing.assert_array_equal(
         np.asarray(m.generate_ragged(sp, sl, 6)), want)
+
+
+def test_generate_streaming_callback():
+    """host_loop streaming: on_token fires once per generated step with
+    that step's (B,) tokens, in order, matching the returned ids; eos
+    early-exit still pads the RETURN but streams only real steps; the
+    scan path rejects on_token loudly."""
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(34)
+    m = TransformerLM(32, embed_dim=16, num_heads=4, num_layers=1,
+                      max_len=16)
+    m.evaluate()
+    prompt = jnp.asarray(np.random.RandomState(22).randint(0, 32, (2, 4)))
+    streamed = []
+    out = m.generate(prompt, 6, host_loop=True,
+                     on_token=lambda t: streamed.append(np.asarray(t)))
+    assert len(streamed) == 6
+    np.testing.assert_array_equal(np.stack(streamed, axis=1),
+                                  np.asarray(out[:, 4:]))
+    # eos early-exit: the RETURN pads to n, but only real (pre-exit)
+    # steps stream — force instant termination by using the first
+    # greedy tokens as "eos" for every row
+    eos = int(np.asarray(out[0, 4]))
+    if (np.asarray(out[:, 4]) == eos).all():
+        streamed.clear()
+        padded = m.generate(prompt, 6, host_loop=True, eos_id=eos,
+                            on_token=lambda t: streamed.append(
+                                np.asarray(t)))
+        assert padded.shape == (2, 10)
+        assert (np.asarray(padded[:, 5:]) == eos).all()
+        assert len(streamed) == 1  # one real step, no synthetic pads
+    with pytest.raises(ValueError, match="host_loop"):
+        m.generate(prompt, 6, on_token=lambda t: None)
