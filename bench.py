@@ -351,7 +351,66 @@ def bench_main(argv=None):
             signal.alarm(0)
             signal.signal(signal.SIGALRM, old)
 
+    _record_bench_metrics(result, model)
+    _dump_prometheus_snapshot()
     print(json.dumps(result))
+
+
+def _record_bench_metrics(result, model):
+    """Mirror the headline numbers into the observability registry —
+    bench snapshots and live scrapes then share one metric schema
+    (bigdl_* names), so the perf trajectory is diffable against
+    production telemetry. Never lets telemetry break the bench."""
+    try:
+        from bigdl_tpu import observability as obs
+
+        # the CURRENT default registry — the same one the snapshot dump
+        # renders (a swapped default must see both halves consistently)
+        reg = obs.default_registry()
+        lbl = ("model",)
+        d = result["detail"]
+        reg.gauge(
+            "bigdl_bench_imgs_per_sec_per_chip",
+            "Bench headline training throughput", labelnames=lbl
+        ).labels(model).set(result["value"])
+        reg.gauge(
+            "bigdl_bench_ms_per_iter", "Bench per-iteration wall time",
+            labelnames=lbl).labels(model).set(d["ms_per_iter"])
+        reg.gauge(
+            "bigdl_bench_mfu", "Bench model FLOPs utilization",
+            labelnames=lbl).labels(model).set(d["mfu"])
+        if result.get("vs_baseline") is not None:
+            reg.gauge(
+                "bigdl_bench_vs_baseline",
+                "Headline vs the north-star baseline (>1.0 beats it)",
+                labelnames=lbl).labels(model).set(result["vs_baseline"])
+        if d.get("lenet_mnist_epoch_s") is not None:
+            reg.gauge(
+                "bigdl_bench_lenet_mnist_epoch_seconds",
+                "LeNet-MNIST synthetic epoch wall clock"
+            ).set(d["lenet_mnist_epoch_s"])
+    except Exception as e:
+        print(f"[bench] metrics registry update failed: {e}",
+              file=sys.stderr)
+
+
+def _dump_prometheus_snapshot():
+    """Prometheus text snapshot alongside the BENCH_*.json trend files
+    (path overridable via BIGDL_BENCH_PROM). Includes everything the run
+    put in the default registry — bench gauges plus any bigdl_train_*
+    series the perf loops populated."""
+    import os
+
+    try:
+        from bigdl_tpu import observability as obs
+
+        path = (os.environ.get("BIGDL_BENCH_PROM")
+                or os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "bench_metrics.prom"))
+        obs.write_prometheus(path)
+        print(f"[bench] prometheus snapshot -> {path}", file=sys.stderr)
+    except Exception as e:
+        print(f"[bench] prometheus snapshot failed: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

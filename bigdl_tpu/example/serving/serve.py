@@ -102,6 +102,21 @@ def main(argv=None):
     s = svc.stats()
     print(f"[service]   {s['served']} requests in {s['dispatches']} "
           f"dispatches (occupancy {s['mean_batch_occupancy']:.1f})")
+
+    # the same counters, scraped: a stdlib /metrics endpoint any
+    # Prometheus-compatible collector can poll
+    import urllib.request
+
+    from bigdl_tpu import observability as obs
+
+    with obs.start_http_server(host="127.0.0.1") as server:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics").read().decode()
+    shown = [ln for ln in body.splitlines()
+             if ln.startswith(("bigdl_serve_requests_total",
+                               "bigdl_generation_tokens_total"))]
+    print(f"[metrics]   GET /metrics -> {len(body.splitlines())} lines, "
+          f"e.g. {'; '.join(shown)}")
     return rows
 
 

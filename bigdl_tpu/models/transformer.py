@@ -37,14 +37,20 @@ def _filter_logits(logits, temperature, top_k, top_p):
         x = jnp.where(x < kth, -jnp.inf, x)
     if top_p is not None and top_p < 1.0:
         probs = jax.nn.softmax(x)
-        sp = jnp.sort(probs, axis=-1)[..., ::-1]
+        order = jnp.argsort(-probs, axis=-1)          # descending
+        sp = jnp.take_along_axis(probs, order, axis=-1)
         cum = jnp.cumsum(sp, axis=-1)
         # smallest prefix whose mass reaches top_p; the top token is kept
         # unconditionally (min_tokens_to_keep=1) so no top_p value can
         # mask the whole vocabulary into a NaN distribution
-        keep = (cum - sp < top_p).at[..., 0].set(True)
-        thr = jnp.min(jnp.where(keep, sp, jnp.inf), axis=-1, keepdims=True)
-        x = jnp.where(probs < thr, -jnp.inf, x)
+        keep_sorted = (cum - sp < top_p).at[..., 0].set(True)
+        # scatter the keep-mask back through the sort indices (inverse
+        # permutation = argsort of the order): exactly the sorted prefix
+        # survives — a tie AT the nucleus boundary no longer admits every
+        # equal-probability token outside the prefix (HF semantics)
+        inv = jnp.argsort(order, axis=-1)
+        keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+        x = jnp.where(keep, x, -jnp.inf)
     return x
 
 

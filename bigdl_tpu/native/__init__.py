@@ -240,10 +240,11 @@ def fused_augment_available() -> bool:
 def fused_augment(img, top: int, left: int, crop_h: int, crop_w: int,
                   flip: bool, means, inv_stds):
     """One-pass native crop+flip+normalize: (h, w, c) uint8 C-contiguous
-    -> (crop_h, crop_w, c) float32. Caller guarantees the crop window is
-    in bounds and len(means) == c. Returns None when the native kernel
+    -> (crop_h, crop_w, c) float32. Returns None when the native kernel
     is unavailable or the input does not qualify (caller falls back to
-    the composed numpy ops)."""
+    the composed numpy ops) — including an out-of-bounds crop window or
+    means/inv_stds whose length differs from c: the C kernel trusts its
+    arguments and would read past the buffers for a bad caller."""
     import numpy as np
 
     lib = get_lib()
@@ -253,9 +254,14 @@ def fused_augment(img, top: int, left: int, crop_h: int, crop_w: int,
             or not img.flags.c_contiguous):
         return None
     h, w, c = img.shape
-    out = np.empty((crop_h, crop_w, c), np.float32)
     mean = np.ascontiguousarray(means, np.float32)
     inv = np.ascontiguousarray(inv_stds, np.float32)
+    if mean.shape != (c,) or inv.shape != (c,):
+        return None
+    if not (0 <= top and 0 <= left and crop_h >= 1 and crop_w >= 1
+            and top + crop_h <= h and left + crop_w <= w):
+        return None
+    out = np.empty((crop_h, crop_w, c), np.float32)
     lib.bigdl_fused_augment(
         img.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         h, w, c, top, left, crop_h, crop_w, int(bool(flip)),

@@ -1,0 +1,78 @@
+"""bigdl_tpu.observability — unified runtime telemetry.
+
+The TPU-native observability subsystem (the reference treats metrics as
+first-class — optim/Metrics.scala over Spark accumulators; this is the
+equivalent for one-process-per-host JAX):
+
+- **Metrics registry** (``metrics``): thread-safe ``Counter`` /
+  ``Gauge`` / ``Histogram`` instruments with labels, near-zero cost when
+  disabled. The process default is ``REGISTRY``.
+- **Span tracer** (``tracing``): ``with trace.span("train/step"):``
+  wall-time trees, nested per thread, forwarded to
+  ``jax.profiler.TraceAnnotation`` when available.
+- **Exporters** (``exporters``): Prometheus text rendering, a
+  stdlib-only ``/metrics`` + ``/healthz`` HTTP endpoint, and a bridge
+  mirroring the registry into ``visualization`` TensorBoard writers.
+
+Wired through the stack: ``Optimizer``/``DistriOptimizer`` (step time,
+throughput, loss, lr, grad norm, JIT compiles, checkpoint latency),
+``GenerationService``/``PredictionService`` (queue wait, batch
+occupancy, dispatch latency, tokens/sec), ``parallel.Engine`` (topology)
+and ``bench.py`` (Prometheus snapshots alongside BENCH json).
+
+Quick start::
+
+    from bigdl_tpu import observability as obs
+
+    server = obs.start_http_server(port=9090)   # scrape /metrics
+    ...
+    print(obs.render_prometheus())              # or render in-process
+    obs.trace.render()                          # last span trees
+
+``disable()`` turns every built-in instrument mutation into a no-op
+(one boolean check — the hot loops stay unmeasurable).
+"""
+
+from bigdl_tpu.observability.metrics import (
+    DEFAULT_BUCKETS, Metric, MetricRegistry, REGISTRY,
+    default_registry, set_default_registry,
+)
+from bigdl_tpu.observability.tracing import Span, Tracer, trace
+from bigdl_tpu.observability.exporters import (
+    MetricsHTTPServer, PROMETHEUS_CONTENT_TYPE, TensorBoardBridge,
+    render_prometheus, start_http_server, write_prometheus,
+)
+from bigdl_tpu.observability.instruments import (
+    OCCUPANCY_BUCKETS, OccupancyStats, TIME_BUCKETS, engine_instruments,
+    generation_instruments, parallel_instruments, serving_instruments,
+    train_instruments,
+)
+
+__all__ = [
+    "DEFAULT_BUCKETS", "Metric", "MetricRegistry", "REGISTRY",
+    "default_registry", "set_default_registry",
+    "Span", "Tracer", "trace",
+    "MetricsHTTPServer", "PROMETHEUS_CONTENT_TYPE", "TensorBoardBridge",
+    "render_prometheus", "start_http_server", "write_prometheus",
+    "OCCUPANCY_BUCKETS", "OccupancyStats", "TIME_BUCKETS",
+    "engine_instruments", "generation_instruments",
+    "parallel_instruments", "serving_instruments", "train_instruments",
+    "enable", "disable", "enabled",
+]
+
+
+def enable() -> None:
+    """Re-enable metric recording and span tracing process-wide."""
+    default_registry().enable()
+    trace.enable()
+
+
+def disable() -> None:
+    """Disable metric recording and span tracing process-wide (every
+    instrument mutation becomes a boolean check and an early return)."""
+    default_registry().disable()
+    trace.disable()
+
+
+def enabled() -> bool:
+    return default_registry().enabled

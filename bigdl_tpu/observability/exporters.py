@@ -1,0 +1,226 @@
+"""Exporters: Prometheus text rendering, a stdlib /metrics HTTP endpoint,
+and a bridge mirroring registry metrics into TensorBoard writers.
+
+Three sinks over one source (the MetricRegistry):
+
+- ``render_prometheus(registry)`` — the text exposition format
+  (``text/plain; version=0.0.4``) any Prometheus-compatible scraper
+  ingests.
+- ``MetricsHTTPServer`` / ``start_http_server`` — a stdlib-only
+  ``ThreadingHTTPServer`` serving ``/metrics`` + ``/healthz``; attach it
+  to a serving process and point the scraper at it. No dependencies.
+- ``TensorBoardBridge`` — mirrors counters/gauges (and histogram
+  sum/count) into anything with ``add_scalar(tag, value, step)``
+  (visualization.TrainSummary / FileWriter), so training dashboards and
+  the scrape endpoint present the same numbers.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Callable, Optional
+
+from bigdl_tpu.observability.metrics import (
+    MetricRegistry, default_registry,
+)
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _escape_help(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(s: str) -> str:
+    return (s.replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _fmt(v: float) -> str:
+    if v != v:
+        return "NaN"
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    if float(v) == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _labels_str(kv) -> str:
+    if not kv:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(str(v))}"' for k, v in kv)
+    return "{" + inner + "}"
+
+
+def render_prometheus(registry: Optional[MetricRegistry] = None) -> str:
+    """The registry in Prometheus text exposition format. Histograms
+    render cumulative ``_bucket{le=...}`` series plus ``_sum``/``_count``
+    per the exposition contract."""
+    registry = registry or default_registry()
+    out = []
+    for m in registry.collect():
+        out.append(f"# HELP {m.name} {_escape_help(m.help)}")
+        out.append(f"# TYPE {m.name} {m.type}")
+        for values, child in m.children():
+            kv = list(zip(m.labelnames, values))
+            if m.type in ("counter", "gauge"):
+                out.append(f"{m.name}{_labels_str(kv)} "
+                           f"{_fmt(child.get())}")
+            else:  # histogram
+                cum, total_sum, count = child.get()
+                edges = [_fmt(b) for b in m.buckets] + ["+Inf"]
+                for edge, c in zip(edges, cum):
+                    le = _labels_str(kv + [("le", edge)])
+                    out.append(f"{m.name}_bucket{le} {c}")
+                out.append(f"{m.name}_sum{_labels_str(kv)} "
+                           f"{_fmt(total_sum)}")
+                out.append(f"{m.name}_count{_labels_str(kv)} {count}")
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def write_prometheus(path: str,
+                     registry: Optional[MetricRegistry] = None) -> str:
+    """Atomically dump the registry snapshot as Prometheus text to
+    ``path`` (write to a unique temp file, then rename; a reader never
+    sees a torn file even under concurrent writers). Returns the
+    rendered text."""
+    import os
+    import tempfile
+
+    text = render_prometheus(registry)
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(os.path.abspath(path)) or ".",
+        prefix=os.path.basename(path) + ".", suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return text
+
+
+# ------------------------------------------------------------- HTTP server
+class MetricsHTTPServer:
+    """Stdlib-only scrape endpoint: ``GET /metrics`` returns the
+    Prometheus text snapshot, ``GET /healthz`` returns 200 with a JSON
+    body (or 503 when the ``healthz`` callable returns falsy/raises).
+    ``port=0`` binds an ephemeral port — read it back from ``.port``."""
+
+    def __init__(self, registry: Optional[MetricRegistry] = None,
+                 host: str = "0.0.0.0", port: int = 0,
+                 healthz: Optional[Callable[[], object]] = None):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        get_registry = (lambda: registry) if registry is not None \
+            else default_registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib handler contract)
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = render_prometheus(get_registry()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     PROMETHEUS_CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == "/healthz":
+                    status, payload = 200, {"status": "ok"}
+                    if healthz is not None:
+                        try:
+                            detail = healthz()
+                            if not detail:
+                                status = 503
+                                payload = {"status": "unhealthy"}
+                            elif isinstance(detail, dict):
+                                payload.update(detail)
+                        except Exception as e:
+                            status = 503
+                            payload = {"status": "unhealthy",
+                                       "error": str(e)}
+                    body = json.dumps(payload).encode()
+                    self.send_response(status)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+            def log_message(self, *args):  # silence per-scrape stderr spam
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="bigdl-metrics-http",
+            daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def start_http_server(port: int = 0,
+                      registry: Optional[MetricRegistry] = None,
+                      host: str = "0.0.0.0",
+                      healthz: Optional[Callable[[], object]] = None
+                      ) -> MetricsHTTPServer:
+    """Convenience wrapper: start and return a MetricsHTTPServer."""
+    return MetricsHTTPServer(registry=registry, host=host, port=port,
+                             healthz=healthz)
+
+
+# -------------------------------------------------------- TensorBoard bridge
+class TensorBoardBridge:
+    """Mirror registry metrics into a TensorBoard writer.
+
+    ``writer`` is anything exposing ``add_scalar(tag, value, step)`` —
+    ``visualization.TrainSummary`` or a raw ``FileWriter``. Each
+    ``publish(step)`` walks the registry: counters and gauges emit their
+    value under ``name{label=value,...}``; histograms emit ``name_count``
+    ``name_sum`` and ``name_mean`` (event files carry scalar series —
+    the full bucket vector stays on the scrape endpoint)."""
+
+    def __init__(self, writer,
+                 registry: Optional[MetricRegistry] = None):
+        self._writer = writer
+        self._registry = registry
+
+    def publish(self, step: int) -> "TensorBoardBridge":
+        registry = self._registry or default_registry()
+        for m in registry.collect():
+            for values, child in m.children():
+                tag = m.name + _labels_str(list(zip(m.labelnames, values)))
+                if m.type in ("counter", "gauge"):
+                    self._writer.add_scalar(tag, float(child.get()), step)
+                else:
+                    _, total_sum, count = child.get()
+                    self._writer.add_scalar(f"{tag}_count", float(count),
+                                            step)
+                    self._writer.add_scalar(f"{tag}_sum", float(total_sum),
+                                            step)
+                    if count:
+                        self._writer.add_scalar(f"{tag}_mean",
+                                                total_sum / count, step)
+        return self

@@ -1,0 +1,181 @@
+"""Canonical instrument families for the built-in integrations.
+
+One place defines every ``bigdl_*`` metric name, type, help string, and
+bucket layout, so the train loops, both serving services, the parallel
+engine, and bench all speak the same schema (the acceptance contract:
+live scrapes and BENCH snapshots share one vocabulary).
+
+Each ``*_instruments`` helper is get-or-create against the CURRENT
+default registry (resolved at call time, so tests can swap registries),
+returning a plain namespace of bound instruments.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+from typing import Optional
+
+from bigdl_tpu.observability.metrics import (
+    MetricRegistry, default_registry,
+)
+
+#: Step/latency buckets tuned for training steps and serving dispatches
+#: (100µs .. 60s — a TPU train step and a cold JIT compile both land).
+TIME_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                60.0)
+
+#: Batch-occupancy buckets: powers of two up to a generous serving
+#: max_batch (a request count is integral; le-buckets still apply).
+OCCUPANCY_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+
+
+def train_instruments(registry: Optional[MetricRegistry] = None
+                      ) -> SimpleNamespace:
+    """Training-path instruments (Local + Distri optimizer loops)."""
+    r = registry or default_registry()
+    return SimpleNamespace(
+        step_seconds=r.histogram(
+            "bigdl_train_step_seconds",
+            "Wall time of one training step (dispatch + host sync)",
+            buckets=TIME_BUCKETS),
+        records_total=r.counter(
+            "bigdl_train_records_total",
+            "Training records consumed"),
+        throughput=r.gauge(
+            "bigdl_train_throughput_records_per_sec",
+            "Training throughput over the last logging window"),
+        loss=r.gauge("bigdl_train_loss", "Last synced training loss"),
+        learning_rate=r.gauge(
+            "bigdl_train_learning_rate",
+            "Current learning rate (optimizer group 0)"),
+        grad_norm=r.gauge(
+            "bigdl_train_grad_norm",
+            "Global (pre-clip) gradient L2 norm of the last synced step"),
+        epoch=r.gauge("bigdl_train_epoch", "Current epoch (1-based)"),
+        jit_compiles=r.gauge(
+            "bigdl_train_jit_compiles",
+            "Distinct compiled train-step executables (signature cache "
+            "size)"),
+        checkpoint_seconds=r.histogram(
+            "bigdl_train_checkpoint_seconds",
+            "Checkpoint latency as seen by the train loop (async mode: "
+            "snapshot + handoff, not the background write)",
+            buckets=TIME_BUCKETS),
+    )
+
+
+def parallel_instruments(registry: Optional[MetricRegistry] = None
+                         ) -> SimpleNamespace:
+    """Per-host SPMD loop instruments (labelled by JAX process index —
+    each host's registry carries its own rank's series)."""
+    r = registry or default_registry()
+    return SimpleNamespace(
+        step_seconds=r.histogram(
+            "bigdl_parallel_step_seconds",
+            "Per-iteration wall time of the SPMD step (window average "
+            "at each host sync), per host", labelnames=("host",),
+            buckets=TIME_BUCKETS),
+        sync_window_seconds=r.histogram(
+            "bigdl_parallel_sync_window_seconds",
+            "Wall time between host syncs (log_interval iterations of "
+            "pipelined dispatch), per host", labelnames=("host",),
+            buckets=TIME_BUCKETS),
+    )
+
+
+def serving_instruments(service: str,
+                        registry: Optional[MetricRegistry] = None
+                        ) -> SimpleNamespace:
+    """Serving-path instruments, shared by GenerationService and
+    PredictionService under a ``service`` label."""
+    r = registry or default_registry()
+    lbl = ("service",)
+    return SimpleNamespace(
+        requests_total=r.counter(
+            "bigdl_serve_requests_total",
+            "Requests accepted (before batching)", labelnames=lbl
+        ).labels(service),
+        dispatches_total=r.counter(
+            "bigdl_serve_dispatches_total",
+            "Device dispatches launched", labelnames=lbl).labels(service),
+        errors_total=r.counter(
+            "bigdl_serve_errors_total",
+            "Requests that failed", labelnames=lbl).labels(service),
+        batch_occupancy=r.histogram(
+            "bigdl_serve_batch_occupancy",
+            "Real (pre-padding) requests per launched batch",
+            labelnames=lbl, buckets=OCCUPANCY_BUCKETS).labels(service),
+        queue_wait_seconds=r.histogram(
+            "bigdl_serve_queue_wait_seconds",
+            "Per-request wait from submit to batch launch",
+            labelnames=lbl, buckets=TIME_BUCKETS).labels(service),
+        dispatch_seconds=r.histogram(
+            "bigdl_serve_dispatch_seconds",
+            "Device dispatch wall time per launched batch",
+            labelnames=lbl, buckets=TIME_BUCKETS).labels(service),
+        inflight=r.gauge(
+            "bigdl_serve_inflight_requests",
+            "Requests currently inside the service", labelnames=lbl
+        ).labels(service),
+    )
+
+
+def generation_instruments(service: str = "generation",
+                           registry: Optional[MetricRegistry] = None
+                           ) -> SimpleNamespace:
+    """GenerationService extras on top of serving_instruments — same
+    ``service`` label, so side-by-side services stay separated here
+    too."""
+    r = registry or default_registry()
+    lbl = ("service",)
+    return SimpleNamespace(
+        tokens_total=r.counter(
+            "bigdl_generation_tokens_total",
+            "Tokens generated (requested max_new_tokens per served "
+            "request)", labelnames=lbl).labels(service),
+        tokens_per_sec=r.gauge(
+            "bigdl_generation_tokens_per_sec",
+            "Delivered throughput of the last dispatch (sum of the real "
+            "requests' max_new_tokens / dispatch wall time)",
+            labelnames=lbl).labels(service),
+    )
+
+
+class OccupancyStats:
+    """The serving ``stats()`` façade, shared by both services: served /
+    dispatches / mean occupancy as the DELTA of a bound batch-occupancy
+    histogram child (sum = requests launched, count = dispatches) since
+    construction.
+
+    Registry-backed by design: ``observability.disable()`` stops the
+    underlying series, and these numbers with it — and two live services
+    sharing a ``service_name`` share the series, so the delta is exact
+    only for the sole live holder of the label."""
+
+    def __init__(self, occupancy_child):
+        self._occ = occupancy_child
+        _, occ_sum, occ_count = occupancy_child.get()
+        self._base = (occ_sum, occ_count)
+
+    def snapshot(self) -> dict:
+        _, occ_sum, occ_count = self._occ.get()
+        served = int(occ_sum - self._base[0])
+        disp = occ_count - self._base[1]
+        return {"served": served, "dispatches": disp,
+                "mean_batch_occupancy": round(served / disp, 3)
+                if disp else 0.0}
+
+
+def engine_instruments(registry: Optional[MetricRegistry] = None
+                       ) -> SimpleNamespace:
+    """Topology gauges set by Engine.init / create_mesh."""
+    r = registry or default_registry()
+    return SimpleNamespace(
+        processes=r.gauge(
+            "bigdl_engine_processes", "JAX process (host) count"),
+        local_devices=r.gauge(
+            "bigdl_engine_local_devices", "Devices on this host"),
+        total_devices=r.gauge(
+            "bigdl_engine_total_devices", "Devices across the pod"),
+    )

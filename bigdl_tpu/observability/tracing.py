@@ -1,0 +1,170 @@
+"""Span-based wall-time tracing.
+
+``with trace.span("train/step"):`` measures the block's wall time and
+records it into a tree of nested spans. Nesting is tracked per thread
+(a ``threading.local`` stack), so concurrent serving threads each build
+their own correct tree instead of corrupting a shared stack; a span
+opened on a worker thread becomes a root of that thread's own trace.
+
+When the JAX profiler is importable, every span also enters a
+``jax.profiler.TraceAnnotation`` so the same names show up on the host
+timeline of a captured profile — one annotation vocabulary across the
+framework's own tracer and xprof. (Device-side HLO naming is separate:
+traced code uses ``jax.named_scope``, see parallel/all_reduce.py.)
+
+Completed ROOT spans accumulate in a bounded ring (oldest dropped), one
+entry per top-level operation; ``trace.roots()`` / ``trace.render()``
+read them back, and ``span(..., histogram=child)`` streams durations
+into a registry histogram so traces and metrics share one timing source.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from contextlib import contextmanager
+from typing import List, Optional
+
+
+class Span:
+    """One timed region. ``duration`` is wall seconds (None while open);
+    ``children`` are the spans opened inside it on the same thread."""
+
+    __slots__ = ("name", "start", "duration", "children", "thread")
+
+    def __init__(self, name: str, thread: str):
+        self.name = name
+        self.start = time.time()
+        self.duration: Optional[float] = None
+        self.children: List["Span"] = []
+        self.thread = thread
+
+    def tree(self, indent: int = 0) -> str:
+        dur = f"{self.duration * 1e3:.3f}ms" if self.duration is not None \
+            else "open"
+        lines = [f"{'  ' * indent}{self.name}  {dur}"]
+        for c in self.children:
+            lines.append(c.tree(indent + 1))
+        return "\n".join(lines)
+
+    def __repr__(self):
+        return f"Span({self.name!r}, duration={self.duration})"
+
+
+_TRACE_ANNOTATION = None  # resolved lazily; False = unavailable
+
+
+def _jax_annotation(name: str):
+    """A jax.profiler.TraceAnnotation for ``name``, or None when jax (or
+    its profiler) is unavailable — the tracer must work in a process
+    that never imports jax."""
+    global _TRACE_ANNOTATION
+    if _TRACE_ANNOTATION is None:
+        try:
+            from jax.profiler import TraceAnnotation
+            _TRACE_ANNOTATION = TraceAnnotation
+        except Exception:
+            _TRACE_ANNOTATION = False
+    if _TRACE_ANNOTATION is False:
+        return None
+    try:
+        return _TRACE_ANNOTATION(name)
+    except Exception:
+        return None
+
+
+class Tracer:
+    """Per-thread span stacks + a bounded ring of completed root spans."""
+
+    def __init__(self, max_roots: int = 256, forward_to_jax: bool = True):
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        self._roots: collections.deque = collections.deque(maxlen=max_roots)
+        self._enabled = True
+        self.forward_to_jax = forward_to_jax
+
+    # ------------------------------------------------------------- switch
+    def enable(self) -> None:
+        self._enabled = True
+
+    def disable(self) -> None:
+        self._enabled = False
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    # -------------------------------------------------------------- spans
+    def _stack(self) -> list:
+        s = getattr(self._local, "stack", None)
+        if s is None:
+            s = self._local.stack = []
+        return s
+
+    @contextmanager
+    def span(self, name: str, histogram=None):
+        """Time the with-block as a span nested under the thread's
+        current span (or as a new root). ``histogram`` (a registry
+        histogram or child) additionally receives the duration."""
+        if not self._enabled:
+            # a disabled TRACER must not silence a caller's METRIC: the
+            # histogram still gets the block's duration
+            if histogram is not None:
+                t0 = time.perf_counter()
+                try:
+                    yield None
+                finally:
+                    histogram.observe(time.perf_counter() - t0)
+            else:
+                yield None
+            return
+        stack = self._stack()
+        sp = Span(name, threading.current_thread().name)
+        if stack:
+            stack[-1].children.append(sp)
+        stack.append(sp)
+        ann = _jax_annotation(name) if self.forward_to_jax else None
+        if ann is not None:
+            ann.__enter__()
+        t0 = time.perf_counter()
+        try:
+            yield sp
+        finally:
+            sp.duration = time.perf_counter() - t0
+            if ann is not None:
+                ann.__exit__(None, None, None)
+            # pop THIS span even if an inner span leaked open
+            while stack and stack.pop() is not sp:
+                pass
+            if not stack:
+                with self._lock:
+                    self._roots.append(sp)
+            if histogram is not None:
+                histogram.observe(sp.duration)
+
+    def current(self) -> Optional[Span]:
+        stack = self._stack()
+        return stack[-1] if stack else None
+
+    # ------------------------------------------------------------ readers
+    def roots(self, name: Optional[str] = None) -> List[Span]:
+        """Completed root spans, oldest first; ``name`` filters."""
+        with self._lock:
+            out = list(self._roots)
+        if name is not None:
+            out = [s for s in out if s.name == name]
+        return out
+
+    def render(self, last: int = 10) -> str:
+        """The newest ``last`` completed root trees, rendered."""
+        roots = self.roots()[-last:]
+        return "\n".join(s.tree() for s in roots)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._roots.clear()
+
+
+#: The process default tracer (what the built-in integrations use).
+trace = Tracer()

@@ -24,6 +24,7 @@ Shape discipline (the TPU serving contract):
 from __future__ import annotations
 
 import threading
+import time
 
 import jax
 import numpy as np
@@ -43,7 +44,8 @@ class GenerationService:
                  batch_timeout_ms: float = 5.0, bucket_tokens: int = 32,
                  prompt_bucket: int = 32, eos_id=None,
                  temperature: float = 0.0, top_k=None, top_p=None,
-                 max_len=None, seed: int = 0):
+                 max_len=None, seed: int = 0, registry=None,
+                 service_name: str = "generation"):
         if bucket_tokens < 1:
             raise ValueError(f"bucket_tokens must be >= 1, got "
                              f"{bucket_tokens}")
@@ -66,14 +68,26 @@ class GenerationService:
         self.max_len = max_len
         self._key = jax.random.PRNGKey(seed)
         self._lock = threading.Lock()
-        self._served = 0
-        self._dispatches = 0
+        # registry-backed telemetry (replaces the bespoke _served /
+        # _dispatches counters); stats() stays a compatible façade over
+        # the occupancy histogram, reading the delta since construction
+        from bigdl_tpu.observability import (
+            OccupancyStats, generation_instruments, serving_instruments,
+        )
+
+        self._ins = serving_instruments(service_name, registry)
+        self._gen_ins = generation_instruments(service_name, registry)
+        self._occ_stats = OccupancyStats(self._ins.batch_occupancy)
+        # the micro-batcher invokes on_batch then run_batch on the SAME
+        # drain thread, so a thread-local carries each dispatch's real
+        # (pre-padding) request count into the tokens/sec computation
+        self._tl = threading.local()
         # one device dispatch at a time: tracing generate() binds state
         # on the module (not thread-safe across concurrent traces), and
         # the chip runs one program at a time anyway — concurrency value
         # lives in the BATCHING, not in parallel dispatch
         self._dispatch = threading.Lock()
-        self._batchers = {}  # bucketed n -> _MicroBatcher
+        self._batchers = {}  # (tpad, bucketed n[, tight]) -> _MicroBatcher
 
     def _cap(self) -> int:
         return min(self.max_len or self.model.max_len, self.model.max_len)
@@ -87,7 +101,7 @@ class GenerationService:
             return sub
 
     def _batcher(self, key) -> _MicroBatcher:
-        bucket = key[0]
+        bucket = key[1]
         with self._lock:
             b = self._batchers.get(key)
             if b is None:
@@ -103,14 +117,30 @@ class GenerationService:
                                   top_k=self.top_k, top_p=self.top_p,
                                   rng=self._next_key())
                     with self._dispatch:
-                        return np.asarray(self.model.generate_ragged(
+                        t0 = time.monotonic()
+                        toks = np.asarray(self.model.generate_ragged(
                             prompts, lengths, n_req, eos_id=self.eos_id,
                             bucket_tokens=self.bucket_tokens,
                             max_len=pinned, **kw))
+                        dt = time.monotonic() - t0
+                        # delivered tokens: the REAL rows sit first in
+                        # the stacked batch (padding duplicates the last
+                        # real row at the end), so their per-row n
+                        # column sums to what this dispatch actually
+                        # served — same accounting as tokens_total. Set
+                        # INSIDE the dispatch lock: dispatches publish
+                        # the gauge in their serialized order, so "last
+                        # dispatch" can never show a stale one.
+                        real = getattr(self._tl, "real", stacked.shape[0])
+                        delivered = int(stacked[:real, -1].sum())
+                        self._gen_ins.tokens_per_sec.set(
+                            delivered / max(dt, 1e-9))
+                    return toks
 
                 b = _MicroBatcher(run_batch, self.max_batch,
                                   self.batch_timeout_ms,
-                                  on_batch=self._count_batch)
+                                  on_batch=self._count_batch,
+                                  telemetry=self._ins)
                 self._batchers[key] = b
             return b
 
@@ -132,35 +162,43 @@ class GenerationService:
                              f"exceeds the context length {cap}")
         tpad = min(-(-t0 // self.prompt_bucket) * self.prompt_bucket, cap)
         bucket = -(-n // self.bucket_tokens) * self.bucket_tokens
-        # Safe-coalescing key: normally lmax <= tpad and n_req <= bucket
-        # guarantee every batch fits the pinned window (tpad + bucket).
-        # In the TIGHT region (tpad + bucket > cap) that guarantee fails
-        # for MIXED n — two individually-valid requests could combine
-        # into lmax + n_req > cap — so tight requests group by their
-        # EXACT n: then lmax + n = max(t0_i + n) <= cap per the
-        # per-request check above.
-        key = (bucket,) if tpad + bucket <= cap else (bucket, "tight", n)
+        # Safe-coalescing key: the PINNED-WINDOW invariant (every batch
+        # fits tpad + bucket) holds because lmax <= tpad and n_req <=
+        # bucket — tpad is part of the key EXPLICITLY rather than
+        # inherited from the micro-batcher's row-shape grouping. In the
+        # TIGHT region (tpad + bucket > cap) that guarantee fails for
+        # MIXED n — two individually-valid requests could combine into
+        # lmax + n_req > cap — so tight requests group by their EXACT n:
+        # then lmax + n = max(t0_i + n) <= cap per the per-request check
+        # above.
+        key = (tpad, bucket) if tpad + bucket <= cap \
+            else (tpad, bucket, "tight", n)
         row = np.zeros((tpad + 2,), np.int32)
         row[:t0] = prompt
         row[-2], row[-1] = t0, n
-        toks = self._batcher(key).submit(row)
+        self._ins.requests_total.inc()
+        # dispatch failures are counted by the micro-batcher's telemetry
+        # (per failed request in the batch) — no second count here
+        with self._ins.inflight.track():
+            toks = self._batcher(key).submit(row)
+        self._gen_ins.tokens_total.inc(n)
         return np.concatenate([prompt, np.asarray(toks[:n])])
 
     def _count_batch(self, real_size: int):
-        # ONE counting point (as each batch launches, with its REAL
-        # pre-padding size): failed or in-flight batches can never skew
-        # the served/dispatch ratio
-        with self._lock:
-            self._served += real_size
-            self._dispatches += 1
+        # the drain thread calls this immediately before run_batch on
+        # the SAME thread: stash the real (pre-padding) request count
+        # for the tokens/sec computation there
+        self._tl.real = real_size
 
     def stats(self) -> dict:
         """Operational counters: requests batched, device dispatches,
         and mean real-requests-per-dispatch (how well the micro-batcher
         is coalescing — 1.0 means every request paid its own dispatch,
-        ``max_batch`` means perfect occupancy)."""
-        with self._lock:
-            served, disp = self._served, self._dispatches
-        return {"served": served, "dispatches": disp,
-                "mean_batch_occupancy": round(served / disp, 3)
-                if disp else 0.0}
+        ``max_batch`` means perfect occupancy). A façade over the
+        registry's batch-occupancy histogram — the delta since THIS
+        service was constructed; exact as long as no other live service
+        shares the same ``service_name``, and disabling the service's
+        registry (``observability.disable()`` when it uses the process
+        default) stops these counters with the rest of that registry
+        (see ``observability.OccupancyStats``)."""
+        return self._occ_stats.snapshot()

@@ -184,9 +184,15 @@ class TrainStep:
             grads = jax.tree.map(jnp.add, g_sum, reg_grads)
             return l_sum + reg_val, new_buffers, grads
 
-        def step(params, buffers, slots, x, y, lrs, rng):
+        def _core(params, buffers, slots, x, y, lrs, rng):
             loss, new_buffers, grads = grad_of_batch(params, buffers, x, y,
                                                      rng)
+            # global pre-clip grad norm for telemetry; callers jitting the
+            # plain ``step`` never pay for it — an unused output is dead
+            # code to XLA
+            gnorm = jnp.sqrt(sum(
+                jnp.sum(g.astype(jnp.float32) ** 2)
+                for g in jax.tree.leaves(grads)))
             if grad_clip:
                 if "constant" in grad_clip:
                     lo, hi = grad_clip["constant"]
@@ -214,9 +220,18 @@ class TrainStep:
             new_params = jax.tree.unflatten(treedef, new_leaves)
             if any_frozen:
                 new_params = _mask_frozen(new_params, params, trainable)
-            return loss, new_params, new_buffers, tuple(new_slots)
+            return loss, gnorm, new_params, new_buffers, tuple(new_slots)
+
+        def step(params, buffers, slots, x, y, lrs, rng):
+            loss, _, new_params, new_buffers, new_slots = _core(
+                params, buffers, slots, x, y, lrs, rng)
+            return loss, new_params, new_buffers, new_slots
 
         self.step = step
+        #: telemetry variant: same update math, additionally returns the
+        #: global pre-clip gradient L2 norm —
+        #: (loss, grad_norm, params, buffers, slots)
+        self.step_with_stats = _core
 
     def init_slots(self, params):
         leaves = jax.tree.leaves(params)
@@ -447,8 +462,15 @@ class LocalOptimizer(Optimizer):
         # (~2x peak parameter memory otherwise); every consumer of the
         # previous values (histograms, validation, checkpoint) reads the
         # freshest POST-step outputs, which are only donated by the NEXT
-        # call, and the async checkpoint thread serializes a deepcopy
-        train_step = jax.jit(ts.step, donate_argnums=(0, 1, 2))
+        # call, and the async checkpoint thread serializes a deepcopy.
+        # With observability on, the stats variant also returns the grad
+        # norm (same math; the loop already syncs loss each iteration).
+        from bigdl_tpu import observability as obs
+
+        self._obs_on = obs.enabled()
+        train_step = jax.jit(
+            ts.step_with_stats if self._obs_on else ts.step,
+            donate_argnums=(0, 1, 2))
 
         num_samples = self.dataset.size()
         data_iter = self._prepared_batches()
@@ -508,19 +530,41 @@ class LocalOptimizer(Optimizer):
 
     def _optimize_loop(self, model, state, params, buffers, ts, slots,
                        train_step, num_samples, data_iter, wall_start):
+        from bigdl_tpu import observability as obs
+
+        obs_on = getattr(self, "_obs_on", False)
+        ins = obs.train_instruments() if obs_on else None
         while not self.end_when(state):
             x, y, n = next(data_iter)
             lrs = ts.current_lrs()
             lr = float(lrs[0])
             rng = bt_random.next_key()
             t0 = time.time()
-            loss, params, buffers, slots = train_step(params, buffers, slots, x, y, lrs, rng)
-            loss = float(loss)
+            gnorm = None
+            with obs.trace.span("train/step"):
+                if obs_on:
+                    loss, gnorm, params, buffers, slots = train_step(
+                        params, buffers, slots, x, y, lrs, rng)
+                else:
+                    loss, params, buffers, slots = train_step(
+                        params, buffers, slots, x, y, lrs, rng)
+                loss = float(loss)
             dt = time.time() - t0
             state["recordsProcessedThisEpoch"] += n
             state["Loss"] = loss
             state["LearningRate"] = float(lr)
             self.metrics.add("computing time", dt * 1e9)
+            if obs_on:
+                ins.step_seconds.observe(dt)
+                ins.records_total.inc(n)
+                ins.throughput.set(n / max(dt, 1e-9))
+                ins.loss.set(loss)
+                ins.learning_rate.set(lr)
+                ins.grad_norm.set(float(gnorm))
+                ins.epoch.set(state["epoch"])
+                cache_size = getattr(train_step, "_cache_size", None)
+                if cache_size is not None:
+                    ins.jit_compiles.set(cache_size())
             logger.info(
                 "[Epoch %d %d/%d][Iteration %d][Wall Clock %.3fs] "
                 "Trained %d records in %.4f seconds. Throughput is %.1f records/second. "
@@ -553,8 +597,15 @@ class LocalOptimizer(Optimizer):
             if self._should_fire_aux(state):
                 model.load_params_dict(params)
                 model.load_buffers_dict(buffers)
-                self._run_validation(state)
-                self._run_checkpoint(state)
+                with obs.trace.span("train/validation"):
+                    self._run_validation(state)
+                # only a real checkpoint samples the latency histogram —
+                # the no-op branch would flood it with ~µs entries
+                ck_hist = (ins.checkpoint_seconds
+                           if obs_on and self._ckpt_now
+                           and self.checkpoint_path is not None else None)
+                with obs.trace.span("train/checkpoint", histogram=ck_hist):
+                    self._run_checkpoint(state)
 
         model.load_params_dict(params)
         model.load_buffers_dict(buffers)

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import io
 import threading
+import time
 from typing import Optional
 
 import jax
@@ -80,18 +81,24 @@ class _MicroBatcher:
     call. Requests are grouped by (shape, dtype) signature — mixed shapes
     never stack together — and every launched batch is padded to
     ``max_batch`` so XLA sees exactly ONE input signature (no per-load-level
-    recompiles)."""
+    recompiles).
+
+    ``telemetry`` (an ``observability.serving_instruments`` namespace, or
+    anything with the same attributes) streams queue wait per request,
+    real batch occupancy, dispatch wall time, and dispatch/error counts
+    into the metrics registry; None (the default) records nothing."""
 
     def __init__(self, run_batch, max_batch: int, timeout_ms: float,
-                 on_batch=None):
+                 on_batch=None, telemetry=None):
         self._run = run_batch
         self.max_batch = max_batch
         self.timeout = timeout_ms / 1000.0
         self._lock = threading.Condition()
-        self._pending = {}  # signature -> list of (array, event, slot)
+        self._pending = {}  # signature -> list of (array, event, slot, t)
         #: optional callable(real_batch_size) invoked as each batch
         #: launches — the REAL request count, before padding (telemetry)
         self._on_batch = on_batch
+        self._telemetry = telemetry
 
     def submit(self, x):
         x = np.asarray(x)
@@ -100,7 +107,7 @@ class _MicroBatcher:
         slot = {}
         with self._lock:
             group = self._pending.setdefault(sig, [])
-            group.append((x, ev, slot))
+            group.append((x, ev, slot, time.monotonic()))
             if len(group) == 1:
                 # group leader: wait out the window, then run this group
                 threading.Thread(target=self._drain, args=(sig,),
@@ -112,8 +119,6 @@ class _MicroBatcher:
         return slot["out"]
 
     def _drain(self, sig):
-        import time
-
         deadline = time.monotonic() + self.timeout
         with self._lock:
             while (len(self._pending.get(sig, ())) < self.max_batch
@@ -128,17 +133,29 @@ class _MicroBatcher:
             else:
                 self._pending.pop(sig, None)
         xs = [b[0] for b in batch]
+        tel = self._telemetry
+        if tel is not None:
+            now = time.monotonic()
+            for _, _, _, t_enq in batch:
+                tel.queue_wait_seconds.observe(now - t_enq)
+            tel.batch_occupancy.observe(len(xs))
+            tel.dispatches_total.inc()
         if self._on_batch is not None:
             self._on_batch(len(xs))
         try:
             pad = self.max_batch - len(xs)  # fixed shape -> one compile
             stacked = np.stack(xs + [xs[-1]] * pad)
+            t0 = time.monotonic()
             outs = self._run(stacked)
-            for i, (_, ev, slot) in enumerate(batch):
+            if tel is not None:
+                tel.dispatch_seconds.observe(time.monotonic() - t0)
+            for i, (_, ev, slot, _) in enumerate(batch):
                 slot["out"] = jax.tree.map(lambda o: o[i], outs)
                 ev.set()
         except Exception as e:
-            for _, ev, slot in batch:
+            if tel is not None:
+                tel.errors_total.inc(len(xs))
+            for _, ev, slot, _ in batch:
                 slot["error"] = e
                 ev.set()
 
@@ -151,13 +168,25 @@ class PredictionService:
     def __init__(self, model: Module, num_threads: int = 4,
                  max_batch: Optional[int] = None,
                  batch_timeout_ms: float = 2.0,
-                 sample_ndim: Optional[int] = None):
+                 sample_ndim: Optional[int] = None,
+                 registry=None, service_name: str = "prediction"):
         """``max_batch`` opts into micro-batching of SINGLE-SAMPLE tensor
         requests (no leading batch axis — the reference's request shape,
         PredictionService.scala:74). Pass ``sample_ndim`` to let batched
         requests coexist: only requests of exactly that rank coalesce;
-        anything else runs standalone."""
+        anything else runs standalone.
+
+        Telemetry lands in ``registry`` (default: the process default
+        MetricRegistry) under ``bigdl_serve_*{service=service_name}`` —
+        run several services side by side with distinct names to keep
+        their series apart."""
+        from bigdl_tpu.observability import (
+            OccupancyStats, serving_instruments,
+        )
+
         model.evaluate()
+        self._ins = serving_instruments(service_name, registry)
+        self._occ_stats = OccupancyStats(self._ins.batch_occupancy)
         self._params = jax.tree.map(jax.numpy.asarray, model.params_dict())
         self._buffers = jax.tree.map(jax.numpy.asarray, model.buffers_dict())
         self._jit = jit_inference_fn(model)
@@ -169,7 +198,8 @@ class PredictionService:
         self._trace_lock = threading.Lock()
         self._seen_sigs = set()
         self._batcher = (_MicroBatcher(self._run_batch, max_batch,
-                                       batch_timeout_ms)
+                                       batch_timeout_ms,
+                                       telemetry=self._ins)
                          if max_batch and max_batch > 1 else None)
 
     # ------------------------------------------------------------- core run
@@ -196,7 +226,9 @@ class PredictionService:
         raise (PredictionService.scala:84-112)."""
         if isinstance(request, (bytes, bytearray)):
             return self._predict_bytes(bytes(request))
-        with self._sem:
+        self._ins.requests_total.inc()
+        with self._ins.inflight.track(), self._sem:
+            batchable = False
             try:
                 batchable = (self._batcher is not None
                              and not isinstance(request, Table)
@@ -204,23 +236,48 @@ class PredictionService:
                                   or np.asarray(request).ndim
                                   == self.sample_ndim))
                 if batchable:
+                    # failures inside the batch are counted by the
+                    # micro-batcher's telemetry
                     out = self._batcher.submit(request)
                 else:
-                    out = self._run(request)
+                    # standalone dispatch still counts occupancy (1) so
+                    # the series reflects how the MXU is being fed
+                    self._ins.dispatches_total.inc()
+                    self._ins.batch_occupancy.observe(1)
+                    with self._ins.dispatch_seconds.time():
+                        out = self._run(request)
             except Exception as e:
+                if not batchable:
+                    self._ins.errors_total.inc()
                 return _error_tensor("running forward", e)
             try:
                 return jax.tree.map(lambda a: np.asarray(a), out)
             except Exception as e:
+                self._ins.errors_total.inc()
                 return _error_tensor("Clone Result", e)
+
+    def stats(self) -> dict:
+        """Operational façade over the registry telemetry (same keys and
+        caveats as ``GenerationService.stats``): requests launched,
+        device dispatches, and mean real-requests-per-dispatch since
+        this service was constructed. Disabling the service's registry
+        (``observability.disable()`` when it uses the process default)
+        stops these counters with the rest of that registry."""
+        return self._occ_stats.snapshot()
 
     def _predict_bytes(self, request: bytes) -> bytes:
         try:
             activity = deserialize_activity(request)
         except Exception as e:
+            # codec failures still count: the inner predict() never runs
+            # for this request, so it must be counted here or a flood of
+            # malformed payloads scrapes as an idle healthy service
+            self._ins.requests_total.inc()
+            self._ins.errors_total.inc()
             return serialize_activity(_error_tensor("DeSerialize Input", e))
-        out = self.predict(activity)
+        out = self.predict(activity)  # counts the request itself
         try:
             return serialize_activity(out)
         except Exception as e:
+            self._ins.errors_total.inc()
             return serialize_activity(_error_tensor("Serialize Output", e))

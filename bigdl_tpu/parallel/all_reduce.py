@@ -75,18 +75,25 @@ class AllReduceParameter:
     def aggregate(self, local_grad_flat: jnp.ndarray) -> jnp.ndarray:
         """putGradients + aggregateGradientPartition: reduce_scatter of the
         (compressed) gradient; returns this device's owned slice, already
-        averaged over the axis (÷N, AllReduceParameter.scala:269)."""
-        n = jax.lax.psum(1, self.axis_name)
-        g = compress(local_grad_flat, self.compress_dtype) \
-            if self.compress_dtype is not None else local_grad_flat
-        owned = jax.lax.psum_scatter(g, self.axis_name, tiled=True)
-        return decompress(owned) / n
+        averaged over the axis (÷N, AllReduceParameter.scala:269).
+
+        The ``named_scope`` tags the collective's HLO so per-op profiles
+        (xprof) attribute all-reduce time to this phase — the device-side
+        half of the observability story (host spans can't see inside one
+        XLA dispatch)."""
+        with jax.named_scope("bigdl/grad_reduce_scatter"):
+            n = jax.lax.psum(1, self.axis_name)
+            g = compress(local_grad_flat, self.compress_dtype) \
+                if self.compress_dtype is not None else local_grad_flat
+            owned = jax.lax.psum_scatter(g, self.axis_name, tiled=True)
+            return decompress(owned) / n
 
     def all_gather_weights(self, owned_slice: jnp.ndarray) -> jnp.ndarray:
         """sendWeightPartition + getWeights: republish the updated owned
         slice and gather the full vector (AllReduceParameter.scala:193-220,
         307-320)."""
-        w = compress(owned_slice, self.compress_dtype) \
-            if self.compress_dtype is not None else owned_slice
-        full = jax.lax.all_gather(w, self.axis_name, tiled=True)
-        return decompress(full)
+        with jax.named_scope("bigdl/weight_all_gather"):
+            w = compress(owned_slice, self.compress_dtype) \
+                if self.compress_dtype is not None else owned_slice
+            full = jax.lax.all_gather(w, self.axis_name, tiled=True)
+            return decompress(full)

@@ -497,8 +497,15 @@ class DistriOptimizer(LocalOptimizer):
         # loss is fetched only at log/aux points (VERDICT round-1 weak #3;
         # XLA's async dispatch pipelines the intervening steps)
         window_records = 0
+        window_iters = 0
         window_start = time.time()
         loss = None
+        from bigdl_tpu import observability as obs
+
+        obs_on = obs.enabled()
+        ins = obs.train_instruments() if obs_on else None
+        host = str(jax.process_index())
+        pins = obs.parallel_instruments() if obs_on else None
 
         while not self.end_when(state):
             x, y, n_local = next(data_iter)
@@ -509,11 +516,13 @@ class DistriOptimizer(LocalOptimizer):
                 lr = method.get_current_rate()
                 lrs = jnp.asarray(lr, jnp.float32)
             rng = bt_random.next_key()
-            if self.parameter_sync == "sharded":
-                loss, params, buffers, flat, slots = step(
-                    params, buffers, flat, slots, x, y, lrs, rng)
-            else:
-                loss, params, buffers, slots = step(params, buffers, slots, x, y, lrs, rng)
+            with obs.trace.span("train/step"):
+                if self.parameter_sync == "sharded":
+                    loss, params, buffers, flat, slots = step(
+                        params, buffers, flat, slots, x, y, lrs, rng)
+                else:
+                    loss, params, buffers, slots = step(
+                        params, buffers, slots, x, y, lrs, rng)
             self._live_slots = slots
             if self._fault_hook is not None:
                 self._fault_hook(state)
@@ -521,6 +530,7 @@ class DistriOptimizer(LocalOptimizer):
             state["recordsProcessedThisEpoch"] += n
             state["LearningRate"] = lr
             window_records += n
+            window_iters += 1
             state["neval"] += 1
             aux_now = self._should_fire_aux(state)
             log_now = (state["neval"] - 1) % self.log_interval == 0
@@ -529,6 +539,21 @@ class DistriOptimizer(LocalOptimizer):
                 dt = time.time() - window_start
                 state["Loss"] = loss_v
                 self.metrics.add("computing time", dt * 1e9)
+                if obs_on:
+                    ins.records_total.inc(window_records)
+                    ins.throughput.set(window_records / max(dt, 1e-9))
+                    ins.loss.set(loss_v)
+                    ins.learning_rate.set(lr)
+                    ins.epoch.set(state["epoch"])
+                    cache_size = getattr(step, "_cache_size", None)
+                    if cache_size is not None:
+                        ins.jit_compiles.set(cache_size())
+                    # per-host SPMD timings: the whole pipelined window,
+                    # and its per-iteration average (the step-time proxy
+                    # when dispatch overlaps host work)
+                    pins.sync_window_seconds.labels(host).observe(dt)
+                    pins.step_seconds.labels(host).observe(
+                        dt / max(window_iters, 1))
                 logger.info(
                     "[Epoch %d %d/%d][Iteration %d][Wall Clock %.3fs] "
                     "Trained %d records in %.4f seconds. "
@@ -543,6 +568,7 @@ class DistriOptimizer(LocalOptimizer):
                     self.train_summary.add_scalar(
                         "Throughput", window_records / max(dt, 1e-9), it)
                 window_records = 0
+                window_iters = 0
                 window_start = time.time()
             if state["recordsProcessedThisEpoch"] >= num_samples:
                 state["epoch"] += 1
@@ -561,9 +587,18 @@ class DistriOptimizer(LocalOptimizer):
                 # fresher, documented as an intentional deviation.
                 model.load_params_dict(params)
                 model.load_buffers_dict(buffers_for_model(buffers))
-                self._run_validation(state)
-                self._run_checkpoint(state)
+                with obs.trace.span("train/validation"):
+                    self._run_validation(state)
+                ck_hist = (ins.checkpoint_seconds
+                           if obs_on and self._ckpt_now
+                           and self.checkpoint_path is not None else None)
+                with obs.trace.span("train/checkpoint", histogram=ck_hist):
+                    self._run_checkpoint(state)
 
+        if obs_on and window_records:
+            # the partial window between the last log sync and loop exit
+            # still counts toward the records counter
+            ins.records_total.inc(window_records)
         model.load_params_dict(params)
         model.load_buffers_dict(buffers_for_model(buffers))
         self.join_pending_checkpoint()

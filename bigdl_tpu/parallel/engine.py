@@ -59,6 +59,14 @@ class Engine:
             "Engine.init: %d process(es), %d local device(s), platform=%s",
             cls.node_number(), jax.local_device_count(),
             jax.devices()[0].platform)
+        from bigdl_tpu import observability as obs
+
+        # one-shot topology gauges: forced past the disable switch —
+        # init runs once, and a later enable() must not read frozen zeros
+        ins = obs.engine_instruments()
+        ins.processes.set(cls.node_number(), force=True)
+        ins.local_devices.set(jax.local_device_count(), force=True)
+        ins.total_devices.set(jax.device_count(), force=True)
 
     @classmethod
     def node_number(cls) -> int:
