@@ -211,6 +211,13 @@ def bench_main(argv=None):
     p.add_argument("--iters", type=int, default=None)
     p.add_argument("--model", default="resnet50")
     p.add_argument("--format", default=os.environ.get("BIGDL_BENCH_FORMAT", "NHWC"))
+    p.add_argument("--serving", action="store_true",
+                   help="Poisson-arrival serving benchmark: continuous-"
+                        "batching engine vs GenerationService")
+    p.add_argument("--requests", type=int, default=24,
+                   help="--serving: workload size")
+    p.add_argument("--rate", type=float, default=20.0,
+                   help="--serving: Poisson arrival rate (req/s)")
     args = p.parse_args(argv)
 
     import jax
@@ -233,6 +240,9 @@ def bench_main(argv=None):
             if attempt == 3:
                 raise
             time.sleep(10.0 * attempt)
+    if args.serving:
+        return _serving_bench(args, dev)
+
     on_tpu = "tpu" in dev.platform.lower() or dev.platform == "axon"
     batch = args.batch or int(os.environ.get(
         "BIGDL_BENCH_BATCH", "256" if on_tpu else "8"))
@@ -354,6 +364,83 @@ def bench_main(argv=None):
     _record_bench_metrics(result, model)
     _dump_prometheus_snapshot()
     print(json.dumps(result))
+
+
+def _serving_bench(args, dev):
+    """`--serving`: replay ONE Poisson-arrival workload through the
+    continuous-batching engine and through GenerationService; emit one
+    JSON line (p50/p99 latency, TTFT, aggregate tokens/sec for both
+    paths) into bench_history.jsonl + the Prometheus snapshot so the
+    serving perf trajectory is tracked alongside the training headline.
+    vs_baseline is the p99-latency speedup over GenerationService
+    (> 1.0: the engine's tail is shorter)."""
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.serving.benchmark import run_poisson_comparison
+    from bigdl_tpu.utils import random as rnd
+    from bigdl_tpu.version import __version__
+
+    log = lambda *a, **k: print(*a, file=sys.stderr, **k)  # noqa: E731
+    rnd.set_seed(7)
+    model = TransformerLM(128, embed_dim=64, num_heads=4, num_kv_heads=2,
+                          num_layers=2, max_len=128, use_rope=True)
+    model.evaluate()
+    res = run_poisson_comparison(model, n_requests=args.requests,
+                                 rate_hz=args.rate, max_slots=4,
+                                 prefill_chunk=8, log=log)
+    result = {
+        "metric": "serving_poisson_tokens_per_sec",
+        "value": res["engine"]["tokens_per_sec"],
+        "unit": "tokens/sec",
+        "vs_baseline": res["p99_speedup"],
+        "detail": {
+            "version": __version__,
+            "device": str(getattr(dev, "device_kind", dev.platform)),
+            **res,
+        },
+    }
+    _record_serving_metrics(res)
+    _dump_prometheus_snapshot()
+    print(json.dumps(result))
+
+
+def _record_serving_metrics(res):
+    """Mirror the serving comparison into the observability registry
+    under a ``path`` label, so live scrapes and bench snapshots share
+    one schema. Never lets telemetry break the bench."""
+    try:
+        from bigdl_tpu import observability as obs
+
+        reg = obs.default_registry()
+        lbl = ("path",)
+        tps = reg.gauge("bigdl_bench_serving_tokens_per_sec",
+                        "Serving bench aggregate delivered tokens/sec",
+                        labelnames=lbl)
+        p50 = reg.gauge("bigdl_bench_serving_latency_p50_seconds",
+                        "Serving bench per-request latency p50",
+                        labelnames=lbl)
+        p99 = reg.gauge("bigdl_bench_serving_latency_p99_seconds",
+                        "Serving bench per-request latency p99",
+                        labelnames=lbl)
+        for path, key in (("engine", "engine"),
+                          ("generation_service", "generation_service")):
+            r = res[key]
+            tps.labels(path).set(r["tokens_per_sec"])
+            if r["latency"]["p50"] is not None:
+                p50.labels(path).set(r["latency"]["p50"])
+                p99.labels(path).set(r["latency"]["p99"])
+        eng = res["engine"]
+        if eng.get("ttft", {}).get("p99") is not None:
+            reg.gauge("bigdl_bench_serving_ttft_p99_seconds",
+                      "Serving bench engine time-to-first-token p99"
+                      ).set(eng["ttft"]["p99"])
+        if res.get("p99_speedup") is not None:
+            reg.gauge("bigdl_bench_serving_p99_speedup",
+                      "Engine p99 latency speedup vs GenerationService "
+                      "(> 1.0: engine tail shorter)"
+                      ).set(res["p99_speedup"])
+    except Exception as e:
+        print(f"[bench] serving metrics registry update failed: {e}",
+              file=sys.stderr)
 
 
 def _record_bench_metrics(result, model):

@@ -283,6 +283,17 @@ class TransformerLM(Module):
         a traced offset cannot be bounds-checked at trace time)."""
         return self._prefill_impl(ids, caches, pos0, chunked=True)
 
+    def prefill_chunk_at(self, ids, caches, pos0, last_idx):
+        """``prefill_chunk`` variant returning the logits at per-row
+        position ``last_idx`` (B,) WITHIN the chunk instead of the
+        chunk's final position — the continuous-batching engine's
+        admission path (bigdl_tpu/serving/engine.py), whose final chunk
+        is RIGHT-padded so the true last prompt token sits mid-chunk.
+        The gather happens before the head: O(B), not O(B*T), vocab
+        projections. Same caller contract as ``prefill_chunk``."""
+        return self._prefill_impl(ids, caches, pos0, chunked=True,
+                                  gather_last=last_idx)
+
     def verify_chunk(self, ids, caches, pos0):
         """Chunked forward (traced ``pos0``) returning logits at EVERY
         chunk position, (B, T, V) — the speculative-decoding verifier:

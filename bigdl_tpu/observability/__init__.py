@@ -44,8 +44,8 @@ from bigdl_tpu.observability.exporters import (
 )
 from bigdl_tpu.observability.instruments import (
     OCCUPANCY_BUCKETS, OccupancyStats, TIME_BUCKETS, engine_instruments,
-    generation_instruments, parallel_instruments, serving_instruments,
-    train_instruments,
+    generation_instruments, parallel_instruments,
+    serving_engine_instruments, serving_instruments, train_instruments,
 )
 
 __all__ = [
@@ -56,7 +56,8 @@ __all__ = [
     "render_prometheus", "start_http_server", "write_prometheus",
     "OCCUPANCY_BUCKETS", "OccupancyStats", "TIME_BUCKETS",
     "engine_instruments", "generation_instruments",
-    "parallel_instruments", "serving_instruments", "train_instruments",
+    "parallel_instruments", "serving_engine_instruments",
+    "serving_instruments", "train_instruments",
     "enable", "disable", "enabled",
 ]
 

@@ -132,13 +132,93 @@ def generation_instruments(service: str = "generation",
     return SimpleNamespace(
         tokens_total=r.counter(
             "bigdl_generation_tokens_total",
-            "Tokens generated (requested max_new_tokens per served "
-            "request)", labelnames=lbl).labels(service),
+            "Tokens delivered per served request (up to and including "
+            "the first eos — the eos-padding tail is not counted)",
+            labelnames=lbl).labels(service),
         tokens_per_sec=r.gauge(
             "bigdl_generation_tokens_per_sec",
-            "Delivered throughput of the last dispatch (sum of the real "
-            "requests' max_new_tokens / dispatch wall time)",
+            "Delivered throughput of the last dispatch (real requests' "
+            "delivered tokens, eos-truncated, / dispatch wall time)",
             labelnames=lbl).labels(service),
+    )
+
+
+def serving_engine_instruments(service: str = "engine",
+                               registry: Optional[MetricRegistry] = None
+                               ) -> SimpleNamespace:
+    """Continuous-batching engine instruments (``bigdl_tpu.serving``),
+    labelled by ``service`` like the batch services' families. The
+    latency pair every serving SLO is written against — TTFT and
+    inter-token latency — plus slot-pool occupancy, admission/eviction
+    flow counters, loop-iteration timing, and the compiled-executable
+    gauge (flat after warmup is the engine's shape-stability
+    contract)."""
+    r = registry or default_registry()
+    lbl = ("service",)
+    return SimpleNamespace(
+        slots=r.gauge(
+            "bigdl_serving_slots",
+            "KV-cache slot pool capacity (max_slots)",
+            labelnames=lbl).labels(service),
+        active_slots=r.gauge(
+            "bigdl_serving_active_slots",
+            "Slots currently decoding a request", labelnames=lbl
+        ).labels(service),
+        queue_depth=r.gauge(
+            "bigdl_serving_queue_depth",
+            "Requests waiting in the admission queue", labelnames=lbl
+        ).labels(service),
+        admitted_total=r.counter(
+            "bigdl_serving_admitted_total",
+            "Requests admitted to a slot (prefill started)",
+            labelnames=lbl).labels(service),
+        finished_total=r.counter(
+            "bigdl_serving_finished_total",
+            "Requests that completed (eos or token budget)",
+            labelnames=lbl).labels(service),
+        evicted_total=r.counter(
+            "bigdl_serving_evicted_total",
+            "Slots freed for reuse (finish, timeout, or cancellation)",
+            labelnames=lbl).labels(service),
+        timed_out_total=r.counter(
+            "bigdl_serving_timed_out_total",
+            "Requests that hit their deadline (queued or mid-decode)",
+            labelnames=lbl).labels(service),
+        cancelled_total=r.counter(
+            "bigdl_serving_cancelled_total",
+            "Requests cancelled by the client", labelnames=lbl
+        ).labels(service),
+        prefill_tokens_total=r.counter(
+            "bigdl_serving_prefill_tokens_total",
+            "Prompt tokens prefilled (chunked admission work)",
+            labelnames=lbl).labels(service),
+        decode_tokens_total=r.counter(
+            "bigdl_serving_decode_tokens_total",
+            "Tokens delivered by the fused decode step", labelnames=lbl
+        ).labels(service),
+        iterations_total=r.counter(
+            "bigdl_serving_iterations_total",
+            "Engine loop iterations", labelnames=lbl).labels(service),
+        iteration_seconds=r.histogram(
+            "bigdl_serving_iteration_seconds",
+            "Wall time of one engine loop iteration (admission sweep + "
+            "prefill budget + fused decode)", labelnames=lbl,
+            buckets=TIME_BUCKETS).labels(service),
+        ttft_seconds=r.histogram(
+            "bigdl_serving_ttft_seconds",
+            "Time to first token: submit to first delivered token",
+            labelnames=lbl, buckets=TIME_BUCKETS).labels(service),
+        inter_token_seconds=r.histogram(
+            "bigdl_serving_inter_token_seconds",
+            "Per-slot gap between consecutive delivered tokens",
+            labelnames=lbl, buckets=TIME_BUCKETS).labels(service),
+        jit_compiles=r.gauge(
+            "bigdl_serving_jit_compiles",
+            "Compiled executables across the engine's jitted programs "
+            "(decode step, prefill chunk, slot insert, first-token "
+            "sample) — flat after warmup: compiled shapes depend only "
+            "on max_slots, never on load", labelnames=lbl
+        ).labels(service),
     )
 
 

@@ -32,6 +32,17 @@ import numpy as np
 from bigdl_tpu.optim.prediction_service import _MicroBatcher
 
 
+def _delivered_tokens(gen_row, n: int, eos_id) -> int:
+    """Tokens actually served out of a generated row: the requested
+    ``n``, or — when ``eos_id`` stopped the row early — the count up to
+    and including the FIRST eos (the tail after it is eos padding)."""
+    if eos_id is None:
+        return n
+    row = np.asarray(gen_row[:n])
+    hits = np.flatnonzero(row == eos_id)
+    return int(hits[0]) + 1 if hits.size else n
+
+
 class GenerationService:
     """Thread-safe generative serving over a ``TransformerLM``.
 
@@ -45,7 +56,8 @@ class GenerationService:
                  prompt_bucket: int = 32, eos_id=None,
                  temperature: float = 0.0, top_k=None, top_p=None,
                  max_len=None, seed: int = 0, registry=None,
-                 service_name: str = "generation"):
+                 service_name: str = "generation",
+                 submit_timeout_s=None):
         if bucket_tokens < 1:
             raise ValueError(f"bucket_tokens must be >= 1, got "
                              f"{bucket_tokens}")
@@ -66,6 +78,10 @@ class GenerationService:
         self.temperature = temperature
         self.top_k, self.top_p = top_k, top_p
         self.max_len = max_len
+        # bound each request's wait for its batch result (a dead drain
+        # thread must raise, not hang the caller forever); None = wait
+        # forever (see _MicroBatcher.submit)
+        self.submit_timeout_s = submit_timeout_s
         self._key = jax.random.PRNGKey(seed)
         self._lock = threading.Lock()
         # registry-backed telemetry (replaces the bespoke _served /
@@ -125,14 +141,20 @@ class GenerationService:
                         dt = time.monotonic() - t0
                         # delivered tokens: the REAL rows sit first in
                         # the stacked batch (padding duplicates the last
-                        # real row at the end), so their per-row n
-                        # column sums to what this dispatch actually
-                        # served — same accounting as tokens_total. Set
-                        # INSIDE the dispatch lock: dispatches publish
-                        # the gauge in their serialized order, so "last
-                        # dispatch" can never show a stale one.
+                        # real row at the end); each real row delivers
+                        # its requested n UNLESS eos stopped it early —
+                        # then only the tokens up to and including the
+                        # first eos count (the tail is eos padding, not
+                        # served output) — same accounting as
+                        # tokens_total. Set INSIDE the dispatch lock:
+                        # dispatches publish the gauge in their
+                        # serialized order, so "last dispatch" can never
+                        # show a stale one.
                         real = getattr(self._tl, "real", stacked.shape[0])
-                        delivered = int(stacked[:real, -1].sum())
+                        delivered = sum(
+                            _delivered_tokens(toks[i], int(stacked[i, -1]),
+                                              self.eos_id)
+                            for i in range(real))
                         self._gen_ins.tokens_per_sec.set(
                             delivered / max(dt, 1e-9))
                     return toks
@@ -140,7 +162,8 @@ class GenerationService:
                 b = _MicroBatcher(run_batch, self.max_batch,
                                   self.batch_timeout_ms,
                                   on_batch=self._count_batch,
-                                  telemetry=self._ins)
+                                  telemetry=self._ins,
+                                  submit_timeout_s=self.submit_timeout_s)
                 self._batchers[key] = b
             return b
 
@@ -181,8 +204,14 @@ class GenerationService:
         # (per failed request in the batch) — no second count here
         with self._ins.inflight.track():
             toks = self._batcher(key).submit(row)
-        self._gen_ins.tokens_total.inc(n)
-        return np.concatenate([prompt, np.asarray(toks[:n])])
+        gen = np.asarray(toks[:n])
+        # count DELIVERED tokens: with eos_id, a row that stopped early
+        # carries an eos-padding tail the caller never asked for —
+        # tokens up to and including the first eos are what was served
+        # (the same accounting run_batch's tokens/sec uses)
+        self._gen_ins.tokens_total.inc(_delivered_tokens(gen, n,
+                                                         self.eos_id))
+        return np.concatenate([prompt, gen])
 
     def _count_batch(self, real_size: int):
         # the drain thread calls this immediately before run_batch on

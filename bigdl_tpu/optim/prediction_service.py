@@ -86,13 +86,21 @@ class _MicroBatcher:
     ``telemetry`` (an ``observability.serving_instruments`` namespace, or
     anything with the same attributes) streams queue wait per request,
     real batch occupancy, dispatch wall time, and dispatch/error counts
-    into the metrics registry; None (the default) records nothing."""
+    into the metrics registry; None (the default) records nothing.
+
+    ``submit_timeout_s`` bounds how long a submitter waits for its
+    batch's result. The wait is normally (window + dispatch) long, but
+    if the drain thread DIES (a bug, an interpreter teardown race) the
+    event never fires and an unbounded ``submit`` hangs its caller
+    forever — with a timeout it raises a descriptive error instead.
+    None (the default) preserves the unbounded wait."""
 
     def __init__(self, run_batch, max_batch: int, timeout_ms: float,
-                 on_batch=None, telemetry=None):
+                 on_batch=None, telemetry=None, submit_timeout_s=None):
         self._run = run_batch
         self.max_batch = max_batch
         self.timeout = timeout_ms / 1000.0
+        self.submit_timeout_s = submit_timeout_s
         self._lock = threading.Condition()
         self._pending = {}  # signature -> list of (array, event, slot, t)
         #: optional callable(real_batch_size) invoked as each batch
@@ -113,7 +121,13 @@ class _MicroBatcher:
                 threading.Thread(target=self._drain, args=(sig,),
                                  daemon=True).start()
             self._lock.notify_all()
-        ev.wait()
+        if not ev.wait(self.submit_timeout_s):
+            raise RuntimeError(
+                f"micro-batch request still unanswered after "
+                f"{self.submit_timeout_s}s (batch window "
+                f"{self.timeout * 1000:.1f}ms): the drain thread died or "
+                "the device dispatch wedged — the request may still "
+                "complete on the device but this caller gives up")
         if "error" in slot:
             raise slot["error"]
         return slot["out"]
@@ -169,12 +183,15 @@ class PredictionService:
                  max_batch: Optional[int] = None,
                  batch_timeout_ms: float = 2.0,
                  sample_ndim: Optional[int] = None,
-                 registry=None, service_name: str = "prediction"):
+                 registry=None, service_name: str = "prediction",
+                 submit_timeout_s: Optional[float] = None):
         """``max_batch`` opts into micro-batching of SINGLE-SAMPLE tensor
         requests (no leading batch axis — the reference's request shape,
         PredictionService.scala:74). Pass ``sample_ndim`` to let batched
         requests coexist: only requests of exactly that rank coalesce;
-        anything else runs standalone.
+        anything else runs standalone. ``submit_timeout_s`` bounds each
+        micro-batched request's wait for its batch result (see
+        ``_MicroBatcher``); None waits forever.
 
         Telemetry lands in ``registry`` (default: the process default
         MetricRegistry) under ``bigdl_serve_*{service=service_name}`` —
@@ -199,7 +216,8 @@ class PredictionService:
         self._seen_sigs = set()
         self._batcher = (_MicroBatcher(self._run_batch, max_batch,
                                        batch_timeout_ms,
-                                       telemetry=self._ins)
+                                       telemetry=self._ins,
+                                       submit_timeout_s=submit_timeout_s)
                          if max_batch and max_batch > 1 else None)
 
     # ------------------------------------------------------------- core run

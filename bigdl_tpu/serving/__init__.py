@@ -1,0 +1,54 @@
+"""bigdl_tpu.serving — continuous-batching LM inference.
+
+The serving-at-scale layer (BigDL 2.0's north-star capability, arxiv
+2204.01715): a persistent device-resident decode loop over a
+slot-pooled KV cache, replacing batch-at-a-time request/response
+dispatch with token-granular continuous batching —
+
+- ``ContinuousBatchingEngine`` (``engine``): the loop thread, the
+  pooled ``(max_slots, ...)`` KV cache, mid-flight chunked-prefill
+  admission, and per-token slot eviction/reuse. Compiled shapes depend
+  only on ``max_slots`` — never on load.
+- ``AdmissionQueue`` / ``PrefillPolicy`` (``scheduler``): bounded FCFS
+  admission with backpressure, deadline/cancellation sweeps, and the
+  prefill-vs-decode token budget.
+- ``RequestHandle`` (``streams``): per-request streaming token
+  iterator + blocking ``result()``; greedy output is token-identical
+  to a lone ``model.generate`` call (tested).
+- ``run_poisson_comparison`` (``benchmark``): the Poisson-arrival
+  engine-vs-``GenerationService`` comparison behind
+  ``bench.py --serving``.
+
+Quick start::
+
+    from bigdl_tpu.serving import ContinuousBatchingEngine
+
+    with ContinuousBatchingEngine(model, max_slots=8,
+                                  eos_id=eos) as engine:
+        h = engine.submit(prompt_ids, max_new_tokens=128)
+        for tok in h.tokens():      # streams as the loop decodes
+            ...
+        row = h.result()            # prompt + generated
+
+Telemetry lands in the observability registry under
+``bigdl_serving_*{service=...}`` (TTFT and inter-token histograms,
+slot-occupancy gauge, admitted/evicted/timed-out counters, loop spans).
+"""
+
+from bigdl_tpu.serving.engine import ContinuousBatchingEngine
+from bigdl_tpu.serving.scheduler import AdmissionQueue, PrefillPolicy
+from bigdl_tpu.serving.streams import (
+    EngineStopped, QueueFull, RequestCancelled, RequestError,
+    RequestHandle, RequestTimedOut,
+)
+from bigdl_tpu.serving.benchmark import (
+    poisson_workload, run_poisson_comparison,
+)
+
+__all__ = [
+    "ContinuousBatchingEngine",
+    "AdmissionQueue", "PrefillPolicy",
+    "RequestHandle", "RequestError", "RequestCancelled",
+    "RequestTimedOut", "QueueFull", "EngineStopped",
+    "poisson_workload", "run_poisson_comparison",
+]

@@ -1,0 +1,145 @@
+"""Poisson-arrival serving benchmark: engine vs ``GenerationService``.
+
+Replays ONE sampled open-loop workload (exponential inter-arrival gaps,
+mixed prompt/decode lengths) against both serving paths and reports the
+numbers a serving SLO is written in: per-request latency p50/p99, TTFT
+p50/p99 (engine only — the batch service has no streaming), and
+aggregate delivered tokens/sec. ``bench.py --serving`` emits the result
+into ``bench_history.jsonl`` and the Prometheus snapshot so the serving
+perf trajectory is tracked alongside the training headline.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+
+def poisson_workload(n_requests: int, rate_hz: float, vocab: int,
+                     prompt_lens=(4, 16), decode_lens=(4, 24),
+                     seed: int = 0) -> List[dict]:
+    """Sample an open-loop workload: each request gets an arrival OFFSET
+    (cumulative exponential gaps at ``rate_hz``), a random prompt, and a
+    random decode length — the same list replays against every serving
+    path under comparison."""
+    r = np.random.RandomState(seed)
+    at = np.cumsum(r.exponential(1.0 / rate_hz, n_requests))
+    out = []
+    for i in range(n_requests):
+        t0 = int(r.randint(prompt_lens[0], prompt_lens[1] + 1))
+        out.append({
+            "arrival_s": float(at[i]),
+            "prompt": r.randint(0, vocab, (t0,)).astype(np.int32),
+            "n": int(r.randint(decode_lens[0], decode_lens[1] + 1)),
+        })
+    return out
+
+
+def _percentiles(xs) -> dict:
+    if not xs:
+        return {"p50": None, "p99": None}
+    return {"p50": round(float(np.percentile(xs, 50)), 6),
+            "p99": round(float(np.percentile(xs, 99)), 6)}
+
+
+def _replay(workload, submit_fn, collect_fn) -> dict:
+    """Open-loop replay: a pacer thread submits each request at its
+    arrival offset (late submissions go immediately — arrival times are
+    an offered load, not a synchronization barrier); ``collect_fn``
+    blocks per request and returns delivered token count."""
+    lat: List[float] = []
+    toks: List[int] = []
+    errs: List[BaseException] = []
+    lock = threading.Lock()
+    t_start = time.monotonic()
+
+    def one(req):
+        try:
+            t_sub = time.monotonic()
+            pending = submit_fn(req)
+            n_tok = collect_fn(pending, req)
+            dt = time.monotonic() - t_sub
+            with lock:
+                lat.append(dt)
+                toks.append(n_tok)
+        except BaseException as e:
+            with lock:
+                errs.append(e)
+
+    threads = []
+    for req in workload:
+        delay = t_start + req["arrival_s"] - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        t = threading.Thread(target=one, args=(req,), daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t_start
+    if errs:
+        raise errs[0]
+    return {"latency": _percentiles(lat),
+            "tokens_per_sec": round(sum(toks) / max(wall, 1e-9), 2),
+            "wall_s": round(wall, 3), "requests": len(workload)}
+
+
+def run_poisson_comparison(model, n_requests: int = 16,
+                           rate_hz: float = 20.0, max_slots: int = 4,
+                           prefill_chunk: int = 8, max_batch: int = 4,
+                           batch_timeout_ms: float = 10.0,
+                           eos_id: Optional[int] = None, seed: int = 0,
+                           registry=None, log=None) -> dict:
+    """Run the same Poisson workload through the continuous-batching
+    engine and through ``GenerationService``; return both result dicts
+    plus the engine's TTFT percentiles and the p99 speedup ratio
+    (> 1.0: the engine's tail is shorter)."""
+    from bigdl_tpu.optim import GenerationService
+    from bigdl_tpu.serving import ContinuousBatchingEngine
+
+    log = log or (lambda *a, **k: None)
+    vocab = model.vocab_size
+    wl = poisson_workload(n_requests, rate_hz, vocab,
+                          decode_lens=(4, min(24, model.max_len // 2)),
+                          seed=seed)
+
+    engine = ContinuousBatchingEngine(
+        model, max_slots=max_slots, prefill_chunk=prefill_chunk,
+        eos_id=eos_id, registry=registry, service_name="bench_engine")
+    ttft: List[float] = []
+    tlock = threading.Lock()
+
+    def collect_engine(handle, req):
+        row = handle.result()
+        if handle.first_token_at is not None:
+            with tlock:
+                ttft.append(handle.first_token_at - handle.submitted_at)
+        return row.shape[0] - req["prompt"].shape[0]
+
+    log("[serving-bench] engine replay...")
+    with engine:
+        eng = _replay(
+            wl, lambda req: engine.submit(req["prompt"], req["n"]),
+            collect_engine)
+    eng["ttft"] = _percentiles(ttft)
+
+    svc = GenerationService(model, max_batch=max_batch,
+                            batch_timeout_ms=batch_timeout_ms,
+                            bucket_tokens=8, prompt_bucket=8,
+                            eos_id=eos_id, registry=registry,
+                            service_name="bench_generation")
+    log("[serving-bench] GenerationService replay...")
+    gen = _replay(
+        wl, lambda req: svc.generate(req["prompt"], req["n"]),
+        lambda row, req: row.shape[0] - req["prompt"].shape[0])
+
+    p99_ratio = (round(gen["latency"]["p99"] / eng["latency"]["p99"], 4)
+                 if eng["latency"]["p99"] else None)
+    return {"engine": eng, "generation_service": gen,
+            "p99_speedup": p99_ratio,
+            "workload": {"requests": n_requests, "rate_hz": rate_hz,
+                         "seed": seed, "max_slots": max_slots,
+                         "max_batch": max_batch}}

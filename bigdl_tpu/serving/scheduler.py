@@ -1,0 +1,174 @@
+"""Admission control for the continuous-batching engine.
+
+Two policies live here, deliberately separate from the device loop:
+
+- ``AdmissionQueue`` — a bounded FCFS queue with BACKPRESSURE
+  (``put`` blocks or raises ``QueueFull`` when the bound is hit, so an
+  overloaded engine pushes back instead of buffering unboundedly) plus
+  deadline/cancellation sweeps: expired or cancelled requests are
+  dropped from the queue without ever costing a prefill.
+- ``PrefillPolicy`` — the prefill-vs-decode interleave: how many
+  prompt tokens each loop iteration may spend on admission before the
+  shared decode step runs. Chunked prefill under a per-iteration token
+  budget means admitting a 10k-token prompt never stalls the decode of
+  already-running requests for more than one chunk's worth of work.
+
+The reference's serving story (optim/PredictionService.scala) bounds
+concurrency with an instance queue; this is the generative analog where
+the bounded resource is KV-cache slots, not model clones.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Tuple
+
+from bigdl_tpu.serving.streams import (
+    QueueFull, RequestCancelled, RequestHandle, RequestTimedOut,
+)
+
+
+class AdmissionQueue:
+    """Bounded FCFS admission queue with backpressure.
+
+    Thread contract: any thread may ``put``; only the engine loop calls
+    ``pop_ready`` / ``sweep``. Dropped handles (cancelled or past their
+    deadline while queued) are returned to the caller as
+    ``(handle, error)`` pairs — the ENGINE finishes them, so all
+    terminal bookkeeping (metrics, stream sentinels) stays in one
+    place."""
+
+    def __init__(self, capacity: int = 64):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._q: "deque[RequestHandle]" = deque()
+        self._lock = threading.Condition()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def put(self, handle: RequestHandle, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        """Enqueue FCFS. When full: raise ``QueueFull`` immediately
+        (``block=False``), or wait up to ``timeout`` (None = forever)
+        for space — the backpressure path."""
+        deadline = (time.monotonic() + timeout) if timeout is not None \
+            else None
+        with self._lock:
+            while len(self._q) >= self.capacity:
+                if not block:
+                    raise QueueFull(
+                        f"admission queue full ({self.capacity} queued); "
+                        "retry later or raise queue_capacity")
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise QueueFull(
+                        f"admission queue still full ({self.capacity} "
+                        f"queued) after {timeout}s")
+                if not self._lock.wait(timeout=remaining):
+                    raise QueueFull(
+                        f"admission queue still full ({self.capacity} "
+                        f"queued) after {timeout}s")
+            self._q.append(handle)
+            self._lock.notify_all()
+
+    def pop_ready(self, now: Optional[float] = None
+                  ) -> Tuple[Optional[RequestHandle],
+                             List[Tuple[RequestHandle, Exception]]]:
+        """Pop the first LIVE handle (FCFS), skipping over — and
+        returning — any cancelled/expired ones encountered on the way.
+        Returns ``(handle_or_None, dropped)``."""
+        now = time.monotonic() if now is None else now
+        dropped: List[Tuple[RequestHandle, Exception]] = []
+        with self._lock:
+            while self._q:
+                h = self._q.popleft()
+                err = self._terminal(h, now)
+                if err is None:
+                    self._lock.notify_all()
+                    return h, dropped
+                dropped.append((h, err))
+            self._lock.notify_all()
+            return None, dropped
+
+    def sweep(self, now: Optional[float] = None
+              ) -> List[Tuple[RequestHandle, Exception]]:
+        """Drop every cancelled/expired handle anywhere in the queue
+        (not just the head) — a deep queue must not let a mid-queue
+        deadline rot until it reaches the front."""
+        now = time.monotonic() if now is None else now
+        dropped: List[Tuple[RequestHandle, Exception]] = []
+        with self._lock:
+            keep: "deque[RequestHandle]" = deque()
+            for h in self._q:
+                err = self._terminal(h, now)
+                (keep.append(h) if err is None
+                 else dropped.append((h, err)))
+            self._q = keep
+            if dropped:
+                self._lock.notify_all()
+        return dropped
+
+    def drain(self) -> List[RequestHandle]:
+        """Remove and return everything (engine shutdown)."""
+        with self._lock:
+            out = list(self._q)
+            self._q.clear()
+            self._lock.notify_all()
+            return out
+
+    @staticmethod
+    def _terminal(h: RequestHandle, now: float) -> Optional[Exception]:
+        if h.cancelled:
+            return RequestCancelled("cancelled while queued")
+        if h.deadline is not None and now > h.deadline:
+            waited = now - h.submitted_at
+            return RequestTimedOut(
+                f"deadline passed after {waited:.3f}s in the admission "
+                "queue (never admitted to a slot)")
+        return None
+
+
+class PrefillPolicy:
+    """The prefill-vs-decode interleave: each loop iteration may spend
+    at most ``budget_tokens`` prompt tokens on chunked prefill before
+    the shared decode step runs. ``chunk`` is the compiled prefill
+    chunk length (ONE program serves every offset — pos0 is traced), so
+    the budget is consumed ``chunk`` tokens at a time.
+
+    Defaults: ``budget_tokens = 2 * chunk`` — admission makes steady
+    progress (a C-token prompt admits in one iteration) while a running
+    decode never waits more than two chunks' worth of prefill."""
+
+    def __init__(self, chunk: int = 16,
+                 budget_tokens: Optional[int] = None):
+        if chunk < 1:
+            raise ValueError(f"prefill chunk must be >= 1, got {chunk}")
+        self.chunk = chunk
+        self.budget_tokens = (2 * chunk if budget_tokens is None
+                              else budget_tokens)
+        if self.budget_tokens < chunk:
+            raise ValueError(
+                f"budget_tokens ({self.budget_tokens}) must cover at "
+                f"least one chunk ({chunk}) or admission never advances")
+        self._left = 0
+
+    def begin_iteration(self) -> None:
+        self._left = self.budget_tokens
+
+    def take_chunk(self) -> bool:
+        """Spend one chunk of this iteration's budget; False once the
+        iteration's prefill allowance is exhausted."""
+        if self._left < self.chunk:
+            return False
+        self._left -= self.chunk
+        return True
+
+    def n_chunks(self, prompt_len: int) -> int:
+        """Chunks a prompt of this length needs (last chunk padded)."""
+        return -(-prompt_len // self.chunk)

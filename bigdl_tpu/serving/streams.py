@@ -1,0 +1,150 @@
+"""Per-request handles for the continuous-batching engine.
+
+A ``RequestHandle`` is the client's side of one generation request: a
+streaming token iterator (``tokens()``), a blocking ``result()``, and
+``cancel()``. The engine's loop thread is the only writer; clients only
+read — all cross-thread state goes through a queue and events, so no
+client ever touches the engine's slot pool.
+
+Greedy output is token-identical to a lone ``model.generate`` call on
+the same prompt (the engine's acceptance contract, tested); with an
+``eos_id`` the stream ends at (and includes) the first eos instead of
+carrying ``generate``'s eos padding tail.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+class RequestError(RuntimeError):
+    """Base class for per-request terminal failures."""
+
+
+class RequestCancelled(RequestError):
+    """The request was cancelled via ``handle.cancel()``."""
+
+
+class RequestTimedOut(RequestError):
+    """The request's deadline passed while queued or mid-decode."""
+
+
+class QueueFull(RuntimeError):
+    """The bounded admission queue rejected the request (backpressure)."""
+
+
+class EngineStopped(RuntimeError):
+    """The engine stopped (or crashed) before the request completed."""
+
+
+#: terminal sentinel on the token stream
+_DONE = object()
+
+
+class RequestHandle:
+    """One in-flight generation request.
+
+    Client API: ``tokens()`` (streaming iterator over generated token
+    ids, in generation order), ``result()`` (blocking: the full
+    ``prompt + generated`` row), ``cancel()``, ``done()``,
+    ``tokens_so_far()``. A terminal failure (timeout, cancellation,
+    engine stop) raises from ``result()`` and from the iterator AFTER
+    every already-delivered token has been yielded — partial output is
+    never silently dropped.
+
+    Engine API (loop thread only): ``_deliver`` / ``_finish``.
+    """
+
+    def __init__(self, prompt, max_new_tokens: int,
+                 timeout_s: Optional[float] = None):
+        self.prompt = np.asarray(prompt, np.int32)
+        self.max_new_tokens = int(max_new_tokens)
+        self.submitted_at = time.monotonic()
+        self.deadline = (self.submitted_at + timeout_s
+                         if timeout_s is not None else None)
+        #: set by the engine when the first token lands (TTFT source)
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self._tokens: list = []
+        self._stream: "queue.Queue" = queue.Queue()
+        self._done = threading.Event()
+        self._cancelled = threading.Event()
+        self._error: Optional[BaseException] = None
+
+    # ---------------------------------------------------- engine side
+    def _deliver(self, token: int, now: float) -> None:
+        if self.first_token_at is None:
+            self.first_token_at = now
+        self._tokens.append(int(token))
+        self._stream.put(int(token))
+
+    def _finish(self, error: Optional[BaseException] = None) -> None:
+        if self._done.is_set():
+            return
+        self._error = error
+        self.finished_at = time.monotonic()
+        self._done.set()
+        self._stream.put(_DONE)
+
+    # ---------------------------------------------------- client side
+    def cancel(self) -> None:
+        """Ask the engine to drop this request. Queued requests are
+        dropped before admission; running requests are evicted at the
+        next loop iteration. ``result()`` then raises
+        ``RequestCancelled`` (unless the request already finished)."""
+        self._cancelled.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def error(self) -> Optional[BaseException]:
+        """The terminal failure, or None (while running / on success)."""
+        return self._error
+
+    def tokens_so_far(self) -> np.ndarray:
+        """Generated tokens delivered so far (a snapshot — the useful
+        partial output after a timeout or cancellation)."""
+        return np.asarray(list(self._tokens), np.int32)
+
+    def tokens(self) -> Iterator[int]:
+        """Stream generated token ids in order as the engine produces
+        them; ends when the request finishes. A terminal failure raises
+        AFTER the delivered prefix has been yielded. Single consumer."""
+        while True:
+            item = self._stream.get()
+            if item is _DONE:
+                if self._error is not None:
+                    raise self._error
+                return
+            yield item
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block until the request finishes; return the 1-D
+        ``prompt + generated`` row (with ``eos_id`` configured on the
+        engine, generation stops at — and includes — the first eos).
+        Raises the terminal error on timeout/cancellation/engine-stop,
+        or ``TimeoutError`` if ``timeout`` expires first."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request not finished after {timeout}s (still "
+                f"{'cancelled' if self.cancelled else 'in flight'})")
+        if self._error is not None:
+            raise self._error
+        return np.concatenate(
+            [self.prompt, np.asarray(self._tokens, np.int32)])
+
+    def __repr__(self):
+        state = ("done" if self._done.is_set() else
+                 "cancelled" if self.cancelled else "pending")
+        return (f"RequestHandle(prompt={self.prompt.shape[0]} toks, "
+                f"n={self.max_new_tokens}, {state}, "
+                f"delivered={len(self._tokens)})")

@@ -25,6 +25,7 @@ PACKAGES = [
     ("bigdl_tpu.transform.vision", "Vision transforms"),
     ("bigdl_tpu.dlframes", "DataFrame estimator layer"),
     ("bigdl_tpu.models", "Model zoo"),
+    ("bigdl_tpu.serving", "Continuous-batching inference engine"),
     ("bigdl_tpu.observability", "Metrics registry, tracing, exporters"),
     ("bigdl_tpu.visualization", "TrainSummary / ValidationSummary"),
     ("bigdl_tpu.utils", "Serialization, import/export, config"),

@@ -164,3 +164,33 @@ def test_sampled_mode_serves(lm):
     for row in rows:
         assert row.shape == (9,)
         assert ((row >= 0) & (row < 32)).all()
+
+
+def test_tokens_total_counts_delivered_not_requested(lm):
+    """With eos_id, a row stopped early delivers only the tokens up to
+    and including the first eos — tokens_total must count those, not
+    the requested max_new_tokens (satellite of the serving-engine PR)."""
+    from bigdl_tpu.observability import MetricRegistry, generation_instruments
+    from bigdl_tpu.optim.generation_service import _delivered_tokens
+
+    # unit surface of the shared accounting helper
+    assert _delivered_tokens(np.array([5, 0, 0, 0]), 4, 0) == 2
+    assert _delivered_tokens(np.array([5, 1, 2, 3]), 4, 0) == 4
+    assert _delivered_tokens(np.array([5, 1]), 2, None) == 2
+
+    # integration: pick the model's own 2nd greedy token as eos so the
+    # early stop is guaranteed to trigger
+    p = np.asarray([1, 2, 3])
+    plain = np.asarray(lm.generate(jnp.asarray(p)[None], 6))[0]
+    eos = int(plain[4])
+    reg = MetricRegistry()
+    svc = GenerationService(lm, bucket_tokens=4, eos_id=eos,
+                            registry=reg, service_name="eosacct")
+    row = svc.generate(p, 6)
+    gen = row[3:]
+    hits = np.where(gen == eos)[0]
+    assert len(hits), "eos chosen from the greedy row must appear"
+    delivered = int(hits[0]) + 1
+    assert delivered < 6  # the early stop actually happened
+    got = generation_instruments("eosacct", reg).tokens_total.get()
+    assert got == delivered, (got, delivered)

@@ -143,3 +143,26 @@ def test_table_request_preserves_keys():
     out = svc.predict(T(a=np.ones((2,), np.float32),
                         b=np.full((2,), 3.0, np.float32)))
     np.testing.assert_allclose(out, [7.0, 7.0])
+
+
+def test_micro_batcher_submit_timeout_raises_instead_of_hanging():
+    """A dead/wedged drain must not hang the caller forever: with
+    submit_timeout_s the submitter raises a descriptive error instead
+    (satellite of the serving-engine PR)."""
+    import pytest
+
+    from bigdl_tpu.optim.prediction_service import _MicroBatcher
+
+    release = threading.Event()
+
+    def wedged(batch):
+        release.wait(30.0)  # simulates a dispatch that never returns
+        return batch
+
+    mb = _MicroBatcher(wedged, max_batch=4, timeout_ms=1.0,
+                       submit_timeout_s=0.05)
+    try:
+        with pytest.raises(RuntimeError, match="drain thread died or"):
+            mb.submit(np.zeros((2,), np.float32))
+    finally:
+        release.set()  # unwedge the daemon drain thread
