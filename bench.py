@@ -214,6 +214,11 @@ def bench_main(argv=None):
     p.add_argument("--serving", action="store_true",
                    help="Poisson-arrival serving benchmark: continuous-"
                         "batching engine vs GenerationService")
+    p.add_argument("--trace", action="store_true",
+                   help="also dump bench_trace.json — the run's span "
+                        "trees + flight-recorder events as Chrome "
+                        "trace JSON (open in Perfetto); path override: "
+                        "BIGDL_BENCH_TRACE")
     p.add_argument("--requests", type=int, default=24,
                    help="--serving: workload size")
     p.add_argument("--rate", type=float, default=20.0,
@@ -363,6 +368,8 @@ def bench_main(argv=None):
 
     _record_bench_metrics(result, model)
     _dump_prometheus_snapshot()
+    if args.trace:
+        _dump_chrome_trace()
     print(json.dumps(result))
 
 
@@ -400,6 +407,8 @@ def _serving_bench(args, dev):
     }
     _record_serving_metrics(res)
     _dump_prometheus_snapshot()
+    if args.trace:
+        _dump_chrome_trace()
     print(json.dumps(result))
 
 
@@ -481,23 +490,39 @@ def _record_bench_metrics(result, model):
               file=sys.stderr)
 
 
-def _dump_prometheus_snapshot():
-    """Prometheus text snapshot alongside the BENCH_*.json trend files
-    (path overridable via BIGDL_BENCH_PROM). Includes everything the run
-    put in the default registry — bench gauges plus any bigdl_train_*
-    series the perf loops populated."""
+def _dump_artifact(env_var, filename, writer_name, label):
+    """Drop one observability artifact next to the BENCH_*.json trend
+    files (path overridable via ``env_var``); ``writer_name`` is the
+    ``bigdl_tpu.observability`` export that does the actual write.
+    Never lets telemetry break the bench."""
     import os
 
     try:
         from bigdl_tpu import observability as obs
 
-        path = (os.environ.get("BIGDL_BENCH_PROM")
+        path = (os.environ.get(env_var)
                 or os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                "bench_metrics.prom"))
-        obs.write_prometheus(path)
-        print(f"[bench] prometheus snapshot -> {path}", file=sys.stderr)
+                                filename))
+        getattr(obs, writer_name)(path)
+        print(f"[bench] {label} -> {path}", file=sys.stderr)
     except Exception as e:
-        print(f"[bench] prometheus snapshot failed: {e}", file=sys.stderr)
+        print(f"[bench] {label} failed: {e}", file=sys.stderr)
+
+
+def _dump_chrome_trace():
+    """`--trace`: Chrome trace-event JSON of the run (span trees +
+    flight-recorder request timelines) alongside bench_metrics.prom —
+    one serving benchmark run becomes one Perfetto-loadable artifact."""
+    _dump_artifact("BIGDL_BENCH_TRACE", "bench_trace.json",
+                   "write_chrome_trace", "chrome trace")
+
+
+def _dump_prometheus_snapshot():
+    """Prometheus text snapshot alongside the BENCH_*.json trend files.
+    Includes everything the run put in the default registry — bench
+    gauges plus any bigdl_train_* series the perf loops populated."""
+    _dump_artifact("BIGDL_BENCH_PROM", "bench_metrics.prom",
+                   "write_prometheus", "prometheus snapshot")
 
 
 if __name__ == "__main__":

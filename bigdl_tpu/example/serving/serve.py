@@ -10,6 +10,10 @@ POINT is the serving machinery, not the prose):
   3. ragged mixed-length batch
   4. int8 draft + speculative decoding (greedy and full sampling)
   5. GenerationService: concurrent requests, coalescing stats
+  6. ContinuousBatchingEngine: streaming requests, request-scoped
+     flight-recorder timelines, and the ops surface — /healthz wired
+     to engine liveness (503 once the decode loop dies),
+     /debug/requests TTFT breakdowns, /debug/trace Chrome trace
 
 Run: python -m bigdl_tpu.example.serving.serve [--tokens 24]
 """
@@ -103,15 +107,42 @@ def main(argv=None):
     print(f"[service]   {s['served']} requests in {s['dispatches']} "
           f"dispatches (occupancy {s['mean_batch_occupancy']:.1f})")
 
-    # the same counters, scraped: a stdlib /metrics endpoint any
-    # Prometheus-compatible collector can poll
+    # continuous batching with the full ops surface: the engine's
+    # liveness feeds /healthz (a crashed decode loop flips it to 503
+    # instead of lying "ok"), and the flight recorder's per-request
+    # timelines come back over /debug/requests + /debug/trace
+    import json
     import urllib.request
 
     from bigdl_tpu import observability as obs
+    from bigdl_tpu.serving import ContinuousBatchingEngine
 
-    with obs.start_http_server(host="127.0.0.1") as server:
-        body = urllib.request.urlopen(
-            f"http://127.0.0.1:{server.port}/metrics").read().decode()
+    with ContinuousBatchingEngine(model, max_slots=2, prefill_chunk=8,
+                                  eos_id=0) as engine, \
+            obs.start_http_server(host="127.0.0.1",
+                                  healthz=engine.healthz,
+                                  debug_requests=engine.debug_requests
+                                  ) as server:
+        base = f"http://127.0.0.1:{server.port}"
+        handles = [engine.submit(r.randint(0, args.vocab, (L,)), nn_)
+                   for L, nn_ in ((6, n), (10, n // 2))]
+        streamed = sum(1 for _ in handles[0].tokens())
+        for h in handles:
+            h.result(timeout=120)
+        hz = json.loads(urllib.request.urlopen(
+            f"{base}/healthz").read())
+        dbg = json.loads(urllib.request.urlopen(
+            f"{base}/debug/requests").read())
+        ttft = dbg["latency"]["ttft"]["p50"]
+        print(f"[engine]    {handles[0].request_id} streamed "
+              f"{streamed} tokens; /healthz {hz['status']} "
+              f"(loop_alive={hz['loop_alive']}); /debug/requests "
+              f"p50 TTFT {ttft * 1e3:.1f}ms over "
+              f"{dbg['latency']['ttft']['count']} requests")
+
+        # the same counters, scraped: a stdlib /metrics endpoint any
+        # Prometheus-compatible collector can poll
+        body = urllib.request.urlopen(f"{base}/metrics").read().decode()
     shown = [ln for ln in body.splitlines()
              if ln.startswith(("bigdl_serve_requests_total",
                                "bigdl_generation_tokens_total"))]

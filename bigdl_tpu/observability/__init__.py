@@ -10,9 +10,20 @@ equivalent for one-process-per-host JAX):
 - **Span tracer** (``tracing``): ``with trace.span("train/step"):``
   wall-time trees, nested per thread, forwarded to
   ``jax.profiler.TraceAnnotation`` when available.
+- **Flight recorder** (``events``): a bounded ring of per-request
+  structured events (submitted → admitted → prefill → first token →
+  per-token decode → finished), near-zero cost when disabled — the
+  "what happened to request X, in what order" black box.
+- **Chrome trace export** (``chrometrace``): span trees + recorder
+  events as one Perfetto/``chrome://tracing`` JSON timeline.
+- **Postmortems** (``postmortem``): on an engine crash, one JSON
+  artifact with the last-N events, open span trees, metrics snapshot,
+  and in-flight request states.
 - **Exporters** (``exporters``): Prometheus text rendering, a
-  stdlib-only ``/metrics`` + ``/healthz`` HTTP endpoint, and a bridge
-  mirroring the registry into ``visualization`` TensorBoard writers.
+  stdlib-only ``/metrics`` + ``/healthz`` HTTP endpoint with
+  ``/debug/events`` + ``/debug/requests`` + ``/debug/trace`` routes,
+  and a bridge mirroring the registry into ``visualization``
+  TensorBoard writers.
 
 Wired through the stack: ``Optimizer``/``DistriOptimizer`` (step time,
 throughput, loss, lr, grad norm, JIT compiles, checkpoint latency),
@@ -38,6 +49,16 @@ from bigdl_tpu.observability.metrics import (
     default_registry, set_default_registry,
 )
 from bigdl_tpu.observability.tracing import Span, Tracer, trace
+from bigdl_tpu.observability.events import (
+    Event, FlightRecorder, RECORDER, default_recorder, next_request_id,
+    percentile_summary, record, set_default_recorder,
+)
+from bigdl_tpu.observability.chrometrace import (
+    chrome_trace_events, render_chrome_trace, write_chrome_trace,
+)
+from bigdl_tpu.observability.postmortem import (
+    build_postmortem, registry_snapshot, write_postmortem,
+)
 from bigdl_tpu.observability.exporters import (
     MetricsHTTPServer, PROMETHEUS_CONTENT_TYPE, TensorBoardBridge,
     render_prometheus, start_http_server, write_prometheus,
@@ -52,6 +73,11 @@ __all__ = [
     "DEFAULT_BUCKETS", "Metric", "MetricRegistry", "REGISTRY",
     "default_registry", "set_default_registry",
     "Span", "Tracer", "trace",
+    "Event", "FlightRecorder", "RECORDER", "default_recorder",
+    "set_default_recorder", "record", "next_request_id",
+    "percentile_summary",
+    "chrome_trace_events", "render_chrome_trace", "write_chrome_trace",
+    "build_postmortem", "registry_snapshot", "write_postmortem",
     "MetricsHTTPServer", "PROMETHEUS_CONTENT_TYPE", "TensorBoardBridge",
     "render_prometheus", "start_http_server", "write_prometheus",
     "OCCUPANCY_BUCKETS", "OccupancyStats", "TIME_BUCKETS",
@@ -63,16 +89,20 @@ __all__ = [
 
 
 def enable() -> None:
-    """Re-enable metric recording and span tracing process-wide."""
+    """Re-enable metric recording, span tracing, and the flight
+    recorder process-wide."""
     default_registry().enable()
     trace.enable()
+    default_recorder().enable()
 
 
 def disable() -> None:
-    """Disable metric recording and span tracing process-wide (every
-    instrument mutation becomes a boolean check and an early return)."""
+    """Disable metric recording, span tracing, and the flight recorder
+    process-wide (every instrument mutation becomes a boolean check
+    and an early return)."""
     default_registry().disable()
     trace.disable()
+    default_recorder().disable()
 
 
 def enabled() -> bool:

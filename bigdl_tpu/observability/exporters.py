@@ -7,8 +7,10 @@ Three sinks over one source (the MetricRegistry):
   (``text/plain; version=0.0.4``) any Prometheus-compatible scraper
   ingests.
 - ``MetricsHTTPServer`` / ``start_http_server`` — a stdlib-only
-  ``ThreadingHTTPServer`` serving ``/metrics`` + ``/healthz``; attach it
-  to a serving process and point the scraper at it. No dependencies.
+  ``ThreadingHTTPServer`` serving ``/metrics`` + ``/healthz`` plus the
+  flight-recorder debug routes (``/debug/events``, ``/debug/requests``,
+  ``/debug/trace`` — Chrome trace download); attach it to a serving
+  process and point the scraper at it. No dependencies.
 - ``TensorBoardBridge`` — mirrors counters/gauges (and histogram
   sum/count) into anything with ``add_scalar(tag, value, step)``
   (visualization.TrainSummary / FileWriter), so training dashboards and
@@ -110,22 +112,64 @@ def write_prometheus(path: str,
 
 # ------------------------------------------------------------- HTTP server
 class MetricsHTTPServer:
-    """Stdlib-only scrape endpoint: ``GET /metrics`` returns the
-    Prometheus text snapshot, ``GET /healthz`` returns 200 with a JSON
-    body (or 503 when the ``healthz`` callable returns falsy/raises).
-    ``port=0`` binds an ephemeral port — read it back from ``.port``."""
+    """Stdlib-only scrape + debug endpoint. ``GET /metrics`` returns
+    the Prometheus text snapshot; ``GET /healthz`` returns 200 with a
+    JSON body (or 503 when the ``healthz`` callable returns
+    falsy/raises). ``port=0`` binds an ephemeral port — read it back
+    from ``.port``.
+
+    Three debug routes expose the request-scoped flight recorder:
+
+    - ``GET /debug/events[?n=256]`` — the recorder's newest events as
+      JSON (``{"events": [...], "total": N}``).
+    - ``GET /debug/requests`` — whatever ``debug_requests()`` returns;
+      wire ``ContinuousBatchingEngine.debug_requests`` here for
+      in-flight request states + recent per-request timeline
+      breakdowns (queue wait / prefill / TTFT / decode percentiles).
+    - ``GET /debug/trace`` — the Chrome trace-event JSON of the span
+      trees + recorder events (open it in Perfetto or
+      ``chrome://tracing``).
+
+    ``recorder``/``tracer`` default to the process defaults, resolved
+    per request (a swapped default redirects the endpoints too)."""
 
     def __init__(self, registry: Optional[MetricRegistry] = None,
                  host: str = "0.0.0.0", port: int = 0,
-                 healthz: Optional[Callable[[], object]] = None):
+                 healthz: Optional[Callable[[], object]] = None,
+                 recorder=None, tracer=None,
+                 debug_requests: Optional[Callable[[], dict]] = None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from bigdl_tpu.observability import events as _events
 
         get_registry = (lambda: registry) if registry is not None \
             else default_registry
+        get_recorder = (lambda: recorder) if recorder is not None \
+            else _events.default_recorder
+
+        def get_tracer():
+            if tracer is not None:
+                return tracer
+            from bigdl_tpu.observability.tracing import trace
+            return trace
 
         class Handler(BaseHTTPRequestHandler):
+            def _send_json(self, payload, status: int = 200,
+                           download: Optional[str] = None):
+                body = payload if isinstance(payload, bytes) \
+                    else json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                if download:
+                    self.send_header(
+                        "Content-Disposition",
+                        f'attachment; filename="{download}"')
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):  # noqa: N802 (stdlib handler contract)
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 if path == "/metrics":
                     body = render_prometheus(get_registry()).encode()
                     self.send_response(200)
@@ -134,6 +178,38 @@ class MetricsHTTPServer:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif path == "/debug/events":
+                    try:
+                        from urllib.parse import parse_qs
+                        n = int(parse_qs(query).get("n", ["256"])[0])
+                        rec = get_recorder()
+                        self._send_json({"events": rec.snapshot(n),
+                                         "total": rec.total,
+                                         "capacity": rec.capacity})
+                    except Exception as e:
+                        self._send_json({"error": str(e)}, status=500)
+                elif path == "/debug/requests":
+                    try:
+                        if debug_requests is None:
+                            self._send_json(
+                                {"in_flight": [], "recent": [],
+                                 "note": "no request source attached "
+                                         "(pass debug_requests=)"})
+                        else:
+                            self._send_json(debug_requests())
+                    except Exception as e:
+                        self._send_json({"error": str(e)}, status=500)
+                elif path == "/debug/trace":
+                    try:
+                        from bigdl_tpu.observability.chrometrace import (
+                            render_chrome_trace,
+                        )
+                        self._send_json(
+                            render_chrome_trace(
+                                get_tracer(), get_recorder()).encode(),
+                            download="bigdl_trace.json")
+                    except Exception as e:
+                        self._send_json({"error": str(e)}, status=500)
                 elif path == "/healthz":
                     status, payload = 200, {"status": "ok"}
                     if healthz is not None:
@@ -184,11 +260,15 @@ class MetricsHTTPServer:
 def start_http_server(port: int = 0,
                       registry: Optional[MetricRegistry] = None,
                       host: str = "0.0.0.0",
-                      healthz: Optional[Callable[[], object]] = None
+                      healthz: Optional[Callable[[], object]] = None,
+                      recorder=None, tracer=None,
+                      debug_requests: Optional[Callable[[], dict]] = None
                       ) -> MetricsHTTPServer:
     """Convenience wrapper: start and return a MetricsHTTPServer."""
     return MetricsHTTPServer(registry=registry, host=host, port=port,
-                             healthz=healthz)
+                             healthz=healthz, recorder=recorder,
+                             tracer=tracer,
+                             debug_requests=debug_requests)
 
 
 # -------------------------------------------------------- TensorBoard bridge

@@ -81,6 +81,12 @@ class Tracer:
         self._local = threading.local()
         self._lock = threading.Lock()
         self._roots: collections.deque = collections.deque(maxlen=max_roots)
+        #: thread ident -> that thread's live span stack. Registered
+        #: when a thread opens its first span, REMOVED when its last
+        #: span closes — so thread churn (one thread per request)
+        #: never grows this map unboundedly, and crash postmortems can
+        #: enumerate every still-open span tree across threads.
+        self._live: dict = {}
         self._enabled = True
         self.forward_to_jax = forward_to_jax
 
@@ -100,7 +106,19 @@ class Tracer:
         s = getattr(self._local, "stack", None)
         if s is None:
             s = self._local.stack = []
+            with self._lock:
+                self._live[threading.get_ident()] = s
         return s
+
+    def _drop_stack(self) -> None:
+        """Reclaim this thread's (now empty) stack storage — both the
+        thread-local slot and the live-stack registration."""
+        with self._lock:
+            self._live.pop(threading.get_ident(), None)
+        try:
+            del self._local.stack
+        except AttributeError:
+            pass
 
     @contextmanager
     def span(self, name: str, histogram=None):
@@ -140,14 +158,29 @@ class Tracer:
             if not stack:
                 with self._lock:
                     self._roots.append(sp)
+                # last span on this thread closed: reclaim its stack
+                # storage (short-lived request threads must not leave
+                # a thread-local entry behind forever)
+                self._drop_stack()
             if histogram is not None:
                 histogram.observe(sp.duration)
 
     def current(self) -> Optional[Span]:
-        stack = self._stack()
-        return stack[-1] if stack else None
+        # read-only: must not allocate (and register) stack storage
+        # for a thread that never opened a span
+        s = getattr(self._local, "stack", None)
+        return s[-1] if s else None
 
     # ------------------------------------------------------------ readers
+    def open_spans(self) -> List[Span]:
+        """The still-open ROOT span of every thread currently inside a
+        ``span(...)`` block — live objects, read for rendering only
+        (crash postmortems and the Chrome trace include them so
+        "what was mid-flight" survives the crash)."""
+        with self._lock:
+            stacks = [list(s) for s in self._live.values()]
+        return [s[0] for s in stacks if s]
+
     def roots(self, name: Optional[str] = None) -> List[Span]:
         """Completed root spans, oldest first; ``name`` filters."""
         with self._lock:

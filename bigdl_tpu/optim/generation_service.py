@@ -23,6 +23,7 @@ Shape discipline (the TPU serving contract):
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 
@@ -90,10 +91,22 @@ class GenerationService:
         from bigdl_tpu.observability import (
             OccupancyStats, generation_instruments, serving_instruments,
         )
+        from bigdl_tpu.observability.events import default_recorder
 
+        self.service_name = service_name
         self._ins = serving_instruments(service_name, registry)
         self._gen_ins = generation_instruments(service_name, registry)
         self._occ_stats = OccupancyStats(self._ins.batch_occupancy)
+        # flight-recorder wiring: per-request submitted/finished events
+        # plus batch/enqueue|dispatch tags from the micro-batcher, all
+        # under the same request-id vocabulary as the serving engine
+        self._rec = default_recorder()
+        #: bounded ring of per-request timeline summaries — the
+        #: stats() percentile source (lock: concurrent generate()
+        #: callers append while stats() snapshots; iterating a deque
+        #: under concurrent append raises in CPython)
+        self._recent: collections.deque = collections.deque(maxlen=256)
+        self._recent_lock = threading.Lock()
         # the micro-batcher invokes on_batch then run_batch on the SAME
         # drain thread, so a thread-local carries each dispatch's real
         # (pre-padding) request count into the tokens/sec computation
@@ -163,7 +176,9 @@ class GenerationService:
                                   self.batch_timeout_ms,
                                   on_batch=self._count_batch,
                                   telemetry=self._ins,
-                                  submit_timeout_s=self.submit_timeout_s)
+                                  submit_timeout_s=self.submit_timeout_s,
+                                  recorder=self._rec,
+                                  name=self.service_name)
                 self._batchers[key] = b
             return b
 
@@ -200,17 +215,62 @@ class GenerationService:
         row[:t0] = prompt
         row[-2], row[-1] = t0, n
         self._ins.requests_total.inc()
+        from bigdl_tpu.observability.events import next_request_id
+
+        rid = next_request_id()
+        t_sub = time.monotonic()
+        self._rec.record("request/submitted", rid,
+                         service=self.service_name, prompt_tokens=t0,
+                         max_new_tokens=n)
+        detail: dict = {}
         # dispatch failures are counted by the micro-batcher's telemetry
-        # (per failed request in the batch) — no second count here
-        with self._ins.inflight.track():
-            toks = self._batcher(key).submit(row)
+        # (per failed request in the batch) — no second count here; the
+        # recorder still needs a TERMINAL event, or a failed request
+        # reads as stuck in flight forever
+        try:
+            with self._ins.inflight.track():
+                toks = self._batcher(key).submit(row, request_id=rid,
+                                                 detail=detail)
+        except Exception as e:
+            t_done = time.monotonic()
+            self._rec.record("request/failed", rid,
+                             service=self.service_name,
+                             error=type(e).__name__)
+            t_launch = detail.get("t_launch")
+            with self._recent_lock:
+                self._recent.append({
+                    "request_id": rid, "outcome": "failed",
+                    "queue_wait_s": (t_launch - t_sub)
+                    if t_launch is not None else None,
+                    "decode_s": None, "ttft_s": None,
+                    "total_s": t_done - t_sub, "tokens": 0,
+                })
+            raise
+        t_done = time.monotonic()
         gen = np.asarray(toks[:n])
         # count DELIVERED tokens: with eos_id, a row that stopped early
         # carries an eos-padding tail the caller never asked for —
         # tokens up to and including the first eos are what was served
         # (the same accounting run_batch's tokens/sec uses)
-        self._gen_ins.tokens_total.inc(_delivered_tokens(gen, n,
-                                                         self.eos_id))
+        delivered = _delivered_tokens(gen, n, self.eos_id)
+        self._gen_ins.tokens_total.inc(delivered)
+        self._rec.record("request/finished", rid,
+                         service=self.service_name, tokens=delivered)
+        t_launch = detail.get("t_launch")
+        # batch-at-a-time timeline: every token lands when the batch
+        # completes, so TTFT == total; prefill is inside the fused
+        # dispatch (decode_s covers device time, launch -> done)
+        with self._recent_lock:
+            self._recent.append({
+                "request_id": rid, "outcome": "finished",
+                "queue_wait_s": (t_launch - t_sub)
+                if t_launch is not None else None,
+                "decode_s": (t_done - t_launch)
+                if t_launch is not None else None,
+                "ttft_s": t_done - t_sub,
+                "total_s": t_done - t_sub,
+                "tokens": delivered,
+            })
         return np.concatenate([prompt, gen])
 
     def _count_batch(self, real_size: int):
@@ -229,5 +289,20 @@ class GenerationService:
         shares the same ``service_name``, and disabling the service's
         registry (``observability.disable()`` when it uses the process
         default) stops these counters with the rest of that registry
-        (see ``observability.OccupancyStats``)."""
-        return self._occ_stats.snapshot()
+        (see ``observability.OccupancyStats``).
+
+        ``latency`` adds percentile summaries over the recent
+        per-request timelines (queue wait to batch launch, device time,
+        TTFT, total — in this batch-at-a-time service every token
+        lands with the batch, so TTFT equals total and prefill is
+        inside the fused dispatch)."""
+        out = self._occ_stats.snapshot()
+        from bigdl_tpu.observability.events import percentile_summary
+
+        with self._recent_lock:
+            snap = list(self._recent)
+        tls = [t for t in snap if t["outcome"] == "finished"]
+        out["latency"] = {
+            phase: percentile_summary(t.get(phase + "_s") for t in tls)
+            for phase in ("queue_wait", "ttft", "decode", "total")}
+        return out

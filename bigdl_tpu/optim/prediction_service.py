@@ -88,6 +88,13 @@ class _MicroBatcher:
     real batch occupancy, dispatch wall time, and dispatch/error counts
     into the metrics registry; None (the default) records nothing.
 
+    Requests submitted with a ``request_id`` are additionally tagged
+    into the flight recorder (``batch/enqueue`` at submit,
+    ``batch/dispatch`` as the coalesced batch launches,
+    ``batch/error`` on a failed dispatch) — the same correlation ids
+    the continuous-batching engine uses, so one Chrome trace shows
+    which requests shared a device dispatch.
+
     ``submit_timeout_s`` bounds how long a submitter waits for its
     batch's result. The wait is normally (window + dispatch) long, but
     if the drain thread DIES (a bug, an interpreter teardown race) the
@@ -96,26 +103,46 @@ class _MicroBatcher:
     None (the default) preserves the unbounded wait."""
 
     def __init__(self, run_batch, max_batch: int, timeout_ms: float,
-                 on_batch=None, telemetry=None, submit_timeout_s=None):
+                 on_batch=None, telemetry=None, submit_timeout_s=None,
+                 recorder=None, name: str = "batch"):
+        from bigdl_tpu.observability.events import default_recorder
+
         self._run = run_batch
         self.max_batch = max_batch
         self.timeout = timeout_ms / 1000.0
         self.submit_timeout_s = submit_timeout_s
         self._lock = threading.Condition()
-        self._pending = {}  # signature -> list of (array, event, slot, t)
+        # signature -> list of (array, event, slot, t_enq, request_id)
+        self._pending = {}
         #: optional callable(real_batch_size) invoked as each batch
         #: launches — the REAL request count, before padding (telemetry)
         self._on_batch = on_batch
         self._telemetry = telemetry
+        self._rec = recorder if recorder is not None \
+            else default_recorder()
+        self.name = name
 
-    def submit(self, x):
+    def submit(self, x, request_id=None, detail=None):
+        """Queue one sample; blocks until its batch lands and returns
+        this sample's row of the output. ``request_id`` tags the
+        request's recorder events; ``detail`` (a dict) receives
+        ``t_launch`` — the monotonic instant this request's batch was
+        dispatched — so callers can split queue wait from device time
+        in their own timelines."""
         x = np.asarray(x)
         sig = (x.shape, x.dtype.str)
         ev = threading.Event()
-        slot = {}
+        slot = detail if detail is not None else {}
+        if request_id is not None:
+            # recorded BEFORE the request becomes poppable — once it
+            # is in the pending group the drain thread may dispatch it
+            # immediately, and batch/dispatch must never precede
+            # batch/enqueue in the request's timeline
+            self._rec.record("batch/enqueue", request_id,
+                             service=self.name)
         with self._lock:
             group = self._pending.setdefault(sig, [])
-            group.append((x, ev, slot, time.monotonic()))
+            group.append((x, ev, slot, time.monotonic(), request_id))
             if len(group) == 1:
                 # group leader: wait out the window, then run this group
                 threading.Thread(target=self._drain, args=(sig,),
@@ -150,7 +177,7 @@ class _MicroBatcher:
         tel = self._telemetry
         if tel is not None:
             now = time.monotonic()
-            for _, _, _, t_enq in batch:
+            for _, _, _, t_enq, _ in batch:
                 tel.queue_wait_seconds.observe(now - t_enq)
             tel.batch_occupancy.observe(len(xs))
             tel.dispatches_total.inc()
@@ -160,16 +187,26 @@ class _MicroBatcher:
             pad = self.max_batch - len(xs)  # fixed shape -> one compile
             stacked = np.stack(xs + [xs[-1]] * pad)
             t0 = time.monotonic()
+            for _, _, slot, _, rid in batch:
+                slot["t_launch"] = t0
+                if rid is not None:
+                    self._rec.record("batch/dispatch", rid,
+                                     service=self.name,
+                                     batch_size=len(xs))
             outs = self._run(stacked)
             if tel is not None:
                 tel.dispatch_seconds.observe(time.monotonic() - t0)
-            for i, (_, ev, slot, _) in enumerate(batch):
+            for i, (_, ev, slot, _, _) in enumerate(batch):
                 slot["out"] = jax.tree.map(lambda o: o[i], outs)
                 ev.set()
         except Exception as e:
             if tel is not None:
                 tel.errors_total.inc(len(xs))
-            for _, ev, slot, _ in batch:
+            for _, ev, slot, _, rid in batch:
+                if rid is not None:
+                    self._rec.record("batch/error", rid,
+                                     service=self.name,
+                                     error=type(e).__name__)
                 slot["error"] = e
                 ev.set()
 
@@ -217,7 +254,8 @@ class PredictionService:
         self._batcher = (_MicroBatcher(self._run_batch, max_batch,
                                        batch_timeout_ms,
                                        telemetry=self._ins,
-                                       submit_timeout_s=submit_timeout_s)
+                                       submit_timeout_s=submit_timeout_s,
+                                       name=service_name)
                          if max_batch and max_batch > 1 else None)
 
     # ------------------------------------------------------------- core run
@@ -255,8 +293,15 @@ class PredictionService:
                                   == self.sample_ndim))
                 if batchable:
                     # failures inside the batch are counted by the
-                    # micro-batcher's telemetry
-                    out = self._batcher.submit(request)
+                    # micro-batcher's telemetry; the request id tags
+                    # this request's share of the coalesced dispatch
+                    # in the flight recorder
+                    from bigdl_tpu.observability.events import (
+                        next_request_id,
+                    )
+
+                    out = self._batcher.submit(
+                        request, request_id=next_request_id("pred"))
                 else:
                     # standalone dispatch still counts occupancy (1) so
                     # the series reflects how the MXU is being fed

@@ -32,7 +32,12 @@ Quick start::
 
 Telemetry lands in the observability registry under
 ``bigdl_serving_*{service=...}`` (TTFT and inter-token histograms,
-slot-occupancy gauge, admitted/evicted/timed-out counters, loop spans).
+slot-occupancy gauge, admitted/evicted/timed-out counters, loop spans),
+and every lifecycle transition lands in the flight recorder under the
+handle's ``request_id`` (``handle.timeline()`` breakdowns,
+``engine.debug_requests()`` / ``/debug/*`` endpoints, Chrome trace
+export, and a crash postmortem from ``engine.healthz()``'s failing
+loop — see ``bigdl_tpu.observability``).
 """
 
 from bigdl_tpu.serving.engine import ContinuousBatchingEngine

@@ -34,6 +34,8 @@ same argmax tie-breaking.
 
 from __future__ import annotations
 
+import collections
+import sys
 import threading
 import time
 from typing import List, Optional
@@ -110,6 +112,15 @@ class ContinuousBatchingEngine:
     (tokens surface per iteration, not per finished batch). Prefer
     ``GenerationService`` for homogeneous offline batches, where one
     fused scan dispatch per batch beats a host round-trip per token.
+
+    Every lifecycle transition (submitted → queued → admitted → each
+    prefill chunk → first token → per-token decode → finished /
+    cancelled / timed-out / stopped / crashed) lands in the flight
+    recorder under the handle's ``request_id``; ``debug_requests()``
+    feeds ``GET /debug/requests``, ``healthz()`` feeds the liveness
+    probe (503 once the loop crashes), and a loop crash writes a
+    postmortem JSON (``postmortem_path`` / ``$BIGDL_POSTMORTEM_PATH``,
+    default ``bigdl_postmortem.json``) before failing the handles.
     """
 
     def __init__(self, model, max_slots: int = 4,
@@ -119,9 +130,12 @@ class ContinuousBatchingEngine:
                  top_k=None, top_p=None, queue_capacity: int = 64,
                  seed: int = 0, registry=None,
                  service_name: str = "engine",
-                 idle_wait_s: float = 0.5):
+                 idle_wait_s: float = 0.5, recorder=None,
+                 postmortem_path: Optional[str] = None,
+                 recent_timelines: int = 256):
         from bigdl_tpu.models.transformer import _validate_sampling
         from bigdl_tpu.observability import serving_engine_instruments
+        from bigdl_tpu.observability.events import default_recorder
 
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
@@ -133,6 +147,24 @@ class ContinuousBatchingEngine:
         self.temperature = temperature
         self.top_k, self.top_p = top_k, top_p
         self.idle_wait_s = idle_wait_s
+        self.service_name = service_name
+        #: flight recorder fed by every lifecycle transition (captured
+        #: at construction, like the instruments — swap the default
+        #: BEFORE building the engine, or pass one explicitly)
+        self._rec = recorder if recorder is not None \
+            else default_recorder()
+        self._registry = registry
+        #: crash black-box destination; resolved at crash time
+        #: ($BIGDL_POSTMORTEM_PATH, else ./bigdl_postmortem.json)
+        self.postmortem_path = postmortem_path
+        #: bounded ring of finished-request timeline summaries — the
+        #: source for stats() percentiles and /debug/requests "recent".
+        #: The lock covers append vs. snapshot: iterating a deque that
+        #: another thread appends to raises RuntimeError in CPython,
+        #: and /debug readers run on HTTP threads while the loop writes
+        self._timelines: collections.deque = collections.deque(
+            maxlen=recent_timelines)
+        self._timelines_lock = threading.Lock()
         self._policy = PrefillPolicy(prefill_chunk, prefill_budget_tokens)
         c = self._policy.chunk
         # the cache length rounds the serving window UP to a chunk
@@ -166,7 +198,8 @@ class ContinuousBatchingEngine:
         self._warm = set()
         self._build_fns()
 
-        self._queue = AdmissionQueue(queue_capacity)
+        self._queue = AdmissionQueue(queue_capacity,
+                                     recorder=self._rec)
         self._slots: List[Optional[_SlotState]] = [None] * max_slots
         self._adm: Optional[_Admission] = None
         self._key = jax.random.PRNGKey(seed)
@@ -297,13 +330,13 @@ class ContinuousBatchingEngine:
                 return
         err = EngineStopped("engine stopped before the request finished")
         for h in self._queue.drain():
-            h._finish(err)
+            self._finish_handle(h, err, "stopped")
         if self._adm is not None:
-            self._adm.handle._finish(err)
+            self._finish_handle(self._adm.handle, err, "stopped")
             self._adm = None
         for sid, st in enumerate(self._slots):
             if st is not None:
-                st.handle._finish(err)
+                self._finish_handle(st.handle, err, "stopped")
                 self._slots[sid] = None
 
     def __enter__(self):
@@ -341,7 +374,18 @@ class ContinuousBatchingEngine:
                 f"engine's serving window {self.max_len}")
         self.start()
         h = RequestHandle(prompt, n, timeout_s)
-        self._queue.put(h, block=block, timeout=queue_timeout_s)
+        self._rec.record("request/submitted", h.request_id,
+                         service=self.service_name, prompt_tokens=t0,
+                         max_new_tokens=n)
+        try:
+            self._queue.put(h, block=block, timeout=queue_timeout_s)
+        except Exception as e:
+            # close the timeline — a backpressure rejection must not
+            # read as a request that vanished mid-flight
+            self._rec.record("request/rejected", h.request_id,
+                             service=self.service_name,
+                             error=type(e).__name__)
+            raise
         with self._wake:
             self._wake.notify_all()
         # submit can race stop() or a loop crash: if the loop died
@@ -358,10 +402,31 @@ class ContinuousBatchingEngine:
             if self._crashed is not None:
                 err.__cause__ = self._crashed
             for dropped in self._queue.drain():
-                dropped._finish(err)
-            h._finish(err)
+                self._finish_handle(dropped, err, "stopped")
+            self._finish_handle(h, err, "stopped")
             raise err
         return h
+
+    def _finish_handle(self, h: RequestHandle,
+                       err: Optional[BaseException],
+                       outcome: str) -> None:
+        """Terminal bookkeeping for ONE request — recorder event,
+        stream sentinel, finished-timeline ring entry. Every lifecycle
+        exit (finished / cancelled / timed_out / stopped / crashed)
+        funnels through here so the flight recorder and the stats()
+        percentiles can never disagree with the handles. ``_finish``
+        arbitrates racing finishers (a stopping submitter vs. the
+        crashing loop) — only the winner records."""
+        if not h._finish(err):
+            return
+        self._rec.record("request/" + outcome, h.request_id,
+                         service=self.service_name,
+                         tokens=len(h._tokens))
+        tl = h.timeline()
+        tl["request_id"] = h.request_id
+        tl["outcome"] = outcome
+        with self._timelines_lock:
+            self._timelines.append(tl)
 
     def _counter(self, key: str):
         return getattr(self._ins, key + "_total")
@@ -370,13 +435,90 @@ class ContinuousBatchingEngine:
         """Operational façade over the registry series (same pattern —
         and same shared-``service_name`` caveat — as the batch
         services' ``stats()``): flow counters are the delta since THIS
-        engine was constructed."""
+        engine was constructed. ``latency`` adds per-phase percentile
+        summaries (queue wait / prefill / TTFT / decode / total,
+        each ``{count, mean, p50, p90, p99}``) computed from the
+        engine's recent finished-request timelines."""
         out = {k: int(self._counter(k).get() - base)
                for k, base in self._stats_base.items()}
         out["active_slots"] = sum(s is not None for s in self._slots)
         out["queue_depth"] = len(self._queue)
         out["jit_compiles"] = self._compile_total()
+        out["latency"] = self._latency_summary()
         return out
+
+    def _latency_summary(self) -> dict:
+        from bigdl_tpu.observability.events import percentile_summary
+
+        with self._timelines_lock:
+            snap = list(self._timelines)
+        tls = [t for t in snap if t.get("outcome") == "finished"]
+        return {phase: percentile_summary(
+                    t[phase + "_s"] for t in tls)
+                for phase in ("queue_wait", "prefill", "ttft",
+                              "decode", "total")}
+
+    def healthz(self) -> dict:
+        """Liveness probe for ``MetricsHTTPServer(healthz=...)``: a
+        status dict while the engine is serviceable, raising
+        ``EngineStopped`` once the loop thread has crashed — the
+        endpoint then flips to 503 instead of reporting a dead decode
+        loop as healthy."""
+        if self._crashed is not None:
+            raise EngineStopped(
+                f"engine loop crashed: {self._crashed!r}"
+            ) from self._crashed
+        return {
+            "engine": self.service_name,
+            "loop_alive": bool(self._thread is not None
+                               and self._thread.is_alive()),
+            "active_slots": sum(s is not None for s in self._slots),
+            "queue_depth": len(self._queue),
+        }
+
+    def debug_requests(self) -> dict:
+        """The ``/debug/requests`` payload: every in-flight request's
+        id, phase, and progress, the recent finished timelines with
+        their queue-wait/prefill/TTFT/decode breakdown, and the
+        percentile summary over them. Snapshot semantics — safe to
+        call from an HTTP thread while the loop runs."""
+        now = time.monotonic()
+        in_flight = []
+        for h in self._queue.snapshot():
+            in_flight.append({
+                "request_id": h.request_id, "state": "queued",
+                "age_s": now - h.submitted_at,
+                "prompt_tokens": int(h.prompt.shape[0]),
+                "max_new_tokens": h.max_new_tokens,
+            })
+        adm = self._adm
+        if adm is not None:
+            h = adm.handle
+            in_flight.append({
+                "request_id": h.request_id, "state": "prefill",
+                "age_s": now - h.submitted_at,
+                "prompt_tokens": int(h.prompt.shape[0]),
+                "max_new_tokens": h.max_new_tokens,
+                "chunks_done": adm.next_chunk,
+                "chunks_total": adm.n_chunks,
+            })
+        for sid, st in enumerate(list(self._slots)):
+            if st is None:
+                continue
+            h = st.handle
+            in_flight.append({
+                "request_id": h.request_id, "state": "decoding",
+                "slot": sid, "age_s": now - h.submitted_at,
+                "prompt_tokens": int(h.prompt.shape[0]),
+                "max_new_tokens": h.max_new_tokens,
+                "tokens_delivered": st.delivered,
+            })
+        with self._timelines_lock:
+            recent = list(self._timelines)[-50:]
+        return {"service": self.service_name,
+                "in_flight": in_flight,
+                "recent": recent,
+                "latency": self._latency_summary()}
 
     # ------------------------------------------------------- loop body
     def _loop(self):
@@ -405,17 +547,57 @@ class ContinuousBatchingEngine:
 
     def _crash(self, e: BaseException) -> None:
         self._crashed = e
+        self._rec.record("engine/crash", service=self.service_name,
+                         error=repr(e))
+        # capture the in-flight picture BEFORE failing the handles —
+        # the postmortem must show what the engine was doing when it
+        # died, not the already-cleaned-up aftermath
+        try:
+            states = self.debug_requests()["in_flight"]
+        except Exception:
+            states = []
+        self._write_postmortem(e, states)
         err = EngineStopped(f"engine loop crashed: {e!r}")
         err.__cause__ = e
         if self._adm is not None:
-            self._adm.handle._finish(err)
+            self._finish_handle(self._adm.handle, err, "crashed")
             self._adm = None
         for sid, st in enumerate(self._slots):
             if st is not None:
-                st.handle._finish(err)
+                self._finish_handle(st.handle, err, "crashed")
                 self._slots[sid] = None
         for h in self._queue.drain():
-            h._finish(err)
+            self._finish_handle(h, err, "crashed")
+
+    def _write_postmortem(self, e: BaseException,
+                          states: List[dict]) -> None:
+        """Best-effort crash black box — the crash path must never
+        raise (donated buffers are already gone; all that is left is
+        to preserve the evidence)."""
+        import os
+
+        from bigdl_tpu.observability.postmortem import write_postmortem
+
+        path = (self.postmortem_path
+                or os.environ.get("BIGDL_POSTMORTEM_PATH")
+                or "bigdl_postmortem.json")
+        try:
+            write_postmortem(
+                path, error=e, requests=states, recorder=self._rec,
+                registry=self._registry,
+                context={"service": self.service_name,
+                         "max_slots": self.max_slots,
+                         "max_len": self.max_len,
+                         "queue_depth": len(self._queue),
+                         "stats": {k: int(self._counter(k).get() - b)
+                                   for k, b in
+                                   self._stats_base.items()}})
+            print(f"[bigdl_tpu.serving] engine {self.service_name!r} "
+                  f"crashed: {e!r}; postmortem -> {path}",
+                  file=sys.stderr)
+        except Exception as pe:
+            print(f"[bigdl_tpu.serving] postmortem write failed: "
+                  f"{pe!r} (crash: {e!r})", file=sys.stderr)
 
     def _iterate(self) -> bool:
         now = time.monotonic()
@@ -447,7 +629,7 @@ class ContinuousBatchingEngine:
                     "deadline passed during prefill"), "timed_out"
             if err is not None:
                 self._count_drop(kind)
-                h._finish(err)
+                self._finish_handle(h, err, kind)
                 self._adm = None
 
         # 2. queued requests: mid-queue deadline/cancel sweep
@@ -502,6 +684,10 @@ class ContinuousBatchingEngine:
         ids = np.zeros((1, n_chunks * c), np.int32)  # right-pad final chunk
         ids[0, :t0] = h.prompt
         self._adm = _Admission(h, slot, ids, t0, n_chunks)
+        h.admitted_at = time.monotonic()
+        self._rec.record("request/admitted", h.request_id,
+                         service=self.service_name, slot=slot,
+                         n_chunks=n_chunks)
         self._ins.admitted_total.inc()
 
     def _prefill_one_chunk(self) -> None:
@@ -520,6 +706,10 @@ class ContinuousBatchingEngine:
             jnp.int32(k * c), jnp.asarray([last], jnp.int32))
         self._warm.add("chunk")
         self._ins.prefill_tokens_total.inc(min(c, adm.t0 - k * c))
+        self._rec.record("request/prefill_chunk", adm.handle.request_id,
+                         service=self.service_name, chunk=k,
+                         n_chunks=adm.n_chunks,
+                         tokens=min(c, adm.t0 - k * c))
         adm.next_chunk += 1
         if not final:
             return
@@ -534,10 +724,13 @@ class ContinuousBatchingEngine:
         h = adm.handle
         h._deliver(tok, now)
         self._ins.ttft_seconds.observe(now - h.submitted_at)
+        self._rec.record("request/first_token", h.request_id,
+                         service=self.service_name, token=tok,
+                         ttft_s=now - h.submitted_at)
         self._adm = None
         if (self.eos_id is not None and tok == self.eos_id) \
                 or h.max_new_tokens == 1:
-            h._finish(None)
+            self._finish_handle(h, None, "finished")
             self._ins.finished_total.inc()
             return
         self._slots[adm.slot] = _SlotState(h, adm.t0, tok, now)
@@ -568,6 +761,9 @@ class ContinuousBatchingEngine:
             h = st.handle
             h._deliver(t, now)
             self._ins.decode_tokens_total.inc()
+            self._rec.record("request/decode_token", h.request_id,
+                             service=self.service_name, slot=sid,
+                             token=t, n=st.delivered)
             if (self.eos_id is not None and t == self.eos_id) \
                     or st.delivered >= h.max_new_tokens:
                 self._release(sid, None, "finished")
@@ -592,12 +788,13 @@ class ContinuousBatchingEngine:
             self._ins.finished_total.inc()
         else:
             self._count_drop(reason)
-        st.handle._finish(error)
+        self._finish_handle(st.handle, error, reason)
 
     def _finish_dropped(self, h: RequestHandle, err: Exception) -> None:
-        self._count_drop("cancelled" if isinstance(err, RequestCancelled)
-                         else "timed_out")
-        h._finish(err)
+        kind = ("cancelled" if isinstance(err, RequestCancelled)
+                else "timed_out")
+        self._count_drop(kind)
+        self._finish_handle(h, err, kind)
 
     def _count_drop(self, kind: str) -> None:
         (self._ins.cancelled_total if kind == "cancelled"

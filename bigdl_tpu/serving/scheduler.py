@@ -40,16 +40,29 @@ class AdmissionQueue:
     terminal bookkeeping (metrics, stream sentinels) stays in one
     place."""
 
-    def __init__(self, capacity: int = 64):
+    def __init__(self, capacity: int = 64, recorder=None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = capacity
         self._q: "deque[RequestHandle]" = deque()
         self._lock = threading.Condition()
+        # queue transitions land in the flight recorder (request/queued
+        # on put, request/queue_dropped for sweep/pop casualties) so a
+        # request's timeline starts before it ever reaches a slot
+        if recorder is None:
+            from bigdl_tpu.observability.events import default_recorder
+            recorder = default_recorder()
+        self._rec = recorder
 
     def __len__(self) -> int:
         with self._lock:
             return len(self._q)
+
+    def snapshot(self) -> List[RequestHandle]:
+        """The queued handles, FCFS order (a copy — ``/debug/requests``
+        reads it without racing the loop thread's pops)."""
+        with self._lock:
+            return list(self._q)
 
     def put(self, handle: RequestHandle, block: bool = True,
             timeout: Optional[float] = None) -> None:
@@ -75,6 +88,13 @@ class AdmissionQueue:
                         f"admission queue still full ({self.capacity} "
                         f"queued) after {timeout}s")
             self._q.append(handle)
+            # recorded while still holding the queue lock: pop_ready
+            # takes the same lock, so the loop thread cannot record
+            # request/admitted before request/queued exists (the
+            # recorder has its own independent lock — no ordering
+            # between the two is ever taken in reverse)
+            self._rec.record("request/queued", handle.request_id,
+                             depth=len(self._q))
             self._lock.notify_all()
 
     def pop_ready(self, now: Optional[float] = None
@@ -122,16 +142,20 @@ class AdmissionQueue:
             self._lock.notify_all()
             return out
 
-    @staticmethod
-    def _terminal(h: RequestHandle, now: float) -> Optional[Exception]:
+    def _terminal(self, h: RequestHandle, now: float
+                  ) -> Optional[Exception]:
+        err: Optional[Exception] = None
         if h.cancelled:
-            return RequestCancelled("cancelled while queued")
-        if h.deadline is not None and now > h.deadline:
+            err = RequestCancelled("cancelled while queued")
+        elif h.deadline is not None and now > h.deadline:
             waited = now - h.submitted_at
-            return RequestTimedOut(
+            err = RequestTimedOut(
                 f"deadline passed after {waited:.3f}s in the admission "
                 "queue (never admitted to a slot)")
-        return None
+        if err is not None:
+            self._rec.record("request/queue_dropped", h.request_id,
+                             reason=type(err).__name__)
+        return err
 
 
 class PrefillPolicy:

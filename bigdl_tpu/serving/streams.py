@@ -21,6 +21,8 @@ from typing import Iterator, Optional
 
 import numpy as np
 
+from bigdl_tpu.observability.events import next_request_id
+
 
 class RequestError(RuntimeError):
     """Base class for per-request terminal failures."""
@@ -57,22 +59,35 @@ class RequestHandle:
     every already-delivered token has been yielded — partial output is
     never silently dropped.
 
+    Every handle carries a process-unique ``request_id`` — the
+    correlation key the flight recorder, the ``/debug/*`` endpoints,
+    and the Chrome trace all share — and, once ``result()`` returns or
+    the token iterator ends, ``timeline()`` reports the final
+    per-phase breakdown (queue wait, prefill, TTFT, decode, total).
+
     Engine API (loop thread only): ``_deliver`` / ``_finish``.
     """
 
     def __init__(self, prompt, max_new_tokens: int,
-                 timeout_s: Optional[float] = None):
+                 timeout_s: Optional[float] = None,
+                 request_id: Optional[str] = None):
         self.prompt = np.asarray(prompt, np.int32)
         self.max_new_tokens = int(max_new_tokens)
+        #: the request's correlation id (flight recorder events, the
+        #: /debug endpoints, and Chrome traces all key on it)
+        self.request_id = request_id or next_request_id()
         self.submitted_at = time.monotonic()
         self.deadline = (self.submitted_at + timeout_s
                          if timeout_s is not None else None)
+        #: set by the engine when prefill starts (queue-wait boundary)
+        self.admitted_at: Optional[float] = None
         #: set by the engine when the first token lands (TTFT source)
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self._tokens: list = []
         self._stream: "queue.Queue" = queue.Queue()
         self._done = threading.Event()
+        self._finish_once = threading.Lock()
         self._cancelled = threading.Event()
         self._error: Optional[BaseException] = None
 
@@ -83,13 +98,19 @@ class RequestHandle:
         self._tokens.append(int(token))
         self._stream.put(int(token))
 
-    def _finish(self, error: Optional[BaseException] = None) -> None:
-        if self._done.is_set():
-            return
-        self._error = error
-        self.finished_at = time.monotonic()
-        self._done.set()
+    def _finish(self, error: Optional[BaseException] = None) -> bool:
+        """Mark terminal; returns True only for the ONE caller that
+        actually performed the transition (the loop thread and a
+        stopping submitter can race here — terminal bookkeeping keyed
+        on the return value must happen exactly once)."""
+        with self._finish_once:
+            if self._done.is_set():
+                return False
+            self._error = error
+            self.finished_at = time.monotonic()
+            self._done.set()
         self._stream.put(_DONE)
+        return True
 
     # ---------------------------------------------------- client side
     def cancel(self) -> None:
@@ -115,10 +136,37 @@ class RequestHandle:
         partial output after a timeout or cancellation)."""
         return np.asarray(list(self._tokens), np.int32)
 
+    def timeline(self) -> dict:
+        """The request's per-phase wall-time breakdown (monotonic
+        seconds; phases the request never reached are None):
+
+        - ``queue_wait_s`` — submitted → admitted (prefill started)
+        - ``prefill_s``    — admitted → first token
+        - ``ttft_s``       — submitted → first token
+        - ``decode_s``     — first token → finished
+        - ``total_s``      — submitted → finished
+        - ``tokens``       — tokens delivered
+
+        Final once the request is ``done()`` (the engine stamps each
+        boundary as the lifecycle advances), partial before that."""
+        def gap(a, b):
+            return (b - a) if (a is not None and b is not None) else None
+
+        return {
+            "queue_wait_s": gap(self.submitted_at, self.admitted_at),
+            "prefill_s": gap(self.admitted_at, self.first_token_at),
+            "ttft_s": gap(self.submitted_at, self.first_token_at),
+            "decode_s": gap(self.first_token_at, self.finished_at),
+            "total_s": gap(self.submitted_at, self.finished_at),
+            "tokens": len(self._tokens),
+        }
+
     def tokens(self) -> Iterator[int]:
         """Stream generated token ids in order as the engine produces
-        them; ends when the request finishes. A terminal failure raises
-        AFTER the delivered prefix has been yielded. Single consumer."""
+        them; ends when the request finishes — at which point
+        ``request_id`` / ``timeline()`` hold the final per-phase
+        breakdown. A terminal failure raises AFTER the delivered
+        prefix has been yielded. Single consumer."""
         while True:
             item = self._stream.get()
             if item is _DONE:
@@ -131,8 +179,10 @@ class RequestHandle:
         """Block until the request finishes; return the 1-D
         ``prompt + generated`` row (with ``eos_id`` configured on the
         engine, generation stops at — and includes — the first eos).
-        Raises the terminal error on timeout/cancellation/engine-stop,
-        or ``TimeoutError`` if ``timeout`` expires first."""
+        On return, ``request_id`` and ``timeline()`` surface the
+        request's identity and final phase breakdown. Raises the
+        terminal error on timeout/cancellation/engine-stop, or
+        ``TimeoutError`` if ``timeout`` expires first."""
         if not self._done.wait(timeout):
             raise TimeoutError(
                 f"request not finished after {timeout}s (still "
@@ -145,6 +195,7 @@ class RequestHandle:
     def __repr__(self):
         state = ("done" if self._done.is_set() else
                  "cancelled" if self.cancelled else "pending")
-        return (f"RequestHandle(prompt={self.prompt.shape[0]} toks, "
+        return (f"RequestHandle({self.request_id}, "
+                f"prompt={self.prompt.shape[0]} toks, "
                 f"n={self.max_new_tokens}, {state}, "
                 f"delivered={len(self._tokens)})")

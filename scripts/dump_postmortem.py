@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Pretty-print a bigdl_tpu crash postmortem.
+
+The continuous-batching engine writes a postmortem JSON when its loop
+thread crashes (``bigdl_tpu.observability.postmortem``); this renders
+it for a human: the error + traceback, the in-flight request states,
+the tail of the flight-recorder event log, the still-open span trees,
+and the non-zero serving metrics.
+
+Usage:
+    python scripts/dump_postmortem.py bigdl_postmortem.json
+    python scripts/dump_postmortem.py --events 50 --no-metrics pm.json
+
+Stdlib-only — runs anywhere the JSON file can be copied to, no jax or
+bigdl_tpu import required.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _hdr(title: str) -> str:
+    return f"\n=== {title} " + "=" * max(0, 60 - len(title))
+
+
+def _fmt_s(v) -> str:
+    return f"{v * 1e3:.1f}ms" if isinstance(v, (int, float)) else "-"
+
+
+def render(pm: dict, events: int = 30, show_metrics: bool = True) -> str:
+    out = []
+    out.append(f"postmortem {pm.get('schema', '?')} "
+               f"written {pm.get('written_at', '?')}")
+    ctx = pm.get("context") or {}
+    if ctx:
+        out.append("context: " + json.dumps(ctx))
+
+    err = pm.get("error")
+    out.append(_hdr("error"))
+    if err:
+        out.append(f"{err.get('type')}: {err.get('message')}")
+        if err.get("cause"):
+            out.append(f"cause: {err['cause']}")
+        tb = (err.get("traceback") or "").rstrip()
+        if tb:
+            out.append(tb)
+    else:
+        out.append("(none recorded)")
+
+    reqs = pm.get("requests") or []
+    out.append(_hdr(f"in-flight requests ({len(reqs)})"))
+    for r in reqs:
+        extra = {k: v for k, v in r.items()
+                 if k not in ("request_id", "state")}
+        out.append(f"  {r.get('request_id', '?'):<12} "
+                   f"{r.get('state', '?'):<9} {json.dumps(extra)}")
+    if not reqs:
+        out.append("  (none)")
+
+    evs = pm.get("events") or []
+    dropped = pm.get("events_dropped", 0)
+    out.append(_hdr(f"last events (showing {min(events, len(evs))} of "
+                    f"{len(evs)} retained, {dropped} older dropped)"))
+    for e in evs[-events:]:
+        rid = e.get("request_id", "")
+        attrs = {k: v for k, v in e.items()
+                 if k not in ("seq", "ts_s", "wall_s", "thread", "kind",
+                              "request_id")}
+        out.append(f"  #{e.get('seq', '?'):<6} {e.get('ts_s', 0):.6f} "
+                   f"[{e.get('thread', '?')}] "
+                   f"{e.get('kind', '?'):<24} {rid:<12} "
+                   f"{json.dumps(attrs) if attrs else ''}")
+
+    spans = pm.get("open_spans") or []
+    out.append(_hdr(f"open spans ({len(spans)} threads)"))
+    for s in spans:
+        out.append(f"  [{s.get('thread', '?')}]")
+        for line in (s.get("tree") or "").splitlines():
+            out.append("    " + line)
+    if not spans:
+        out.append("  (none)")
+
+    if show_metrics:
+        out.append(_hdr("metrics (non-zero)"))
+        shown = 0
+        for m in pm.get("metrics") or []:
+            for s in m.get("series", []):
+                val = s.get("value", s.get("count"))
+                if not val:
+                    continue
+                lbl = ",".join(f"{k}={v}"
+                               for k, v in (s.get("labels") or {}).items())
+                lbl = "{" + lbl + "}" if lbl else ""
+                if "sum" in s:
+                    out.append(f"  {m['name']}{lbl} count={s['count']} "
+                               f"sum={s['sum']:.6g}")
+                else:
+                    out.append(f"  {m['name']}{lbl} {val}")
+                shown += 1
+        if not shown:
+            out.append("  (none)")
+    return "\n".join(out) + "\n"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Pretty-print a bigdl_tpu crash postmortem JSON")
+    p.add_argument("path", help="postmortem file "
+                                "(e.g. bigdl_postmortem.json)")
+    p.add_argument("--events", type=int, default=30,
+                   help="how many trailing events to show (default 30)")
+    p.add_argument("--no-metrics", action="store_true",
+                   help="skip the metrics snapshot section")
+    args = p.parse_args(argv)
+    try:
+        with open(args.path) as f:
+            pm = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"cannot read postmortem {args.path!r}: {e}",
+              file=sys.stderr)
+        return 1
+    sys.stdout.write(render(pm, events=args.events,
+                            show_metrics=not args.no_metrics))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
