@@ -214,6 +214,14 @@ def bench_main(argv=None):
     p.add_argument("--serving", action="store_true",
                    help="Poisson-arrival serving benchmark: continuous-"
                         "batching engine vs GenerationService")
+    p.add_argument("--shared-prefix", action="store_true",
+                   help="with --serving: prefix-heavy workload (Poisson "
+                        "arrivals over N shared prompt templates), "
+                        "engine prefix-cache ON vs OFF — emits TTFT "
+                        "speedup + hit rate into bench_history.jsonl")
+    p.add_argument("--templates", type=int, default=4,
+                   help="--shared-prefix: number of shared prompt "
+                        "templates")
     p.add_argument("--trace", action="store_true",
                    help="also dump bench_trace.json — the run's span "
                         "trees + flight-recorder events as Chrome "
@@ -380,9 +388,20 @@ def _serving_bench(args, dev):
     paths) into bench_history.jsonl + the Prometheus snapshot so the
     serving perf trajectory is tracked alongside the training headline.
     vs_baseline is the p99-latency speedup over GenerationService
-    (> 1.0: the engine's tail is shorter)."""
+    (> 1.0: the engine's tail is shorter).
+
+    `--serving --shared-prefix`: the prefix-heavy variant — Poisson
+    arrivals over N shared prompt templates, replayed through the
+    engine with its prefix cache ON vs OFF. vs_baseline is the p50
+    TTFT speedup of the cached path (>1.0: the cache pays for itself;
+    the acceptance bar is >=2x), and detail carries the hit rate,
+    reused-token fraction, and greedy token-parity flag.
+    `scripts/perf_gate.py` gates CI on the p99 TTFT of consecutive
+    comparable rows."""
     from bigdl_tpu.models.transformer import TransformerLM
-    from bigdl_tpu.serving.benchmark import run_poisson_comparison
+    from bigdl_tpu.serving.benchmark import (
+        run_poisson_comparison, run_shared_prefix_comparison,
+    )
     from bigdl_tpu.utils import random as rnd
     from bigdl_tpu.version import __version__
 
@@ -391,25 +410,88 @@ def _serving_bench(args, dev):
     model = TransformerLM(128, embed_dim=64, num_heads=4, num_kv_heads=2,
                           num_layers=2, max_len=128, use_rope=True)
     model.evaluate()
-    res = run_poisson_comparison(model, n_requests=args.requests,
-                                 rate_hz=args.rate, max_slots=4,
-                                 prefill_chunk=8, log=log)
-    result = {
-        "metric": "serving_poisson_tokens_per_sec",
-        "value": res["engine"]["tokens_per_sec"],
-        "unit": "tokens/sec",
-        "vs_baseline": res["p99_speedup"],
-        "detail": {
-            "version": __version__,
-            "device": str(getattr(dev, "device_kind", dev.platform)),
-            **res,
-        },
-    }
-    _record_serving_metrics(res)
+    if args.shared_prefix:
+        res = run_shared_prefix_comparison(
+            model, n_requests=args.requests, rate_hz=args.rate,
+            max_slots=4, prefill_chunk=8, prefill_rows=2,
+            n_templates=args.templates, template_len=96, log=log)
+        result = {
+            "metric": "serving_shared_prefix_tokens_per_sec",
+            "value": res["cached"]["tokens_per_sec"],
+            "unit": "tokens/sec",
+            "vs_baseline": res["ttft_p50_speedup"],
+            "detail": {
+                "version": __version__,
+                "device": str(getattr(dev, "device_kind", dev.platform)),
+                **res,
+            },
+        }
+        _record_shared_prefix_metrics(res)
+    else:
+        res = run_poisson_comparison(model, n_requests=args.requests,
+                                     rate_hz=args.rate, max_slots=4,
+                                     prefill_chunk=8, log=log)
+        result = {
+            "metric": "serving_poisson_tokens_per_sec",
+            "value": res["engine"]["tokens_per_sec"],
+            "unit": "tokens/sec",
+            "vs_baseline": res["p99_speedup"],
+            "detail": {
+                "version": __version__,
+                "device": str(getattr(dev, "device_kind", dev.platform)),
+                **res,
+            },
+        }
+        _record_serving_metrics(res)
     _dump_prometheus_snapshot()
     if args.trace:
         _dump_chrome_trace()
     print(json.dumps(result))
+
+
+def _record_shared_prefix_metrics(res):
+    """Mirror the shared-prefix comparison into the observability
+    registry (``path`` label: cached / uncached) so live scrapes and
+    bench snapshots share one schema. Never lets telemetry break the
+    bench."""
+    try:
+        from bigdl_tpu import observability as obs
+
+        reg = obs.default_registry()
+        lbl = ("path",)
+        tps = reg.gauge("bigdl_bench_serving_tokens_per_sec",
+                        "Serving bench aggregate delivered tokens/sec",
+                        labelnames=lbl)
+        p50 = reg.gauge("bigdl_bench_serving_ttft_p50_seconds",
+                        "Serving bench time-to-first-token p50",
+                        labelnames=lbl)
+        p99 = reg.gauge("bigdl_bench_serving_ttft_p99_seconds_by_path",
+                        "Serving bench time-to-first-token p99",
+                        labelnames=lbl)
+        for path in ("cached", "uncached"):
+            r = res[path]
+            tps.labels(path).set(r["tokens_per_sec"])
+            if r["ttft"]["p50"] is not None:
+                p50.labels(path).set(r["ttft"]["p50"])
+                p99.labels(path).set(r["ttft"]["p99"])
+        if res.get("ttft_p50_speedup") is not None:
+            reg.gauge("bigdl_bench_serving_prefix_ttft_p50_speedup",
+                      "Cached-vs-uncached engine TTFT p50 speedup on "
+                      "the shared-prefix workload (>1.0: the prefix "
+                      "cache pays for itself)"
+                      ).set(res["ttft_p50_speedup"])
+        pc = res["cached"].get("prefix_cache", {})
+        if pc.get("enabled"):
+            reg.gauge("bigdl_bench_serving_prefix_hit_rate",
+                      "Prefix-cache hit rate over the shared-prefix "
+                      "bench workload").set(pc["hit_rate"])
+            reg.gauge("bigdl_bench_serving_prefix_reused_fraction",
+                      "Fraction of prompt tokens served from the "
+                      "prefix cache instead of prefilled"
+                      ).set(pc["reused_fraction"])
+    except Exception as e:
+        print(f"[bench] shared-prefix metrics registry update failed: "
+              f"{e}", file=sys.stderr)
 
 
 def _record_serving_metrics(res):

@@ -289,8 +289,12 @@ class TransformerLM(Module):
         chunk's final position — the continuous-batching engine's
         admission path (bigdl_tpu/serving/engine.py), whose final chunk
         is RIGHT-padded so the true last prompt token sits mid-chunk.
-        The gather happens before the head: O(B), not O(B*T), vocab
-        projections. Same caller contract as ``prefill_chunk``."""
+        ``pos0`` may be a (B,) vector of per-row offsets (the RAGGED
+        batched-prefill path: each row is an independent chunked
+        prefill at its own depth — see
+        MultiHeadAttention.forward_chunk). The gather happens before
+        the head: O(B), not O(B*T), vocab projections. Same caller
+        contract as ``prefill_chunk``."""
         return self._prefill_impl(ids, caches, pos0, chunked=True,
                                   gather_last=last_idx)
 
@@ -310,9 +314,16 @@ class TransformerLM(Module):
         b, t = ids.shape
         x = jnp.take(self.tok_embed, ids, axis=0)
         if not self.use_rope:
-            pe = (jax.lax.dynamic_slice_in_dim(self.pos_embed, pos0, t, 0)
-                  if chunked else self.pos_embed[pos0:pos0 + t])
-            x = x + pe[None]
+            if chunked and jnp.ndim(pos0) == 1:
+                # ragged chunk: per-row positional rows, (B, T, C)
+                x = x + jnp.take(self.pos_embed,
+                                 pos0[:, None] + jnp.arange(t)[None],
+                                 axis=0)
+            else:
+                pe = (jax.lax.dynamic_slice_in_dim(
+                          self.pos_embed, pos0, t, 0)
+                      if chunked else self.pos_embed[pos0:pos0 + t])
+                x = x + pe[None]
         new_caches = []
         for i in range(self.num_layers):
             blk = getattr(self, f"block{i}")

@@ -89,13 +89,16 @@ def rotary_embedding(x, positions, base: float = 10000.0):
 
 
 def rotary_embedding_rowwise(x, positions, base: float = 10000.0):
-    """RoPE for one decode step at PER-ROW positions: x (B, H, 1, D),
-    ``positions`` (B,) — each batch row rotated by its own absolute
-    position (the ragged-batch decode path, where rows sit at different
-    sequence depths). One formula: vmap of :func:`rotary_embedding` over
-    the batch, so the rotation math can never diverge between paths."""
+    """RoPE at PER-ROW positions: each batch row of x (B, H, T, D)
+    rotated by its own absolute positions — ``positions`` is (B,) for
+    a one-token decode step or (B, T) for a ragged chunk (rows at
+    different sequence depths, the mixed-depth serving paths). One
+    formula: vmap of :func:`rotary_embedding` over the batch, so the
+    rotation math can never diverge between paths."""
+    if jnp.ndim(positions) == 1:
+        positions = positions[:, None]
     return jax.vmap(
-        lambda xi, pi: rotary_embedding(xi, pi[None], base))(x, positions)
+        lambda xi, pi: rotary_embedding(xi, pi, base))(x, positions)
 
 
 class MultiHeadAttention(Module):
@@ -285,24 +288,45 @@ class MultiHeadAttention(Module):
         chunked-prefill form; GQA runs grouped against the un-expanded
         cache like forward_step.
 
-        CALLER CONTRACT: ``pos0 + T_chunk <= cache length`` must hold —
-        pos0 is traced, so it cannot be checked at trace time the way
-        forward_prefill checks its static offset, and an overflowing
-        write would be silently CLAMPED by dynamic_update_slice
-        (corrupting the prefix) while the mask still assumes positions
-        pos0..pos0+T. generate()'s _decode_setup validates this;
-        standalone users (e.g. the exported serving program) must too."""
+        RAGGED batches: ``pos0`` may be a (B,) vector of per-row offsets
+        (each row's chunk lands at its OWN depth — the multi-admission
+        batched-prefill serving path): each row writes its KV at,
+        rotates by, and masks against its own ``pos0 + i`` positions,
+        so one dispatch advances several independent prefills at once.
+
+        CALLER CONTRACT: ``pos0 + T_chunk <= cache length`` must hold
+        (per row, when ragged) — pos0 is traced, so it cannot be checked
+        at trace time the way forward_prefill checks its static offset,
+        and an overflowing write would be silently CLAMPED by
+        dynamic_update_slice (corrupting the prefix) while the mask
+        still assumes positions pos0..pos0+T. generate()'s _decode_setup
+        validates this; standalone users (e.g. the exported serving
+        program) must too."""
+        ragged = jnp.ndim(pos0) == 1
         b, t, _ = x.shape
         qkv = self.qkv(x.reshape(b * t, self.embed_dim)).reshape(b, t, -1)
         q, k, v = self._split_kv_step(qkv)
         if self.rotary:
-            positions = pos0 + jnp.arange(t)
-            q, k = self._rope(q, positions), self._rope(k, positions)
+            if ragged:
+                positions = pos0[:, None] + jnp.arange(t)[None]  # (B, T)
+                q = rotary_embedding_rowwise(q, positions,
+                                             self.rotary_base)
+                k = rotary_embedding_rowwise(k, positions,
+                                             self.rotary_base)
+            else:
+                positions = pos0 + jnp.arange(t)
+                q, k = self._rope(q, positions), self._rope(k, positions)
         k_cache, v_cache = cache
-        k_cache = jax.lax.dynamic_update_slice(
-            k_cache, k.astype(k_cache.dtype), (0, 0, pos0, 0))
-        v_cache = jax.lax.dynamic_update_slice(
-            v_cache, v.astype(v_cache.dtype), (0, 0, pos0, 0))
+        if ragged:
+            write = jax.vmap(lambda c, blk, p: jax.lax.dynamic_update_slice(
+                c, blk, (0, p, 0)))
+            k_cache = write(k_cache, k.astype(k_cache.dtype), pos0)
+            v_cache = write(v_cache, v.astype(v_cache.dtype), pos0)
+        else:
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k.astype(k_cache.dtype), (0, 0, pos0, 0))
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v.astype(v_cache.dtype), (0, 0, pos0, 0))
         h_kv = self.num_kv_heads
         rep = self.num_heads // h_kv
         qg = q.reshape(b, h_kv, rep, t, self.head_dim)
@@ -310,8 +334,13 @@ class MultiHeadAttention(Module):
         s = jnp.einsum("bgrtd,bgTd->bgrtT", qg, k_cache,
                        preferred_element_type=jnp.float32) * scale
         ln = k_cache.shape[2]
-        live = jnp.arange(ln)[None, :] <= (pos0 + jnp.arange(t))[:, None]
-        s = jnp.where(live[None, None, None], s, -jnp.inf)
+        if ragged:
+            live = (jnp.arange(ln)[None, None, :]
+                    <= (pos0[:, None] + jnp.arange(t)[None])[:, :, None])
+            s = jnp.where(live[:, None, None], s, -jnp.inf)
+        else:
+            live = jnp.arange(ln)[None, :] <= (pos0 + jnp.arange(t))[:, None]
+            s = jnp.where(live[None, None, None], s, -jnp.inf)
         p = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
         o = jnp.einsum("bgrtT,bgTd->bgrtd", p, v_cache)
         o = o.transpose(0, 3, 1, 2, 4).reshape(b, t, self.embed_dim)

@@ -215,9 +215,38 @@ def serving_engine_instruments(service: str = "engine",
         jit_compiles=r.gauge(
             "bigdl_serving_jit_compiles",
             "Compiled executables across the engine's jitted programs "
-            "(decode step, prefill chunk, slot insert, first-token "
-            "sample) — flat after warmup: compiled shapes depend only "
-            "on max_slots, never on load", labelnames=lbl
+            "(decode step, ragged prefill chunk, slot insert, first-"
+            "token sample, prefix stage/donate copies) — flat after "
+            "warmup: compiled shapes depend only on max_slots/"
+            "prefill_rows/pool rows, never on load", labelnames=lbl
+        ).labels(service),
+        prefix_hits_total=r.counter(
+            "bigdl_serving_prefix_hits_total",
+            "Admissions whose prompt head was served from the prefix "
+            "cache (prefill skipped for the matched, chunk-aligned "
+            "head)", labelnames=lbl).labels(service),
+        prefix_misses_total=r.counter(
+            "bigdl_serving_prefix_misses_total",
+            "Admissions with no usable cached prefix (full prompt "
+            "prefilled)", labelnames=lbl).labels(service),
+        prefix_reused_tokens_total=r.counter(
+            "bigdl_serving_prefix_reused_tokens_total",
+            "Prompt tokens served from the prefix cache instead of "
+            "being prefilled (the work the cache eliminated; compare "
+            "against bigdl_serving_prefill_tokens_total)",
+            labelnames=lbl).labels(service),
+        prefix_evicted_total=r.counter(
+            "bigdl_serving_prefix_evicted_total",
+            "Prefix-cache entries evicted (LRU among unpinned) to make "
+            "room under the byte budget", labelnames=lbl).labels(service),
+        prefix_cache_bytes=r.gauge(
+            "bigdl_serving_prefix_cache_bytes",
+            "Device bytes of KV currently retained by the prefix "
+            "cache (occupied pool rows x per-row footprint)",
+            labelnames=lbl).labels(service),
+        prefix_cache_entries=r.gauge(
+            "bigdl_serving_prefix_cache_entries",
+            "Prefix-cache entries currently retained", labelnames=lbl
         ).labels(service),
     )
 

@@ -7,10 +7,19 @@ dispatch with token-granular continuous batching —
 
 - ``ContinuousBatchingEngine`` (``engine``): the loop thread, the
   pooled ``(max_slots, ...)`` KV cache, mid-flight chunked-prefill
-  admission, and per-token slot eviction/reuse. Compiled shapes depend
-  only on ``max_slots`` — never on load.
+  admission (batched ``prefill_rows`` wide through one ragged dispatch
+  per round), and per-token slot eviction/reuse. Compiled shapes
+  depend only on ``max_slots``/``prefill_rows``/pool rows — never on
+  load.
+- ``PrefixCache`` (``prefix_cache``): the host-side radix-trie index
+  over token-id prefixes mapping to retained KV pool rows — a new
+  request whose prompt shares a cached prefix skips prefill for the
+  shared head (O(novel-suffix) TTFT); finished slots donate their KV
+  back under an LRU/ref-count policy within a configurable byte
+  budget.
 - ``AdmissionQueue`` / ``PrefillPolicy`` (``scheduler``): bounded FCFS
-  admission with backpressure, deadline/cancellation sweeps, and the
+  admission with backpressure, deadline/cancellation sweeps,
+  prefix-aware pop ordering (bounded bypass window), and the
   prefill-vs-decode token budget.
 - ``RequestHandle`` (``streams``): per-request streaming token
   iterator + blocking ``result()``; greedy output is token-identical
@@ -41,6 +50,7 @@ loop — see ``bigdl_tpu.observability``).
 """
 
 from bigdl_tpu.serving.engine import ContinuousBatchingEngine
+from bigdl_tpu.serving.prefix_cache import PrefixCache, PrefixEntry
 from bigdl_tpu.serving.scheduler import AdmissionQueue, PrefillPolicy
 from bigdl_tpu.serving.streams import (
     EngineStopped, QueueFull, RequestCancelled, RequestError,
@@ -48,12 +58,15 @@ from bigdl_tpu.serving.streams import (
 )
 from bigdl_tpu.serving.benchmark import (
     poisson_workload, run_poisson_comparison,
+    run_shared_prefix_comparison, shared_prefix_workload,
 )
 
 __all__ = [
     "ContinuousBatchingEngine",
+    "PrefixCache", "PrefixEntry",
     "AdmissionQueue", "PrefillPolicy",
     "RequestHandle", "RequestError", "RequestCancelled",
     "RequestTimedOut", "QueueFull", "EngineStopped",
     "poisson_workload", "run_poisson_comparison",
+    "shared_prefix_workload", "run_shared_prefix_comparison",
 ]

@@ -7,6 +7,14 @@ p50/p99 (engine only — the batch service has no streaming), and
 aggregate delivered tokens/sec. ``bench.py --serving`` emits the result
 into ``bench_history.jsonl`` and the Prometheus snapshot so the serving
 perf trajectory is tracked alongside the training headline.
+
+``--serving --shared-prefix`` runs the PREFIX-HEAVY variant instead
+(:func:`run_shared_prefix_comparison`): Poisson arrivals over N shared
+prompt templates, replayed through the engine with its prefix cache
+enabled vs disabled — the O(prompt) → O(novel-suffix) TTFT claim,
+measured, with greedy token parity asserted between the two paths.
+``scripts/perf_gate.py`` turns consecutive rows of either variant into
+a CI regression gate.
 """
 
 from __future__ import annotations
@@ -85,6 +93,131 @@ def _replay(workload, submit_fn, collect_fn) -> dict:
     return {"latency": _percentiles(lat),
             "tokens_per_sec": round(sum(toks) / max(wall, 1e-9), 2),
             "wall_s": round(wall, 3), "requests": len(workload)}
+
+
+def shared_prefix_workload(n_requests: int, rate_hz: float, vocab: int,
+                           n_templates: int = 4, template_len: int = 96,
+                           tail_lens=(4, 12), decode_lens=(4, 16),
+                           seed: int = 0) -> List[dict]:
+    """Sample a PREFIX-HEAVY open-loop workload: every prompt is one of
+    ``n_templates`` shared heads (a system prompt / few-shot template)
+    followed by a short random tail — the traffic shape the engine's
+    prefix cache exists for. Same arrival/replay semantics as
+    :func:`poisson_workload`."""
+    r = np.random.RandomState(seed)
+    templates = [r.randint(0, vocab, (template_len,)).astype(np.int32)
+                 for _ in range(n_templates)]
+    at = np.cumsum(r.exponential(1.0 / rate_hz, n_requests))
+    out = []
+    for i in range(n_requests):
+        tpl = templates[int(r.randint(0, n_templates))]
+        tail = r.randint(0, vocab, (int(r.randint(
+            tail_lens[0], tail_lens[1] + 1)),)).astype(np.int32)
+        out.append({
+            "arrival_s": float(at[i]),
+            "prompt": np.concatenate([tpl, tail]),
+            "n": int(r.randint(decode_lens[0], decode_lens[1] + 1)),
+        })
+    return out
+
+
+def run_shared_prefix_comparison(model, n_requests: int = 24,
+                                 rate_hz: float = 30.0,
+                                 max_slots: int = 4,
+                                 prefill_chunk: int = 8,
+                                 prefill_rows: int = 2,
+                                 n_templates: int = 4,
+                                 template_len: int = 96,
+                                 eos_id: Optional[int] = None,
+                                 seed: int = 0, registry=None,
+                                 log=None) -> dict:
+    """Replay ONE shared-prefix Poisson workload through the engine
+    twice — prefix cache ENABLED vs DISABLED, everything else identical
+    — and report TTFT/latency percentiles for both, the cached run's
+    hit-rate block, the p50/p99 TTFT speedups, and whether the two
+    paths produced token-identical greedy outputs (they must). This is
+    the O(prompt) → O(novel-suffix) TTFT claim, measured."""
+    from bigdl_tpu.serving import ContinuousBatchingEngine
+
+    log = log or (lambda *a, **k: None)
+    vocab = model.vocab_size
+    # fit tail + decode inside the ENGINE's serving window: a sampled
+    # prompt of template + tail_hi plus decode_hi tokens must never
+    # overflow it (engine.submit would reject it mid-replay). The
+    # window is the model context rounded DOWN to a chunk multiple
+    # when it doesn't divide evenly — mirror engine.__init__'s cap.
+    window = (model.max_len // prefill_chunk) * prefill_chunk
+    room = window - template_len
+    if room < 2:
+        raise ValueError(
+            f"template_len {template_len} leaves only {room} of the "
+            f"engine's {window}-token serving window for tail + decode")
+    tail_hi = max(1, min(12, room // 2))
+    decode_hi = max(1, min(16, room - tail_hi))
+    wl = shared_prefix_workload(
+        n_requests, rate_hz, vocab, n_templates=n_templates,
+        template_len=template_len,
+        tail_lens=(min(4, tail_hi), tail_hi),
+        decode_lens=(min(4, decode_hi), decode_hi),
+        seed=seed)
+    warm_prompt = np.asarray(
+        np.random.RandomState(seed + 1).randint(
+            0, vocab, (template_len,)), np.int32)
+
+    def run_path(name: str, **engine_kw) -> dict:
+        engine = ContinuousBatchingEngine(
+            model, max_slots=max_slots, prefill_chunk=prefill_chunk,
+            prefill_rows=prefill_rows, eos_id=eos_id,
+            registry=registry, service_name=name, **engine_kw)
+        ttft: List[float] = []
+        rows: dict = {}
+        tlock = threading.Lock()
+
+        def collect(handle, req):
+            row = handle.result()
+            with tlock:
+                rows[id(req)] = row
+                if handle.first_token_at is not None:
+                    ttft.append(handle.first_token_at
+                                - handle.submitted_at)
+            return row.shape[0] - req["prompt"].shape[0]
+
+        log(f"[serving-bench] shared-prefix replay ({name})...")
+        with engine:
+            # warm the executables with a NON-template prompt so the
+            # compile cost lands outside the measurement and the
+            # template cache starts cold for both paths
+            engine.submit(warm_prompt, 2).result(timeout=300)
+            res = _replay(
+                wl, lambda req: engine.submit(req["prompt"], req["n"]),
+                collect)
+        res["ttft"] = _percentiles(ttft)
+        res["prefix_cache"] = engine.stats()["prefix_cache"]
+        res["rows"] = rows
+        return res
+
+    cached = run_path("bench_prefix_on")
+    uncached = run_path("bench_prefix_off", prefix_cache_bytes=0)
+    parity = all(
+        np.array_equal(cached["rows"][id(req)], uncached["rows"][id(req)])
+        for req in wl)
+    for r in (cached, uncached):
+        del r["rows"]
+
+    def ratio(key):
+        a, b = uncached["ttft"][key], cached["ttft"][key]
+        return round(a / b, 4) if a and b else None
+
+    return {"cached": cached, "uncached": uncached,
+            "ttft_p50_speedup": ratio("p50"),
+            "ttft_p99_speedup": ratio("p99"),
+            "token_parity": bool(parity),
+            "workload": {"kind": "shared_prefix",
+                         "requests": n_requests, "rate_hz": rate_hz,
+                         "seed": seed, "max_slots": max_slots,
+                         "prefill_rows": prefill_rows,
+                         "n_templates": n_templates,
+                         "template_len": template_len}}
 
 
 def run_poisson_comparison(model, n_requests: int = 16,

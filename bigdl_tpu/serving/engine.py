@@ -9,27 +9,40 @@ analog of the RDMA paper's persistent dataflow, arxiv 1805.08430):
 
 - ONE pooled KV cache of shape ``(max_slots, H_kv, cache_len, D)`` per
   layer lives on device for the engine's whole life. Every compiled
-  program's shape depends only on ``max_slots``/``cache_len`` — never
-  on load — so steady state runs exactly FOUR executables (decode
-  step, prefill chunk, slot insert, first-token sample) no matter what
-  traffic does.
+  program's shape depends only on ``max_slots`` / ``cache_len`` /
+  ``prefill_rows`` / the prefix-pool row count — never on load — so
+  steady state runs a FIXED executable set (decode step, ragged
+  prefill chunk, row copy, first-token sample) no matter what traffic
+  does.
 - a dedicated loop thread runs one fused ``decode_step`` over ALL
   slots per iteration (rows at their own depths — the ragged per-row
   position vector path), so requests join and leave the batch at token
   granularity.
-- admission happens MID-FLIGHT: a queued request prefills in fixed
-  chunks into a one-row staging cache under a per-iteration token
-  budget (``PrefillPolicy``), then its staged rows are scattered into a
-  free slot in one donated ``dynamic_update_slice``. Decode never waits
+- admission happens MID-FLIGHT: queued requests prefill in fixed
+  chunks into a ``prefill_rows``-wide staging cache under a
+  per-iteration token budget (``PrefillPolicy``) — each prefill round
+  advances EVERY staged admission by one chunk through one ragged
+  dispatch (each row at its own offset), then finished stagings are
+  scattered into free slots by a donated row copy. Decode never waits
   for more than one iteration's prefill budget.
+- prompts are PREFIX-CACHED: a host-side radix trie
+  (``prefix_cache.PrefixCache``) indexes retained KV pool rows by
+  token-id prefix. An admission whose prompt shares a cached prefix
+  copies the pool row into its staging row (one program) and
+  chunk-prefills only the novel tail — O(novel-suffix) TTFT instead
+  of O(prompt). Finished slots donate their KV back to the pool under
+  an LRU/ref-count policy with a configurable byte budget.
 - rows finish at their OWN eos/token budget and their slot frees
   immediately for the next queued request (eviction ≡ slot reuse; the
   stale KV is overwritten before it can ever be attended — decode
   writes position p before masking attention to ``<= p``).
 
 Greedy output is token-identical to a lone ``model.generate`` call per
-request (tested): same prefill math, same per-row ragged decode step,
-same argmax tie-breaking.
+request — with the prefix cache COLD or WARM (tested): cached KV rows
+are bitwise the values prefill would recompute (the reuse offset is
+chunk-aligned, so chunk geometry matches; KV at position i depends
+only on tokens 0..i), same per-row ragged decode step, same argmax
+tie-breaking.
 """
 
 from __future__ import annotations
@@ -44,6 +57,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bigdl_tpu.serving.prefix_cache import PrefixCache
 from bigdl_tpu.serving.scheduler import AdmissionQueue, PrefillPolicy
 from bigdl_tpu.serving.streams import (
     EngineStopped, RequestCancelled, RequestHandle, RequestTimedOut,
@@ -51,20 +65,27 @@ from bigdl_tpu.serving.streams import (
 
 
 class _Admission:
-    """Host-side progress of one chunked prefill (one at a time — FCFS
-    admission means a second prompt never overtakes the first's
-    prefill)."""
+    """Host-side progress of one chunked prefill. Up to
+    ``prefill_rows`` of these are in flight at once, each owning one
+    staging-cache row and one reserved slot; every prefill round
+    advances all of them together through one ragged dispatch."""
 
-    __slots__ = ("handle", "slot", "ids", "t0", "n_chunks", "next_chunk")
+    __slots__ = ("handle", "slot", "row", "ids", "t0", "base", "tail",
+                 "n_chunks", "next_chunk", "entry")
 
-    def __init__(self, handle: RequestHandle, slot: int, ids: np.ndarray,
-                 t0: int, n_chunks: int):
+    def __init__(self, handle: RequestHandle, slot: int, row: int,
+                 ids: np.ndarray, t0: int, base: int, n_chunks: int,
+                 entry=None):
         self.handle = handle
-        self.slot = slot
-        self.ids = ids            # (1, n_chunks * chunk) right-padded
-        self.t0 = t0
+        self.slot = slot          # reserved pool slot (insert target)
+        self.row = row            # staging-cache row this prefill owns
+        self.ids = ids            # (n_chunks * chunk,) right-padded TAIL
+        self.t0 = t0              # full prompt length
+        self.base = base          # chunk-aligned cached-prefix offset
+        self.tail = t0 - base     # tokens actually prefilled
         self.n_chunks = n_chunks
         self.next_chunk = 0
+        self.entry = entry        # pinned PrefixEntry on a hit, else None
 
 
 class _SlotState:
@@ -97,7 +118,7 @@ def _compile_count(fn):
 class ContinuousBatchingEngine:
     """Token-granular continuous batching over ``TransformerLM``'s
     incremental-decoding API (``init_cache`` / ``prefill_chunk`` /
-    ``decode_step``).
+    ``decode_step``), with prefix-cached, batched multi-row prefill.
 
     ``submit()`` returns a ``RequestHandle`` immediately (bounded FCFS
     queue — ``QueueFull`` is the backpressure signal); the loop thread
@@ -106,21 +127,39 @@ class ContinuousBatchingEngine:
     ``GenerationService``; the default is greedy, whose output is
     token-identical to per-request ``model.generate``.
 
+    PREFIX CACHE: on by default. ``prefix_cache_bytes`` sets the byte
+    budget for the device-resident KV pool the cache retains (None =
+    auto, two pool rows per slot; 0 disables the cache entirely —
+    admission then always prefills the full prompt).
+    ``prefix_cache_rows`` overrides the row count directly;
+    ``prefix_min_tokens`` (default: one prefill chunk) is the floor
+    under which a shared head is not worth a copy dispatch. Reuse is
+    chunk-aligned, so matched lengths round down to a multiple of
+    ``prefill_chunk``. ``admission_window > 1`` additionally lets the
+    scheduler pop the queued request with the LONGEST cached prefix
+    from the first ``admission_window`` candidates (FCFS on ties, with
+    a hard starvation bound — see ``AdmissionQueue.pop_ready``).
+
+    BATCHED PREFILL: ``prefill_rows`` widens the staging cache so that
+    many queued admissions chunk-prefill TOGETHER through one ragged
+    dispatch per round instead of one admission at a time.
+
     When to prefer this over ``GenerationService``: mixed or long
     decode lengths under concurrent load (no head-of-line blocking on
-    batch completion, slots recycle per token) and streaming clients
-    (tokens surface per iteration, not per finished batch). Prefer
-    ``GenerationService`` for homogeneous offline batches, where one
-    fused scan dispatch per batch beats a host round-trip per token.
+    batch completion, slots recycle per token), streaming clients
+    (tokens surface per iteration, not per finished batch), and
+    prefix-heavy traffic (system prompts, few-shot templates,
+    multi-turn) — TTFT scales with the NOVEL suffix, not the prompt.
 
-    Every lifecycle transition (submitted → queued → admitted → each
-    prefill chunk → first token → per-token decode → finished /
-    cancelled / timed-out / stopped / crashed) lands in the flight
-    recorder under the handle's ``request_id``; ``debug_requests()``
-    feeds ``GET /debug/requests``, ``healthz()`` feeds the liveness
-    probe (503 once the loop crashes), and a loop crash writes a
-    postmortem JSON (``postmortem_path`` / ``$BIGDL_POSTMORTEM_PATH``,
-    default ``bigdl_postmortem.json``) before failing the handles.
+    Every lifecycle transition (submitted → queued → admitted [+
+    ``prefix_hit``] → each prefill chunk → first token → per-token
+    decode → finished / cancelled / timed-out / stopped / crashed)
+    lands in the flight recorder under the handle's ``request_id``;
+    ``debug_requests()`` feeds ``GET /debug/requests``, ``healthz()``
+    feeds the liveness probe (503 once the loop crashes), and a loop
+    crash writes a postmortem JSON (``postmortem_path`` /
+    ``$BIGDL_POSTMORTEM_PATH``, default ``bigdl_postmortem.json``)
+    before failing the handles.
     """
 
     def __init__(self, model, max_slots: int = 4,
@@ -132,13 +171,21 @@ class ContinuousBatchingEngine:
                  service_name: str = "engine",
                  idle_wait_s: float = 0.5, recorder=None,
                  postmortem_path: Optional[str] = None,
-                 recent_timelines: int = 256):
+                 recent_timelines: int = 256,
+                 prefill_rows: int = 1,
+                 prefix_cache_bytes: Optional[int] = None,
+                 prefix_cache_rows: Optional[int] = None,
+                 prefix_min_tokens: Optional[int] = None,
+                 admission_window: int = 4):
         from bigdl_tpu.models.transformer import _validate_sampling
         from bigdl_tpu.observability import serving_engine_instruments
         from bigdl_tpu.observability.events import default_recorder
 
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        if admission_window < 1:
+            raise ValueError(
+                f"admission_window must be >= 1, got {admission_window}")
         _validate_sampling(temperature > 0.0, top_k, top_p)
         model.evaluate()
         self.model = model
@@ -148,6 +195,7 @@ class ContinuousBatchingEngine:
         self.top_k, self.top_p = top_k, top_p
         self.idle_wait_s = idle_wait_s
         self.service_name = service_name
+        self.admission_window = admission_window
         #: flight recorder fed by every lifecycle transition (captured
         #: at construction, like the instruments — swap the default
         #: BEFORE building the engine, or pass one explicitly)
@@ -165,7 +213,8 @@ class ContinuousBatchingEngine:
         self._timelines: collections.deque = collections.deque(
             maxlen=recent_timelines)
         self._timelines_lock = threading.Lock()
-        self._policy = PrefillPolicy(prefill_chunk, prefill_budget_tokens)
+        self._policy = PrefillPolicy(prefill_chunk, prefill_budget_tokens,
+                                     prefill_rows)
         c = self._policy.chunk
         # the cache length rounds the serving window UP to a chunk
         # multiple (the last prefill chunk is padded, and forward_chunk's
@@ -190,9 +239,38 @@ class ContinuousBatchingEngine:
         # donated through every step — updates are in-place for the
         # engine's whole life
         self._caches = model.init_cache(max_slots, cache_len, dtype=dtype)
-        # one-row staging cache for chunked prefill; reused across
-        # admissions (stale tail KV is position-masked, never attended)
-        self._staging = model.init_cache(1, cache_len, dtype=dtype)
+        # prefill_rows-wide staging cache for chunked prefill; rows are
+        # reused across admissions (stale tail KV is position-masked,
+        # never attended)
+        self._staging = model.init_cache(self._policy.prefill_rows,
+                                         cache_len, dtype=dtype)
+        # prefix-cache KV pool: a third persistent buffer set holding
+        # the retained prefixes, plus its host-side radix-trie index.
+        # The byte budget is enforced as a row budget fixed here, so
+        # every compiled shape stays load-independent.
+        row_bytes = sum(int(leaf.nbytes) // max_slots
+                        for leaf in jax.tree.leaves(self._caches))
+        if prefix_cache_rows is not None:
+            pool_rows = max(0, int(prefix_cache_rows))
+        elif prefix_cache_bytes is None:
+            pool_rows = 2 * max_slots
+        else:
+            pool_rows = max(0, int(prefix_cache_bytes) // row_bytes)
+        if pool_rows > 0:
+            self._pool = model.init_cache(pool_rows, cache_len,
+                                          dtype=dtype)
+            self._prefix = PrefixCache(
+                pool_rows, row_bytes,
+                min_tokens=(prefix_min_tokens
+                            if prefix_min_tokens is not None else c))
+        else:
+            self._pool = None
+            self._prefix = None
+        self._prefix_evictions_seen = 0
+        #: host-side prompt-token tally actually prefilled by THIS
+        #: engine (the reused-fraction denominator — per-instance
+        #: exact, unlike the shared-label registry counter)
+        self._prefilled_tokens = 0
         #: programs that have run at least once — the jit_compiles
         #: fallback when jax's _cache_size probe is unavailable
         self._warm = set()
@@ -201,7 +279,7 @@ class ContinuousBatchingEngine:
         self._queue = AdmissionQueue(queue_capacity,
                                      recorder=self._rec)
         self._slots: List[Optional[_SlotState]] = [None] * max_slots
-        self._adm: Optional[_Admission] = None
+        self._adms: List[_Admission] = []
         self._key = jax.random.PRNGKey(seed)
         self._zero_key = jax.random.PRNGKey(0)
 
@@ -245,22 +323,33 @@ class ContinuousBatchingEngine:
             return nxt, caches
 
         def chunk(p, bufs, ids, caches, pos0, last_idx):
-            # one fixed-length prefill chunk at a TRACED offset into the
-            # staging cache; last_idx gathers the true last prompt
-            # position's logits (the final chunk is right-padded, so
-            # "last position of the chunk" would be a pad)
+            # one RAGGED prefill round over the whole staging cache:
+            # row r writes its chunk at its own traced offset pos0[r]
+            # (rows without an active admission ride along at offset 0
+            # — their junk write lands in their own idle row and is
+            # overwritten by that row's next occupant before it can
+            # ever be attended); last_idx gathers each row's true last
+            # prompt position's logits (the final chunk is
+            # right-padded, so "last position of the chunk" would be a
+            # pad)
             with bind(model, p, bufs, False, None):
                 return model.prefill_chunk_at(ids, caches, pos0,
                                               last_idx)
 
-        def insert(big, stage, slot):
-            # scatter the staged single-row caches into pool row `slot`
-            # (traced — one compile serves every slot)
+        def copy_row(dst, src, dst_row, src_row):
+            # copy row src_row of cache-tree src into row dst_row of
+            # cache-tree dst (dst donated — in place for the engine's
+            # life). ONE program, three compiled signatures, all
+            # load-independent: staging→pool-slot insert, prefix-pool→
+            # staging on a hit, pool-slot→prefix-pool on donation.
             return jax.tree.map(
-                lambda b, s: jax.lax.dynamic_update_slice(
-                    b, s.astype(b.dtype),
-                    (slot,) + (jnp.int32(0),) * (b.ndim - 1)),
-                big, stage)
+                lambda d, s: jax.lax.dynamic_update_slice(
+                    d,
+                    jax.lax.dynamic_slice(
+                        s, (src_row,) + (0,) * (s.ndim - 1),
+                        (1,) + s.shape[1:]).astype(d.dtype),
+                    (dst_row,) + (jnp.int32(0),) * (d.ndim - 1)),
+                dst, src)
 
         def sample0(logits, rng, temperature):
             if sampled:
@@ -271,12 +360,27 @@ class ContinuousBatchingEngine:
 
         self._step_jit = jax.jit(step, donate_argnums=(4,))
         self._chunk_jit = jax.jit(chunk, donate_argnums=(3,))
-        self._insert_jit = jax.jit(insert, donate_argnums=(0,))
+        self._copy_row_jit = jax.jit(copy_row, donate_argnums=(0,))
         self._sample0_jit = jax.jit(sample0)
+        # warm the copy signatures NOW (zero rows copied onto zero rows
+        # — harmless): the insert/stage/donate copies first fire at a
+        # request's FINISH or at the first cache hit, and a compile
+        # there would show up as a post-warmup jit_compiles bump — the
+        # exact flatness contract the gauge exists to police.
+        z = jnp.int32(0)
+        self._caches = self._copy_row_jit(self._caches, self._staging,
+                                          z, z)
+        self._warm.add("copy:insert")
+        if self._pool is not None:
+            self._staging = self._copy_row_jit(self._staging, self._pool,
+                                               z, z)
+            self._pool = self._copy_row_jit(self._pool, self._caches,
+                                            z, z)
+            self._warm.update(("copy:stage", "copy:donate"))
 
     def _compile_total(self) -> int:
         counts = [_compile_count(f) for f in
-                  (self._step_jit, self._chunk_jit, self._insert_jit,
+                  (self._step_jit, self._chunk_jit, self._copy_row_jit,
                    self._sample0_jit)]
         if all(c is None for c in counts):
             # _cache_size absent in this jax build: approximate with
@@ -331,9 +435,12 @@ class ContinuousBatchingEngine:
         err = EngineStopped("engine stopped before the request finished")
         for h in self._queue.drain():
             self._finish_handle(h, err, "stopped")
-        if self._adm is not None:
-            self._finish_handle(self._adm.handle, err, "stopped")
-            self._adm = None
+        for a in self._adms:
+            if a.entry is not None:
+                self._prefix.release(a.entry)
+                a.entry = None
+            self._finish_handle(a.handle, err, "stopped")
+        self._adms = []
         for sid, st in enumerate(self._slots):
             if st is not None:
                 self._finish_handle(st.handle, err, "stopped")
@@ -346,7 +453,7 @@ class ContinuousBatchingEngine:
         self.stop(drain=exc_type is None)
 
     def _has_work(self) -> bool:
-        return (len(self._queue) > 0 or self._adm is not None
+        return (len(self._queue) > 0 or len(self._adms) > 0
                 or any(s is not None for s in self._slots))
 
     # ---------------------------------------------------------- client
@@ -357,8 +464,9 @@ class ContinuousBatchingEngine:
         immediately; stream with ``handle.tokens()`` or block on
         ``handle.result()``. ``timeout_s`` is a wall deadline covering
         queue + prefill + decode (expiry raises ``RequestTimedOut`` from
-        the handle); a full admission queue blocks (``block=True``, up
-        to ``queue_timeout_s``) or raises ``QueueFull``."""
+        the handle — including while blocked on a full queue); a full
+        admission queue blocks (``block=True``, up to
+        ``queue_timeout_s``) or raises ``QueueFull``."""
         if self._crashed is not None:
             raise EngineStopped("engine loop crashed") from self._crashed
         prompt = np.asarray(prompt_ids, np.int32)
@@ -385,6 +493,8 @@ class ContinuousBatchingEngine:
             self._rec.record("request/rejected", h.request_id,
                              service=self.service_name,
                              error=type(e).__name__)
+            if isinstance(e, RequestTimedOut):
+                self._ins.timed_out_total.inc()
             raise
         with self._wake:
             self._wake.notify_all()
@@ -438,14 +548,32 @@ class ContinuousBatchingEngine:
         engine was constructed. ``latency`` adds per-phase percentile
         summaries (queue wait / prefill / TTFT / decode / total,
         each ``{count, mean, p50, p90, p99}``) computed from the
-        engine's recent finished-request timelines."""
+        engine's recent finished-request timelines; ``prefix_cache``
+        adds the cache's hit rate, reused-token fraction, and current
+        byte occupancy (per-instance exact — the cache object belongs
+        to this engine)."""
         out = {k: int(self._counter(k).get() - base)
                for k, base in self._stats_base.items()}
         out["active_slots"] = sum(s is not None for s in self._slots)
         out["queue_depth"] = len(self._queue)
         out["jit_compiles"] = self._compile_total()
         out["latency"] = self._latency_summary()
+        out["prefix_cache"] = self._prefix_summary()
         return out
+
+    def _prefix_summary(self) -> dict:
+        if self._prefix is None:
+            return {"enabled": False}
+        ps = self._prefix.stats()
+        prefilled = self._prefilled_tokens
+        denom = ps["reused_tokens"] + prefilled
+        return {
+            "enabled": True,
+            **ps,
+            "prefilled_tokens": prefilled,
+            "reused_fraction": (round(ps["reused_tokens"] / denom, 4)
+                                if denom else 0.0),
+        }
 
     def _latency_summary(self) -> dict:
         from bigdl_tpu.observability.events import percentile_summary
@@ -479,9 +607,11 @@ class ContinuousBatchingEngine:
     def debug_requests(self) -> dict:
         """The ``/debug/requests`` payload: every in-flight request's
         id, phase, and progress, the recent finished timelines with
-        their queue-wait/prefill/TTFT/decode breakdown, and the
-        percentile summary over them. Snapshot semantics — safe to
-        call from an HTTP thread while the loop runs."""
+        their queue-wait/prefill/TTFT/decode breakdown (now including
+        per-request ``prefix_tokens``), the percentile summary over
+        them, and the prefix-cache occupancy/hit-rate block. Snapshot
+        semantics — safe to call from an HTTP thread while the loop
+        runs."""
         now = time.monotonic()
         in_flight = []
         for h in self._queue.snapshot():
@@ -491,8 +621,7 @@ class ContinuousBatchingEngine:
                 "prompt_tokens": int(h.prompt.shape[0]),
                 "max_new_tokens": h.max_new_tokens,
             })
-        adm = self._adm
-        if adm is not None:
+        for adm in list(self._adms):
             h = adm.handle
             in_flight.append({
                 "request_id": h.request_id, "state": "prefill",
@@ -501,6 +630,8 @@ class ContinuousBatchingEngine:
                 "max_new_tokens": h.max_new_tokens,
                 "chunks_done": adm.next_chunk,
                 "chunks_total": adm.n_chunks,
+                "staging_row": adm.row,
+                "prefix_tokens": adm.base,
             })
         for sid, st in enumerate(list(self._slots)):
             if st is None:
@@ -518,7 +649,8 @@ class ContinuousBatchingEngine:
         return {"service": self.service_name,
                 "in_flight": in_flight,
                 "recent": recent,
-                "latency": self._latency_summary()}
+                "latency": self._latency_summary(),
+                "prefix_cache": self._prefix_summary()}
 
     # ------------------------------------------------------- loop body
     def _loop(self):
@@ -559,9 +691,12 @@ class ContinuousBatchingEngine:
         self._write_postmortem(e, states)
         err = EngineStopped(f"engine loop crashed: {e!r}")
         err.__cause__ = e
-        if self._adm is not None:
-            self._finish_handle(self._adm.handle, err, "crashed")
-            self._adm = None
+        for a in self._adms:
+            if a.entry is not None:
+                self._prefix.release(a.entry)
+                a.entry = None
+            self._finish_handle(a.handle, err, "crashed")
+        self._adms = []
         for sid, st in enumerate(self._slots):
             if st is not None:
                 self._finish_handle(st.handle, err, "crashed")
@@ -617,9 +752,9 @@ class ContinuousBatchingEngine:
                     f"deadline passed mid-decode after {st.delivered} "
                     "tokens (partial output in tokens_so_far())"),
                     "timed_out")
-        # ... and the admission in progress
-        if self._adm is not None:
-            h = self._adm.handle
+        # ... and the admissions in progress
+        for a in list(self._adms):
+            h = a.handle
             err = kind = None
             if h.cancelled:
                 err, kind = RequestCancelled(
@@ -628,30 +763,22 @@ class ContinuousBatchingEngine:
                 err, kind = RequestTimedOut(
                     "deadline passed during prefill"), "timed_out"
             if err is not None:
-                self._count_drop(kind)
-                self._finish_handle(h, err, kind)
-                self._adm = None
+                self._abort_admission(a, err, kind)
 
         # 2. queued requests: mid-queue deadline/cancel sweep
         for h, err in self._queue.sweep(now):
             self._finish_dropped(h, err)
 
-        # 3. admission: chunked prefill under this iteration's budget
+        # 3. admission: prefix-aware intake + batched chunked-prefill
+        #    rounds under this iteration's budget — every round
+        #    advances ALL staged admissions together through one
+        #    ragged dispatch
         self._policy.begin_iteration()
         while True:
-            if self._adm is None:
-                slot = self._free_slot()
-                if slot is None:
-                    break
-                h, dropped = self._queue.pop_ready(now)
-                for hd, err in dropped:
-                    self._finish_dropped(hd, err)
-                if h is None:
-                    break
-                self._start_admission(h, slot)
-            if not self._policy.take_chunk():
+            self._fill_admissions(now)
+            if not self._adms or not self._policy.take_chunk():
                 break
-            self._prefill_one_chunk()
+            self._prefill_round()
             worked = True
 
         # 4. one fused decode step over every occupied slot
@@ -670,70 +797,216 @@ class ContinuousBatchingEngine:
 
     # ------------------------------------------------ admission stages
     def _free_slot(self) -> Optional[int]:
-        # only called with no admission in flight (_iterate step 3), so
-        # a bare empty-slot scan is exact
+        # a slot is free when no running request occupies it AND no
+        # in-flight admission has reserved it as its insert target
+        reserved = {a.slot for a in self._adms}
         for sid, st in enumerate(self._slots):
-            if st is None:
+            if st is None and sid not in reserved:
                 return sid
         return None
 
-    def _start_admission(self, h: RequestHandle, slot: int) -> None:
+    def _free_staging_row(self) -> Optional[int]:
+        used = {a.row for a in self._adms}
+        for r in range(self._policy.prefill_rows):
+            if r not in used:
+                return r
+        return None
+
+    def _fill_admissions(self, now: float) -> None:
+        """Start new admissions until the staging cache is full, the
+        slot pool is exhausted, or the queue runs dry. With a prefix
+        cache and ``admission_window > 1``, the pop prefers the queued
+        candidate with the longest cached prefix (bounded bypass —
+        see AdmissionQueue.pop_ready)."""
+        scorer = None
+        if self._prefix is not None and self.admission_window > 1:
+            c = self._policy.chunk
+
+            def scorer(h):
+                # score by the USABLE (capped, chunk-aligned) reuse —
+                # exactly what _start_admission will skip — so a match
+                # that alignment reduces to zero never bypasses the
+                # FCFS head for nothing. The raw lookup is stamped on
+                # the handle (generation-guarded) so the winner's
+                # admission doesn't re-walk the trie.
+                e, m = self._prefix.lookup(h.prompt)
+                h._prefix_probe = (e, m, self._prefix.generation)
+                return (min(m, h.prompt.shape[0] - 1) // c) * c
+        while len(self._adms) < self._policy.prefill_rows:
+            slot = self._free_slot()
+            if slot is None:
+                return
+            row = self._free_staging_row()
+            if row is None:
+                return
+            h, dropped = self._queue.pop_ready(
+                now, scorer=scorer, window=self.admission_window)
+            for hd, err in dropped:
+                self._finish_dropped(hd, err)
+            if h is None:
+                return
+            self._start_admission(h, slot, row)
+
+    def _start_admission(self, h: RequestHandle, slot: int,
+                         row: int) -> None:
         c = self._policy.chunk
         t0 = h.prompt.shape[0]
-        n_chunks = self._policy.n_chunks(t0)
-        ids = np.zeros((1, n_chunks * c), np.int32)  # right-pad final chunk
-        ids[0, :t0] = h.prompt
-        self._adm = _Admission(h, slot, ids, t0, n_chunks)
+        base, entry = 0, None
+        if self._prefix is not None:
+            # reuse the pop_ready scorer's lookup when it is still
+            # valid — the generation guard rejects probes that predate
+            # any donation/eviction (a stale entry's pool row may
+            # already hold different tokens' KV)
+            probe = h.__dict__.pop("_prefix_probe", None)
+            if probe is not None and probe[2] == self._prefix.generation:
+                e, matched = probe[0], probe[1]
+            else:
+                e, matched = self._prefix.lookup(h.prompt)
+            if e is not None:
+                # cap at t0-1 (the last prompt position must be
+                # COMPUTED — its logits seed the first token), then
+                # chunk-align DOWN so the tail's chunk geometry — and
+                # with it the numerics — matches a cold prefill's, and
+                # the padded tail write can never overflow the cache
+                base = (min(matched, t0 - 1) // c) * c
+            if base > 0:
+                entry = e
+                self._prefix.record_hit(entry, base)
+                self._prefix.acquire(entry)
+                self._staging = self._copy_row_jit(
+                    self._staging, self._pool, jnp.int32(row),
+                    jnp.int32(entry.row))
+                self._warm.add("copy:stage")
+                self._ins.prefix_hits_total.inc()
+                self._ins.prefix_reused_tokens_total.inc(base)
+                self._rec.record("request/prefix_hit", h.request_id,
+                                 service=self.service_name,
+                                 matched_tokens=base,
+                                 raw_matched_tokens=matched,
+                                 tail_tokens=t0 - base)
+            else:
+                self._prefix.record_miss()
+                self._ins.prefix_misses_total.inc()
+        tail = t0 - base
+        n_chunks = self._policy.n_chunks(tail)
+        ids = np.zeros((n_chunks * c,), np.int32)  # right-pad final chunk
+        ids[:tail] = h.prompt[base:]
+        self._adms.append(_Admission(h, slot, row, ids, t0, base,
+                                     n_chunks, entry))
+        h.prefix_tokens = base
         h.admitted_at = time.monotonic()
         self._rec.record("request/admitted", h.request_id,
                          service=self.service_name, slot=slot,
-                         n_chunks=n_chunks)
+                         staging_row=row, n_chunks=n_chunks,
+                         prefix_tokens=base)
         self._ins.admitted_total.inc()
 
-    def _prefill_one_chunk(self) -> None:
-        adm = self._adm
+    def _prefill_round(self) -> None:
+        """Advance EVERY in-flight admission by one chunk through one
+        ragged dispatch, then complete the ones whose prompt is fully
+        staged (slot insert + first-token sample)."""
         c = self._policy.chunk
-        k = adm.next_chunk
-        final = k == adm.n_chunks - 1
-        # the true last prompt position within the final chunk — pad
-        # positions behind it are written but never attended (causal
-        # mask within the chunk; decode overwrites position p before
-        # attending <= p)
-        last = (adm.t0 - 1 - k * c) if final else (c - 1)
+        rows = self._policy.prefill_rows
+        ids = np.zeros((rows, c), np.int32)
+        pos0 = np.zeros((rows,), np.int32)
+        last = np.full((rows,), c - 1, np.int32)
+        finals: List[_Admission] = []
+        for a in self._adms:
+            k = a.next_chunk
+            ids[a.row] = a.ids[k * c:(k + 1) * c]
+            pos0[a.row] = a.base + k * c
+            if k == a.n_chunks - 1:
+                # the true last prompt position within the final chunk
+                # — pad positions behind it are written but never
+                # attended (causal mask within the chunk; decode
+                # overwrites position p before attending <= p)
+                last[a.row] = a.tail - 1 - k * c
+                finals.append(a)
         logits, self._staging = self._chunk_jit(
-            self._params, self._buffers,
-            jnp.asarray(adm.ids[:, k * c:(k + 1) * c]), self._staging,
-            jnp.int32(k * c), jnp.asarray([last], jnp.int32))
+            self._params, self._buffers, jnp.asarray(ids), self._staging,
+            jnp.asarray(pos0), jnp.asarray(last))
         self._warm.add("chunk")
-        self._ins.prefill_tokens_total.inc(min(c, adm.t0 - k * c))
-        self._rec.record("request/prefill_chunk", adm.handle.request_id,
-                         service=self.service_name, chunk=k,
-                         n_chunks=adm.n_chunks,
-                         tokens=min(c, adm.t0 - k * c))
-        adm.next_chunk += 1
-        if not final:
+        for a in self._adms:
+            k = a.next_chunk
+            done = min(c, a.tail - k * c)
+            self._prefilled_tokens += done
+            self._ins.prefill_tokens_total.inc(done)
+            self._rec.record("request/prefill_chunk",
+                             a.handle.request_id,
+                             service=self.service_name, chunk=k,
+                             n_chunks=a.n_chunks, tokens=done)
+            a.next_chunk += 1
+        if not finals:
             return
-        # prompt fully staged: scatter into the pool row, sample the
-        # first token from the true-last-position logits
-        self._caches = self._insert_jit(self._caches, self._staging,
-                                        jnp.int32(adm.slot))
-        tok = int(np.asarray(self._sample0_jit(
-            logits, self._next_key(), self._temp())))
-        self._warm.update(("insert", "sample0"))
+        toks = np.asarray(self._sample0_jit(
+            logits, self._next_key(), self._temp()))
+        self._warm.add("sample0")
+        for a in finals:
+            self._complete_admission(a, int(toks[a.row]))
+
+    def _complete_admission(self, a: _Admission, tok: int) -> None:
+        # prompt fully staged: scatter the staging row into the
+        # reserved pool slot, release the prefix pin (the staged copy
+        # is now independent of the pool row), deliver the first token
+        self._caches = self._copy_row_jit(
+            self._caches, self._staging, jnp.int32(a.slot),
+            jnp.int32(a.row))
+        self._warm.add("copy:insert")
+        if a.entry is not None:
+            self._prefix.release(a.entry)
+            a.entry = None
+        self._adms.remove(a)
         now = time.monotonic()
-        h = adm.handle
+        h = a.handle
         h._deliver(tok, now)
         self._ins.ttft_seconds.observe(now - h.submitted_at)
         self._rec.record("request/first_token", h.request_id,
                          service=self.service_name, token=tok,
                          ttft_s=now - h.submitted_at)
-        self._adm = None
         if (self.eos_id is not None and tok == self.eos_id) \
                 or h.max_new_tokens == 1:
+            # instant finisher: the slot row still holds the full
+            # prompt's KV — donate it before the slot identity is lost
+            self._maybe_donate(a.slot, h.prompt, h.request_id)
             self._finish_handle(h, None, "finished")
             self._ins.finished_total.inc()
             return
-        self._slots[adm.slot] = _SlotState(h, adm.t0, tok, now)
+        self._slots[a.slot] = _SlotState(h, a.t0, tok, now)
+
+    def _abort_admission(self, a: _Admission, err: Exception,
+                         kind: str) -> None:
+        if a.entry is not None:
+            self._prefix.release(a.entry)
+            a.entry = None
+        self._adms.remove(a)
+        self._count_drop(kind)
+        self._finish_handle(a.handle, err, kind)
+
+    # --------------------------------------------------- prefix donation
+    def _maybe_donate(self, sid: int, tokens: np.ndarray,
+                      request_id: str) -> None:
+        """Offer a finishing slot's KV to the prefix pool. ``tokens``
+        are exactly the ids whose KV the slot holds (positions
+        ``0..len-1``); the index decides (covered / LRU-evict /
+        decline) and the accepted row is filled by one donated copy."""
+        if self._prefix is None:
+            return
+        row = self._prefix.donate(tokens)
+        if row is not None:
+            self._pool = self._copy_row_jit(
+                self._pool, self._caches, jnp.int32(row),
+                jnp.int32(sid))
+            self._warm.add("copy:donate")
+            self._rec.record("request/prefix_donated", request_id,
+                             service=self.service_name,
+                             tokens=int(tokens.shape[0]), pool_row=row)
+        ev = self._prefix.evictions
+        if ev > self._prefix_evictions_seen:
+            self._ins.prefix_evicted_total.inc(
+                ev - self._prefix_evictions_seen)
+            self._prefix_evictions_seen = ev
+        self._ins.prefix_cache_bytes.set(self._prefix.bytes_in_use)
+        self._ins.prefix_cache_entries.set(len(self._prefix))
 
     # --------------------------------------------------------- decode
     def _decode_all(self, active: List[int]) -> None:
@@ -782,6 +1055,18 @@ class ContinuousBatchingEngine:
     def _release(self, sid: int, error: Optional[Exception],
                  reason: str) -> None:
         st = self._slots[sid]
+        # donate BEFORE the slot is surrendered: the slot's KV covers
+        # positions [0, st.pos) — the prompt plus every delivered token
+        # except the last (whose KV the next decode step would have
+        # written), so the donated key is exactly prompt +
+        # generated[:-1]. Cancelled/timed-out slots donate too: their
+        # KV satisfies the same invariant, and a timed-out long prompt
+        # is exactly the request most likely to be RETRIED — the retry
+        # then pays O(novel-suffix), not a second full prefill.
+        tokens = np.concatenate(
+            [st.handle.prompt,
+             np.asarray(st.handle._tokens[:-1], np.int32)])
+        self._maybe_donate(sid, tokens, st.handle.request_id)
         self._slots[sid] = None
         self._ins.evicted_total.inc()
         if reason == "finished":

@@ -1,0 +1,384 @@
+"""Host-side prefix index over retained KV-cache rows.
+
+Real serving traffic is prefix-heavy: system prompts, few-shot
+templates, and multi-turn conversations share long identical prompt
+heads. Recomputing those heads through chunked prefill makes TTFT scale
+with FULL prompt length no matter how redundant the work is — the same
+redundant-hot-path sin BigDL's design (Dai et al., 2018, arxiv
+1804.05839) existed to eliminate for data movement. ``PrefixCache`` is
+the serving engine's fix: a **radix trie** over token-id prefixes whose
+entries point at rows of a device-resident KV *pool*. A new request
+whose prompt shares a cached prefix copies the pool row into its
+staging slot (one jitted program) and chunk-prefills only the novel
+tail — O(novel-suffix) TTFT instead of O(prompt).
+
+This module is pure HOST bookkeeping: token keys, trie structure, LRU /
+ref-count accounting, and pool-row allocation. The device copies
+(pool→staging on a hit, slot→pool on donation) live in
+``engine.ContinuousBatchingEngine``; correctness of reuse rests on KV
+causality — the KV row at position ``i`` depends only on tokens ``0..i``
+— so any entry sharing the first ``m`` tokens with a prompt yields
+``m`` valid positions, even when the entry diverges afterwards
+(partial match) or extends past the prompt (truncated match).
+
+Eviction: entries are LRU-ordered; ``donate`` reclaims the
+least-recently-used entry with ``refs == 0`` when every pool row is
+occupied. An entry is pinned (``acquire``/``release``) for the lifetime
+of any admission staging from it, so a row is never overwritten while a
+copy consumer may still be in flight. The byte budget is enforced as a
+row budget (``rows * row_bytes``) fixed at construction — compiled
+shapes stay load-independent.
+
+Thread contract: the engine's loop thread is the only mutator;
+``stats()`` / ``snapshot()`` may be called from HTTP/debug threads (an
+internal lock covers the races).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class PrefixEntry:
+    """One retained prefix: ``tokens`` (the exact token ids whose KV the
+    pool row holds, positions ``0..length-1``), the pool ``row`` that
+    holds them, and the LRU/ref-count bookkeeping."""
+
+    __slots__ = ("tokens", "row", "refs", "last_used", "hits")
+
+    def __init__(self, tokens: np.ndarray, row: int, stamp: int):
+        self.tokens = tokens
+        self.row = row
+        self.refs = 0
+        self.last_used = stamp
+        self.hits = 0
+
+    @property
+    def length(self) -> int:
+        return int(self.tokens.shape[0])
+
+    def __repr__(self):
+        return (f"PrefixEntry(len={self.length}, row={self.row}, "
+                f"refs={self.refs}, hits={self.hits})")
+
+
+class _Node:
+    """Radix-trie node: edge-compressed children keyed by first token;
+    ``entry`` marks a retained prefix ending exactly here."""
+
+    __slots__ = ("children", "entry")
+
+    def __init__(self):
+        # first_token -> (edge_tokens np.ndarray, child _Node)
+        self.children: Dict[int, Tuple[np.ndarray, "_Node"]] = {}
+        self.entry: Optional[PrefixEntry] = None
+
+
+def _common_len(a: np.ndarray, b: np.ndarray) -> int:
+    n = min(a.shape[0], b.shape[0])
+    if n == 0:
+        return 0
+    neq = np.flatnonzero(a[:n] != b[:n])
+    return int(neq[0]) if neq.size else n
+
+
+class PrefixCache:
+    """Radix-trie index over token-id prefixes → device KV pool rows.
+
+    ``rows`` is the pool capacity (0 disables the cache entirely);
+    ``row_bytes`` is the device footprint of one pool row across every
+    layer's (k, v) buffers — ``capacity_bytes = rows * row_bytes`` is
+    the configured byte budget, ``bytes_in_use`` the occupied part.
+
+    The engine-facing flow per admission: ``lookup(prompt)`` → best
+    ``(entry, matched)``; on a hit ``acquire(entry)`` pins it while the
+    staged copy is consumed, ``release(entry)`` unpins. Per finished
+    request: ``donate(tokens)`` returns the pool row to copy the slot's
+    KV into (or None when covered / unevictable), possibly evicting an
+    LRU ``refs == 0`` entry to make room.
+    """
+
+    def __init__(self, rows: int, row_bytes: int,
+                 min_tokens: int = 1):
+        if rows < 0:
+            raise ValueError(f"rows must be >= 0, got {rows}")
+        if min_tokens < 1:
+            raise ValueError(
+                f"min_tokens must be >= 1, got {min_tokens}")
+        self.rows = rows
+        self.row_bytes = int(row_bytes)
+        #: prefixes shorter than this are never matched or donated —
+        #: a few shared tokens are not worth a row or a copy dispatch
+        self.min_tokens = min_tokens
+        self._root = _Node()
+        self._entries: List[PrefixEntry] = []
+        self._free_rows = list(range(rows))
+        self._stamp = 0
+        self._lock = threading.Lock()
+        #: bumped on every structural change (insert/evict) — lets a
+        #: caller validate a cached ``lookup`` result before acting on
+        #: it (a stale entry may have been evicted and its row reused)
+        self.generation = 0
+        # cumulative flow (monotonic, for stats deltas)
+        self.hits = 0
+        self.misses = 0
+        self.reused_tokens = 0
+        self.donations = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def capacity_bytes(self) -> int:
+        return self.rows * self.row_bytes
+
+    @property
+    def bytes_in_use(self) -> int:
+        with self._lock:
+            return len(self._entries) * self.row_bytes
+
+    # ------------------------------------------------------------ match
+    def lookup(self, prompt: np.ndarray
+               ) -> Tuple[Optional[PrefixEntry], int]:
+        """Best cached prefix for ``prompt``: walk the trie as deep as
+        the prompt's tokens agree, then take the better of (a) the
+        deepest entry ENDING on the walked path (a full-entry match —
+        every one of its tokens is a prefix of the prompt) and (b) any
+        entry in the subtree below the divergence point (a PARTIAL
+        match: the entry shares exactly the walked depth, then
+        diverges or extends — its KV is still valid for the shared
+        head, by causality). Returns ``(entry, matched_tokens)`` with
+        ``matched >= min_tokens``, else ``(None, 0)``.
+
+        PURE: no counters move and no LRU stamp is touched — the
+        engine uses ``lookup`` both to probe admissions and to SCORE
+        queued candidates for prefix-aware ordering, and scoring must
+        not pollute the hit-rate. The engine's admission decision
+        lands via ``record_hit`` / ``record_miss``."""
+        prompt = np.asarray(prompt, np.int32)
+        with self._lock:
+            best: Optional[PrefixEntry] = None
+            best_len = 0
+
+            def consider(cand: Optional[PrefixEntry], ln: int):
+                nonlocal best, best_len
+                if cand is not None and ln > best_len:
+                    best, best_len = cand, ln
+
+            node, depth, off = self._root, 0, prompt
+            while True:
+                if node.entry is not None:
+                    consider(node.entry, node.entry.length)
+                if off.shape[0] == 0:
+                    # prompt exhausted AT a node: entries extending
+                    # below all share the full walked depth
+                    consider(self._mru_below(node), depth)
+                    break
+                nxt = node.children.get(int(off[0]))
+                if nxt is None:
+                    # no child continues the prompt, but every entry
+                    # below this node still shares `depth` tokens
+                    consider(self._mru_below(node), depth)
+                    break
+                edge, child = nxt
+                m = _common_len(edge, off)
+                depth += m
+                if m < edge.shape[0]:
+                    # diverged (or prompt exhausted) mid-edge: every
+                    # entry below shares exactly `depth` tokens
+                    consider(self._mru_below(child), depth)
+                    break
+                node, off = child, off[m:]
+            if best is None or best_len < self.min_tokens:
+                return None, 0
+            return best, best_len
+
+    def record_hit(self, entry: PrefixEntry, reused_tokens: int) -> None:
+        """Commit an admission's hit: LRU touch, per-entry and global
+        hit counts, and the chunk-aligned reused-token figure the
+        engine actually skipped prefill for."""
+        with self._lock:
+            self._stamp += 1
+            entry.last_used = self._stamp
+            entry.hits += 1
+            self.hits += 1
+            self.reused_tokens += int(reused_tokens)
+
+    def record_miss(self) -> None:
+        with self._lock:
+            self.misses += 1
+
+    def _mru_below(self, node: _Node) -> Optional[PrefixEntry]:
+        """Most-recently-used entry in ``node``'s subtree (entry count
+        is bounded by pool rows, so the DFS is trivially cheap)."""
+        best = node.entry
+        for edge, child in node.children.values():
+            c = self._mru_below(child)
+            if c is not None and (best is None
+                                  or c.last_used > best.last_used):
+                best = c
+        return best
+
+    # -------------------------------------------------------- pin/unpin
+    def acquire(self, entry: PrefixEntry) -> None:
+        """Pin ``entry`` while an admission consumes its pool row — a
+        pinned entry is never evicted, so the row cannot be overwritten
+        under an in-flight copy consumer."""
+        with self._lock:
+            entry.refs += 1
+
+    def release(self, entry: PrefixEntry) -> None:
+        with self._lock:
+            if entry.refs <= 0:
+                raise RuntimeError(
+                    f"release() without matching acquire(): {entry!r}")
+            entry.refs -= 1
+
+    # --------------------------------------------------------- donation
+    def donate(self, tokens: np.ndarray) -> Optional[int]:
+        """Offer a finished request's cached tokens to the pool.
+        Returns the pool row the caller must copy the KV into, or None
+        when the donation is declined: too short, already covered by an
+        existing entry (which gets an LRU touch instead), or no free
+        row and every entry pinned. May evict (and reuse the row of)
+        the LRU ``refs == 0`` entry — byte pressure resolves by
+        recency, never by silently dropping pinned entries."""
+        # own the key: np.asarray would ALIAS an int32 caller buffer,
+        # and a client reusing one preallocated prompt array across
+        # requests would then rewrite the trie key under an entry
+        # whose pool row still holds the OLD tokens' KV — a silent
+        # wrong-prefix hit later
+        tokens = np.array(tokens, np.int32, copy=True)
+        with self._lock:
+            if self.rows == 0 or tokens.shape[0] < self.min_tokens:
+                return None
+            covered = self._covering_entry(tokens)
+            if covered is not None:
+                self._stamp += 1
+                covered.last_used = self._stamp
+                return None
+            if self._free_rows:
+                row = self._free_rows.pop()
+            else:
+                victim = self._lru_unpinned()
+                if victim is None:
+                    return None
+                self._remove(victim)
+                self.evictions += 1
+                row = victim.row
+            self._stamp += 1
+            self.generation += 1
+            entry = PrefixEntry(tokens, row, self._stamp)
+            self._insert(entry)
+            self._entries.append(entry)
+            self.donations += 1
+            return row
+
+    def _covering_entry(self, tokens: np.ndarray
+                        ) -> Optional[PrefixEntry]:
+        """An existing entry of which ``tokens`` is a (non-strict)
+        prefix — any future prompt matches it at least as deeply as it
+        would match ``tokens``, so the donation adds nothing."""
+        node, off = self._root, tokens
+        while True:
+            if off.shape[0] == 0:
+                return self._mru_below(node)
+            nxt = node.children.get(int(off[0]))
+            if nxt is None:
+                return None
+            edge, child = nxt
+            m = _common_len(edge, off)
+            if m == off.shape[0]:
+                return self._mru_below(child)
+            if m < edge.shape[0]:
+                return None
+            node, off = child, off[m:]
+
+    def _lru_unpinned(self) -> Optional[PrefixEntry]:
+        cand = [e for e in self._entries if e.refs == 0]
+        return min(cand, key=lambda e: e.last_used) if cand else None
+
+    # ---------------------------------------------------- trie plumbing
+    def _insert(self, entry: PrefixEntry) -> None:
+        node, off = self._root, entry.tokens
+        while off.shape[0] > 0:
+            nxt = node.children.get(int(off[0]))
+            if nxt is None:
+                child = _Node()
+                node.children[int(off[0])] = (off, child)
+                child.entry = entry
+                return
+            edge, child = nxt
+            m = _common_len(edge, off)
+            if m < edge.shape[0]:
+                # split the edge at the divergence point
+                mid = _Node()
+                node.children[int(off[0])] = (edge[:m], mid)
+                mid.children[int(edge[m])] = (edge[m:], child)
+                node, off = mid, off[m:]
+            else:
+                node, off = child, off[m:]
+        node.entry = entry
+
+    def _remove(self, entry: PrefixEntry) -> None:
+        self._entries.remove(entry)
+        # walk to the entry's node, clearing the marker; structural
+        # merge of pass-through nodes is skipped — the trie is bounded
+        # by rows * key-length and rebuilt nodes are reused by the next
+        # insert along the same path
+        node, off = self._root, entry.tokens
+        path: List[Tuple[_Node, int]] = []
+        while off.shape[0] > 0:
+            nxt = node.children.get(int(off[0]))
+            if nxt is None:
+                return
+            edge, child = nxt
+            m = _common_len(edge, off)
+            if m < edge.shape[0]:
+                return
+            path.append((node, int(off[0])))
+            node, off = child, off[m:]
+        if node.entry is entry:
+            node.entry = None
+        # prune now-empty leaf chains so the trie cannot grow without
+        # bound across many donate/evict cycles
+        while path:
+            parent, first = path.pop()
+            edge, child = parent.children[first]
+            if child.entry is None and not child.children:
+                del parent.children[first]
+            else:
+                break
+
+    # ------------------------------------------------------------ stats
+    def stats(self) -> dict:
+        """Operational snapshot: occupancy, byte budget, and cumulative
+        hit/reuse/eviction flow (the engine's ``stats()['prefix_cache']``
+        and ``/debug/requests`` both render this)."""
+        with self._lock:
+            looked = self.hits + self.misses
+            return {
+                "entries": len(self._entries),
+                "rows": self.rows,
+                "bytes": len(self._entries) * self.row_bytes,
+                "capacity_bytes": self.rows * self.row_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": round(self.hits / looked, 4) if looked else 0.0,
+                "reused_tokens": self.reused_tokens,
+                "donations": self.donations,
+                "evictions": self.evictions,
+            }
+
+    def snapshot(self) -> List[dict]:
+        """Per-entry debug view (LRU order, oldest first)."""
+        with self._lock:
+            return [{"length": e.length, "row": e.row, "refs": e.refs,
+                     "hits": e.hits, "last_used": e.last_used}
+                    for e in sorted(self._entries,
+                                    key=lambda e: e.last_used)]

@@ -6,12 +6,17 @@ Two policies live here, deliberately separate from the device loop:
   (``put`` blocks or raises ``QueueFull`` when the bound is hit, so an
   overloaded engine pushes back instead of buffering unboundedly) plus
   deadline/cancellation sweeps: expired or cancelled requests are
-  dropped from the queue without ever costing a prefill.
+  dropped from the queue without ever costing a prefill. ``pop_ready``
+  optionally reorders within a bounded window by a caller-supplied
+  scorer (the engine scores by cached-prefix length — prefix-aware
+  admission ordering with a hard starvation bound).
 - ``PrefillPolicy`` — the prefill-vs-decode interleave: how many
   prompt tokens each loop iteration may spend on admission before the
-  shared decode step runs. Chunked prefill under a per-iteration token
-  budget means admitting a 10k-token prompt never stalls the decode of
-  already-running requests for more than one chunk's worth of work.
+  shared decode step runs (``budget_tokens``), and how many admissions
+  prefill TOGETHER through one ragged dispatch (``prefill_rows``).
+  Chunked prefill under a per-iteration token budget means admitting a
+  10k-token prompt never stalls the decode of already-running requests
+  for more than one round's worth of work.
 
 The reference's serving story (optim/PredictionService.scala) bounds
 concurrency with an instance queue; this is the generative analog where
@@ -53,6 +58,8 @@ class AdmissionQueue:
             from bigdl_tpu.observability.events import default_recorder
             recorder = default_recorder()
         self._rec = recorder
+        #: consecutive scorer-driven head bypasses (pop_ready fairness)
+        self._head_bypasses = 0
 
     def __len__(self) -> int:
         with self._lock:
@@ -68,22 +75,43 @@ class AdmissionQueue:
             timeout: Optional[float] = None) -> None:
         """Enqueue FCFS. When full: raise ``QueueFull`` immediately
         (``block=False``), or wait up to ``timeout`` (None = forever)
-        for space — the backpressure path."""
+        for space — the backpressure path.
+
+        A handle with its own request deadline never out-sleeps it: the
+        wait is bounded by the deadline too, and a request whose
+        deadline expired while it was blocked here is rejected with
+        ``RequestTimedOut`` at wake-up — admitting it would hand a slot
+        (and a prefill) to a request that can only ever time out."""
         deadline = (time.monotonic() + timeout) if timeout is not None \
             else None
         with self._lock:
             while len(self._q) >= self.capacity:
+                now = time.monotonic()
+                if handle.deadline is not None and now > handle.deadline:
+                    self._rec.record("request/queue_dropped",
+                                     handle.request_id,
+                                     reason="RequestTimedOut")
+                    raise RequestTimedOut(
+                        f"deadline passed after "
+                        f"{now - handle.submitted_at:.3f}s blocked on a "
+                        f"full admission queue ({self.capacity} queued) "
+                        "— rejected instead of admitted with a dead "
+                        "deadline")
                 if not block:
                     raise QueueFull(
                         f"admission queue full ({self.capacity} queued); "
                         "retry later or raise queue_capacity")
-                remaining = None if deadline is None \
-                    else deadline - time.monotonic()
+                remaining = None if deadline is None else deadline - now
                 if remaining is not None and remaining <= 0:
                     raise QueueFull(
                         f"admission queue still full ({self.capacity} "
                         f"queued) after {timeout}s")
-                if not self._lock.wait(timeout=remaining):
+                if handle.deadline is not None:
+                    dl_left = handle.deadline - now
+                    remaining = (dl_left if remaining is None
+                                 else min(remaining, dl_left))
+                if (not self._lock.wait(timeout=remaining)
+                        and handle.deadline is None):
                     raise QueueFull(
                         f"admission queue still full ({self.capacity} "
                         f"queued) after {timeout}s")
@@ -97,24 +125,65 @@ class AdmissionQueue:
                              depth=len(self._q))
             self._lock.notify_all()
 
-    def pop_ready(self, now: Optional[float] = None
+    def pop_ready(self, now: Optional[float] = None, scorer=None,
+                  window: int = 1
                   ) -> Tuple[Optional[RequestHandle],
                              List[Tuple[RequestHandle, Exception]]]:
-        """Pop the first LIVE handle (FCFS), skipping over — and
-        returning — any cancelled/expired ones encountered on the way.
-        Returns ``(handle_or_None, dropped)``."""
+        """Pop the next LIVE handle, skipping over — and returning —
+        any cancelled/expired ones encountered on the way. Returns
+        ``(handle_or_None, dropped)``.
+
+        PREFIX-AWARE ordering: with ``scorer`` (handle → number, e.g.
+        the cached-prefix length of the handle's prompt) and
+        ``window > 1``, the pop considers the first ``window`` live
+        handles and takes the highest-scoring one (ties and
+        all-zero scores fall back to FCFS — the scorer only ever
+        REORDERS within the window, admission stays work-conserving).
+        Starvation is bounded: after ``window`` consecutive pops bypass
+        the queue head, the next pop is forced FCFS, so the head waits
+        at most ``window`` extra admissions."""
         now = time.monotonic() if now is None else now
         dropped: List[Tuple[RequestHandle, Exception]] = []
         with self._lock:
-            while self._q:
+            if scorer is None or window <= 1:
+                # plain FCFS fast path: O(1) popleft per live pop —
+                # a deep queue must not pay a full rebuild per
+                # admission when nothing reorders
+                while self._q:
+                    h = self._q.popleft()
+                    err = self._terminal(h, now)
+                    if err is None:
+                        self._head_bypasses = 0
+                        self._lock.notify_all()
+                        return h, dropped
+                    dropped.append((h, err))
+                self._lock.notify_all()
+                return None, dropped
+            # scored path: materialize only the first `window` live
+            # candidates off the head; the tail never moves
+            live: List[RequestHandle] = []
+            while self._q and len(live) < window:
                 h = self._q.popleft()
                 err = self._terminal(h, now)
-                if err is None:
-                    self._lock.notify_all()
-                    return h, dropped
-                dropped.append((h, err))
+                (live.append(h) if err is None
+                 else dropped.append((h, err)))
+            if not live:
+                self._lock.notify_all()
+                return None, dropped
+            pick = live[0]
+            if len(live) > 1 and self._head_bypasses < window:
+                # one scorer call per candidate (each is a trie walk)
+                scores = [scorer(h) for h in live]
+                best = max(range(len(live)), key=scores.__getitem__)
+                if scores[best] > scores[0]:
+                    pick = live[best]
+            self._head_bypasses = (self._head_bypasses + 1
+                                   if pick is not live[0] else 0)
+            for h in reversed(live):
+                if h is not pick:
+                    self._q.appendleft(h)
             self._lock.notify_all()
-            return None, dropped
+            return pick, dropped
 
     def sweep(self, now: Optional[float] = None
               ) -> List[Tuple[RequestHandle, Exception]]:
@@ -160,20 +229,35 @@ class AdmissionQueue:
 
 class PrefillPolicy:
     """The prefill-vs-decode interleave: each loop iteration may spend
-    at most ``budget_tokens`` prompt tokens on chunked prefill before
-    the shared decode step runs. ``chunk`` is the compiled prefill
-    chunk length (ONE program serves every offset — pos0 is traced), so
-    the budget is consumed ``chunk`` tokens at a time.
+    at most ``budget_tokens`` prompt tokens (per staged row) on chunked
+    prefill before the shared decode step runs. ``chunk`` is the
+    compiled prefill chunk length (ONE program serves every offset —
+    pos0 is traced), so the budget is consumed ``chunk`` tokens at a
+    time — one *round* per take.
 
-    Defaults: ``budget_tokens = 2 * chunk`` — admission makes steady
-    progress (a C-token prompt admits in one iteration) while a running
-    decode never waits more than two chunks' worth of prefill."""
+    ``prefill_rows`` is the second lever: the width of the engine's
+    staging cache. Each prefill round advances up to ``prefill_rows``
+    queued admissions by one chunk THROUGH ONE ragged dispatch (each
+    row at its own offset), instead of one admission at a time — under
+    a burst of arrivals, admission cost per request amortizes across
+    the batch while the decode stall per iteration stays bounded by
+    the same per-row token budget.
+
+    Defaults: ``budget_tokens = 2 * chunk``, ``prefill_rows = 1`` —
+    admission makes steady progress (a C-token prompt admits in one
+    iteration) while a running decode never waits more than two
+    rounds' worth of prefill."""
 
     def __init__(self, chunk: int = 16,
-                 budget_tokens: Optional[int] = None):
+                 budget_tokens: Optional[int] = None,
+                 prefill_rows: int = 1):
         if chunk < 1:
             raise ValueError(f"prefill chunk must be >= 1, got {chunk}")
+        if prefill_rows < 1:
+            raise ValueError(
+                f"prefill_rows must be >= 1, got {prefill_rows}")
         self.chunk = chunk
+        self.prefill_rows = prefill_rows
         self.budget_tokens = (2 * chunk if budget_tokens is None
                               else budget_tokens)
         if self.budget_tokens < chunk:
@@ -186,8 +270,9 @@ class PrefillPolicy:
         self._left = self.budget_tokens
 
     def take_chunk(self) -> bool:
-        """Spend one chunk of this iteration's budget; False once the
-        iteration's prefill allowance is exhausted."""
+        """Spend one round (``chunk`` tokens per staged row) of this
+        iteration's budget; False once the iteration's prefill
+        allowance is exhausted."""
         if self._left < self.chunk:
             return False
         self._left -= self.chunk

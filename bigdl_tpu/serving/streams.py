@@ -81,6 +81,11 @@ class RequestHandle:
                          if timeout_s is not None else None)
         #: set by the engine when prefill starts (queue-wait boundary)
         self.admitted_at: Optional[float] = None
+        #: prompt tokens served from the engine's prefix cache instead
+        #: of being prefilled (0 on a miss or with the cache disabled);
+        #: stamped at admission alongside the ``request/prefix_hit``
+        #: flight-recorder event
+        self.prefix_tokens: int = 0
         #: set by the engine when the first token lands (TTFT source)
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
@@ -146,6 +151,8 @@ class RequestHandle:
         - ``decode_s``     — first token → finished
         - ``total_s``      — submitted → finished
         - ``tokens``       — tokens delivered
+        - ``prefix_tokens`` — prompt tokens reused from the prefix
+          cache (prefill skipped for them; 0 on a miss)
 
         Final once the request is ``done()`` (the engine stamps each
         boundary as the lifecycle advances), partial before that."""
@@ -159,6 +166,7 @@ class RequestHandle:
             "decode_s": gap(self.first_token_at, self.finished_at),
             "total_s": gap(self.submitted_at, self.finished_at),
             "tokens": len(self._tokens),
+            "prefix_tokens": self.prefix_tokens,
         }
 
     def tokens(self) -> Iterator[int]:

@@ -1,0 +1,128 @@
+#!/usr/bin/env python3
+"""CI perf gate over ``bench_history.jsonl`` serving rows.
+
+Reads the TWO newest *comparable* serving rows (same metric, same
+workload signature — request count, arrival rate, template config) and
+fails (exit 1) when the newer row's p99 TTFT regressed by more than
+``--threshold`` (default 20%) against the previous one. Anything that
+prevents a comparison — no history, a single row, unparsable lines,
+rows without a TTFT — exits 0 with an explanation: the gate blocks
+measured regressions, it never blocks the first run of a new workload.
+
+Serving rows come from ``bench.py --serving`` (p99 TTFT under
+``detail.engine.ttft.p99``) and ``bench.py --serving --shared-prefix``
+(``detail.cached.ttft.p99``); both shapes are understood. Stdlib only —
+runnable from any CI step without the package installed.
+
+Usage::
+
+    python scripts/perf_gate.py [--history bench_history.jsonl]
+                                [--threshold 0.20] [--metric NAME]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+#: detail keys that hold a serving result with a ``ttft`` percentile
+#: block, in precedence order (--serving vs --serving --shared-prefix)
+_TTFT_PATHS = ("engine", "cached")
+
+
+def ttft_p99(row: dict):
+    """The row's p99 TTFT in seconds, or None when the row carries no
+    TTFT measurement (training rows, failed runs)."""
+    detail = row.get("detail") or {}
+    for key in _TTFT_PATHS:
+        block = detail.get(key) or {}
+        p99 = (block.get("ttft") or {}).get("p99")
+        if p99 is not None:
+            return float(p99)
+    return None
+
+
+def signature(row: dict):
+    """What must match for two rows to be comparable: the metric name
+    plus the workload shape (request count, rate, template config,
+    slot/staging widths). Device intentionally included — a CPU
+    fallback row must never gate against a TPU row."""
+    detail = row.get("detail") or {}
+    wl = detail.get("workload") or {}
+    return (row.get("metric"), detail.get("device"),
+            tuple(sorted((k, v) for k, v in wl.items()
+                         if isinstance(v, (int, float, str)))))
+
+
+def load_rows(path: str):
+    rows = []
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                rows.append(json.loads(ln))
+            except ValueError:
+                continue  # torn line: skip, never crash the gate
+    return rows
+
+
+def main(argv=None) -> int:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = argparse.ArgumentParser(
+        description="Fail CI on a serving p99-TTFT regression between "
+                    "the two newest comparable bench_history rows.")
+    p.add_argument("--history",
+                   default=os.environ.get(
+                       "BIGDL_BENCH_HISTORY",
+                       os.path.join(here, "bench_history.jsonl")))
+    p.add_argument("--threshold", type=float, default=0.20,
+                   help="allowed fractional p99-TTFT regression "
+                        "(0.20 = +20%%)")
+    p.add_argument("--metric", default=None,
+                   help="only gate rows with this metric name "
+                        "(default: any serving row carrying a TTFT)")
+    args = p.parse_args(argv)
+
+    try:
+        rows = load_rows(args.history)
+    except OSError as e:
+        print(f"[perf-gate] no history ({e}); nothing to gate")
+        return 0
+
+    serving = [r for r in rows if ttft_p99(r) is not None
+               and (args.metric is None or r.get("metric") == args.metric)]
+    if not serving:
+        print("[perf-gate] no serving rows with a TTFT in "
+              f"{args.history}; nothing to gate")
+        return 0
+
+    newest = serving[-1]
+    sig = signature(newest)
+    prev = next((r for r in reversed(serving[:-1])
+                 if signature(r) == sig), None)
+    if prev is None:
+        print(f"[perf-gate] no earlier row comparable to "
+              f"{newest.get('metric')} (signature {sig}); first run "
+              "passes")
+        return 0
+
+    new_p99, old_p99 = ttft_p99(newest), ttft_p99(prev)
+    ratio = new_p99 / old_p99 if old_p99 else float("inf")
+    verdict = (f"p99 TTFT {old_p99 * 1e3:.2f}ms -> {new_p99 * 1e3:.2f}ms "
+               f"({ratio:.3f}x) for {newest.get('metric')} "
+               f"[{prev.get('ts', '?')} -> {newest.get('ts', '?')}]")
+    if ratio > 1.0 + args.threshold:
+        print(f"[perf-gate] FAIL: {verdict} exceeds the "
+              f"+{args.threshold:.0%} budget")
+        return 1
+    print(f"[perf-gate] ok: {verdict} within the "
+          f"+{args.threshold:.0%} budget")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,474 @@
+"""Prefix-aware KV reuse + batched multi-row prefill (bigdl_tpu/serving/).
+
+The acceptance contract under test: with the prefix cache WARM (prior
+requests donated their KV), every request still gets EXACTLY the tokens
+a lone greedy ``model.generate`` call would produce — reuse changes the
+WORK, never the tokens — while ``stats()`` shows hits, reused tokens,
+and byte occupancy, and the compiled-program gauge stays flat (hit,
+miss, donation, and eviction paths all run through construction-warmed
+executables). Plus the satellites: the radix-trie match semantics
+(exact / partial / truncated), LRU + ref-count eviction under byte
+pressure, the ``AdmissionQueue.put`` dead-deadline rejection,
+prefix-aware admission ordering with its starvation bound, multi-row
+batched prefill parity, and the ``scripts/perf_gate.py`` CI gate."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.serving import (
+    AdmissionQueue, ContinuousBatchingEngine, PrefillPolicy, PrefixCache,
+    RequestTimedOut,
+)
+from bigdl_tpu.serving.streams import RequestHandle
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(21)
+    m = TransformerLM(32, embed_dim=16, num_heads=4, num_kv_heads=2,
+                      num_layers=2, max_len=48, use_rope=True)
+    m.evaluate()
+    return m
+
+
+def _direct(lm, prompt, n, eos=None):
+    """The per-request oracle: a lone greedy generate, trimmed at the
+    first eos (the engine stops there instead of emitting the padding
+    tail)."""
+    want = np.asarray(
+        lm.generate(jnp.asarray(prompt)[None], n, eos_id=eos))[0]
+    if eos is not None:
+        gen = want[len(prompt):]
+        hits = np.flatnonzero(gen == eos)
+        if hits.size:
+            want = want[:len(prompt) + hits[0] + 1]
+    return want
+
+
+# --------------------------------------------------------- trie units
+def test_radix_trie_match_semantics():
+    pc = PrefixCache(rows=4, row_bytes=1000, min_tokens=4)
+    t1 = np.arange(1, 9, dtype=np.int32)               # [1..8]
+    t2 = np.asarray([1, 2, 3, 4, 9, 9, 9, 9], np.int32)  # splits at 4
+    assert pc.donate(t1) is not None
+    assert pc.donate(t2) is not None
+    assert len(pc) == 2
+
+    # exact: the full entry is a prefix of the prompt
+    e, m = pc.lookup(np.asarray([1, 2, 3, 4, 5, 6, 7, 8, 30], np.int32))
+    assert m == 8 and np.array_equal(e.tokens, t1)
+    # partial: prompt diverges mid-entry — the shared head still counts
+    e, m = pc.lookup(np.asarray([1, 2, 3, 4, 5, 6, 30, 30], np.int32))
+    assert m == 6 and np.array_equal(e.tokens, t1)
+    # truncated: the prompt is SHORTER than every entry — KV causality
+    # still makes the shared head valid
+    e, m = pc.lookup(np.asarray([1, 2, 3, 4, 9], np.int32))
+    assert m == 5 and np.array_equal(e.tokens, t2)
+    # below the min_tokens floor: no match
+    e, m = pc.lookup(np.asarray([1, 2, 3, 30], np.int32))
+    assert e is None and m == 0
+    # total miss
+    e, m = pc.lookup(np.asarray([7, 7, 7, 7, 7], np.int32))
+    assert e is None and m == 0
+    # lookup is PURE: nothing above moved the counters
+    assert pc.stats()["hits"] == 0 and pc.stats()["misses"] == 0
+
+    # covered donation: a prefix of an existing entry adds nothing
+    assert pc.donate(t1[:6]) is None
+    assert len(pc) == 2 and pc.stats()["donations"] == 2
+
+    # donate COPIES the key: a caller mutating its buffer afterwards
+    # (e.g. a client reusing one preallocated prompt array) must not
+    # rewrite the trie under the entry's retained KV
+    buf = np.asarray([5, 5, 5, 5, 5, 5], np.int32)
+    assert pc.donate(buf) is not None
+    buf[:] = 9
+    e, m = pc.lookup(np.asarray([5, 5, 5, 5, 5, 5, 1], np.int32))
+    assert m == 6 and np.array_equal(e.tokens, [5] * 6)
+
+
+def test_lru_and_refcount_eviction_under_byte_pressure():
+    pc = PrefixCache(rows=2, row_bytes=512, min_tokens=4)
+    t1 = np.asarray([1] * 8, np.int32)
+    t2 = np.asarray([2] * 8, np.int32)
+    t3 = np.asarray([3] * 8, np.int32)
+    assert pc.donate(t1) is not None and pc.donate(t2) is not None
+    assert pc.bytes_in_use == 2 * 512 == pc.capacity_bytes
+    # touch t1 so t2 is the LRU victim
+    e1, _ = pc.lookup(t1)
+    pc.record_hit(e1, 8)
+    row3 = pc.donate(t3)
+    assert row3 is not None and pc.stats()["evictions"] == 1
+    assert pc.lookup(t2)[0] is None          # t2 evicted
+    assert pc.lookup(t1)[0] is not None      # t1 survived (recently used)
+
+    # ref-count: a PINNED entry is never evicted, even at full budget
+    pc.acquire(e1)
+    t4 = np.asarray([4] * 8, np.int32)
+    e3, _ = pc.lookup(t3)
+    pc.acquire(e3)
+    assert pc.donate(t4) is None             # both rows pinned: declined
+    pc.release(e3)
+    assert pc.donate(t4) is not None         # t3 evictable now
+    assert pc.lookup(t1)[0] is e1            # the pinned entry survived
+    pc.release(e1)
+    with pytest.raises(RuntimeError, match="acquire"):
+        pc.release(e1)
+
+
+def test_policy_and_cache_validation():
+    with pytest.raises(ValueError, match="prefill_rows"):
+        PrefillPolicy(chunk=4, prefill_rows=0)
+    with pytest.raises(ValueError, match="rows"):
+        PrefixCache(rows=-1, row_bytes=8)
+    with pytest.raises(ValueError, match="min_tokens"):
+        PrefixCache(rows=1, row_bytes=8, min_tokens=0)
+    # rows=0 is the disabled cache: donations are declined, lookups miss
+    pc = PrefixCache(rows=0, row_bytes=8)
+    assert pc.donate(np.arange(8, dtype=np.int32)) is None
+    assert pc.lookup(np.arange(8, dtype=np.int32)) == (None, 0)
+
+
+# ------------------------------------------------- scheduler satellites
+def test_put_rejects_dead_deadline_at_wakeup():
+    """A request whose deadline expires while BLOCKED on a full queue
+    must be rejected with RequestTimedOut at wake-up — not admitted
+    with a dead deadline, and not left sleeping out the full put
+    timeout."""
+    q = AdmissionQueue(capacity=1)
+    q.put(RequestHandle(np.asarray([1]), 2))  # fill the queue
+    h = RequestHandle(np.asarray([2]), 2, timeout_s=0.05)
+    t0 = time.monotonic()
+    with pytest.raises(RequestTimedOut, match="full admission queue"):
+        q.put(h, block=True, timeout=30.0)
+    assert time.monotonic() - t0 < 5.0, \
+        "must wake at the DEADLINE, not the 30s put timeout"
+    # an already-expired deadline is rejected immediately
+    h2 = RequestHandle(np.asarray([3]), 2, timeout_s=0.0)
+    time.sleep(0.002)
+    with pytest.raises(RequestTimedOut):
+        q.put(h2, block=True)
+
+
+def test_pop_ready_prefix_aware_window_and_starvation_bound():
+    q = AdmissionQueue(capacity=8)
+    score = lambda h: 10 if h.prompt[0] == 1 else 0  # noqa: E731
+
+    plain = RequestHandle(np.asarray([9, 9]), 2)
+    hit1 = RequestHandle(np.asarray([1, 1]), 2)
+    q.put(plain)
+    q.put(hit1)
+    h, dropped = q.pop_ready(scorer=score, window=2)
+    assert h is hit1 and not dropped  # cached prefix jumps the queue
+    # plain is still queued, in order
+    assert q.snapshot() == [plain]
+
+    # starvation bound: after `window` consecutive bypasses the next
+    # pop is forced FCFS — the head waits at most window admissions
+    hit2 = RequestHandle(np.asarray([1, 2]), 2)
+    q.put(hit2)
+    assert q.pop_ready(scorer=score, window=2)[0] is hit2  # bypass #2
+    hit3 = RequestHandle(np.asarray([1, 3]), 2)
+    q.put(hit3)
+    assert q.pop_ready(scorer=score, window=2)[0] is plain, \
+        "bypass cap reached: the starved head must pop next"
+    assert q.pop_ready(scorer=score, window=2)[0] is hit3
+    # window=1 (or no scorer) is pure FCFS
+    a, b = RequestHandle(np.asarray([9]), 2), RequestHandle(
+        np.asarray([1]), 2)
+    q.put(a)
+    q.put(b)
+    assert q.pop_ready(scorer=score, window=1)[0] is a
+    assert q.pop_ready()[0] is b
+
+
+def test_engine_submit_timed_out_while_blocked(lm):
+    r = np.random.RandomState(11)
+    p = r.randint(0, 32, (4,))
+    with ContinuousBatchingEngine(lm, max_slots=1, prefill_chunk=4,
+                                  queue_capacity=1) as eng:
+        h_long = eng.submit(p, 24)
+        it = h_long.tokens()
+        next(it)                 # admitted: slot busy, queue empty
+        eng.submit(p, 4)         # fills the 1-deep queue
+        with pytest.raises(RequestTimedOut):
+            eng.submit(p, 4, timeout_s=0.05, queue_timeout_s=30.0)
+        # the engine keeps serving correctly afterwards
+        np.testing.assert_array_equal(h_long.result(timeout=60),
+                                      _direct(lm, p, 24))
+    assert eng.stats()["timed_out"] >= 1
+
+
+# ------------------------------------------------ engine: cache reuse
+def test_prefix_hit_parity_and_stats(lm):
+    """Second request sharing an 8-token template head: token-identical
+    to the cold oracle, with the hit visible end-to-end — handle,
+    timeline, stats(), and /debug/requests."""
+    r = np.random.RandomState(7)
+    tpl = r.randint(0, 32, (8,))
+    pa = np.concatenate([tpl, r.randint(0, 32, (3,))])
+    pb = np.concatenate([tpl, r.randint(0, 32, (4,))])
+    with ContinuousBatchingEngine(lm, max_slots=2,
+                                  prefill_chunk=4) as eng:
+        ha = eng.submit(pa, 5)
+        np.testing.assert_array_equal(ha.result(timeout=60),
+                                      _direct(lm, pa, 5))
+        assert ha.prefix_tokens == 0          # cold cache: a miss
+        hb = eng.submit(pb, 5)
+        np.testing.assert_array_equal(hb.result(timeout=60),
+                                      _direct(lm, pb, 5))
+        assert hb.prefix_tokens == 8          # the whole template head
+        assert hb.timeline()["prefix_tokens"] == 8
+        s = eng.stats()["prefix_cache"]
+        assert s["enabled"] and s["hits"] == 1 and s["misses"] == 1
+        assert s["hit_rate"] == 0.5
+        assert s["reused_tokens"] == 8 and s["reused_fraction"] > 0
+        assert s["entries"] >= 1 and s["bytes"] > 0
+        assert s["bytes"] <= s["capacity_bytes"]
+        dbg = eng.debug_requests()
+        assert dbg["prefix_cache"]["hits"] == 1
+
+
+def test_greedy_parity_shared_prefix_load_vs_cold_engine(lm):
+    """The tentpole acceptance: a shared-prefix workload through the
+    cached engine (multi-row staging) is token-identical, request for
+    request, to the cache-DISABLED engine and to the lone-generate
+    oracle."""
+    r = np.random.RandomState(8)
+    tpls = [r.randint(0, 32, (8,)) for _ in range(2)]
+    reqs = []
+    for i in range(8):
+        tpl = tpls[i % 2]
+        reqs.append((np.concatenate([tpl, r.randint(0, 32,
+                                                    (1 + i % 4,))]),
+                     3 + i % 5))
+
+    def run(**kw):
+        rows = []
+        with ContinuousBatchingEngine(lm, max_slots=3, prefill_chunk=4,
+                                      prefill_rows=2, **kw) as eng:
+            handles = [eng.submit(p, n) for p, n in reqs]
+            rows = [h.result(timeout=120) for h in handles]
+        return rows, eng
+
+    warm_rows, warm_eng = run()
+    cold_rows, _ = run(prefix_cache_bytes=0)
+    for (p, n), wr, cr in zip(reqs, warm_rows, cold_rows):
+        want = _direct(lm, p, n)
+        np.testing.assert_array_equal(wr, want)
+        np.testing.assert_array_equal(cr, want)
+    s = warm_eng.stats()["prefix_cache"]
+    assert s["hits"] >= 1 and s["reused_tokens"] >= 8
+
+
+def test_multiturn_reuse_crosses_decode_kv(lm):
+    """Turn 2's prompt embeds turn 1's full prompt+reply: the cached
+    head extends past the original prompt into DECODE-produced KV, and
+    the greedy output still matches the cold oracle exactly."""
+    r = np.random.RandomState(9)
+    p1 = r.randint(0, 32, (6,))
+    with ContinuousBatchingEngine(lm, max_slots=2,
+                                  prefill_chunk=4) as eng:
+        row1 = eng.submit(p1, 7).result(timeout=60)   # 13 tokens
+        p2 = np.concatenate([row1, r.randint(0, 32, (2,))])
+        h2 = eng.submit(p2, 4)
+        np.testing.assert_array_equal(h2.result(timeout=60),
+                                      _direct(lm, p2, 4))
+        # donated key = prompt + generated[:-1] = 12 tokens; chunk-
+        # aligned reuse = 12 — strictly more than p1's 6 prompt tokens,
+        # so the reused head provably crosses into decode-written KV
+        assert h2.prefix_tokens == 12
+
+
+def test_concurrent_submits_sharing_one_prefix(lm):
+    r = np.random.RandomState(10)
+    tpl = r.randint(0, 32, (8,))
+    warm = np.concatenate([tpl, r.randint(0, 32, (2,))])
+    reqs = [(np.concatenate([tpl, r.randint(0, 32, (2 + i % 3,))]),
+             3 + i % 4) for i in range(6)]
+    rows = [None] * len(reqs)
+    errs = []
+    with ContinuousBatchingEngine(lm, max_slots=3, prefill_chunk=4,
+                                  prefill_rows=2) as eng:
+        eng.submit(warm, 2).result(timeout=60)  # donate the template
+
+        def worker(i, p, n):
+            try:
+                rows[i] = eng.submit(p, n).result(timeout=120)
+            except Exception as e:
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i, p, n))
+                   for i, (p, n) in enumerate(reqs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert not errs, errs
+    for (p, n), row in zip(reqs, rows):
+        np.testing.assert_array_equal(row, _direct(lm, p, n))
+    s = eng.stats()["prefix_cache"]
+    assert s["hits"] == len(reqs), \
+        "every post-warm submit shares the donated template head"
+
+
+def test_engine_eviction_under_byte_pressure(lm):
+    """prefix_cache_rows=1: the second donated template evicts the
+    first (LRU, refs==0), visible in stats — and serving stays
+    correct throughout."""
+    r = np.random.RandomState(12)
+    t1, t2 = r.randint(0, 32, (8,)), r.randint(0, 32, (8,))
+    with ContinuousBatchingEngine(lm, max_slots=2, prefill_chunk=4,
+                                  prefix_cache_rows=1) as eng:
+        for tpl in (t1, t2):
+            p = np.concatenate([tpl, r.randint(0, 32, (2,))])
+            np.testing.assert_array_equal(eng.submit(p, 3).result(60),
+                                          _direct(lm, p, 3))
+        s = eng.stats()["prefix_cache"]
+        assert s["rows"] == 1 and s["entries"] == 1
+        assert s["evictions"] >= 1
+        assert s["bytes"] == s["capacity_bytes"]
+
+
+def test_prefix_cache_disabled(lm):
+    r = np.random.RandomState(13)
+    p = r.randint(0, 32, (8,))
+    with ContinuousBatchingEngine(lm, max_slots=2, prefill_chunk=4,
+                                  prefix_cache_bytes=0) as eng:
+        np.testing.assert_array_equal(eng.submit(p, 4).result(60),
+                                      _direct(lm, p, 4))
+        h = eng.submit(p, 4)        # identical prompt: still no reuse
+        np.testing.assert_array_equal(h.result(60), _direct(lm, p, 4))
+        assert h.prefix_tokens == 0
+        assert eng.stats()["prefix_cache"] == {"enabled": False}
+    assert eng._pool is None
+
+
+# --------------------------------------- engine: batched multi-row path
+def test_multirow_prefill_parity_and_flat_jit(lm):
+    """prefill_rows=3: queued admissions prefill TOGETHER through the
+    ragged staging dispatch; every reply stays token-identical and the
+    compiled-program count is flat from the first request's warmup
+    onward (hit, miss, donation, and batched rounds all reuse the
+    construction-warmed executables)."""
+    r = np.random.RandomState(14)
+    tpl = r.randint(0, 32, (8,))
+    reqs = [(r.randint(0, 32, (3 + i,)), 3 + i % 4) for i in range(3)]
+    reqs += [(np.concatenate([tpl, r.randint(0, 32, (2 + i,))]), 4)
+             for i in range(3)]
+    with ContinuousBatchingEngine(lm, max_slots=3, prefill_chunk=4,
+                                  prefill_rows=3) as eng:
+        warm_p = r.randint(0, 32, (6,))
+        np.testing.assert_array_equal(eng.submit(warm_p, 3).result(60),
+                                      _direct(lm, warm_p, 3))
+        # donate the template so the trio below hits the cache (they
+        # are admitted in ONE multi-row wave — a donation landing
+        # after their admission would be too late)
+        warm_t = np.concatenate([tpl, r.randint(0, 32, (2,))])
+        np.testing.assert_array_equal(eng.submit(warm_t, 2).result(60),
+                                      _direct(lm, warm_t, 2))
+        compiles_after_warmup = eng.stats()["jit_compiles"]
+        assert compiles_after_warmup > 0
+
+        # submit everything at once: the queue drains through batched
+        # multi-row admission (and, for the template trio, the hit
+        # path) with no further compiles
+        handles = [eng.submit(p, n) for p, n in reqs]
+        for (p, n), h in zip(reqs, handles):
+            np.testing.assert_array_equal(h.result(timeout=120),
+                                          _direct(lm, p, n))
+        assert eng.stats()["prefix_cache"]["hits"] >= 1
+        assert eng.stats()["jit_compiles"] == compiles_after_warmup, \
+            "hit/donation/batched-prefill paths must not compile " \
+            "anything new after warmup"
+
+
+def test_norope_model_ragged_path():
+    """The learned-positional (non-rope) model exercises the ragged
+    pos_embed gather: parity for a batched, prefix-hitting pair."""
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(22)
+    m = TransformerLM(32, embed_dim=16, num_heads=4, num_kv_heads=2,
+                      num_layers=2, max_len=48, use_rope=False)
+    m.evaluate()
+    r = np.random.RandomState(15)
+    tpl = r.randint(0, 32, (8,))
+    pa = np.concatenate([tpl, r.randint(0, 32, (2,))])
+    pb = np.concatenate([tpl, r.randint(0, 32, (3,))])
+    with ContinuousBatchingEngine(m, max_slots=2, prefill_chunk=4,
+                                  prefill_rows=2) as eng:
+        ha, hb = eng.submit(pa, 4), eng.submit(pb, 4)
+        np.testing.assert_array_equal(ha.result(60), _direct(m, pa, 4))
+        np.testing.assert_array_equal(hb.result(60), _direct(m, pb, 4))
+
+
+# ---------------------------------------------------------- perf gate
+def _gate(history_path, *extra):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "perf_gate.py"),
+         "--history", history_path, *extra],
+        capture_output=True, text=True)
+
+
+def _serving_row(p99_ms, metric="serving_shared_prefix_tokens_per_sec",
+                 requests=24, ts="2026-08-04T00:00:00+00:00"):
+    return {"metric": metric, "value": 100.0, "unit": "tokens/sec",
+            "ts": ts,
+            "detail": {"device": "cpu",
+                       "cached": {"ttft": {"p50": p99_ms / 2e3,
+                                           "p99": p99_ms / 1e3}},
+                       "workload": {"kind": "shared_prefix",
+                                    "requests": requests,
+                                    "rate_hz": 30.0}}}
+
+
+def test_perf_gate(tmp_path):
+    hist = tmp_path / "hist.jsonl"
+
+    # no file / no serving rows / single row: the gate passes
+    assert _gate(str(hist)).returncode == 0
+    hist.write_text(json.dumps({"metric": "training", "value": 1}) + "\n")
+    assert _gate(str(hist)).returncode == 0
+    hist.write_text(json.dumps(_serving_row(10.0)) + "\n")
+    assert _gate(str(hist)).returncode == 0
+
+    # within budget (+10% < 20%): pass
+    rows = [_serving_row(10.0), _serving_row(11.0)]
+    hist.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    res = _gate(str(hist))
+    assert res.returncode == 0, res.stdout + res.stderr
+
+    # >20% p99 regression: FAIL
+    rows = [_serving_row(10.0), _serving_row(12.5)]
+    hist.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    res = _gate(str(hist))
+    assert res.returncode == 1 and "FAIL" in res.stdout
+
+    # regression vs a NON-comparable row (different workload): pass —
+    # the gate compares only rows with matching signatures
+    rows = [_serving_row(10.0, requests=8), _serving_row(30.0)]
+    hist.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    assert _gate(str(hist)).returncode == 0
+
+    # the newest row gates against the newest COMPARABLE one, skipping
+    # interleaved rows of other workloads; custom threshold respected
+    rows = [_serving_row(10.0), _serving_row(5.0, requests=8),
+            _serving_row(10.5)]
+    hist.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    assert _gate(str(hist)).returncode == 0
+    assert _gate(str(hist), "--threshold", "0.01").returncode == 1
