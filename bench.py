@@ -227,6 +227,13 @@ def bench_main(argv=None):
                         "trees + flight-recorder events as Chrome "
                         "trace JSON (open in Perfetto); path override: "
                         "BIGDL_BENCH_TRACE")
+    p.add_argument("--profile", type=float, default=None,
+                   metavar="SECONDS",
+                   help="capture a jax.profiler trace of (up to) the "
+                        "first SECONDS of the benchmark run — model/"
+                        "engine build, compile, and warmup included "
+                        "(observability.profiler); the artifact dir "
+                        "lands in detail.profile_artifact")
     p.add_argument("--requests", type=int, default=24,
                    help="--serving: workload size")
     p.add_argument("--rate", type=float, default=20.0,
@@ -276,6 +283,9 @@ def bench_main(argv=None):
     # Same config family on CPU as on TPU (NHWC + bf16 compute, f32 masters)
     # so tunnel-wedged rounds exercise — and time — the real code path.
     fmt = args.format if model.startswith("resnet") else "NCHW"
+    # start as close to the profiled work as bench controls: run_perf
+    # builds + compiles + warms + measures, all inside the capture
+    prof = _start_profile(args.profile)
     s = run_perf(model, batch_size=batch, iterations=iters,
                  dtype=jnp.bfloat16 if model != "lenet5" else jnp.float32,
                  format=fmt,
@@ -374,6 +384,10 @@ def bench_main(argv=None):
             signal.alarm(0)
             signal.signal(signal.SIGALRM, old)
 
+    art = _finish_profile(prof)
+    if art is not None:
+        result["detail"]["profile_artifact"] = art
+    result["detail"]["memory"] = _memory_snapshot()
     _record_bench_metrics(result, model)
     _dump_prometheus_snapshot()
     if args.trace:
@@ -410,6 +424,7 @@ def _serving_bench(args, dev):
     model = TransformerLM(128, embed_dim=64, num_heads=4, num_kv_heads=2,
                           num_layers=2, max_len=128, use_rope=True)
     model.evaluate()
+    prof = _start_profile(args.profile)
     if args.shared_prefix:
         res = run_shared_prefix_comparison(
             model, n_requests=args.requests, rate_hz=args.rate,
@@ -443,10 +458,77 @@ def _serving_bench(args, dev):
             },
         }
         _record_serving_metrics(res)
+    art = _finish_profile(prof)
+    if art is not None:
+        result["detail"]["profile_artifact"] = art
+    result["detail"]["memory"] = _memory_snapshot()
     _dump_prometheus_snapshot()
     if args.trace:
         _dump_chrome_trace()
     print(json.dumps(result))
+
+
+def _start_profile(seconds):
+    """``--profile``: begin a jax.profiler capture of the measured run
+    plus a timer that stops it at the requested bound (whichever of
+    run-end / timer comes first wins — stop_capture is idempotent).
+    Returns an opaque handle for ``_finish_profile``, or None."""
+    if not seconds or seconds <= 0:
+        return None
+    import threading
+
+    from bigdl_tpu.observability import profiler
+
+    try:
+        path = profiler.start_capture()
+    except Exception as e:
+        print(f"[bench] profiler capture unavailable: {e}",
+              file=sys.stderr)
+        return None
+    timer = threading.Timer(min(float(seconds), profiler.MAX_SECONDS),
+                            profiler.stop_capture, kwargs={"strict": False})
+    timer.daemon = True
+    timer.start()
+    print(f"[bench] profiling up to {seconds}s -> {path}",
+          file=sys.stderr)
+    return {"path": path, "timer": timer}
+
+
+def _finish_profile(prof):
+    """Stop the ``--profile`` capture (if the timer has not already)
+    and return the artifact directory, or None when not profiling."""
+    if prof is None:
+        return None
+    from bigdl_tpu.observability import profiler
+
+    prof["timer"].cancel()
+    try:
+        profiler.stop_capture(strict=False)
+    except Exception as e:
+        print(f"[bench] profiler stop failed: {e}", file=sys.stderr)
+    return prof["path"]
+
+
+def _memory_snapshot():
+    """One device-memory sample for the result's detail block: total
+    bytes in use, per-device source, and the per-pool attribution the
+    run registered (KV pools, params, optimizer slots). Never lets
+    telemetry break the bench."""
+    try:
+        from bigdl_tpu.observability.memory import default_monitor
+
+        s = default_monitor().sample()
+        return {
+            "bytes_in_use": s["bytes_in_use"],
+            "devices": [{k: d[k] for k in
+                         ("device", "bytes_in_use", "limit_bytes",
+                          "source")}
+                        for d in s["devices"]],
+            "pools": s["pools"],
+        }
+    except Exception as e:
+        print(f"[bench] memory snapshot failed: {e}", file=sys.stderr)
+        return None
 
 
 def _record_shared_prefix_metrics(res):
@@ -457,38 +539,24 @@ def _record_shared_prefix_metrics(res):
     try:
         from bigdl_tpu import observability as obs
 
-        reg = obs.default_registry()
-        lbl = ("path",)
-        tps = reg.gauge("bigdl_bench_serving_tokens_per_sec",
-                        "Serving bench aggregate delivered tokens/sec",
-                        labelnames=lbl)
-        p50 = reg.gauge("bigdl_bench_serving_ttft_p50_seconds",
-                        "Serving bench time-to-first-token p50",
-                        labelnames=lbl)
-        p99 = reg.gauge("bigdl_bench_serving_ttft_p99_seconds_by_path",
-                        "Serving bench time-to-first-token p99",
-                        labelnames=lbl)
+        # instruments resolve against the CURRENT default registry —
+        # the same one the snapshot dump renders
+        ins = obs.serving_bench_instruments()
         for path in ("cached", "uncached"):
             r = res[path]
-            tps.labels(path).set(r["tokens_per_sec"])
+            ins.tokens_per_sec.labels(path).set(r["tokens_per_sec"])
             if r["ttft"]["p50"] is not None:
-                p50.labels(path).set(r["ttft"]["p50"])
-                p99.labels(path).set(r["ttft"]["p99"])
+                ins.ttft_p50.labels(path).set(r["ttft"]["p50"])
+                ins.ttft_p99_by_path.labels(path).set(r["ttft"]["p99"])
+            if r.get("inter_token", {}).get("p99") is not None:
+                ins.inter_token_p99.labels(path).set(
+                    r["inter_token"]["p99"])
         if res.get("ttft_p50_speedup") is not None:
-            reg.gauge("bigdl_bench_serving_prefix_ttft_p50_speedup",
-                      "Cached-vs-uncached engine TTFT p50 speedup on "
-                      "the shared-prefix workload (>1.0: the prefix "
-                      "cache pays for itself)"
-                      ).set(res["ttft_p50_speedup"])
+            ins.prefix_ttft_p50_speedup().set(res["ttft_p50_speedup"])
         pc = res["cached"].get("prefix_cache", {})
         if pc.get("enabled"):
-            reg.gauge("bigdl_bench_serving_prefix_hit_rate",
-                      "Prefix-cache hit rate over the shared-prefix "
-                      "bench workload").set(pc["hit_rate"])
-            reg.gauge("bigdl_bench_serving_prefix_reused_fraction",
-                      "Fraction of prompt tokens served from the "
-                      "prefix cache instead of prefilled"
-                      ).set(pc["reused_fraction"])
+            ins.prefix_hit_rate().set(pc["hit_rate"])
+            ins.prefix_reused_fraction().set(pc["reused_fraction"])
     except Exception as e:
         print(f"[bench] shared-prefix metrics registry update failed: "
               f"{e}", file=sys.stderr)
@@ -501,34 +569,22 @@ def _record_serving_metrics(res):
     try:
         from bigdl_tpu import observability as obs
 
-        reg = obs.default_registry()
-        lbl = ("path",)
-        tps = reg.gauge("bigdl_bench_serving_tokens_per_sec",
-                        "Serving bench aggregate delivered tokens/sec",
-                        labelnames=lbl)
-        p50 = reg.gauge("bigdl_bench_serving_latency_p50_seconds",
-                        "Serving bench per-request latency p50",
-                        labelnames=lbl)
-        p99 = reg.gauge("bigdl_bench_serving_latency_p99_seconds",
-                        "Serving bench per-request latency p99",
-                        labelnames=lbl)
+        ins = obs.serving_bench_instruments()
         for path, key in (("engine", "engine"),
                           ("generation_service", "generation_service")):
             r = res[key]
-            tps.labels(path).set(r["tokens_per_sec"])
+            ins.tokens_per_sec.labels(path).set(r["tokens_per_sec"])
             if r["latency"]["p50"] is not None:
-                p50.labels(path).set(r["latency"]["p50"])
-                p99.labels(path).set(r["latency"]["p99"])
+                ins.latency_p50.labels(path).set(r["latency"]["p50"])
+                ins.latency_p99.labels(path).set(r["latency"]["p99"])
         eng = res["engine"]
         if eng.get("ttft", {}).get("p99") is not None:
-            reg.gauge("bigdl_bench_serving_ttft_p99_seconds",
-                      "Serving bench engine time-to-first-token p99"
-                      ).set(eng["ttft"]["p99"])
+            ins.ttft_p99().set(eng["ttft"]["p99"])
+        if eng.get("inter_token", {}).get("p99") is not None:
+            ins.inter_token_p99.labels("engine").set(
+                eng["inter_token"]["p99"])
         if res.get("p99_speedup") is not None:
-            reg.gauge("bigdl_bench_serving_p99_speedup",
-                      "Engine p99 latency speedup vs GenerationService "
-                      "(> 1.0: engine tail shorter)"
-                      ).set(res["p99_speedup"])
+            ins.p99_speedup().set(res["p99_speedup"])
     except Exception as e:
         print(f"[bench] serving metrics registry update failed: {e}",
               file=sys.stderr)
@@ -537,36 +593,22 @@ def _record_serving_metrics(res):
 def _record_bench_metrics(result, model):
     """Mirror the headline numbers into the observability registry —
     bench snapshots and live scrapes then share one metric schema
-    (bigdl_* names), so the perf trajectory is diffable against
-    production telemetry. Never lets telemetry break the bench."""
+    (bigdl_* names, all minted in observability/instruments.py — the
+    metrics lint holds that line), so the perf trajectory is diffable
+    against production telemetry. Never lets telemetry break the
+    bench."""
     try:
         from bigdl_tpu import observability as obs
 
-        # the CURRENT default registry — the same one the snapshot dump
-        # renders (a swapped default must see both halves consistently)
-        reg = obs.default_registry()
-        lbl = ("model",)
+        ins = obs.bench_instruments()
         d = result["detail"]
-        reg.gauge(
-            "bigdl_bench_imgs_per_sec_per_chip",
-            "Bench headline training throughput", labelnames=lbl
-        ).labels(model).set(result["value"])
-        reg.gauge(
-            "bigdl_bench_ms_per_iter", "Bench per-iteration wall time",
-            labelnames=lbl).labels(model).set(d["ms_per_iter"])
-        reg.gauge(
-            "bigdl_bench_mfu", "Bench model FLOPs utilization",
-            labelnames=lbl).labels(model).set(d["mfu"])
+        ins.imgs_per_sec.labels(model).set(result["value"])
+        ins.ms_per_iter.labels(model).set(d["ms_per_iter"])
+        ins.mfu.labels(model).set(d["mfu"])
         if result.get("vs_baseline") is not None:
-            reg.gauge(
-                "bigdl_bench_vs_baseline",
-                "Headline vs the north-star baseline (>1.0 beats it)",
-                labelnames=lbl).labels(model).set(result["vs_baseline"])
+            ins.vs_baseline.labels(model).set(result["vs_baseline"])
         if d.get("lenet_mnist_epoch_s") is not None:
-            reg.gauge(
-                "bigdl_bench_lenet_mnist_epoch_seconds",
-                "LeNet-MNIST synthetic epoch wall clock"
-            ).set(d["lenet_mnist_epoch_s"])
+            ins.lenet_epoch_seconds().set(d["lenet_mnist_epoch_s"])
     except Exception as e:
         print(f"[bench] metrics registry update failed: {e}",
               file=sys.stderr)

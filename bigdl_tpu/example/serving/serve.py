@@ -12,8 +12,11 @@ POINT is the serving machinery, not the prose):
   5. GenerationService: concurrent requests, coalescing stats
   6. ContinuousBatchingEngine: streaming requests, request-scoped
      flight-recorder timelines, and the ops surface — /healthz wired
-     to engine liveness (503 once the decode loop dies),
-     /debug/requests TTFT breakdowns, /debug/trace Chrome trace
+     to engine liveness (503 once the decode loop dies; a watchdog
+     alert degrades the body while staying 200), /debug/requests TTFT
+     breakdowns, /debug/trace Chrome trace, /debug/memory per-pool
+     HBM attribution (KV slots / staging / prefix pool / params), and
+     on-demand /debug/profile capture (--profile-seconds N)
 
 Run: python -m bigdl_tpu.example.serving.serve [--tokens 24]
 """
@@ -31,6 +34,9 @@ def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--tokens", type=int, default=24)
     p.add_argument("--vocab", type=int, default=128)
+    p.add_argument("--profile-seconds", type=float, default=0.0,
+                   help="also exercise GET /debug/profile with a "
+                        "capture of this many seconds (0 = skip)")
     args = p.parse_args(argv)
 
     import jax.numpy as jnp
@@ -112,6 +118,7 @@ def main(argv=None):
     # instead of lying "ok"), and the flight recorder's per-request
     # timelines come back over /debug/requests + /debug/trace
     import json
+    import urllib.error
     import urllib.request
 
     from bigdl_tpu import observability as obs
@@ -136,9 +143,36 @@ def main(argv=None):
         ttft = dbg["latency"]["ttft"]["p50"]
         print(f"[engine]    {handles[0].request_id} streamed "
               f"{streamed} tokens; /healthz {hz['status']} "
-              f"(loop_alive={hz['loop_alive']}); /debug/requests "
+              f"(loop_alive={hz['loop_alive']}, "
+              f"alerts={len(hz['alerts'])}); /debug/requests "
               f"p50 TTFT {ttft * 1e3:.1f}ms over "
               f"{dbg['latency']['ttft']['count']} requests")
+
+        # who owns the HBM: the engine registered its KV slot pool,
+        # prefill staging, prefix pool, and params as named memory
+        # pools — /debug/memory attributes device bytes to each
+        mem = json.loads(urllib.request.urlopen(
+            f"{base}/debug/memory").read())
+        eng_pools = {k.split("/")[-1]: v
+                     for k, v in mem["now"]["pools"].items()
+                     if k.startswith("serving/")}
+        print(f"[memory]    /debug/memory: "
+              f"{mem['now']['bytes_in_use'] / 1e6:.1f} MB in use; "
+              f"engine pools (KB): "
+              + ", ".join(f"{k}={v // 1024}"
+                          for k, v in sorted(eng_pools.items())))
+
+        if args.profile_seconds > 0:
+            # zero-redeploy profiling: one bounded capture over HTTP
+            try:
+                prof = json.loads(urllib.request.urlopen(
+                    f"{base}/debug/profile"
+                    f"?seconds={args.profile_seconds}").read())
+                print(f"[profile]   /debug/profile -> "
+                      f"{prof['artifact']}")
+            except urllib.error.HTTPError as e:
+                print(f"[profile]   unavailable here: "
+                      f"{json.loads(e.read()).get('error')}")
 
         # the same counters, scraped: a stdlib /metrics endpoint any
         # Prometheus-compatible collector can poll

@@ -19,11 +19,24 @@ equivalent for one-process-per-host JAX):
 - **Postmortems** (``postmortem``): on an engine crash, one JSON
   artifact with the last-N events, open span trees, metrics snapshot,
   and in-flight request states.
+- **Device memory** (``memory``): a ``DeviceMemoryMonitor`` sampling
+  HBM bytes in use / peak / limit per device with per-pool byte
+  attribution (``register_pool`` hooks fed by the serving engine's KV
+  pools, the prefix cache, and the optimizers) — the "who owns the
+  HBM" layer behind ``GET /debug/memory``.
+- **Profiler** (``profiler``): bounded on-demand ``jax.profiler``
+  capture — ``capture(seconds)`` programmatically, or
+  ``GET/POST /debug/profile?seconds=N`` with zero redeploys.
+- **Watchdogs** (``watchdog``): ``RecompileWatchdog`` (post-warmup
+  compile growth → recompile-storm alert) and ``SloWatchdog``
+  (burn-rate evaluation of latency objectives over the TTFT /
+  inter-token / queue-wait histograms) — alert gauges, flight-recorder
+  events, and the engine's degraded-``/healthz`` state.
 - **Exporters** (``exporters``): Prometheus text rendering, a
   stdlib-only ``/metrics`` + ``/healthz`` HTTP endpoint with
-  ``/debug/events`` + ``/debug/requests`` + ``/debug/trace`` routes,
-  and a bridge mirroring the registry into ``visualization``
-  TensorBoard writers.
+  ``/debug/events`` + ``/debug/requests`` + ``/debug/trace`` +
+  ``/debug/memory`` + ``/debug/profile`` routes, and a bridge
+  mirroring the registry into ``visualization`` TensorBoard writers.
 
 Wired through the stack: ``Optimizer``/``DistriOptimizer`` (step time,
 throughput, loss, lr, grad norm, JIT compiles, checkpoint latency),
@@ -64,9 +77,21 @@ from bigdl_tpu.observability.exporters import (
     render_prometheus, start_http_server, write_prometheus,
 )
 from bigdl_tpu.observability.instruments import (
-    OCCUPANCY_BUCKETS, OccupancyStats, TIME_BUCKETS, engine_instruments,
-    generation_instruments, parallel_instruments,
+    OCCUPANCY_BUCKETS, OccupancyStats, TIME_BUCKETS, bench_instruments,
+    engine_instruments, generation_instruments, memory_instruments,
+    parallel_instruments, serving_bench_instruments,
     serving_engine_instruments, serving_instruments, train_instruments,
+    watchdog_instruments,
+)
+from bigdl_tpu.observability.memory import (
+    DeviceMemoryMonitor, default_monitor, pool_sizes, register_pool,
+    register_owned_pools, static_pools, tree_bytes, unregister_pool,
+)
+from bigdl_tpu.observability.profiler import (
+    ProfilerBusy, ProfilerUnavailable, capture,
+)
+from bigdl_tpu.observability.watchdog import (
+    RecompileWatchdog, SloObjective, SloWatchdog,
 )
 
 __all__ = [
@@ -81,9 +106,15 @@ __all__ = [
     "MetricsHTTPServer", "PROMETHEUS_CONTENT_TYPE", "TensorBoardBridge",
     "render_prometheus", "start_http_server", "write_prometheus",
     "OCCUPANCY_BUCKETS", "OccupancyStats", "TIME_BUCKETS",
-    "engine_instruments", "generation_instruments",
-    "parallel_instruments", "serving_engine_instruments",
-    "serving_instruments", "train_instruments",
+    "bench_instruments", "engine_instruments", "generation_instruments",
+    "memory_instruments", "parallel_instruments",
+    "serving_bench_instruments", "serving_engine_instruments",
+    "serving_instruments", "train_instruments", "watchdog_instruments",
+    "DeviceMemoryMonitor", "default_monitor", "pool_sizes",
+    "register_pool", "register_owned_pools", "static_pools",
+    "tree_bytes", "unregister_pool",
+    "ProfilerBusy", "ProfilerUnavailable", "capture",
+    "RecompileWatchdog", "SloObjective", "SloWatchdog",
     "enable", "disable", "enabled",
 ]
 

@@ -118,7 +118,8 @@ class MetricsHTTPServer:
     falsy/raises). ``port=0`` binds an ephemeral port — read it back
     from ``.port``.
 
-    Three debug routes expose the request-scoped flight recorder:
+    Five debug routes expose the flight recorder and the resource
+    layer:
 
     - ``GET /debug/events[?n=256]`` — the recorder's newest events as
       JSON (``{"events": [...], "total": N}``).
@@ -129,6 +130,15 @@ class MetricsHTTPServer:
     - ``GET /debug/trace`` — the Chrome trace-event JSON of the span
       trees + recorder events (open it in Perfetto or
       ``chrome://tracing``).
+    - ``GET /debug/memory`` — the device-memory picture: per-device
+      HBM bytes in use / peak / limit / headroom plus per-pool byte
+      attribution and the high-watermark history
+      (``memory.DeviceMemoryMonitor.debug_memory``; defaults to the
+      process-default monitor).
+    - ``GET/POST /debug/profile?seconds=N`` — one bounded on-demand
+      ``jax.profiler`` capture; responds with the artifact directory
+      (501 when the backend cannot capture, 409 while another capture
+      is in flight).
 
     ``recorder``/``tracer`` default to the process defaults, resolved
     per request (a swapped default redirects the endpoints too)."""
@@ -137,7 +147,9 @@ class MetricsHTTPServer:
                  host: str = "0.0.0.0", port: int = 0,
                  healthz: Optional[Callable[[], object]] = None,
                  recorder=None, tracer=None,
-                 debug_requests: Optional[Callable[[], dict]] = None):
+                 debug_requests: Optional[Callable[[], dict]] = None,
+                 debug_memory: Optional[Callable[[], dict]] = None,
+                 profiler: Optional[Callable[[float], str]] = None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         from bigdl_tpu.observability import events as _events
@@ -152,6 +164,41 @@ class MetricsHTTPServer:
                 return tracer
             from bigdl_tpu.observability.tracing import trace
             return trace
+
+        def run_profile(query: str):
+            """Shared GET/POST body of ``/debug/profile``: one bounded
+            capture, returning (payload, status)."""
+            from urllib.parse import parse_qs
+
+            from bigdl_tpu.observability import profiler as _profiler
+
+            import math
+
+            try:
+                seconds = float(parse_qs(query).get("seconds",
+                                                    ["1.0"])[0])
+            except ValueError:
+                return {"error": "seconds must be a number"}, 400
+            if not math.isfinite(seconds) or seconds <= 0:
+                return {"error": "seconds must be a finite value > 0"
+                        }, 400
+            seconds = min(seconds, _profiler.MAX_SECONDS)
+            try:
+                fn = profiler or _profiler.capture
+                path = fn(seconds)
+                return {"artifact": path, "seconds": seconds}, 200
+            except _profiler.ProfilerUnavailable as e:
+                return {"error": str(e)}, 501
+            except _profiler.ProfilerBusy as e:
+                return {"error": str(e)}, 409
+            except Exception as e:
+                return {"error": str(e)}, 500
+
+        def run_debug_memory():
+            if debug_memory is not None:
+                return debug_memory()
+            from bigdl_tpu.observability.memory import default_monitor
+            return default_monitor().debug_memory()
 
         class Handler(BaseHTTPRequestHandler):
             def _send_json(self, payload, status: int = 200,
@@ -210,6 +257,14 @@ class MetricsHTTPServer:
                             download="bigdl_trace.json")
                     except Exception as e:
                         self._send_json({"error": str(e)}, status=500)
+                elif path == "/debug/memory":
+                    try:
+                        self._send_json(run_debug_memory())
+                    except Exception as e:
+                        self._send_json({"error": str(e)}, status=500)
+                elif path == "/debug/profile":
+                    payload, status = run_profile(query)
+                    self._send_json(payload, status=status)
                 elif path == "/healthz":
                     status, payload = 200, {"status": "ok"}
                     if healthz is not None:
@@ -230,6 +285,15 @@ class MetricsHTTPServer:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+            def do_POST(self):  # noqa: N802 (stdlib handler contract)
+                path, _, query = self.path.partition("?")
+                if path == "/debug/profile":
+                    payload, status = run_profile(query)
+                    self._send_json(payload, status=status)
                 else:
                     self.send_response(404)
                     self.end_headers()
@@ -262,13 +326,17 @@ def start_http_server(port: int = 0,
                       host: str = "0.0.0.0",
                       healthz: Optional[Callable[[], object]] = None,
                       recorder=None, tracer=None,
-                      debug_requests: Optional[Callable[[], dict]] = None
+                      debug_requests: Optional[Callable[[], dict]] = None,
+                      debug_memory: Optional[Callable[[], dict]] = None,
+                      profiler: Optional[Callable[[float], str]] = None
                       ) -> MetricsHTTPServer:
     """Convenience wrapper: start and return a MetricsHTTPServer."""
     return MetricsHTTPServer(registry=registry, host=host, port=port,
                              healthz=healthz, recorder=recorder,
                              tracer=tracer,
-                             debug_requests=debug_requests)
+                             debug_requests=debug_requests,
+                             debug_memory=debug_memory,
+                             profiler=profiler)
 
 
 # -------------------------------------------------------- TensorBoard bridge

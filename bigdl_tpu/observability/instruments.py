@@ -212,6 +212,11 @@ def serving_engine_instruments(service: str = "engine",
             "bigdl_serving_inter_token_seconds",
             "Per-slot gap between consecutive delivered tokens",
             labelnames=lbl, buckets=TIME_BUCKETS).labels(service),
+        queue_wait_seconds=r.histogram(
+            "bigdl_serving_queue_wait_seconds",
+            "Per-request wait from submit to admission (prefill "
+            "started) in the continuous-batching engine",
+            labelnames=lbl, buckets=TIME_BUCKETS).labels(service),
         jit_compiles=r.gauge(
             "bigdl_serving_jit_compiles",
             "Compiled executables across the engine's jitted programs "
@@ -274,6 +279,151 @@ class OccupancyStats:
         return {"served": served, "dispatches": disp,
                 "mean_batch_occupancy": round(served / disp, 3)
                 if disp else 0.0}
+
+
+def memory_instruments(registry: Optional[MetricRegistry] = None
+                       ) -> SimpleNamespace:
+    """Device-memory gauges fed by ``memory.DeviceMemoryMonitor`` —
+    per-device HBM accounting plus per-pool byte attribution (KV slot
+    pool, prefix-cache pool, staging cache, params, optimizer slots)."""
+    r = registry or default_registry()
+    dev = ("device",)
+    return SimpleNamespace(
+        bytes_in_use=r.gauge(
+            "bigdl_device_hbm_bytes_in_use",
+            "Device memory currently in use (backend memory_stats, or "
+            "live-array accounting where the backend reports none)",
+            labelnames=dev),
+        peak_bytes=r.gauge(
+            "bigdl_device_hbm_peak_bytes",
+            "Backend-reported peak device memory in use", labelnames=dev),
+        limit_bytes=r.gauge(
+            "bigdl_device_hbm_limit_bytes",
+            "Device memory capacity available to this process",
+            labelnames=dev),
+        headroom_bytes=r.gauge(
+            "bigdl_device_hbm_headroom_bytes",
+            "limit - bytes_in_use: how close the process is to an OOM",
+            labelnames=dev),
+        pool_bytes=r.gauge(
+            "bigdl_device_pool_bytes",
+            "Per-pool device-byte attribution (register_pool hooks: KV "
+            "slot pool, prefix-cache pool, prefill staging, model "
+            "params, optimizer slots, ...)", labelnames=("pool",)),
+    )
+
+
+def watchdog_instruments(registry: Optional[MetricRegistry] = None
+                         ) -> SimpleNamespace:
+    """Alert-state instruments shared by ``RecompileWatchdog`` and
+    ``SloWatchdog`` — the Prometheus side of ``stats()['alerts']``."""
+    r = registry or default_registry()
+    return SimpleNamespace(
+        alert_active=r.gauge(
+            "bigdl_watchdog_alert_active",
+            "1 while the named alert is firing, 0 otherwise (alert= "
+            "'recompile_storm' or 'slo:<objective>')",
+            labelnames=("alert", "service")),
+        alerts_fired=r.counter(
+            "bigdl_watchdog_alerts_fired_total",
+            "Alert activations (rising edges) per alert name",
+            labelnames=("alert", "service")),
+        recompile_growth=r.counter(
+            "bigdl_watchdog_recompile_growth_total",
+            "Watchdog samples that observed the compile counter grow "
+            "(warmup included; the storm alert only counts post-warmup "
+            "growth)", labelnames=("service",)),
+        slo_burn_rate=r.gauge(
+            "bigdl_watchdog_slo_burn_rate",
+            "Error-budget burn rate of the objective over its trailing "
+            "window (1.0 = spending budget exactly as fast as the "
+            "target allows)", labelnames=("objective", "service")),
+    )
+
+
+def bench_instruments(registry: Optional[MetricRegistry] = None
+                      ) -> SimpleNamespace:
+    """Headline-bench gauges (``bench.py``) — defined here so bench
+    snapshots and live scrapes share one schema and the metrics lint
+    can hold the line that no ``bigdl_*`` name is minted elsewhere."""
+    r = registry or default_registry()
+    lbl = ("model",)
+    return SimpleNamespace(
+        imgs_per_sec=r.gauge(
+            "bigdl_bench_imgs_per_sec_per_chip",
+            "Bench headline training throughput", labelnames=lbl),
+        ms_per_iter=r.gauge(
+            "bigdl_bench_ms_per_iter", "Bench per-iteration wall time",
+            labelnames=lbl),
+        mfu=r.gauge(
+            "bigdl_bench_mfu", "Bench model FLOPs utilization",
+            labelnames=lbl),
+        vs_baseline=r.gauge(
+            "bigdl_bench_vs_baseline",
+            "Headline vs the north-star baseline (>1.0 beats it)",
+            labelnames=lbl),
+        # zero-arg factory, NOT a bound gauge: an unlabeled gauge mints
+        # its series at registration and would render as a spurious 0
+        # in snapshots of runs that never measured it — mint only when
+        # a run actually sets it
+        lenet_epoch_seconds=lambda: r.gauge(
+            "bigdl_bench_lenet_mnist_epoch_seconds",
+            "LeNet-MNIST synthetic epoch wall clock"),
+    )
+
+
+def serving_bench_instruments(registry: Optional[MetricRegistry] = None
+                              ) -> SimpleNamespace:
+    """Serving-bench gauges (``bench.py --serving`` and
+    ``--shared-prefix``), keyed by a ``path`` label (engine /
+    generation_service, cached / uncached)."""
+    r = registry or default_registry()
+    lbl = ("path",)
+    return SimpleNamespace(
+        tokens_per_sec=r.gauge(
+            "bigdl_bench_serving_tokens_per_sec",
+            "Serving bench aggregate delivered tokens/sec",
+            labelnames=lbl),
+        latency_p50=r.gauge(
+            "bigdl_bench_serving_latency_p50_seconds",
+            "Serving bench per-request latency p50", labelnames=lbl),
+        latency_p99=r.gauge(
+            "bigdl_bench_serving_latency_p99_seconds",
+            "Serving bench per-request latency p99", labelnames=lbl),
+        ttft_p50=r.gauge(
+            "bigdl_bench_serving_ttft_p50_seconds",
+            "Serving bench time-to-first-token p50", labelnames=lbl),
+        ttft_p99_by_path=r.gauge(
+            "bigdl_bench_serving_ttft_p99_seconds_by_path",
+            "Serving bench time-to-first-token p99", labelnames=lbl),
+        inter_token_p99=r.gauge(
+            "bigdl_bench_serving_inter_token_p99_seconds",
+            "Serving bench per-request mean inter-token gap, p99 "
+            "across requests", labelnames=lbl),
+        # the unlabeled scalars below are zero-arg factories (see
+        # bench_instruments): each serving-bench VARIANT sets a
+        # different subset, and a gauge minted but never set would
+        # render as a spurious 0 in that run's snapshot
+        ttft_p99=lambda: r.gauge(
+            "bigdl_bench_serving_ttft_p99_seconds",
+            "Serving bench engine time-to-first-token p99"),
+        p99_speedup=lambda: r.gauge(
+            "bigdl_bench_serving_p99_speedup",
+            "Engine p99 latency speedup vs GenerationService (> 1.0: "
+            "engine tail shorter)"),
+        prefix_ttft_p50_speedup=lambda: r.gauge(
+            "bigdl_bench_serving_prefix_ttft_p50_speedup",
+            "Cached-vs-uncached engine TTFT p50 speedup on the shared-"
+            "prefix workload (>1.0: the prefix cache pays for itself)"),
+        prefix_hit_rate=lambda: r.gauge(
+            "bigdl_bench_serving_prefix_hit_rate",
+            "Prefix-cache hit rate over the shared-prefix bench "
+            "workload"),
+        prefix_reused_fraction=lambda: r.gauge(
+            "bigdl_bench_serving_prefix_reused_fraction",
+            "Fraction of prompt tokens served from the prefix cache "
+            "instead of prefilled"),
+    )
 
 
 def engine_instruments(registry: Optional[MetricRegistry] = None

@@ -476,14 +476,24 @@ class LocalOptimizer(Optimizer):
         data_iter = self._prepared_batches()
         wall_start = time.time()
 
-        try:
-            return self._optimize_loop(
-                model, state, params, buffers, ts, slots, train_step,
-                num_samples, data_iter, wall_start)
-        finally:
-            # even on an exception mid-training, never abandon an in-flight
-            # async checkpoint write (the one run where it matters most)
-            self.join_pending_checkpoint()
+        # /debug/memory attribution: params and optimizer slots are the
+        # training run's two big persistent buffer sets (sizes are
+        # shape-derived constants). The context manager unregisters on
+        # EVERY exit — including a join_pending_checkpoint re-raise.
+        from bigdl_tpu.observability import memory as obs_memory
+
+        with obs_memory.static_pools({
+                "train/params": obs_memory.tree_bytes(params),
+                "train/optimizer_slots": obs_memory.tree_bytes(slots)}):
+            try:
+                return self._optimize_loop(
+                    model, state, params, buffers, ts, slots, train_step,
+                    num_samples, data_iter, wall_start)
+            finally:
+                # even on an exception mid-training, never abandon an
+                # in-flight async checkpoint write (the one run where
+                # it matters most)
+                self.join_pending_checkpoint()
 
     def _batch_stream(self):
         """Infinite minibatch stream with PRODUCER-side epoch reshuffles.

@@ -476,130 +476,140 @@ class DistriOptimizer(LocalOptimizer):
             self._restored_slots = None
             flat = None
 
-        num_samples = self.dataset.size()
+        # /debug/memory attribution for the distributed run: the
+        # replicated/sharded params and the optimizer slot tree
+        # (shape-derived constant sizes; unregistered fn-guarded on
+        # EVERY exit — a crashed run must not leave stale pool sizes
+        # misattributing freed HBM).
+        from bigdl_tpu.observability import memory as obs_memory
 
-        def prepare(batch):
-            # host stack + divisibility check + sharded H2D, all on the
-            # prefetch thread so they overlap the device step
-            x = np.asarray(batch.get_input())
-            y = np.asarray(batch.get_target())
-            if (x.shape[0] * nproc) % n_data != 0:
-                raise ValueError(
-                    f"global batch {x.shape[0] * nproc} must divide mesh "
-                    f"data axis {n_data} (≙ batch divisibility invariant, "
-                    "SURVEY.md Appendix B.2)")
-            return (self._to_global(x, data_sharding),
-                    self._to_global(y, data_sharding), batch.size())
+        with obs_memory.static_pools({
+                "train/params": obs_memory.tree_bytes(params),
+                "train/optimizer_slots": obs_memory.tree_bytes(slots)}):
+            num_samples = self.dataset.size()
 
-        data_iter = self._prepared_batches(prepare)
-        wall_start = time.time()
-        # windowed throughput accounting: no per-step device→host sync —
-        # loss is fetched only at log/aux points (VERDICT round-1 weak #3;
-        # XLA's async dispatch pipelines the intervening steps)
-        window_records = 0
-        window_iters = 0
-        window_start = time.time()
-        loss = None
-        from bigdl_tpu import observability as obs
+            def prepare(batch):
+                # host stack + divisibility check + sharded H2D, all on the
+                # prefetch thread so they overlap the device step
+                x = np.asarray(batch.get_input())
+                y = np.asarray(batch.get_target())
+                if (x.shape[0] * nproc) % n_data != 0:
+                    raise ValueError(
+                        f"global batch {x.shape[0] * nproc} must divide mesh "
+                        f"data axis {n_data} (≙ batch divisibility invariant, "
+                        "SURVEY.md Appendix B.2)")
+                return (self._to_global(x, data_sharding),
+                        self._to_global(y, data_sharding), batch.size())
 
-        obs_on = obs.enabled()
-        ins = obs.train_instruments() if obs_on else None
-        host = str(jax.process_index())
-        pins = obs.parallel_instruments() if obs_on else None
+            data_iter = self._prepared_batches(prepare)
+            wall_start = time.time()
+            # windowed throughput accounting: no per-step device→host sync —
+            # loss is fetched only at log/aux points (VERDICT round-1 weak #3;
+            # XLA's async dispatch pipelines the intervening steps)
+            window_records = 0
+            window_iters = 0
+            window_start = time.time()
+            loss = None
+            from bigdl_tpu import observability as obs
 
-        while not self.end_when(state):
-            x, y, n_local = next(data_iter)
-            if ts is not None:
-                lrs = ts.current_lrs()
-                lr = float(lrs[0])
-            else:
-                lr = method.get_current_rate()
-                lrs = jnp.asarray(lr, jnp.float32)
-            rng = bt_random.next_key()
-            with obs.trace.span("train/step"):
-                if self.parameter_sync == "sharded":
-                    loss, params, buffers, flat, slots = step(
-                        params, buffers, flat, slots, x, y, lrs, rng)
+            obs_on = obs.enabled()
+            ins = obs.train_instruments() if obs_on else None
+            host = str(jax.process_index())
+            pins = obs.parallel_instruments() if obs_on else None
+
+            while not self.end_when(state):
+                x, y, n_local = next(data_iter)
+                if ts is not None:
+                    lrs = ts.current_lrs()
+                    lr = float(lrs[0])
                 else:
-                    loss, params, buffers, slots = step(
-                        params, buffers, slots, x, y, lrs, rng)
-            self._live_slots = slots
-            if self._fault_hook is not None:
-                self._fault_hook(state)
-            n = n_local * nproc  # global records this iteration
-            state["recordsProcessedThisEpoch"] += n
-            state["LearningRate"] = lr
-            window_records += n
-            window_iters += 1
-            state["neval"] += 1
-            aux_now = self._should_fire_aux(state)
-            log_now = (state["neval"] - 1) % self.log_interval == 0
-            if log_now or aux_now:
-                loss_v = float(loss)  # the only host sync in the loop
-                dt = time.time() - window_start
-                state["Loss"] = loss_v
-                self.metrics.add("computing time", dt * 1e9)
-                if obs_on:
-                    ins.records_total.inc(window_records)
-                    ins.throughput.set(window_records / max(dt, 1e-9))
-                    ins.loss.set(loss_v)
-                    ins.learning_rate.set(lr)
-                    ins.epoch.set(state["epoch"])
-                    cache_size = getattr(step, "_cache_size", None)
-                    if cache_size is not None:
-                        ins.jit_compiles.set(cache_size())
-                    # per-host SPMD timings: the whole pipelined window,
-                    # and its per-iteration average (the step-time proxy
-                    # when dispatch overlaps host work)
-                    pins.sync_window_seconds.labels(host).observe(dt)
-                    pins.step_seconds.labels(host).observe(
-                        dt / max(window_iters, 1))
-                logger.info(
-                    "[Epoch %d %d/%d][Iteration %d][Wall Clock %.3fs] "
-                    "Trained %d records in %.4f seconds. "
-                    "Throughput is %.1f records/second. Loss is %.4f.",
-                    state["epoch"], state["recordsProcessedThisEpoch"],
-                    num_samples, state["neval"] - 1, time.time() - wall_start,
-                    window_records, dt, window_records / max(dt, 1e-9), loss_v)
-                if self.train_summary is not None:
-                    it = state["neval"] - 1
-                    self.train_summary.add_scalar("Loss", loss_v, it)
-                    self.train_summary.add_scalar("LearningRate", lr, it)
-                    self.train_summary.add_scalar(
-                        "Throughput", window_records / max(dt, 1e-9), it)
-                window_records = 0
-                window_iters = 0
-                window_start = time.time()
-            if state["recordsProcessedThisEpoch"] >= num_samples:
-                state["epoch"] += 1
-                state["recordsProcessedThisEpoch"] = 0
-                # reshuffle + restart happen inside _batch_stream (producer
-                # side, ordered ahead of the prefetched batches)
-            if ts is not None:
-                kv = dict(neval=state["neval"], epoch=state["epoch"])
-                if "Loss" in state:
-                    kv["Loss"] = state["Loss"]
-                ts.update_states(**kv)
-            if aux_now:
-                # NOTE (Appendix B.5 contract decision): the reference
-                # validates with start-of-iteration weights; this build
-                # validates with the just-updated weights — strictly
-                # fresher, documented as an intentional deviation.
-                model.load_params_dict(params)
-                model.load_buffers_dict(buffers_for_model(buffers))
-                with obs.trace.span("train/validation"):
-                    self._run_validation(state)
-                ck_hist = (ins.checkpoint_seconds
-                           if obs_on and self._ckpt_now
-                           and self.checkpoint_path is not None else None)
-                with obs.trace.span("train/checkpoint", histogram=ck_hist):
-                    self._run_checkpoint(state)
+                    lr = method.get_current_rate()
+                    lrs = jnp.asarray(lr, jnp.float32)
+                rng = bt_random.next_key()
+                with obs.trace.span("train/step"):
+                    if self.parameter_sync == "sharded":
+                        loss, params, buffers, flat, slots = step(
+                            params, buffers, flat, slots, x, y, lrs, rng)
+                    else:
+                        loss, params, buffers, slots = step(
+                            params, buffers, slots, x, y, lrs, rng)
+                self._live_slots = slots
+                if self._fault_hook is not None:
+                    self._fault_hook(state)
+                n = n_local * nproc  # global records this iteration
+                state["recordsProcessedThisEpoch"] += n
+                state["LearningRate"] = lr
+                window_records += n
+                window_iters += 1
+                state["neval"] += 1
+                aux_now = self._should_fire_aux(state)
+                log_now = (state["neval"] - 1) % self.log_interval == 0
+                if log_now or aux_now:
+                    loss_v = float(loss)  # the only host sync in the loop
+                    dt = time.time() - window_start
+                    state["Loss"] = loss_v
+                    self.metrics.add("computing time", dt * 1e9)
+                    if obs_on:
+                        ins.records_total.inc(window_records)
+                        ins.throughput.set(window_records / max(dt, 1e-9))
+                        ins.loss.set(loss_v)
+                        ins.learning_rate.set(lr)
+                        ins.epoch.set(state["epoch"])
+                        cache_size = getattr(step, "_cache_size", None)
+                        if cache_size is not None:
+                            ins.jit_compiles.set(cache_size())
+                        # per-host SPMD timings: the whole pipelined window,
+                        # and its per-iteration average (the step-time proxy
+                        # when dispatch overlaps host work)
+                        pins.sync_window_seconds.labels(host).observe(dt)
+                        pins.step_seconds.labels(host).observe(
+                            dt / max(window_iters, 1))
+                    logger.info(
+                        "[Epoch %d %d/%d][Iteration %d][Wall Clock %.3fs] "
+                        "Trained %d records in %.4f seconds. "
+                        "Throughput is %.1f records/second. Loss is %.4f.",
+                        state["epoch"], state["recordsProcessedThisEpoch"],
+                        num_samples, state["neval"] - 1, time.time() - wall_start,
+                        window_records, dt, window_records / max(dt, 1e-9), loss_v)
+                    if self.train_summary is not None:
+                        it = state["neval"] - 1
+                        self.train_summary.add_scalar("Loss", loss_v, it)
+                        self.train_summary.add_scalar("LearningRate", lr, it)
+                        self.train_summary.add_scalar(
+                            "Throughput", window_records / max(dt, 1e-9), it)
+                    window_records = 0
+                    window_iters = 0
+                    window_start = time.time()
+                if state["recordsProcessedThisEpoch"] >= num_samples:
+                    state["epoch"] += 1
+                    state["recordsProcessedThisEpoch"] = 0
+                    # reshuffle + restart happen inside _batch_stream (producer
+                    # side, ordered ahead of the prefetched batches)
+                if ts is not None:
+                    kv = dict(neval=state["neval"], epoch=state["epoch"])
+                    if "Loss" in state:
+                        kv["Loss"] = state["Loss"]
+                    ts.update_states(**kv)
+                if aux_now:
+                    # NOTE (Appendix B.5 contract decision): the reference
+                    # validates with start-of-iteration weights; this build
+                    # validates with the just-updated weights — strictly
+                    # fresher, documented as an intentional deviation.
+                    model.load_params_dict(params)
+                    model.load_buffers_dict(buffers_for_model(buffers))
+                    with obs.trace.span("train/validation"):
+                        self._run_validation(state)
+                    ck_hist = (ins.checkpoint_seconds
+                               if obs_on and self._ckpt_now
+                               and self.checkpoint_path is not None else None)
+                    with obs.trace.span("train/checkpoint", histogram=ck_hist):
+                        self._run_checkpoint(state)
 
-        if obs_on and window_records:
-            # the partial window between the last log sync and loop exit
-            # still counts toward the records counter
-            ins.records_total.inc(window_records)
-        model.load_params_dict(params)
-        model.load_buffers_dict(buffers_for_model(buffers))
-        self.join_pending_checkpoint()
-        return model
+            if obs_on and window_records:
+                # the partial window between the last log sync and loop exit
+                # still counts toward the records counter
+                ins.records_total.inc(window_records)
+            model.load_params_dict(params)
+            model.load_buffers_dict(buffers_for_model(buffers))
+            self.join_pending_checkpoint()
+            return model

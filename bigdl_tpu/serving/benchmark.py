@@ -53,6 +53,15 @@ def _percentiles(xs) -> dict:
             "p99": round(float(np.percentile(xs, 99)), 6)}
 
 
+def _append_itl(itl: List[float], handle) -> None:
+    """Record the request's mean inter-token gap (decode wall time over
+    the decoded-token count) — the per-request figure whose p99 the
+    perf gate tracks next to TTFT."""
+    tl = handle.timeline()
+    if tl["decode_s"] is not None and tl["tokens"] > 1:
+        itl.append(tl["decode_s"] / (tl["tokens"] - 1))
+
+
 def _replay(workload, submit_fn, collect_fn) -> dict:
     """Open-loop replay: a pacer thread submits each request at its
     arrival offset (late submissions go immediately — arrival times are
@@ -170,6 +179,7 @@ def run_shared_prefix_comparison(model, n_requests: int = 24,
             prefill_rows=prefill_rows, eos_id=eos_id,
             registry=registry, service_name=name, **engine_kw)
         ttft: List[float] = []
+        itl: List[float] = []
         rows: dict = {}
         tlock = threading.Lock()
 
@@ -180,6 +190,7 @@ def run_shared_prefix_comparison(model, n_requests: int = 24,
                 if handle.first_token_at is not None:
                     ttft.append(handle.first_token_at
                                 - handle.submitted_at)
+                _append_itl(itl, handle)
             return row.shape[0] - req["prompt"].shape[0]
 
         log(f"[serving-bench] shared-prefix replay ({name})...")
@@ -191,8 +202,11 @@ def run_shared_prefix_comparison(model, n_requests: int = 24,
             res = _replay(
                 wl, lambda req: engine.submit(req["prompt"], req["n"]),
                 collect)
+            stats = engine.stats()
         res["ttft"] = _percentiles(ttft)
-        res["prefix_cache"] = engine.stats()["prefix_cache"]
+        res["inter_token"] = _percentiles(itl)
+        res["prefix_cache"] = stats["prefix_cache"]
+        res["alerts"] = stats["alerts"]
         res["rows"] = rows
         return res
 
@@ -243,13 +257,15 @@ def run_poisson_comparison(model, n_requests: int = 16,
         model, max_slots=max_slots, prefill_chunk=prefill_chunk,
         eos_id=eos_id, registry=registry, service_name="bench_engine")
     ttft: List[float] = []
+    itl: List[float] = []
     tlock = threading.Lock()
 
     def collect_engine(handle, req):
         row = handle.result()
-        if handle.first_token_at is not None:
-            with tlock:
+        with tlock:
+            if handle.first_token_at is not None:
                 ttft.append(handle.first_token_at - handle.submitted_at)
+            _append_itl(itl, handle)
         return row.shape[0] - req["prompt"].shape[0]
 
     log("[serving-bench] engine replay...")
@@ -257,7 +273,9 @@ def run_poisson_comparison(model, n_requests: int = 16,
         eng = _replay(
             wl, lambda req: engine.submit(req["prompt"], req["n"]),
             collect_engine)
+        eng["alerts"] = engine.stats()["alerts"]
     eng["ttft"] = _percentiles(ttft)
+    eng["inter_token"] = _percentiles(itl)
 
     svc = GenerationService(model, max_batch=max_batch,
                             batch_timeout_ms=batch_timeout_ms,

@@ -160,6 +160,19 @@ class ContinuousBatchingEngine:
     crash writes a postmortem JSON (``postmortem_path`` /
     ``$BIGDL_POSTMORTEM_PATH``, default ``bigdl_postmortem.json``)
     before failing the handles.
+
+    RESOURCE OBSERVABILITY: the engine registers its persistent device
+    buffers (KV slot pool, prefill staging, prefix pool + occupied
+    prefix bytes, params) as named memory pools
+    (``observability.memory.register_pool``) so ``/debug/memory``
+    attributes HBM by owner; a ``RecompileWatchdog`` samples the
+    compile counter every iteration (post-warmup growth — a shape leak
+    — raises the recompile-storm alert), and ``slo_objectives`` (a
+    list of ``observability.SloObjective`` or kwargs dicts, bound to
+    the ``ttft`` / ``inter_token`` / ``queue_wait`` histograms by
+    their ``metric`` field) drives an ``SloWatchdog``. Active alerts
+    surface in ``stats()["alerts"]`` and flip the ``/healthz`` body to
+    ``status: degraded`` while staying HTTP 200.
     """
 
     def __init__(self, model, max_slots: int = 4,
@@ -176,10 +189,15 @@ class ContinuousBatchingEngine:
                  prefix_cache_bytes: Optional[int] = None,
                  prefix_cache_rows: Optional[int] = None,
                  prefix_min_tokens: Optional[int] = None,
-                 admission_window: int = 4):
+                 admission_window: int = 4,
+                 slo_objectives=None):
         from bigdl_tpu.models.transformer import _validate_sampling
         from bigdl_tpu.observability import serving_engine_instruments
+        from bigdl_tpu.observability import memory as obs_memory
         from bigdl_tpu.observability.events import default_recorder
+        from bigdl_tpu.observability.watchdog import (
+            RecompileWatchdog, SloObjective, SloWatchdog,
+        )
 
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
@@ -276,15 +294,60 @@ class ContinuousBatchingEngine:
         self._warm = set()
         self._build_fns()
 
-        self._queue = AdmissionQueue(queue_capacity,
-                                     recorder=self._rec)
+        self._ins = serving_engine_instruments(service_name, registry)
+        self._queue = AdmissionQueue(
+            queue_capacity, recorder=self._rec,
+            wait_histogram=self._ins.queue_wait_seconds)
         self._slots: List[Optional[_SlotState]] = [None] * max_slots
         self._adms: List[_Admission] = []
         self._key = jax.random.PRNGKey(seed)
         self._zero_key = jax.random.PRNGKey(0)
 
-        self._ins = serving_engine_instruments(service_name, registry)
         self._ins.slots.set(max_slots, force=True)
+
+        # ---- resource observability -----------------------------------
+        # per-pool HBM attribution: every persistent device buffer set
+        # this engine owns, registered under weakrefs (the monitor must
+        # never keep a dead engine's KV pools alive). Names are keyed
+        # by service_name; a same-named successor engine takes them over.
+        pools = {
+            f"serving/{service_name}/kv_slots":
+                lambda e: obs_memory.tree_bytes(e._caches),
+            f"serving/{service_name}/prefill_staging":
+                lambda e: obs_memory.tree_bytes(e._staging),
+            f"serving/{service_name}/params":
+                lambda e: obs_memory.tree_bytes(e._params),
+        }
+        if self._pool is not None:
+            pools[f"serving/{service_name}/prefix_pool"] = \
+                lambda e: obs_memory.tree_bytes(e._pool)
+        self._memory_pools = obs_memory.register_owned_pools(self, pools)
+        if self._prefix is not None:
+            self._memory_pools.append(self._prefix.register_memory_pool(
+                f"serving/{service_name}/prefix_kv_in_use"))
+
+        # watchdogs, sampled once per loop iteration: compiles that keep
+        # growing after warmup break the engine's shape-stability
+        # contract (storm alert); SLO objectives burn against the TTFT /
+        # inter-token / queue-wait histograms. Alerts surface through
+        # stats()["alerts"] and a degraded (but 200) /healthz body.
+        self._recompile_wd = RecompileWatchdog(
+            self._compile_total, service=service_name,
+            registry=registry, recorder=self._rec)
+        self._slo_wd = SloWatchdog(service=service_name,
+                                   registry=registry, recorder=self._rec)
+        slo_children = {"ttft": self._ins.ttft_seconds,
+                        "inter_token": self._ins.inter_token_seconds,
+                        "queue_wait": self._ins.queue_wait_seconds}
+        for obj in (slo_objectives or ()):
+            if isinstance(obj, dict):
+                obj = SloObjective(**obj)
+            if obj.metric not in slo_children:
+                raise ValueError(
+                    f"SloObjective {obj.name!r} names unknown engine "
+                    f"metric {obj.metric!r}; expected one of "
+                    f"{sorted(slo_children)}")
+            self._slo_wd.watch(obj, slo_children[obj.metric])
         # stats() reports the DELTA since construction (the same
         # registry-façade convention as OccupancyStats): two engines
         # sharing a service_name share the series, so each instance
@@ -559,6 +622,18 @@ class ContinuousBatchingEngine:
         out["jit_compiles"] = self._compile_total()
         out["latency"] = self._latency_summary()
         out["prefix_cache"] = self._prefix_summary()
+        out["alerts"] = self.alerts()
+        return out
+
+    def alerts(self) -> List[dict]:
+        """The active watchdog alerts (recompile storm, SLO burns) as
+        plain dicts — empty while the engine is healthy. The same list
+        rides in ``stats()["alerts"]`` and the ``/healthz`` body."""
+        out = []
+        storm = self._recompile_wd.alert()
+        if storm is not None:
+            out.append(storm)
+        out.extend(self._slo_wd.alerts())
         return out
 
     def _prefix_summary(self) -> dict:
@@ -591,17 +666,25 @@ class ContinuousBatchingEngine:
         status dict while the engine is serviceable, raising
         ``EngineStopped`` once the loop thread has crashed — the
         endpoint then flips to 503 instead of reporting a dead decode
-        loop as healthy."""
+        loop as healthy. While a watchdog alert is active the body
+        carries ``status: degraded`` plus the alert list — still HTTP
+        200 (the engine serves; 503 remains the crashed-loop signal),
+        so orchestrators keep routing while operators see the fire."""
         if self._crashed is not None:
             raise EngineStopped(
                 f"engine loop crashed: {self._crashed!r}"
             ) from self._crashed
+        alerts = self.alerts()
         return {
+            # always present: direct callers key on it, not only the
+            # HTTP handler (which would merge in an "ok" of its own)
+            "status": "degraded" if alerts else "ok",
             "engine": self.service_name,
             "loop_alive": bool(self._thread is not None
                                and self._thread.is_alive()),
             "active_slots": sum(s is not None for s in self._slots),
             "queue_depth": len(self._queue),
+            "alerts": alerts,
         }
 
     def debug_requests(self) -> dict:
@@ -650,7 +733,8 @@ class ContinuousBatchingEngine:
                 "in_flight": in_flight,
                 "recent": recent,
                 "latency": self._latency_summary(),
-                "prefix_cache": self._prefix_summary()}
+                "prefix_cache": self._prefix_summary(),
+                "alerts": self.alerts()}
 
     # ------------------------------------------------------- loop body
     def _loop(self):
@@ -788,11 +872,14 @@ class ContinuousBatchingEngine:
             self._decode_all(active)
             worked = True
 
-        # 5. load gauges
+        # 5. load gauges + watchdog sampling (one probe read and one
+        #    histogram snapshot per objective — iteration-rate cheap)
         ins = self._ins
         ins.active_slots.set(sum(s is not None for s in self._slots))
         ins.queue_depth.set(len(self._queue))
         ins.jit_compiles.set(self._compile_total())
+        self._recompile_wd.sample()
+        self._slo_wd.sample()
         return worked
 
     # ------------------------------------------------ admission stages

@@ -355,6 +355,21 @@ class PrefixCache:
             else:
                 break
 
+    # --------------------------------------------------- memory account
+    def register_memory_pool(self, name: str) -> str:
+        """Register this cache's OCCUPIED pool bytes as a named device-
+        memory pool (``observability.memory``) — the `/debug/memory`
+        attribution line that separates "prefix KV actually retained"
+        from the pool's fixed capacity (which the engine registers
+        alongside). Weakly referenced: the registration never keeps the
+        cache (or, transitively, its engine) alive. Returns the pool
+        name (the unregistration token)."""
+        from bigdl_tpu.observability import memory as obs_memory
+
+        names = obs_memory.register_owned_pools(
+            self, {name: lambda c: c.bytes_in_use})
+        return names[0]
+
     # ------------------------------------------------------------ stats
     def stats(self) -> dict:
         """Operational snapshot: occupancy, byte budget, and cumulative

@@ -45,12 +45,18 @@ class AdmissionQueue:
     terminal bookkeeping (metrics, stream sentinels) stays in one
     place."""
 
-    def __init__(self, capacity: int = 64, recorder=None):
+    def __init__(self, capacity: int = 64, recorder=None,
+                 wait_histogram=None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = capacity
         self._q: "deque[RequestHandle]" = deque()
         self._lock = threading.Condition()
+        #: optional histogram child observing each popped handle's
+        #: submit→admission wait (the engine binds
+        #: bigdl_serving_queue_wait_seconds — the queue-wait series the
+        #: SLO watchdog burns against)
+        self._wait_hist = wait_histogram
         # queue transitions land in the flight recorder (request/queued
         # on put, request/queue_dropped for sweep/pop casualties) so a
         # request's timeline starts before it ever reaches a slot
@@ -154,6 +160,9 @@ class AdmissionQueue:
                     err = self._terminal(h, now)
                     if err is None:
                         self._head_bypasses = 0
+                        if self._wait_hist is not None:
+                            self._wait_hist.observe(
+                                max(0.0, now - h.submitted_at))
                         self._lock.notify_all()
                         return h, dropped
                     dropped.append((h, err))
@@ -182,6 +191,9 @@ class AdmissionQueue:
             for h in reversed(live):
                 if h is not pick:
                     self._q.appendleft(h)
+            if self._wait_hist is not None:
+                self._wait_hist.observe(
+                    max(0.0, now - pick.submitted_at))
             self._lock.notify_all()
             return pick, dropped
 

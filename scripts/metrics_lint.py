@@ -1,0 +1,90 @@
+#!/usr/bin/env python3
+"""CI lint: every ``bigdl_*`` metric name is minted in ONE place.
+
+``bigdl_tpu/observability/instruments.py`` is the canonical schema —
+one module defines every ``bigdl_*`` metric name, type, help string,
+and bucket layout, so live scrapes, bench snapshots, and dashboards
+can never drift apart. This lint greps the tree for registration
+calls (``.counter("bigdl_...")`` / ``.gauge(...)`` /
+``.histogram(...)``) OUTSIDE that module and fails (exit 1) when it
+finds one — the fix is always to add an ``*_instruments`` entry and
+call it.
+
+Scopes deliberately skipped: ``tests/`` (tests mint throwaway names
+against throwaway registries), ``docs/`` (examples use ``myapp_*``),
+and build/VCS droppings. Stdlib only — runnable from any CI step
+without the package installed; ``tests/test_resource_observability.py``
+wires it as a tier-1 test.
+
+Usage::
+
+    python scripts/metrics_lint.py [--root REPO_ROOT]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+#: the one module allowed to register bigdl_* instruments
+ALLOWED = ("bigdl_tpu", "observability", "instruments.py")
+
+SKIP_DIRS = {".git", "__pycache__", "build", "dist", "docs", "tests",
+             ".eggs", "bigdl_tpu.egg-info", "native", "docker"}
+
+# a registration call with a bigdl_* name literal as its first
+# argument; assembled from pieces so this file never matches itself
+_PATTERN = re.compile(
+    r"\.\s*(counter|gauge|histogram)\s*\(\s*"   # .counter( / .gauge( /...
+    r"[\"']" + "(bigdl" + r"_[A-Za-z0-9_:]*)[\"']",
+    re.S)
+
+
+def lint(root: str):
+    """Yield (path, lineno, method, metric_name) violations."""
+    allowed = os.path.join(root, *ALLOWED)
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
+        for fname in filenames:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            if os.path.abspath(path) == os.path.abspath(allowed):
+                continue
+            try:
+                with open(path, encoding="utf-8") as f:
+                    text = f.read()
+            except (OSError, UnicodeDecodeError):
+                continue
+            for m in _PATTERN.finditer(text):
+                lineno = text.count("\n", 0, m.start()) + 1
+                yield (os.path.relpath(path, root), lineno,
+                       m.group(1), m.group(2))
+
+
+def main(argv=None) -> int:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = argparse.ArgumentParser(
+        description="Fail when a bigdl_* metric is registered outside "
+                    "observability/instruments.py.")
+    p.add_argument("--root", default=here)
+    args = p.parse_args(argv)
+
+    violations = list(lint(args.root))
+    for path, lineno, method, name in violations:
+        print(f"[metrics-lint] {path}:{lineno}: .{method}({name!r}) — "
+              f"bigdl_* metrics must be defined in "
+              f"{'/'.join(ALLOWED)} (add an *_instruments entry)")
+    if violations:
+        print(f"[metrics-lint] FAIL: {len(violations)} out-of-place "
+              "registration(s)")
+        return 1
+    print("[metrics-lint] ok: all bigdl_* metrics registered in "
+          + "/".join(ALLOWED))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

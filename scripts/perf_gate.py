@@ -3,16 +3,20 @@
 
 Reads the TWO newest *comparable* serving rows (same metric, same
 workload signature — request count, arrival rate, template config) and
-fails (exit 1) when the newer row's p99 TTFT regressed by more than
-``--threshold`` (default 20%) against the previous one. Anything that
-prevents a comparison — no history, a single row, unparsable lines,
-rows without a TTFT — exits 0 with an explanation: the gate blocks
-measured regressions, it never blocks the first run of a new workload.
+fails (exit 1) when the newer row regressed by more than
+``--threshold`` (default 20%) against the previous one on EITHER gated
+latency: p99 TTFT, or p99 inter-token latency (the per-request mean
+decode gap — the steady-state streaming experience TTFT cannot see).
+Anything that prevents a comparison — no history, a single row,
+unparsable lines, rows without the measurement — exits 0 with an
+explanation: the gate blocks measured regressions, it never blocks the
+first run of a new workload, and rows predating the inter-token field
+gate on TTFT alone.
 
-Serving rows come from ``bench.py --serving`` (p99 TTFT under
-``detail.engine.ttft.p99``) and ``bench.py --serving --shared-prefix``
-(``detail.cached.ttft.p99``); both shapes are understood. Stdlib only —
-runnable from any CI step without the package installed.
+Serving rows come from ``bench.py --serving`` (percentiles under
+``detail.engine.{ttft,inter_token}.p99``) and ``bench.py --serving
+--shared-prefix`` (``detail.cached.*``); both shapes are understood.
+Stdlib only — runnable from any CI step without the package installed.
 
 Usage::
 
@@ -32,16 +36,26 @@ import sys
 _TTFT_PATHS = ("engine", "cached")
 
 
-def ttft_p99(row: dict):
-    """The row's p99 TTFT in seconds, or None when the row carries no
-    TTFT measurement (training rows, failed runs)."""
+def _p99(row: dict, measure: str):
     detail = row.get("detail") or {}
     for key in _TTFT_PATHS:
         block = detail.get(key) or {}
-        p99 = (block.get("ttft") or {}).get("p99")
+        p99 = (block.get(measure) or {}).get("p99")
         if p99 is not None:
             return float(p99)
     return None
+
+
+def ttft_p99(row: dict):
+    """The row's p99 TTFT in seconds, or None when the row carries no
+    TTFT measurement (training rows, failed runs)."""
+    return _p99(row, "ttft")
+
+
+def inter_token_p99(row: dict):
+    """The row's p99 per-request mean inter-token gap in seconds, or
+    None (rows predating the measurement, training rows)."""
+    return _p99(row, "inter_token")
 
 
 def signature(row: dict):
@@ -110,18 +124,29 @@ def main(argv=None) -> int:
               "passes")
         return 0
 
-    new_p99, old_p99 = ttft_p99(newest), ttft_p99(prev)
-    ratio = new_p99 / old_p99 if old_p99 else float("inf")
-    verdict = (f"p99 TTFT {old_p99 * 1e3:.2f}ms -> {new_p99 * 1e3:.2f}ms "
-               f"({ratio:.3f}x) for {newest.get('metric')} "
-               f"[{prev.get('ts', '?')} -> {newest.get('ts', '?')}]")
-    if ratio > 1.0 + args.threshold:
-        print(f"[perf-gate] FAIL: {verdict} exceeds the "
-              f"+{args.threshold:.0%} budget")
-        return 1
-    print(f"[perf-gate] ok: {verdict} within the "
-          f"+{args.threshold:.0%} budget")
-    return 0
+    span = f"[{prev.get('ts', '?')} -> {newest.get('ts', '?')}]"
+    failed = False
+    for label, reader in (("p99 TTFT", ttft_p99),
+                          ("p99 inter-token", inter_token_p99)):
+        new_p99, old_p99 = reader(newest), reader(prev)
+        if new_p99 is None or old_p99 is None:
+            # older rows predate the inter-token field: gate on what
+            # both rows actually measured
+            print(f"[perf-gate] skip: {label} absent from one of the "
+                  f"compared rows {span}")
+            continue
+        ratio = new_p99 / old_p99 if old_p99 else float("inf")
+        verdict = (f"{label} {old_p99 * 1e3:.2f}ms -> "
+                   f"{new_p99 * 1e3:.2f}ms ({ratio:.3f}x) for "
+                   f"{newest.get('metric')} {span}")
+        if ratio > 1.0 + args.threshold:
+            print(f"[perf-gate] FAIL: {verdict} exceeds the "
+                  f"+{args.threshold:.0%} budget")
+            failed = True
+        else:
+            print(f"[perf-gate] ok: {verdict} within the "
+                  f"+{args.threshold:.0%} budget")
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":

@@ -3,6 +3,7 @@ Prometheus rendering, the /metrics endpoint against a live
 GenerationService, and the Optimizer integration."""
 
 import json
+import re
 import threading
 import urllib.request
 
@@ -219,6 +220,92 @@ def test_label_escaping(reg):
     line = [l for l in obs.render_prometheus(reg).splitlines()
             if l.startswith("esc{")][0]
     assert line == 'esc{v="a\\"b\\\\c\\nd"} 1'
+
+
+def _unescape_label(s: str) -> str:
+    """Decode a label value per the exposition format (the scraper's
+    side of the contract: \\\\ -> \\, \\" -> ", \\n -> newline)."""
+    out, i = [], 0
+    while i < len(s):
+        c = s[i]
+        if c == "\\":
+            nxt = s[i + 1]  # a trailing lone backslash would be a bug
+            out.append({"\\": "\\", '"': '"', "n": "\n"}[nxt])
+            i += 2
+        else:
+            assert c not in ('"', "\n"), \
+                f"raw {c!r} must never appear inside a label value"
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def test_label_escaping_hostile_values_roundtrip(reg):
+    """Regression: every exposition-format special (backslash,
+    double-quote, line feed) survives a render → parse round-trip,
+    including the adversarial literal-backslash-then-n sequence that
+    naive escapers turn into a newline, on gauges AND on histogram
+    bucket lines (where the hostile value shares the label set with
+    ``le``)."""
+    hostiles = [
+        'plain',
+        'he said "hi"',
+        'back\\slash',
+        'line\nfeed',
+        'literal\\nbackslash-n',
+        'trailing\\',
+        '\\"\n mixed \n"\\',
+    ]
+    g = reg.gauge("esc_hostile", "g", labelnames=("v",))
+    for i, v in enumerate(hostiles):
+        g.labels(v).set(i)
+    h = reg.histogram("esc_hostile_hist", "h", labelnames=("v",),
+                      buckets=(0.1, 1.0))
+    h.labels(hostiles[-1]).observe(0.5)
+    text = obs.render_prometheus(reg)
+
+    label_re = re.compile(r'\{v="((?:[^"\\]|\\.)*)"')
+    seen = []
+    for line in text.splitlines():
+        if line.startswith("esc_hostile{"):
+            m = label_re.match(line[len("esc_hostile"):])
+            assert m, f"unparseable label set in {line!r}"
+            seen.append(_unescape_label(m.group(1)))
+    assert sorted(seen) == sorted(hostiles)  # children render sorted
+    # each physical line is one sample: a raw newline inside a value
+    # would have split it and broken the value column
+    for line in text.splitlines():
+        if line.startswith("esc_hostile{"):
+            assert line.rsplit(" ", 1)[1] in {str(i) for i in
+                                              range(len(hostiles))}
+    # histogram bucket lines keep (v, le) both parseable
+    bucket_lines = [l for l in text.splitlines()
+                    if l.startswith("esc_hostile_hist_bucket")]
+    assert len(bucket_lines) == 3  # 0.1, 1.0, +Inf
+    for line in bucket_lines:
+        m = label_re.match(line[len("esc_hostile_hist_bucket"):])
+        assert _unescape_label(m.group(1)) == hostiles[-1]
+        assert ',le="' in line
+    # HELP lines escape backslash + newline too
+    reg.gauge("esc_help", "help with\nnewline and \\ backslash").set(1)
+    help_line = [l for l in obs.render_prometheus(reg).splitlines()
+                 if l.startswith("# HELP esc_help")][0]
+    assert help_line == ("# HELP esc_help help with\\nnewline and "
+                         "\\\\ backslash")
+
+
+def test_percentile_summary_single_and_none_samples():
+    """Regression for the freshly-constructed-engine path: one sample
+    and all-None samples must summarize, never raise."""
+    from bigdl_tpu.observability import percentile_summary
+
+    s = percentile_summary([0.25])
+    assert s == {"count": 1, "mean": 0.25, "p50": 0.25, "p90": 0.25,
+                 "p99": 0.25}
+    s = percentile_summary([None, None])
+    assert s["count"] == 0
+    assert s["mean"] is s["p50"] is s["p90"] is s["p99"] is None
+    assert percentile_summary(iter([]))["count"] == 0
 
 
 def test_write_prometheus_snapshot(reg, tmp_path):

@@ -472,3 +472,36 @@ def test_perf_gate(tmp_path):
     hist.write_text("".join(json.dumps(r) + "\n" for r in rows))
     assert _gate(str(hist)).returncode == 0
     assert _gate(str(hist), "--threshold", "0.01").returncode == 1
+
+
+def test_perf_gate_inter_token(tmp_path):
+    """The gate also holds the p99 inter-token line: a steady-state
+    decode regression fails even when TTFT is flat, and rows predating
+    the inter_token field skip that comparison instead of crashing."""
+    def row(ttft_ms, itl_ms=None, ts="2026-08-04T00:00:00+00:00"):
+        r = _serving_row(ttft_ms, ts=ts)
+        if itl_ms is not None:
+            r["detail"]["cached"]["inter_token"] = {
+                "p50": itl_ms / 2e3, "p99": itl_ms / 1e3}
+        return r
+
+    hist = tmp_path / "hist.jsonl"
+    # TTFT flat, inter-token +50%: FAIL, and the verdict names it
+    rows = [row(10.0, 2.0), row(10.0, 3.0)]
+    hist.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    res = _gate(str(hist))
+    assert res.returncode == 1
+    assert "inter-token" in res.stdout and "FAIL" in res.stdout
+
+    # both within budget: pass, both comparisons reported
+    rows = [row(10.0, 2.0), row(10.5, 2.1)]
+    hist.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    res = _gate(str(hist))
+    assert res.returncode == 0
+    assert res.stdout.count("ok:") == 2
+
+    # an old row without the field: inter-token comparison skipped
+    rows = [row(10.0), row(10.5, 2.0)]
+    hist.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    res = _gate(str(hist))
+    assert res.returncode == 0 and "skip" in res.stdout
