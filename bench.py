@@ -402,7 +402,11 @@ def _serving_bench(args, dev):
     paths) into bench_history.jsonl + the Prometheus snapshot so the
     serving perf trajectory is tracked alongside the training headline.
     vs_baseline is the p99-latency speedup over GenerationService
-    (> 1.0: the engine's tail is shorter).
+    (> 1.0: the engine's tail is shorter). Engine rows also carry the
+    usage ledger's goodput block (padding waste, utilization, tokens
+    per device-second) and the per-tenant token/device-second
+    breakdown; `scripts/perf_gate.py` additionally gates goodput
+    between comparable rows, skipping rows that predate the field.
 
     `--serving --shared-prefix`: the prefix-heavy variant — Poisson
     arrivals over N shared prompt templates, replayed through the
@@ -557,9 +561,23 @@ def _record_shared_prefix_metrics(res):
         if pc.get("enabled"):
             ins.prefix_hit_rate().set(pc["hit_rate"])
             ins.prefix_reused_fraction().set(pc["reused_fraction"])
+        for path in ("cached", "uncached"):
+            _record_goodput_metrics(ins, res[path], path)
     except Exception as e:
         print(f"[bench] shared-prefix metrics registry update failed: "
               f"{e}", file=sys.stderr)
+
+
+def _record_goodput_metrics(ins, block, path):
+    """Mirror one serving result's usage-ledger goodput block (emitted
+    by the engine replays in ``bigdl_tpu.serving.benchmark``) into the
+    ``path``-labelled bench gauges."""
+    g = block.get("goodput") or {}
+    if g.get("tokens_per_device_second") is not None:
+        ins.goodput_tokens_per_device_second.labels(path).set(
+            g["tokens_per_device_second"])
+    if g.get("padding_waste_mean") is not None:
+        ins.padding_waste_mean.labels(path).set(g["padding_waste_mean"])
 
 
 def _record_serving_metrics(res):
@@ -585,6 +603,7 @@ def _record_serving_metrics(res):
                 eng["inter_token"]["p99"])
         if res.get("p99_speedup") is not None:
             ins.p99_speedup().set(res["p99_speedup"])
+        _record_goodput_metrics(ins, eng, "engine")
     except Exception as e:
         print(f"[bench] serving metrics registry update failed: {e}",
               file=sys.stderr)

@@ -15,8 +15,11 @@ POINT is the serving machinery, not the prose):
      to engine liveness (503 once the decode loop dies; a watchdog
      alert degrades the body while staying 200), /debug/requests TTFT
      breakdowns, /debug/trace Chrome trace, /debug/memory per-pool
-     HBM attribution (KV slots / staging / prefix pool / params), and
-     on-demand /debug/profile capture (--profile-seconds N)
+     HBM attribution (KV slots / staging / prefix pool / params),
+     per-tenant usage accounting (requests submitted under tenant
+     names; the /debug/usage table — tokens, device-seconds, KV
+     byte-seconds, goodput — round-tripped over HTTP), and on-demand
+     /debug/profile capture (--profile-seconds N)
 
 Run: python -m bigdl_tpu.example.serving.serve [--tokens 24]
 """
@@ -128,11 +131,19 @@ def main(argv=None):
                                   eos_id=0) as engine, \
             obs.start_http_server(host="127.0.0.1",
                                   healthz=engine.healthz,
-                                  debug_requests=engine.debug_requests
+                                  debug_requests=engine.debug_requests,
+                                  debug_usage=engine.debug_usage
                                   ) as server:
         base = f"http://127.0.0.1:{server.port}"
-        handles = [engine.submit(r.randint(0, args.vocab, (L,)), nn_)
-                   for L, nn_ in ((6, n), (10, n // 2))]
+        # each request bills a tenant: the usage ledger attributes
+        # queue wait, tokens, KV byte-seconds, and pro-rata dispatch
+        # device-seconds to it (unknown names past the cardinality
+        # cap would fold into "other")
+        handles = [engine.submit(r.randint(0, args.vocab, (L,)), nn_,
+                                 tenant=t)
+                   for L, nn_, t in ((6, n, "alice"),
+                                     (10, n // 2, "bob"),
+                                     (8, n // 2, "alice"))]
         streamed = sum(1 for _ in handles[0].tokens())
         for h in handles:
             h.result(timeout=120)
@@ -161,6 +172,26 @@ def main(argv=None):
               f"engine pools (KB): "
               + ", ".join(f"{k}={v // 1024}"
                           for k, v in sorted(eng_pools.items())))
+
+        # who consumed the device: the per-tenant usage table, the
+        # goodput block, and the top requests by device-seconds —
+        # round-tripped over HTTP exactly as a billing scraper would
+        usage = json.loads(urllib.request.urlopen(
+            f"{base}/debug/usage?n=3").read())
+        for t, a in sorted(usage["tenants"].items()):
+            print(f"[usage]     tenant {t:<8} {a['requests']} req, "
+                  f"{a['prefill_tokens']:>3} prefill + "
+                  f"{a['decode_tokens']:>3} decoded tok, "
+                  f"{a['device_s'] * 1e3:8.1f} ms device, "
+                  f"{a['kv_byte_seconds'] / 1024:8.1f} KB*s KV")
+        g = usage["goodput"]
+        top = usage["top_requests"][0] if usage["top_requests"] else {}
+        print(f"[usage]     goodput {g['tokens_per_device_second']} "
+              f"tok/device-s, utilization {g['utilization']:.0%}, "
+              f"padding waste {g['padding_waste_mean']:.0%}; top "
+              f"burner {top.get('request_id')} "
+              f"({top.get('tenant')}, "
+              f"{top.get('device_s', 0) * 1e3:.1f} ms)")
 
         if args.profile_seconds > 0:
             # zero-redeploy profiling: one bounded capture over HTTP

@@ -27,6 +27,12 @@ equivalent for one-process-per-host JAX):
 - **Profiler** (``profiler``): bounded on-demand ``jax.profiler``
   capture — ``capture(seconds)`` programmatically, or
   ``GET/POST /debug/profile?seconds=N`` with zero redeploys.
+- **Usage accounting** (``accounting``): a per-request
+  ``UsageLedger`` metering queue wait, prefill/decode tokens,
+  prefix-reuse savings, KV byte-seconds held, and device-seconds
+  attributed pro-rata per dispatch — aggregated per ``tenant=`` under
+  a cardinality cap, with engine goodput (padding waste, utilization,
+  tokens per device-second) behind ``GET /debug/usage``.
 - **Watchdogs** (``watchdog``): ``RecompileWatchdog`` (post-warmup
   compile growth → recompile-storm alert) and ``SloWatchdog``
   (burn-rate evaluation of latency objectives over the TTFT /
@@ -77,12 +83,14 @@ from bigdl_tpu.observability.exporters import (
     render_prometheus, start_http_server, write_prometheus,
 )
 from bigdl_tpu.observability.instruments import (
-    OCCUPANCY_BUCKETS, OccupancyStats, TIME_BUCKETS, bench_instruments,
-    engine_instruments, generation_instruments, memory_instruments,
-    parallel_instruments, serving_bench_instruments,
-    serving_engine_instruments, serving_instruments, train_instruments,
+    FRACTION_BUCKETS, OCCUPANCY_BUCKETS, OccupancyStats, TIME_BUCKETS,
+    bench_instruments, engine_instruments, generation_instruments,
+    memory_instruments, parallel_instruments,
+    serving_bench_instruments, serving_engine_instruments,
+    serving_instruments, tenant_usage_instruments, train_instruments,
     watchdog_instruments,
 )
+from bigdl_tpu.observability.accounting import UsageLedger, UsageRecord
 from bigdl_tpu.observability.memory import (
     DeviceMemoryMonitor, default_monitor, pool_sizes, register_pool,
     register_owned_pools, static_pools, tree_bytes, unregister_pool,
@@ -105,11 +113,14 @@ __all__ = [
     "build_postmortem", "registry_snapshot", "write_postmortem",
     "MetricsHTTPServer", "PROMETHEUS_CONTENT_TYPE", "TensorBoardBridge",
     "render_prometheus", "start_http_server", "write_prometheus",
-    "OCCUPANCY_BUCKETS", "OccupancyStats", "TIME_BUCKETS",
+    "FRACTION_BUCKETS", "OCCUPANCY_BUCKETS", "OccupancyStats",
+    "TIME_BUCKETS",
     "bench_instruments", "engine_instruments", "generation_instruments",
     "memory_instruments", "parallel_instruments",
     "serving_bench_instruments", "serving_engine_instruments",
-    "serving_instruments", "train_instruments", "watchdog_instruments",
+    "serving_instruments", "tenant_usage_instruments",
+    "train_instruments", "watchdog_instruments",
+    "UsageLedger", "UsageRecord",
     "DeviceMemoryMonitor", "default_monitor", "pool_sizes",
     "register_pool", "register_owned_pools", "static_pools",
     "tree_bytes", "unregister_pool",

@@ -118,8 +118,8 @@ class MetricsHTTPServer:
     falsy/raises). ``port=0`` binds an ephemeral port — read it back
     from ``.port``.
 
-    Five debug routes expose the flight recorder and the resource
-    layer:
+    Six debug routes expose the flight recorder, the resource layer,
+    and the usage ledger:
 
     - ``GET /debug/events[?n=256]`` — the recorder's newest events as
       JSON (``{"events": [...], "total": N}``).
@@ -135,6 +135,12 @@ class MetricsHTTPServer:
       attribution and the high-watermark history
       (``memory.DeviceMemoryMonitor.debug_memory``; defaults to the
       process-default monitor).
+    - ``GET /debug/usage[?n=10]`` — per-tenant usage attribution:
+      wire ``ContinuousBatchingEngine.debug_usage`` here for the
+      tenant table (tokens, queue seconds, device-seconds, KV
+      byte-seconds, prefix savings), the engine goodput block, and
+      the top-``n`` requests by attributed device-seconds. The
+      callable receives the top-N count.
     - ``GET/POST /debug/profile?seconds=N`` — one bounded on-demand
       ``jax.profiler`` capture; responds with the artifact directory
       (501 when the backend cannot capture, 409 while another capture
@@ -149,6 +155,7 @@ class MetricsHTTPServer:
                  recorder=None, tracer=None,
                  debug_requests: Optional[Callable[[], dict]] = None,
                  debug_memory: Optional[Callable[[], dict]] = None,
+                 debug_usage: Optional[Callable[[int], dict]] = None,
                  profiler: Optional[Callable[[float], str]] = None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -262,6 +269,19 @@ class MetricsHTTPServer:
                         self._send_json(run_debug_memory())
                     except Exception as e:
                         self._send_json({"error": str(e)}, status=500)
+                elif path == "/debug/usage":
+                    try:
+                        if debug_usage is None:
+                            self._send_json(
+                                {"tenants": {}, "top_requests": [],
+                                 "note": "no usage source attached "
+                                         "(pass debug_usage=)"})
+                        else:
+                            from urllib.parse import parse_qs
+                            n = int(parse_qs(query).get("n", ["10"])[0])
+                            self._send_json(debug_usage(n))
+                    except Exception as e:
+                        self._send_json({"error": str(e)}, status=500)
                 elif path == "/debug/profile":
                     payload, status = run_profile(query)
                     self._send_json(payload, status=status)
@@ -328,6 +348,7 @@ def start_http_server(port: int = 0,
                       recorder=None, tracer=None,
                       debug_requests: Optional[Callable[[], dict]] = None,
                       debug_memory: Optional[Callable[[], dict]] = None,
+                      debug_usage: Optional[Callable[[int], dict]] = None,
                       profiler: Optional[Callable[[float], str]] = None
                       ) -> MetricsHTTPServer:
     """Convenience wrapper: start and return a MetricsHTTPServer."""
@@ -336,6 +357,7 @@ def start_http_server(port: int = 0,
                              tracer=tracer,
                              debug_requests=debug_requests,
                              debug_memory=debug_memory,
+                             debug_usage=debug_usage,
                              profiler=profiler)
 
 

@@ -29,6 +29,11 @@ TIME_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
 #: max_batch (a request count is integral; le-buckets still apply).
 OCCUPANCY_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
 
+#: Fraction buckets (0..1) for ratio-valued histograms — the
+#: per-dispatch padding-waste distribution lands here.
+FRACTION_BUCKETS = (0.0, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875,
+                    0.95, 1.0)
+
 
 def train_instruments(registry: Optional[MetricRegistry] = None
                       ) -> SimpleNamespace:
@@ -253,6 +258,88 @@ def serving_engine_instruments(service: str = "engine",
             "bigdl_serving_prefix_cache_entries",
             "Prefix-cache entries currently retained", labelnames=lbl
         ).labels(service),
+        device_prefill_seconds_total=r.counter(
+            "bigdl_serving_device_seconds_total",
+            "Host-measured wall seconds spent driving engine device "
+            "dispatches, by kind (ragged prefill rounds vs fused "
+            "decode steps) — the goodput denominator and the pool the "
+            "usage ledger attributes pro-rata across requests",
+            labelnames=("service", "kind")).labels(service, "prefill"),
+        device_decode_seconds_total=r.counter(
+            "bigdl_serving_device_seconds_total",
+            "Host-measured wall seconds spent driving engine device "
+            "dispatches, by kind (ragged prefill rounds vs fused "
+            "decode steps) — the goodput denominator and the pool the "
+            "usage ledger attributes pro-rata across requests",
+            labelnames=("service", "kind")).labels(service, "decode"),
+        padding_waste_prefill=r.histogram(
+            "bigdl_serving_dispatch_padding_waste",
+            "Per-dispatch padded-idle fraction: rows the compiled "
+            "shape paid for but no request advanced, over the dispatch "
+            "width (max_slots for decode, prefill_rows for prefill) — "
+            "0 is a full dispatch, near 1 is mostly padding",
+            labelnames=("service", "kind"),
+            buckets=FRACTION_BUCKETS).labels(service, "prefill"),
+        padding_waste_decode=r.histogram(
+            "bigdl_serving_dispatch_padding_waste",
+            "Per-dispatch padded-idle fraction: rows the compiled "
+            "shape paid for but no request advanced, over the dispatch "
+            "width (max_slots for decode, prefill_rows for prefill) — "
+            "0 is a full dispatch, near 1 is mostly padding",
+            labelnames=("service", "kind"),
+            buckets=FRACTION_BUCKETS).labels(service, "decode"),
+        utilization=r.gauge(
+            "bigdl_serving_occupancy_weighted_utilization",
+            "Dispatch-wall-weighted occupancy fraction (advanced rows "
+            "x wall / capacity rows x wall, cumulative): how much of "
+            "the compiled batch shape has carried real work",
+            labelnames=lbl).labels(service),
+        tokens_per_device_second=r.gauge(
+            "bigdl_serving_tokens_per_device_second",
+            "Delivered tokens per host-measured device-dispatch "
+            "second, cumulative — the engine's goodput headline",
+            labelnames=lbl).labels(service),
+    )
+
+
+def tenant_usage_instruments(registry: Optional[MetricRegistry] = None
+                             ) -> SimpleNamespace:
+    """Per-tenant usage counters fed by ``accounting.UsageLedger`` at
+    request finalization. Returned UNBOUND (families, not children):
+    the ledger binds ``(service, tenant)`` per finalized request, and
+    its cardinality cap (overflow tenants fold into ``"other"``) is
+    what keeps the tenant label space bounded."""
+    r = registry or default_registry()
+    lbl = ("service", "tenant")
+    return SimpleNamespace(
+        requests_total=r.counter(
+            "bigdl_serving_tenant_requests_total",
+            "Requests finalized per tenant (all outcomes)",
+            labelnames=lbl),
+        prefill_tokens_total=r.counter(
+            "bigdl_serving_tenant_prefill_tokens_total",
+            "Prompt tokens actually prefilled per tenant",
+            labelnames=lbl),
+        decode_tokens_total=r.counter(
+            "bigdl_serving_tenant_decode_tokens_total",
+            "Tokens delivered per tenant", labelnames=lbl),
+        prefix_reused_tokens_total=r.counter(
+            "bigdl_serving_tenant_prefix_reused_tokens_total",
+            "Prompt tokens served from the prefix cache per tenant "
+            "(prefill work the cache saved them)", labelnames=lbl),
+        queue_seconds_total=r.counter(
+            "bigdl_serving_tenant_queue_seconds_total",
+            "Admission-queue wait seconds accumulated per tenant",
+            labelnames=lbl),
+        device_seconds_total=r.counter(
+            "bigdl_serving_tenant_device_seconds_total",
+            "Device-dispatch seconds attributed pro-rata per tenant "
+            "(sums across tenants to "
+            "bigdl_serving_device_seconds_total)", labelnames=lbl),
+        kv_byte_seconds_total=r.counter(
+            "bigdl_serving_tenant_kv_byte_seconds_total",
+            "KV byte-seconds held per tenant (staging/slot row bytes "
+            "x residency — HBM occupancy over time)", labelnames=lbl),
     )
 
 
@@ -400,6 +487,15 @@ def serving_bench_instruments(registry: Optional[MetricRegistry] = None
             "bigdl_bench_serving_inter_token_p99_seconds",
             "Serving bench per-request mean inter-token gap, p99 "
             "across requests", labelnames=lbl),
+        goodput_tokens_per_device_second=r.gauge(
+            "bigdl_bench_serving_tokens_per_device_second",
+            "Serving bench delivered tokens per device-dispatch "
+            "second (engine goodput over the replayed workload)",
+            labelnames=lbl),
+        padding_waste_mean=r.gauge(
+            "bigdl_bench_serving_padding_waste_mean",
+            "Serving bench mean per-dispatch padded-idle fraction "
+            "over the replayed workload", labelnames=lbl),
         # the unlabeled scalars below are zero-arg factories (see
         # bench_instruments): each serving-bench VARIANT sets a
         # different subset, and a gauge minted but never set would

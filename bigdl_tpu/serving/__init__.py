@@ -46,7 +46,12 @@ and every lifecycle transition lands in the flight recorder under the
 handle's ``request_id`` (``handle.timeline()`` breakdowns,
 ``engine.debug_requests()`` / ``/debug/*`` endpoints, Chrome trace
 export, and a crash postmortem from ``engine.healthz()``'s failing
-loop — see ``bigdl_tpu.observability``).
+loop — see ``bigdl_tpu.observability``). Usage is BILLED per request
+under ``submit(..., tenant=...)``: the engine's ``UsageLedger``
+attributes queue wait, prefilled vs prefix-reused tokens, delivered
+tokens, KV byte-seconds held, and pro-rata dispatch device-seconds to
+each tenant (``handle.usage()``, ``stats()["usage"]``,
+``GET /debug/usage``, ``bigdl_serving_tenant_*`` counters).
 """
 
 from bigdl_tpu.serving.engine import ContinuousBatchingEngine

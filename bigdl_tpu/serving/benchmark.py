@@ -4,9 +4,15 @@ Replays ONE sampled open-loop workload (exponential inter-arrival gaps,
 mixed prompt/decode lengths) against both serving paths and reports the
 numbers a serving SLO is written in: per-request latency p50/p99, TTFT
 p50/p99 (engine only — the batch service has no streaming), and
-aggregate delivered tokens/sec. ``bench.py --serving`` emits the result
-into ``bench_history.jsonl`` and the Prometheus snapshot so the serving
-perf trajectory is tracked alongside the training headline.
+aggregate delivered tokens/sec. Engine rows also carry the usage
+ledger's GOODPUT block (device-seconds by dispatch kind, padding-waste
+mean, occupancy-weighted utilization, tokens per device-second) and a
+per-tenant token / device-second breakdown — the workload submits
+round-robin under three tenant names (one per template on the
+shared-prefix variant) so attribution is exercised under load.
+``bench.py --serving`` emits the result into ``bench_history.jsonl``
+and the Prometheus snapshot so the serving perf trajectory is tracked
+alongside the training headline.
 
 ``--serving --shared-prefix`` runs the PREFIX-HEAVY variant instead
 (:func:`run_shared_prefix_comparison`): Poisson arrivals over N shared
@@ -28,11 +34,14 @@ import numpy as np
 
 def poisson_workload(n_requests: int, rate_hz: float, vocab: int,
                      prompt_lens=(4, 16), decode_lens=(4, 24),
-                     seed: int = 0) -> List[dict]:
+                     seed: int = 0,
+                     tenants=("tenant-a", "tenant-b", "tenant-c")
+                     ) -> List[dict]:
     """Sample an open-loop workload: each request gets an arrival OFFSET
-    (cumulative exponential gaps at ``rate_hz``), a random prompt, and a
-    random decode length — the same list replays against every serving
-    path under comparison."""
+    (cumulative exponential gaps at ``rate_hz``), a random prompt, a
+    random decode length, and a round-robin ``tenant`` (the usage
+    ledger's attribution key) — the same list replays against every
+    serving path under comparison."""
     r = np.random.RandomState(seed)
     at = np.cumsum(r.exponential(1.0 / rate_hz, n_requests))
     out = []
@@ -42,6 +51,7 @@ def poisson_workload(n_requests: int, rate_hz: float, vocab: int,
             "arrival_s": float(at[i]),
             "prompt": r.randint(0, vocab, (t0,)).astype(np.int32),
             "n": int(r.randint(decode_lens[0], decode_lens[1] + 1)),
+            "tenant": tenants[i % len(tenants)] if tenants else None,
         })
     return out
 
@@ -60,6 +70,21 @@ def _append_itl(itl: List[float], handle) -> None:
     tl = handle.timeline()
     if tl["decode_s"] is not None and tl["tokens"] > 1:
         itl.append(tl["decode_s"] / (tl["tokens"] - 1))
+
+
+def _usage_blocks(stats: dict) -> dict:
+    """Compress ``engine.stats()["usage"]`` into the bench-row shape:
+    the goodput block verbatim plus a per-tenant token /
+    device-second breakdown (the columns a capacity planner reads)."""
+    u = stats.get("usage") or {}
+    tenants = {
+        t: {"requests": a["requests"],
+            "prefill_tokens": a["prefill_tokens"],
+            "decode_tokens": a["decode_tokens"],
+            "device_s": a["device_s"],
+            "tokens_per_device_second": a["tokens_per_device_second"]}
+        for t, a in (u.get("tenants") or {}).items()}
+    return {"goodput": u.get("goodput"), "tenants": tenants}
 
 
 def _replay(workload, submit_fn, collect_fn) -> dict:
@@ -119,13 +144,16 @@ def shared_prefix_workload(n_requests: int, rate_hz: float, vocab: int,
     at = np.cumsum(r.exponential(1.0 / rate_hz, n_requests))
     out = []
     for i in range(n_requests):
-        tpl = templates[int(r.randint(0, n_templates))]
+        ti = int(r.randint(0, n_templates))
         tail = r.randint(0, vocab, (int(r.randint(
             tail_lens[0], tail_lens[1] + 1)),)).astype(np.int32)
         out.append({
             "arrival_s": float(at[i]),
-            "prompt": np.concatenate([tpl, tail]),
+            "prompt": np.concatenate([templates[ti], tail]),
             "n": int(r.randint(decode_lens[0], decode_lens[1] + 1)),
+            # one tenant per template — the usage table then shows
+            # which shared prompt is eating the device
+            "tenant": f"tpl-{ti}",
         })
     return out
 
@@ -200,12 +228,14 @@ def run_shared_prefix_comparison(model, n_requests: int = 24,
             # template cache starts cold for both paths
             engine.submit(warm_prompt, 2).result(timeout=300)
             res = _replay(
-                wl, lambda req: engine.submit(req["prompt"], req["n"]),
+                wl, lambda req: engine.submit(req["prompt"], req["n"],
+                                              tenant=req.get("tenant")),
                 collect)
             stats = engine.stats()
         res["ttft"] = _percentiles(ttft)
         res["inter_token"] = _percentiles(itl)
         res["prefix_cache"] = stats["prefix_cache"]
+        res.update(_usage_blocks(stats))
         res["alerts"] = stats["alerts"]
         res["rows"] = rows
         return res
@@ -271,9 +301,12 @@ def run_poisson_comparison(model, n_requests: int = 16,
     log("[serving-bench] engine replay...")
     with engine:
         eng = _replay(
-            wl, lambda req: engine.submit(req["prompt"], req["n"]),
+            wl, lambda req: engine.submit(req["prompt"], req["n"],
+                                          tenant=req.get("tenant")),
             collect_engine)
-        eng["alerts"] = engine.stats()["alerts"]
+        stats = engine.stats()
+        eng["alerts"] = stats["alerts"]
+        eng.update(_usage_blocks(stats))
     eng["ttft"] = _percentiles(ttft)
     eng["inter_token"] = _percentiles(itl)
 

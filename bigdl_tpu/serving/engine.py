@@ -173,6 +173,21 @@ class ContinuousBatchingEngine:
     their ``metric`` field) drives an ``SloWatchdog``. Active alerts
     surface in ``stats()["alerts"]`` and flip the ``/healthz`` body to
     ``status: degraded`` while staying HTTP 200.
+
+    USAGE ACCOUNTING: every request is metered by a ``UsageLedger``
+    (``observability.accounting``) under the ``tenant=`` it was
+    submitted for — queue seconds, prefilled vs prefix-reused prompt
+    tokens (and the KV bytes reuse saved), delivered tokens, KV
+    byte-seconds held (staging/slot row bytes x residency), and
+    device-seconds attributed pro-rata from every ragged prefill round
+    and fused decode step across the rows each dispatch advanced.
+    ``usage_tenants`` caps tenant-label cardinality (overflow folds
+    into ``"other"``); ``usage_recent`` bounds the finished-record
+    ring behind top-N queries. Surfaces: ``handle.usage()``,
+    ``stats()["usage"]``, ``debug_usage()`` / ``GET /debug/usage``,
+    ``request/usage_final`` recorder events, and the
+    ``bigdl_serving_tenant_*`` counters. Pure host bookkeeping — the
+    jit-compile gauge stays flat with accounting on.
     """
 
     def __init__(self, model, max_slots: int = 4,
@@ -190,10 +205,13 @@ class ContinuousBatchingEngine:
                  prefix_cache_rows: Optional[int] = None,
                  prefix_min_tokens: Optional[int] = None,
                  admission_window: int = 4,
-                 slo_objectives=None):
+                 slo_objectives=None,
+                 usage_tenants: int = 32,
+                 usage_recent: int = 256):
         from bigdl_tpu.models.transformer import _validate_sampling
         from bigdl_tpu.observability import serving_engine_instruments
         from bigdl_tpu.observability import memory as obs_memory
+        from bigdl_tpu.observability.accounting import UsageLedger
         from bigdl_tpu.observability.events import default_recorder
         from bigdl_tpu.observability.watchdog import (
             RecompileWatchdog, SloObjective, SloWatchdog,
@@ -268,6 +286,10 @@ class ContinuousBatchingEngine:
         # every compiled shape stays load-independent.
         row_bytes = sum(int(leaf.nbytes) // max_slots
                         for leaf in jax.tree.leaves(self._caches))
+        self._row_bytes = row_bytes
+        #: device KV bytes one cached token position costs — the
+        #: exchange rate prefix-reuse savings are credited at
+        self._token_bytes = row_bytes / cache_len
         if prefix_cache_rows is not None:
             pool_rows = max(0, int(prefix_cache_rows))
         elif prefix_cache_bytes is None:
@@ -280,7 +302,8 @@ class ContinuousBatchingEngine:
             self._prefix = PrefixCache(
                 pool_rows, row_bytes,
                 min_tokens=(prefix_min_tokens
-                            if prefix_min_tokens is not None else c))
+                            if prefix_min_tokens is not None else c),
+                token_bytes=self._token_bytes)
         else:
             self._pool = None
             self._prefix = None
@@ -295,6 +318,16 @@ class ContinuousBatchingEngine:
         self._build_fns()
 
         self._ins = serving_engine_instruments(service_name, registry)
+        #: per-request / per-tenant usage meter: queue wait, prefill
+        #: vs prefix-reused tokens, delivered tokens, KV byte-seconds
+        #: held, and device-seconds attributed pro-rata per dispatch.
+        #: Pure host bookkeeping — zero device programs, so the
+        #: jit-compile gauge stays flat with accounting on.
+        self._usage = UsageLedger(
+            service=service_name, registry=registry, recorder=self._rec,
+            instruments=self._ins, max_tenants=usage_tenants,
+            recent=usage_recent, slot_row_bytes=row_bytes,
+            staging_row_bytes=row_bytes, token_bytes=self._token_bytes)
         self._queue = AdmissionQueue(
             queue_capacity, recorder=self._rec,
             wait_histogram=self._ins.queue_wait_seconds)
@@ -522,14 +555,24 @@ class ContinuousBatchingEngine:
     # ---------------------------------------------------------- client
     def submit(self, prompt_ids, max_new_tokens: int,
                timeout_s: Optional[float] = None, block: bool = True,
-               queue_timeout_s: Optional[float] = None) -> RequestHandle:
+               queue_timeout_s: Optional[float] = None,
+               tenant: Optional[str] = None) -> RequestHandle:
         """Queue one request (1-D prompt). Returns its handle
         immediately; stream with ``handle.tokens()`` or block on
         ``handle.result()``. ``timeout_s`` is a wall deadline covering
         queue + prefill + decode (expiry raises ``RequestTimedOut`` from
         the handle — including while blocked on a full queue); a full
         admission queue blocks (``block=True``, up to
-        ``queue_timeout_s``) or raises ``QueueFull``."""
+        ``queue_timeout_s``) or raises ``QueueFull``.
+
+        ``tenant`` names the workload the request's usage is billed to
+        (the usage ledger's attribution key and the
+        ``bigdl_serving_tenant_*`` label; ``None`` bills to
+        ``"default"``). The first ``usage_tenants`` distinct names get
+        their own series; later new names fold into ``"other"`` — the
+        cardinality cap that keeps the label space bounded no matter
+        what clients send. ``handle.usage()`` returns the request's
+        metered consumption."""
         if self._crashed is not None:
             raise EngineStopped("engine loop crashed") from self._crashed
         prompt = np.asarray(prompt_ids, np.int32)
@@ -545,14 +588,22 @@ class ContinuousBatchingEngine:
                 f"engine's serving window {self.max_len}")
         self.start()
         h = RequestHandle(prompt, n, timeout_s)
+        h._usage = self._usage.begin(h.request_id, tenant, t0, n,
+                                     submitted_at=h.submitted_at)
+        h.tenant = h._usage.tenant
         self._rec.record("request/submitted", h.request_id,
                          service=self.service_name, prompt_tokens=t0,
-                         max_new_tokens=n)
+                         max_new_tokens=n, tenant=h.tenant)
         try:
             self._queue.put(h, block=block, timeout=queue_timeout_s)
         except Exception as e:
-            # close the timeline — a backpressure rejection must not
-            # read as a request that vanished mid-flight
+            # close the ledger, then the timeline — a backpressure
+            # rejection must not read as a request that vanished
+            # mid-flight, and the outcome event stays the LAST event
+            # of the request's recorded arc (same order as
+            # _finish_handle)
+            self._usage.finalize(h._usage, "rejected",
+                                 time.monotonic())
             self._rec.record("request/rejected", h.request_id,
                              service=self.service_name,
                              error=type(e).__name__)
@@ -592,12 +643,22 @@ class ContinuousBatchingEngine:
         crashing loop) — only the winner records."""
         if not h._finish(err):
             return
+        rec = getattr(h, "_usage", None)
+        if rec is not None:
+            # the usage ledger's terminal funnel shares _finish's
+            # arbitration: exactly one finalizer closes residencies,
+            # bills the tenant, and records request/usage_final —
+            # BEFORE the outcome event, which stays the last event of
+            # every request's recorded timeline (tested contract)
+            self._usage.finalize(rec, outcome, h.finished_at)
         self._rec.record("request/" + outcome, h.request_id,
                          service=self.service_name,
-                         tokens=len(h._tokens))
+                         tokens=len(h._tokens),
+                         tenant=getattr(h, "tenant", None))
         tl = h.timeline()
         tl["request_id"] = h.request_id
         tl["outcome"] = outcome
+        tl["tenant"] = getattr(h, "tenant", None)
         with self._timelines_lock:
             self._timelines.append(tl)
 
@@ -614,7 +675,10 @@ class ContinuousBatchingEngine:
         engine's recent finished-request timelines; ``prefix_cache``
         adds the cache's hit rate, reused-token fraction, and current
         byte occupancy (per-instance exact — the cache object belongs
-        to this engine)."""
+        to this engine); ``usage`` adds the ledger's per-tenant
+        attribution table and the engine goodput block (device
+        seconds by kind, occupancy-weighted utilization, padding
+        waste, tokens per device-second)."""
         out = {k: int(self._counter(k).get() - base)
                for k, base in self._stats_base.items()}
         out["active_slots"] = sum(s is not None for s in self._slots)
@@ -622,6 +686,7 @@ class ContinuousBatchingEngine:
         out["jit_compiles"] = self._compile_total()
         out["latency"] = self._latency_summary()
         out["prefix_cache"] = self._prefix_summary()
+        out["usage"] = self._usage.summary()
         out["alerts"] = self.alerts()
         return out
 
@@ -703,6 +768,7 @@ class ContinuousBatchingEngine:
                 "age_s": now - h.submitted_at,
                 "prompt_tokens": int(h.prompt.shape[0]),
                 "max_new_tokens": h.max_new_tokens,
+                "tenant": getattr(h, "tenant", None),
             })
         for adm in list(self._adms):
             h = adm.handle
@@ -711,6 +777,7 @@ class ContinuousBatchingEngine:
                 "age_s": now - h.submitted_at,
                 "prompt_tokens": int(h.prompt.shape[0]),
                 "max_new_tokens": h.max_new_tokens,
+                "tenant": getattr(h, "tenant", None),
                 "chunks_done": adm.next_chunk,
                 "chunks_total": adm.n_chunks,
                 "staging_row": adm.row,
@@ -725,6 +792,7 @@ class ContinuousBatchingEngine:
                 "slot": sid, "age_s": now - h.submitted_at,
                 "prompt_tokens": int(h.prompt.shape[0]),
                 "max_new_tokens": h.max_new_tokens,
+                "tenant": getattr(h, "tenant", None),
                 "tokens_delivered": st.delivered,
             })
         with self._timelines_lock:
@@ -735,6 +803,16 @@ class ContinuousBatchingEngine:
                 "latency": self._latency_summary(),
                 "prefix_cache": self._prefix_summary(),
                 "alerts": self.alerts()}
+
+    def debug_usage(self, top_n: int = 10) -> dict:
+        """The ``GET /debug/usage`` payload: the per-tenant usage
+        table (tokens, queue seconds, device-seconds, KV
+        byte-seconds, prefix savings), engine-wide totals, the
+        goodput block, and the top-``top_n`` recently finished
+        requests by attributed device-seconds. Snapshot semantics —
+        safe from HTTP threads while the loop runs."""
+        return {"service": self.service_name,
+                **self._usage.summary(top_n=top_n)}
 
     # ------------------------------------------------------- loop body
     def _loop(self):
@@ -982,6 +1060,12 @@ class ContinuousBatchingEngine:
                                      n_chunks, entry))
         h.prefix_tokens = base
         h.admitted_at = time.monotonic()
+        rec = getattr(h, "_usage", None)
+        if rec is not None:
+            # queue wait closes, staging-row residency opens, and the
+            # chunk-aligned reuse is credited as tokens + bytes saved
+            self._usage.admitted(rec, h.admitted_at,
+                                 reused_tokens=base)
         self._rec.record("request/admitted", h.request_id,
                          service=self.service_name, slot=slot,
                          staging_row=row, n_chunks=n_chunks,
@@ -1009,25 +1093,52 @@ class ContinuousBatchingEngine:
                 # overwrites position p before attending <= p)
                 last[a.row] = a.tail - 1 - k * c
                 finals.append(a)
+        # a COLD dispatch's wall is dominated by its one-time compile —
+        # billing that to whichever tenants happen to arrive first
+        # would poison their device-seconds forever, so warmup rounds
+        # are excluded from attribution AND the busy tally (both sides
+        # skip: conservation holds, goodput reads the warm engine)
+        was_warm = "chunk" in self._warm and (
+            not finals or "sample0" in self._warm)
+        t_disp = time.monotonic()
         logits, self._staging = self._chunk_jit(
             self._params, self._buffers, jnp.asarray(ids), self._staging,
             jnp.asarray(pos0), jnp.asarray(last))
         self._warm.add("chunk")
-        for a in self._adms:
+        toks = None
+        if finals:
+            # the host-side transfer blocks on the sampled tokens —
+            # which depend on the chunk's logits, so the measured wall
+            # covers the real dispatch on rounds that finish a prompt
+            toks = np.asarray(self._sample0_jit(
+                logits, self._next_key(), self._temp()))
+            self._warm.add("sample0")
+        wall = time.monotonic() - t_disp
+        # pro-rata attribution by REAL tokens each row advanced (the
+        # padded tail of a final chunk is engine overhead, not billable
+        # work); weights sum to 1 — the round's full wall is conserved
+        done_by = [(a, min(c, a.tail - a.next_chunk * c))
+                   for a in self._adms]
+        if was_warm:
+            total_done = sum(d for _, d in done_by) or 1
+            self._usage.charge_dispatch(
+                "prefill", wall,
+                [(getattr(a.handle, "_usage", None), d / total_done)
+                 for a, d in done_by],
+                rows_advanced=len(self._adms),
+                capacity_rows=self._policy.prefill_rows)
+        for a, done in done_by:
             k = a.next_chunk
-            done = min(c, a.tail - k * c)
             self._prefilled_tokens += done
             self._ins.prefill_tokens_total.inc(done)
+            rec = getattr(a.handle, "_usage", None)
+            if rec is not None:
+                self._usage.add_prefill(rec, done)
             self._rec.record("request/prefill_chunk",
                              a.handle.request_id,
                              service=self.service_name, chunk=k,
                              n_chunks=a.n_chunks, tokens=done)
             a.next_chunk += 1
-        if not finals:
-            return
-        toks = np.asarray(self._sample0_jit(
-            logits, self._next_key(), self._temp()))
-        self._warm.add("sample0")
         for a in finals:
             self._complete_admission(a, int(toks[a.row]))
 
@@ -1046,6 +1157,12 @@ class ContinuousBatchingEngine:
         now = time.monotonic()
         h = a.handle
         h._deliver(tok, now)
+        rec = getattr(h, "_usage", None)
+        if rec is not None:
+            # staging residency closes into kv_byte_seconds, the slot
+            # row's opens; the first token counts as delivered
+            self._usage.slot_acquired(rec, now)
+            self._usage.delivered(rec, 1)
         self._ins.ttft_seconds.observe(now - h.submitted_at)
         self._rec.record("request/first_token", h.request_id,
                          service=self.service_name, token=tok,
@@ -1103,13 +1220,26 @@ class ContinuousBatchingEngine:
             st = self._slots[sid]
             tok[sid] = st.last_token
             pos[sid] = st.pos
+        was_warm = "step" in self._warm   # cold = compile in the wall
+        t_disp = time.monotonic()
         nxt, self._caches = self._step_jit(
             self._params, self._buffers, jnp.asarray(tok),
             jnp.asarray(pos), self._caches, self._next_key(),
             self._temp())
         self._warm.add("step")
-        nxt_np = np.asarray(nxt)
+        nxt_np = np.asarray(nxt)   # blocks on the fused step
         now = time.monotonic()
+        # every advanced row got exactly one token: the step's wall
+        # splits evenly across them (idle slots ride along as padding
+        # — their share is the dispatch's padding waste, not billed).
+        # Warmup steps are excluded like cold prefill rounds above.
+        if was_warm:
+            w = 1.0 / len(active)
+            self._usage.charge_dispatch(
+                "decode", now - t_disp,
+                [(getattr(self._slots[sid].handle, "_usage", None), w)
+                 for sid in active],
+                rows_advanced=len(active), capacity_rows=self.max_slots)
         for sid in active:
             st = self._slots[sid]
             t = int(nxt_np[sid])
@@ -1120,6 +1250,9 @@ class ContinuousBatchingEngine:
             st.last_token_at = now
             h = st.handle
             h._deliver(t, now)
+            rec = getattr(h, "_usage", None)
+            if rec is not None:
+                self._usage.delivered(rec, 1)
             self._ins.decode_tokens_total.inc()
             self._rec.record("request/decode_token", h.request_id,
                              service=self.service_name, slot=sid,

@@ -102,7 +102,7 @@ class PrefixCache:
     """
 
     def __init__(self, rows: int, row_bytes: int,
-                 min_tokens: int = 1):
+                 min_tokens: int = 1, token_bytes: float = 0.0):
         if rows < 0:
             raise ValueError(f"rows must be >= 0, got {rows}")
         if min_tokens < 1:
@@ -113,6 +113,10 @@ class PrefixCache:
         #: prefixes shorter than this are never matched or donated —
         #: a few shared tokens are not worth a row or a copy dispatch
         self.min_tokens = min_tokens
+        #: device KV bytes one cached token position occupies
+        #: (row_bytes / cache_len — the engine passes it); the
+        #: exchange rate behind the ``bytes_saved`` savings credit
+        self.token_bytes = float(token_bytes)
         self._root = _Node()
         self._entries: List[PrefixEntry] = []
         self._free_rows = list(range(rows))
@@ -126,6 +130,11 @@ class PrefixCache:
         self.hits = 0
         self.misses = 0
         self.reused_tokens = 0
+        #: device KV bytes reuse avoided recomputing + rewriting —
+        #: the cache's cumulative savings credit (reused positions x
+        #: token_bytes), per-request shares ledgered by the engine's
+        #: usage accounting
+        self.bytes_saved = 0
         self.donations = 0
         self.evictions = 0
 
@@ -208,6 +217,7 @@ class PrefixCache:
             entry.hits += 1
             self.hits += 1
             self.reused_tokens += int(reused_tokens)
+            self.bytes_saved += int(reused_tokens * self.token_bytes)
 
     def record_miss(self) -> None:
         with self._lock:
@@ -386,6 +396,7 @@ class PrefixCache:
                 "misses": self.misses,
                 "hit_rate": round(self.hits / looked, 4) if looked else 0.0,
                 "reused_tokens": self.reused_tokens,
+                "bytes_saved": self.bytes_saved,
                 "donations": self.donations,
                 "evictions": self.evictions,
             }

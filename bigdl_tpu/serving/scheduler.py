@@ -96,7 +96,9 @@ class AdmissionQueue:
                 if handle.deadline is not None and now > handle.deadline:
                     self._rec.record("request/queue_dropped",
                                      handle.request_id,
-                                     reason="RequestTimedOut")
+                                     reason="RequestTimedOut",
+                                     tenant=getattr(handle, "tenant",
+                                                    None))
                     raise RequestTimedOut(
                         f"deadline passed after "
                         f"{now - handle.submitted_at:.3f}s blocked on a "
@@ -128,7 +130,8 @@ class AdmissionQueue:
             # recorder has its own independent lock — no ordering
             # between the two is ever taken in reverse)
             self._rec.record("request/queued", handle.request_id,
-                             depth=len(self._q))
+                             depth=len(self._q),
+                             tenant=getattr(handle, "tenant", None))
             self._lock.notify_all()
 
     def pop_ready(self, now: Optional[float] = None, scorer=None,
@@ -235,7 +238,8 @@ class AdmissionQueue:
                 "queue (never admitted to a slot)")
         if err is not None:
             self._rec.record("request/queue_dropped", h.request_id,
-                             reason=type(err).__name__)
+                             reason=type(err).__name__,
+                             tenant=getattr(h, "tenant", None))
         return err
 
 

@@ -86,6 +86,13 @@ class RequestHandle:
         #: stamped at admission alongside the ``request/prefix_hit``
         #: flight-recorder event
         self.prefix_tokens: int = 0
+        #: the tenant this request's usage is billed to — stamped by
+        #: ``engine.submit(tenant=...)`` after cardinality-cap
+        #: resolution (None outside an engine)
+        self.tenant: Optional[str] = None
+        #: the engine's UsageRecord for this request (engine-stamped;
+        #: read through ``usage()``)
+        self._usage = None
         #: set by the engine when the first token lands (TTFT source)
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
@@ -168,6 +175,18 @@ class RequestHandle:
             "tokens": len(self._tokens),
             "prefix_tokens": self.prefix_tokens,
         }
+
+    def usage(self) -> Optional[dict]:
+        """The request's metered resource consumption from the
+        engine's usage ledger (``observability.accounting``): tenant,
+        queue wait, prefilled vs prefix-reused prompt tokens (and KV
+        bytes the reuse saved), delivered tokens, pro-rata
+        device-seconds by dispatch kind, and KV byte-seconds held.
+        Final once the request is ``done()`` (the ``outcome`` field is
+        set); a live snapshot before that. None when the handle never
+        entered an engine."""
+        rec = self._usage
+        return rec.to_dict() if rec is not None else None
 
     def tokens(self) -> Iterator[int]:
         """Stream generated token ids in order as the engine produces

@@ -1,20 +1,29 @@
 #!/usr/bin/env python3
-"""CI lint: every ``bigdl_*`` metric name is minted in ONE place.
+"""CI lint: every ``bigdl_*`` metric name is minted in ONE place —
+and documented.
 
 ``bigdl_tpu/observability/instruments.py`` is the canonical schema —
 one module defines every ``bigdl_*`` metric name, type, help string,
 and bucket layout, so live scrapes, bench snapshots, and dashboards
-can never drift apart. This lint greps the tree for registration
-calls (``.counter("bigdl_...")`` / ``.gauge(...)`` /
-``.histogram(...)``) OUTSIDE that module and fails (exit 1) when it
-finds one — the fix is always to add an ``*_instruments`` entry and
-call it.
+can never drift apart. Two checks hold that line (both fail the build,
+exit 1):
 
-Scopes deliberately skipped: ``tests/`` (tests mint throwaway names
-against throwaway registries), ``docs/`` (examples use ``myapp_*``),
-and build/VCS droppings. Stdlib only — runnable from any CI step
-without the package installed; ``tests/test_resource_observability.py``
-wires it as a tier-1 test.
+1. REGISTRATION: grep the tree for registration calls
+   (``.counter("bigdl_...")`` / ``.gauge(...)`` / ``.histogram(...)``)
+   OUTSIDE that module — the fix is always to add an
+   ``*_instruments`` entry and call it.
+2. DOC DRIFT: every name registered IN that module must appear in the
+   instrument table of ``docs/programming-guide/observability.md`` —
+   an operator reading the docs sees every series a scrape can emit.
+   The table may spell names exactly, expand one ``{a,b,c}``
+   alternation, or end in ``*`` for a family prefix
+   (``bigdl_bench_*``).
+
+Scopes deliberately skipped by the registration check: ``tests/``
+(tests mint throwaway names against throwaway registries), ``docs/``
+(examples use ``myapp_*``), and build/VCS droppings. Stdlib only —
+runnable from any CI step without the package installed;
+``tests/test_resource_observability.py`` wires it as a tier-1 test.
 
 Usage::
 
@@ -30,6 +39,9 @@ import sys
 
 #: the one module allowed to register bigdl_* instruments
 ALLOWED = ("bigdl_tpu", "observability", "instruments.py")
+
+#: the guide whose instrument table must cover every registered name
+DOCS_GUIDE = ("docs", "programming-guide", "observability.md")
 
 SKIP_DIRS = {".git", "__pycache__", "build", "dist", "docs", "tests",
              ".eggs", "bigdl_tpu.egg-info", "native", "docker"}
@@ -64,11 +76,71 @@ def lint(root: str):
                        m.group(1), m.group(2))
 
 
+# a documented-name token in the guide: a bigdl_ head, at most one
+# {a,b,c} alternation (a {label=} brace contains '=' and is NOT an
+# alternation, so it terminates the token), an optional tail, and an
+# optional trailing * marking a family prefix; assembled from pieces
+# so this file never matches itself
+_DOC_TOKEN = re.compile(
+    "(" + "bigdl" + r"_[A-Za-z0-9_]*)"
+    r"(?:\{([A-Za-z0-9_,]+)\})?"
+    r"([A-Za-z0-9_]*)"
+    r"(\*)?")
+
+
+def registered_names(root: str):
+    """Every metric name literal registered in the canonical module."""
+    path = os.path.join(root, *ALLOWED)
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        return []
+    return sorted({m.group(2) for m in _PATTERN.finditer(text)})
+
+
+def documented_patterns(root: str):
+    """The doc guide's instrument-TABLE vocabulary: exact names,
+    expanded ``{a,b,c}`` alternations, and ``prefix*`` family
+    wildcards. Only markdown table rows (lines starting with ``|``)
+    count — prose mentioning ``bigdl_*`` generically must not satisfy
+    the per-instrument documentation requirement."""
+    path = os.path.join(root, *DOCS_GUIDE)
+    try:
+        with open(path, encoding="utf-8") as f:
+            lines = f.readlines()
+    except OSError:
+        return set()
+    pats = set()
+    for line in lines:
+        if not line.lstrip().startswith("|"):
+            continue
+        for m in _DOC_TOKEN.finditer(line):
+            head, alts, tail, star = m.groups()
+            for alt in (alts.split(",") if alts else ("",)):
+                pats.add(head + alt + (tail or "")
+                         + ("*" if star else ""))
+    return pats
+
+
+def doc_drift(root: str):
+    """Yield registered instrument names the docs table never
+    mentions."""
+    pats = documented_patterns(root)
+
+    def covered(name):
+        return any((p.endswith("*") and name.startswith(p[:-1]))
+                   or name == p for p in pats)
+
+    return [n for n in registered_names(root) if not covered(n)]
+
+
 def main(argv=None) -> int:
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     p = argparse.ArgumentParser(
         description="Fail when a bigdl_* metric is registered outside "
-                    "observability/instruments.py.")
+                    "observability/instruments.py, or registered there "
+                    "but missing from the docs instrument table.")
     p.add_argument("--root", default=here)
     args = p.parse_args(argv)
 
@@ -77,12 +149,19 @@ def main(argv=None) -> int:
         print(f"[metrics-lint] {path}:{lineno}: .{method}({name!r}) — "
               f"bigdl_* metrics must be defined in "
               f"{'/'.join(ALLOWED)} (add an *_instruments entry)")
-    if violations:
+    undocumented = doc_drift(args.root)
+    for name in undocumented:
+        print(f"[metrics-lint] {'/'.join(ALLOWED)}: {name!r} is "
+              f"registered but missing from the instrument table in "
+              f"{'/'.join(DOCS_GUIDE)} (add a table row)")
+    if violations or undocumented:
         print(f"[metrics-lint] FAIL: {len(violations)} out-of-place "
-              "registration(s)")
+              f"registration(s), {len(undocumented)} undocumented "
+              "instrument(s)")
         return 1
     print("[metrics-lint] ok: all bigdl_* metrics registered in "
-          + "/".join(ALLOWED))
+          + "/".join(ALLOWED) + " and documented in "
+          + "/".join(DOCS_GUIDE))
     return 0
 
 

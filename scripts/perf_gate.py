@@ -4,14 +4,16 @@
 Reads the TWO newest *comparable* serving rows (same metric, same
 workload signature — request count, arrival rate, template config) and
 fails (exit 1) when the newer row regressed by more than
-``--threshold`` (default 20%) against the previous one on EITHER gated
-latency: p99 TTFT, or p99 inter-token latency (the per-request mean
-decode gap — the steady-state streaming experience TTFT cannot see).
+``--threshold`` (default 20%) against the previous one on ANY gated
+measure: p99 TTFT, p99 inter-token latency (the per-request mean
+decode gap — the steady-state streaming experience TTFT cannot see),
+or the engine's goodput (delivered tokens per device-second from the
+usage ledger — higher is better, so the regression direction flips).
 Anything that prevents a comparison — no history, a single row,
 unparsable lines, rows without the measurement — exits 0 with an
 explanation: the gate blocks measured regressions, it never blocks the
-first run of a new workload, and rows predating the inter-token field
-gate on TTFT alone.
+first run of a new workload, and rows predating a field (inter-token,
+goodput) gate on what both rows actually measured.
 
 Serving rows come from ``bench.py --serving`` (percentiles under
 ``detail.engine.{ttft,inter_token}.p99``) and ``bench.py --serving
@@ -56,6 +58,19 @@ def inter_token_p99(row: dict):
     """The row's p99 per-request mean inter-token gap in seconds, or
     None (rows predating the measurement, training rows)."""
     return _p99(row, "inter_token")
+
+
+def goodput_tokens_per_device_second(row: dict):
+    """The row's engine goodput (delivered tokens per device-dispatch
+    second, from the usage ledger), or None for rows predating the
+    field. Higher is better — the gate inverts the direction."""
+    detail = row.get("detail") or {}
+    for key in _TTFT_PATHS:
+        block = detail.get(key) or {}
+        g = (block.get("goodput") or {}).get("tokens_per_device_second")
+        if g is not None:
+            return float(g)
+    return None
 
 
 def signature(row: dict):
@@ -126,20 +141,31 @@ def main(argv=None) -> int:
 
     span = f"[{prev.get('ts', '?')} -> {newest.get('ts', '?')}]"
     failed = False
-    for label, reader in (("p99 TTFT", ttft_p99),
-                          ("p99 inter-token", inter_token_p99)):
-        new_p99, old_p99 = reader(newest), reader(prev)
-        if new_p99 is None or old_p99 is None:
-            # older rows predate the inter-token field: gate on what
-            # both rows actually measured
+    # (label, reader, unit scale, unit, higher_is_better)
+    measures = (
+        ("p99 TTFT", ttft_p99, 1e3, "ms", False),
+        ("p99 inter-token", inter_token_p99, 1e3, "ms", False),
+        ("goodput", goodput_tokens_per_device_second, 1.0,
+         "tok/dev-s", True),
+    )
+    for label, reader, scale, unit, higher_better in measures:
+        new_v, old_v = reader(newest), reader(prev)
+        if new_v is None or old_v is None:
+            # older rows predate the field (inter-token, goodput):
+            # gate on what both rows actually measured
             print(f"[perf-gate] skip: {label} absent from one of the "
                   f"compared rows {span}")
             continue
-        ratio = new_p99 / old_p99 if old_p99 else float("inf")
-        verdict = (f"{label} {old_p99 * 1e3:.2f}ms -> "
-                   f"{new_p99 * 1e3:.2f}ms ({ratio:.3f}x) for "
+        ratio = new_v / old_v if old_v else float("inf")
+        verdict = (f"{label} {old_v * scale:.2f}{unit} -> "
+                   f"{new_v * scale:.2f}{unit} ({ratio:.3f}x) for "
                    f"{newest.get('metric')} {span}")
-        if ratio > 1.0 + args.threshold:
+        # a regression is a ratio above budget for latencies, below
+        # the inverse budget for throughput-like measures
+        regressed = (ratio < 1.0 / (1.0 + args.threshold)
+                     if higher_better else
+                     ratio > 1.0 + args.threshold)
+        if regressed:
             print(f"[perf-gate] FAIL: {verdict} exceeds the "
                   f"+{args.threshold:.0%} budget")
             failed = True
