@@ -222,6 +222,18 @@ def bench_main(argv=None):
     p.add_argument("--templates", type=int, default=4,
                    help="--shared-prefix: number of shared prompt "
                         "templates")
+    p.add_argument("--speculative", action="store_true",
+                   help="with --serving: repeated-text workload "
+                        "replayed with an int8-draft speculative "
+                        "engine vs the plain engine — emits the "
+                        "inter-token p50/p99 A/B and the draft "
+                        "acceptance rate into bench_history.jsonl")
+    p.add_argument("--gamma", type=int, default=8,
+                   help="--speculative: draft tokens proposed per "
+                        "fused decode round (the int8 draft agrees "
+                        "with its float source ~90%% of the time, so "
+                        "a wide gamma amortizes dispatch overhead "
+                        "hardest)")
     p.add_argument("--trace", action="store_true",
                    help="also dump bench_trace.json — the run's span "
                         "trees + flight-recorder events as Chrome "
@@ -415,10 +427,20 @@ def _serving_bench(args, dev):
     the acceptance bar is >=2x), and detail carries the hit rate,
     reused-token fraction, and greedy token-parity flag.
     `scripts/perf_gate.py` gates CI on the p99 TTFT of consecutive
-    comparable rows."""
+    comparable rows.
+
+    `--serving --speculative`: the speculative A/B — one repeated-text
+    Poisson workload replayed through the engine with an int8-clone
+    draft (gamma proposals per fused round) vs the plain engine.
+    vs_baseline is the inter-token p50 speedup of the speculative path
+    (>1.0: the draft pays for itself), and detail carries both paths'
+    inter-token p50/p99, the acceptance rate, and the greedy
+    token-parity flag; perf_gate gates the speculative row's p99
+    inter-token (and TTFT / goodput) between comparable runs."""
     from bigdl_tpu.models.transformer import TransformerLM
     from bigdl_tpu.serving.benchmark import (
         run_poisson_comparison, run_shared_prefix_comparison,
+        run_speculative_comparison,
     )
     from bigdl_tpu.utils import random as rnd
     from bigdl_tpu.version import __version__
@@ -429,7 +451,24 @@ def _serving_bench(args, dev):
                           num_layers=2, max_len=128, use_rope=True)
     model.evaluate()
     prof = _start_profile(args.profile)
-    if args.shared_prefix:
+    if args.speculative:
+        res = run_speculative_comparison(
+            model, n_requests=args.requests, rate_hz=args.rate,
+            max_slots=4, prefill_chunk=8, prefill_rows=2,
+            gamma=args.gamma, log=log)
+        result = {
+            "metric": "serving_speculative_tokens_per_sec",
+            "value": res["spec"]["tokens_per_sec"],
+            "unit": "tokens/sec",
+            "vs_baseline": res["inter_token_p50_speedup"],
+            "detail": {
+                "version": __version__,
+                "device": str(getattr(dev, "device_kind", dev.platform)),
+                **res,
+            },
+        }
+        _record_speculative_metrics(res)
+    elif args.shared_prefix:
         res = run_shared_prefix_comparison(
             model, n_requests=args.requests, rate_hz=args.rate,
             max_slots=4, prefill_chunk=8, prefill_rows=2,
@@ -565,6 +604,38 @@ def _record_shared_prefix_metrics(res):
             _record_goodput_metrics(ins, res[path], path)
     except Exception as e:
         print(f"[bench] shared-prefix metrics registry update failed: "
+              f"{e}", file=sys.stderr)
+
+
+def _record_speculative_metrics(res):
+    """Mirror the speculative A/B into the observability registry
+    (``path`` label: spec_on / spec_off) so live scrapes and bench
+    snapshots share one schema. Never lets telemetry break the
+    bench."""
+    try:
+        from bigdl_tpu import observability as obs
+
+        ins = obs.serving_bench_instruments()
+        for path, key in (("spec_on", "spec"), ("spec_off", "nospec")):
+            r = res[key]
+            ins.tokens_per_sec.labels(path).set(r["tokens_per_sec"])
+            if r["latency"]["p50"] is not None:
+                ins.latency_p50.labels(path).set(r["latency"]["p50"])
+                ins.latency_p99.labels(path).set(r["latency"]["p99"])
+            if r["ttft"]["p50"] is not None:
+                ins.ttft_p50.labels(path).set(r["ttft"]["p50"])
+                ins.ttft_p99_by_path.labels(path).set(r["ttft"]["p99"])
+            if r.get("inter_token", {}).get("p99") is not None:
+                ins.inter_token_p99.labels(path).set(
+                    r["inter_token"]["p99"])
+            _record_goodput_metrics(ins, r, path)
+        if res.get("acceptance_rate") is not None:
+            ins.spec_acceptance_rate().set(res["acceptance_rate"])
+        if res.get("inter_token_p50_speedup") is not None:
+            ins.spec_inter_token_p50_speedup().set(
+                res["inter_token_p50_speedup"])
+    except Exception as e:
+        print(f"[bench] speculative metrics registry update failed: "
               f"{e}", file=sys.stderr)
 
 

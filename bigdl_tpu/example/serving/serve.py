@@ -40,6 +40,13 @@ def main(argv=None):
     p.add_argument("--profile-seconds", type=float, default=0.0,
                    help="also exercise GET /debug/profile with a "
                         "capture of this many seconds (0 = skip)")
+    p.add_argument("--draft", action="store_true",
+                   help="run the continuous-batching engine with the "
+                        "int8 clone as a speculative DRAFT (gamma "
+                        "proposals per fused decode round) and print "
+                        "the acceptance rate from stats()")
+    p.add_argument("--gamma", type=int, default=4,
+                   help="--draft: tokens proposed per decode round")
     args = p.parse_args(argv)
 
     import jax.numpy as jnp
@@ -127,8 +134,15 @@ def main(argv=None):
     from bigdl_tpu import observability as obs
     from bigdl_tpu.serving import ContinuousBatchingEngine
 
+    engine_kw = {}
+    if args.draft:
+        # the int8 clone doubles as the ENGINE's speculative draft:
+        # per iteration it proposes gamma tokens for every live slot
+        # in one scan, the target verifies them in one ragged
+        # dispatch, and greedy output stays token-identical
+        engine_kw = dict(draft=draft, spec_gamma=args.gamma)
     with ContinuousBatchingEngine(model, max_slots=2, prefill_chunk=8,
-                                  eos_id=0) as engine, \
+                                  eos_id=0, **engine_kw) as engine, \
             obs.start_http_server(host="127.0.0.1",
                                   healthz=engine.healthz,
                                   debug_requests=engine.debug_requests,
@@ -158,6 +172,12 @@ def main(argv=None):
               f"alerts={len(hz['alerts'])}); /debug/requests "
               f"p50 TTFT {ttft * 1e3:.1f}ms over "
               f"{dbg['latency']['ttft']['count']} requests")
+        if args.draft:
+            sp = engine.stats()["speculation"]
+            print(f"[spec-eng]  int8 draft gamma={sp['gamma']}: "
+                  f"accepted {sp['accepted_tokens']}/"
+                  f"{sp['proposed_tokens']} proposals "
+                  f"({sp['acceptance_rate']:.0%} acceptance rate)")
 
         # who owns the HBM: the engine registered its KV slot pool,
         # prefill staging, prefix pool, and params as named memory

@@ -302,7 +302,18 @@ class TransformerLM(Module):
         """Chunked forward (traced ``pos0``) returning logits at EVERY
         chunk position, (B, T, V) — the speculative-decoding verifier:
         one pass scores all draft proposals at once. Writes the chunk
-        tokens' KV like prefill_chunk (same caller contract)."""
+        tokens' KV like prefill_chunk (same caller contract).
+
+        ``pos0`` may be a (B,) vector of per-row offsets — the BATCHED
+        RAGGED verify entry point: each row's gamma+1-token proposal
+        chunk is scored at that row's OWN cache depth in one dispatch
+        (rows at different sequence positions, the continuous-batching
+        engine's slot-pooled speculative decode — see
+        ``bigdl_tpu.serving.engine``). Rides the same
+        ``forward_chunk`` ragged machinery as batched prefill, so one
+        compiled program serves every mix of per-row depths; caller
+        contract is per-row: ``pos0[r] + T <= cache length`` (an
+        overflowing row would silently clamp-corrupt its prefix)."""
         return self._prefill_impl(ids, caches, pos0, chunked=True,
                                   all_logits=True)
 
@@ -727,8 +738,12 @@ class TransformerLM(Module):
         when ``sampled``), writing the input tokens' KV as it goes.
         Returns ((gamma, B) proposals, (gamma, B, V) step logits — the
         sampled verifier's q distributions, ignored by the greedy
-        caller — and the caches). One factory for both modes so the
-        proposal scan can never diverge between them."""
+        caller — and the caches). ``pos0`` may be scalar or a (B,)
+        per-row position vector (``decode_step`` is ragged-aware and
+        the scan carry just holds the vector) — the serving engine
+        proposes for every live slot at its own depth through this
+        same program. One factory for both modes so the proposal scan
+        can never diverge between them."""
         per_model = _SPEC_JIT.setdefault(self, {})
         key = ("propose", b, gamma, sampled)
         fn = per_model.get(key)
@@ -761,7 +776,10 @@ class TransformerLM(Module):
 
     def _verify_fn(self, b: int, chunk_len: int):
         """Cached jitted speculative verifier for this (model, batch,
-        chunk): one chunked forward scoring every proposed position."""
+        chunk): one chunked forward scoring every proposed position.
+        ``pos0`` may be scalar (the lockstep ``speculative_generate``
+        path) or a (B,) per-row vector (ragged slot-pooled serving) —
+        each shape traces once through the same wrapper."""
         per_model = _SPEC_JIT.setdefault(self, {})
         fn = per_model.get((b, chunk_len))
         if fn is not None:

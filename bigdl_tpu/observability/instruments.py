@@ -258,6 +258,24 @@ def serving_engine_instruments(service: str = "engine",
             "bigdl_serving_prefix_cache_entries",
             "Prefix-cache entries currently retained", labelnames=lbl
         ).labels(service),
+        spec_proposed_tokens_total=r.counter(
+            "bigdl_serving_spec_proposed_tokens_total",
+            "Draft tokens proposed by the speculative decode loop "
+            "(gamma per live slot per iteration; 0 without a draft)",
+            labelnames=lbl).labels(service),
+        spec_accepted_tokens_total=r.counter(
+            "bigdl_serving_spec_accepted_tokens_total",
+            "Draft proposals the target's verify pass accepted (the "
+            "extra tokens speculation bought; compare against "
+            "bigdl_serving_spec_proposed_tokens_total for the "
+            "acceptance rate)", labelnames=lbl).labels(service),
+        spec_acceptance_ratio=r.histogram(
+            "bigdl_serving_spec_acceptance_ratio",
+            "Per-iteration draft acceptance fraction (accepted / "
+            "proposed across the live slots of one speculative decode "
+            "round) — near 1 says raise gamma, near 0 says the draft "
+            "disagrees with the target", labelnames=lbl,
+            buckets=FRACTION_BUCKETS).labels(service),
         device_prefill_seconds_total=r.counter(
             "bigdl_serving_device_seconds_total",
             "Host-measured wall seconds spent driving engine device "
@@ -519,6 +537,15 @@ def serving_bench_instruments(registry: Optional[MetricRegistry] = None
             "bigdl_bench_serving_prefix_reused_fraction",
             "Fraction of prompt tokens served from the prefix cache "
             "instead of prefilled"),
+        spec_acceptance_rate=lambda: r.gauge(
+            "bigdl_bench_serving_spec_acceptance_rate",
+            "Draft-token acceptance rate over the speculative bench "
+            "workload (accepted / proposed)"),
+        spec_inter_token_p50_speedup=lambda: r.gauge(
+            "bigdl_bench_serving_spec_inter_token_p50_speedup",
+            "Speculation-on vs -off engine inter-token p50 speedup on "
+            "the repeated-text workload (>1.0: the draft pays for "
+            "itself)"),
     )
 
 

@@ -10,7 +10,12 @@ dispatch with token-granular continuous batching —
   admission (batched ``prefill_rows`` wide through one ragged dispatch
   per round), and per-token slot eviction/reuse. Compiled shapes
   depend only on ``max_slots``/``prefill_rows``/pool rows — never on
-  load.
+  load. Pass ``draft=`` (plus ``spec_gamma``) for SPECULATIVE decode:
+  the draft proposes gamma tokens for every live slot in one scan,
+  the target verifies them in one ragged dispatch, and each row
+  accepts its own variable-length extension — greedy output stays
+  token-identical, decode dispatches per token drop by the acceptance
+  rate (``SpeculationPolicy``).
 - ``PrefixCache`` (``prefix_cache``): the host-side radix-trie index
   over token-id prefixes mapping to retained KV pool rows — a new
   request whose prompt shares a cached prefix skips prefill for the
@@ -56,22 +61,26 @@ each tenant (``handle.usage()``, ``stats()["usage"]``,
 
 from bigdl_tpu.serving.engine import ContinuousBatchingEngine
 from bigdl_tpu.serving.prefix_cache import PrefixCache, PrefixEntry
-from bigdl_tpu.serving.scheduler import AdmissionQueue, PrefillPolicy
+from bigdl_tpu.serving.scheduler import (
+    AdmissionQueue, PrefillPolicy, SpeculationPolicy,
+)
 from bigdl_tpu.serving.streams import (
     EngineStopped, QueueFull, RequestCancelled, RequestError,
     RequestHandle, RequestTimedOut,
 )
 from bigdl_tpu.serving.benchmark import (
-    poisson_workload, run_poisson_comparison,
-    run_shared_prefix_comparison, shared_prefix_workload,
+    poisson_workload, repeated_text_workload, run_poisson_comparison,
+    run_shared_prefix_comparison, run_speculative_comparison,
+    shared_prefix_workload,
 )
 
 __all__ = [
     "ContinuousBatchingEngine",
     "PrefixCache", "PrefixEntry",
-    "AdmissionQueue", "PrefillPolicy",
+    "AdmissionQueue", "PrefillPolicy", "SpeculationPolicy",
     "RequestHandle", "RequestError", "RequestCancelled",
     "RequestTimedOut", "QueueFull", "EngineStopped",
     "poisson_workload", "run_poisson_comparison",
     "shared_prefix_workload", "run_shared_prefix_comparison",
+    "repeated_text_workload", "run_speculative_comparison",
 ]

@@ -19,8 +19,17 @@ alongside the training headline.
 prompt templates, replayed through the engine with its prefix cache
 enabled vs disabled — the O(prompt) → O(novel-suffix) TTFT claim,
 measured, with greedy token parity asserted between the two paths.
-``scripts/perf_gate.py`` turns consecutive rows of either variant into
-a CI regression gate.
+
+``--serving --speculative`` runs the SPECULATIVE A/B
+(:func:`run_speculative_comparison`): one repeated-text Poisson
+workload replayed through the engine with an int8-clone draft
+proposing ``gamma`` tokens per fused round vs the plain one-token
+decode — inter-token p50/p99 both ways, the draft acceptance rate,
+and greedy token parity (a draft must never change the output, only
+how many dispatches it costs).
+
+``scripts/perf_gate.py`` turns consecutive rows of any variant into a
+CI regression gate.
 """
 
 from __future__ import annotations
@@ -156,6 +165,136 @@ def shared_prefix_workload(n_requests: int, rate_hz: float, vocab: int,
             "tenant": f"tpl-{ti}",
         })
     return out
+
+
+def repeated_text_workload(n_requests: int, rate_hz: float, vocab: int,
+                           motif_len: int = 4, prompt_lens=(8, 16),
+                           decode_lens=(8, 24), seed: int = 0,
+                           tenants=("tenant-a", "tenant-b", "tenant-c")
+                           ) -> List[dict]:
+    """Sample a REPEATED-TEXT open-loop workload: each prompt tiles a
+    short random motif (boilerplate, markup, table rows — the
+    self-similar traffic a draft model predicts well), so speculative
+    decoding gets a fair shot at a high acceptance rate while prompts
+    stay distinct enough that the prefix cache is not the thing being
+    measured. Same arrival/replay semantics as
+    :func:`poisson_workload`."""
+    r = np.random.RandomState(seed)
+    at = np.cumsum(r.exponential(1.0 / rate_hz, n_requests))
+    out = []
+    for i in range(n_requests):
+        motif = r.randint(0, vocab, (motif_len,)).astype(np.int32)
+        t0 = int(r.randint(prompt_lens[0], prompt_lens[1] + 1))
+        reps = -(-t0 // motif_len)
+        out.append({
+            "arrival_s": float(at[i]),
+            "prompt": np.tile(motif, reps)[:t0],
+            "n": int(r.randint(decode_lens[0], decode_lens[1] + 1)),
+            "tenant": tenants[i % len(tenants)] if tenants else None,
+        })
+    return out
+
+
+def run_speculative_comparison(model, draft=None, n_requests: int = 24,
+                               rate_hz: float = 30.0,
+                               max_slots: int = 4,
+                               prefill_chunk: int = 8,
+                               prefill_rows: int = 2,
+                               gamma: int = 4,
+                               eos_id: Optional[int] = None,
+                               seed: int = 0, registry=None,
+                               log=None) -> dict:
+    """Replay ONE repeated-text Poisson workload through the engine
+    twice — speculative decoding ON (``draft`` proposing ``gamma``
+    tokens per fused round; default: the int8-quantized clone of
+    ``model``, PERF.md's draft construction) vs OFF, everything else
+    identical — and report inter-token/TTFT/latency percentiles for
+    both, the speculative run's acceptance rate, the inter-token
+    p50/p99 speedups, and whether the two paths produced
+    token-identical greedy outputs (they must: a draft changes dispatch
+    count, never tokens). This is the decode-throughput claim of
+    speculative serving, measured."""
+    from bigdl_tpu.serving import ContinuousBatchingEngine
+
+    log = log or (lambda *a, **k: None)
+    if draft is None:
+        from bigdl_tpu.nn.quantized import Quantizer
+
+        log("[serving-bench] quantizing the int8 draft clone...")
+        draft = Quantizer.quantize(model)
+        draft.evaluate()
+    vocab = model.vocab_size
+    window = (model.max_len // prefill_chunk) * prefill_chunk
+    decode_hi = max(8, min(24, window // 2 - 16))
+    wl = repeated_text_workload(
+        n_requests, rate_hz, vocab,
+        prompt_lens=(8, min(16, window - decode_hi - 1)),
+        decode_lens=(min(8, decode_hi), decode_hi), seed=seed)
+    warm_prompt = np.asarray(
+        np.random.RandomState(seed + 1).randint(0, vocab, (12,)),
+        np.int32)
+
+    def run_path(name: str, **engine_kw) -> dict:
+        engine = ContinuousBatchingEngine(
+            model, max_slots=max_slots, prefill_chunk=prefill_chunk,
+            prefill_rows=prefill_rows, eos_id=eos_id,
+            registry=registry, service_name=name, **engine_kw)
+        ttft: List[float] = []
+        itl: List[float] = []
+        rows: dict = {}
+        tlock = threading.Lock()
+
+        def collect(handle, req):
+            row = handle.result()
+            with tlock:
+                rows[id(req)] = row
+                if handle.first_token_at is not None:
+                    ttft.append(handle.first_token_at
+                                - handle.submitted_at)
+                _append_itl(itl, handle)
+            return row.shape[0] - req["prompt"].shape[0]
+
+        log(f"[serving-bench] speculative replay ({name})...")
+        with engine:
+            # warm every executable outside the measurement window
+            engine.submit(warm_prompt, 4).result(timeout=300)
+            res = _replay(
+                wl, lambda req: engine.submit(req["prompt"], req["n"],
+                                              tenant=req.get("tenant")),
+                collect)
+            stats = engine.stats()
+        res["ttft"] = _percentiles(ttft)
+        res["inter_token"] = _percentiles(itl)
+        res["speculation"] = stats["speculation"]
+        res["jit_compiles"] = stats["jit_compiles"]
+        res.update(_usage_blocks(stats))
+        res["alerts"] = stats["alerts"]
+        res["rows"] = rows
+        return res
+
+    spec = run_path("bench_spec_on", draft=draft, spec_gamma=gamma)
+    nospec = run_path("bench_spec_off")
+    parity = all(
+        np.array_equal(spec["rows"][id(req)], nospec["rows"][id(req)])
+        for req in wl)
+    for r in (spec, nospec):
+        del r["rows"]
+
+    def ratio(key):
+        a, b = nospec["inter_token"][key], spec["inter_token"][key]
+        return round(a / b, 4) if a and b else None
+
+    return {"spec": spec, "nospec": nospec,
+            "inter_token_p50_speedup": ratio("p50"),
+            "inter_token_p99_speedup": ratio("p99"),
+            "acceptance_rate":
+                spec["speculation"].get("acceptance_rate"),
+            "token_parity": bool(parity),
+            "workload": {"kind": "speculative",
+                         "requests": n_requests, "rate_hz": rate_hz,
+                         "seed": seed, "max_slots": max_slots,
+                         "prefill_rows": prefill_rows,
+                         "gamma": gamma}}
 
 
 def run_shared_prefix_comparison(model, n_requests: int = 24,

@@ -36,13 +36,25 @@ analog of the RDMA paper's persistent dataflow, arxiv 1805.08430):
   immediately for the next queued request (eviction ≡ slot reuse; the
   stale KV is overwritten before it can ever be attended — decode
   writes position p before masking attention to ``<= p``).
+- decode is optionally SPECULATIVE (``draft=``): per iteration a
+  cheaper draft model proposes ``spec_gamma`` tokens for ALL live
+  slots in one ``lax.scan`` dispatch (its own slot-pooled KV cache,
+  allocated/recycled in lockstep with the target's), the target
+  scores every proposal through ONE ragged ``verify_chunk`` dispatch,
+  and each row accepts a VARIABLE-length extension (1..gamma+1
+  tokens) into its slot — per-row position advance, per-row
+  eos/budget truncation mid-extension, streaming handles emitting the
+  burst in order. Compiled shapes depend only on
+  ``(max_slots, spec_gamma)``, so the jit gauge stays flat.
 
 Greedy output is token-identical to a lone ``model.generate`` call per
-request — with the prefix cache COLD or WARM (tested): cached KV rows
-are bitwise the values prefill would recompute (the reuse offset is
-chunk-aligned, so chunk geometry matches; KV at position i depends
-only on tokens 0..i), same per-row ragged decode step, same argmax
-tie-breaking.
+request — with the prefix cache COLD or WARM, and with speculation ON
+or OFF (tested): cached KV rows are bitwise the values prefill would
+recompute (the reuse offset is chunk-aligned, so chunk geometry
+matches; KV at position i depends only on tokens 0..i), same per-row
+ragged decode step, same argmax tie-breaking; a draft only ever
+changes HOW MANY target dispatches an output costs, never the output
+(rejected proposals are replaced by the target's own argmax).
 """
 
 from __future__ import annotations
@@ -58,7 +70,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu.serving.prefix_cache import PrefixCache
-from bigdl_tpu.serving.scheduler import AdmissionQueue, PrefillPolicy
+from bigdl_tpu.serving.scheduler import (
+    AdmissionQueue, PrefillPolicy, SpeculationPolicy,
+)
 from bigdl_tpu.serving.streams import (
     EngineStopped, RequestCancelled, RequestHandle, RequestTimedOut,
 )
@@ -71,11 +85,12 @@ class _Admission:
     advances all of them together through one ragged dispatch."""
 
     __slots__ = ("handle", "slot", "row", "ids", "t0", "base", "tail",
-                 "n_chunks", "next_chunk", "entry")
+                 "n_chunks", "next_chunk", "entry", "d_ids",
+                 "d_n_chunks", "d_next_chunk")
 
     def __init__(self, handle: RequestHandle, slot: int, row: int,
                  ids: np.ndarray, t0: int, base: int, n_chunks: int,
-                 entry=None):
+                 entry=None, d_ids=None, d_n_chunks: int = 0):
         self.handle = handle
         self.slot = slot          # reserved pool slot (insert target)
         self.row = row            # staging-cache row this prefill owns
@@ -86,6 +101,14 @@ class _Admission:
         self.n_chunks = n_chunks
         self.next_chunk = 0
         self.entry = entry        # pinned PrefixEntry on a hit, else None
+        #: speculative decoding: the DRAFT model prefills the FULL
+        #: prompt into its own staging row (a prefix-cache hit skips
+        #: target work only — the draft pool holds no reusable prefix),
+        #: so its cursor can lag the target's on a hit; the admission
+        #: completes when BOTH caches hold the prompt
+        self.d_ids = d_ids        # (d_n_chunks * chunk,) full prompt
+        self.d_n_chunks = d_n_chunks
+        self.d_next_chunk = 0
 
 
 class _SlotState:
@@ -99,7 +122,10 @@ class _SlotState:
         self.handle = handle
         #: cache position the NEXT decode step writes (= prompt length
         #: + delivered - 1: the last sampled token's KV is not yet
-        #: cached, exactly generate()'s host-loop invariant)
+        #: cached, exactly generate()'s host-loop invariant — preserved
+        #: under VARIABLE advance too: a speculative round delivering m
+        #: tokens moves pos by m, and the slot's KV covers [0, pos)
+        #: either way, which is what donation relies on)
         self.pos = pos
         self.last_token = last_token
         self.last_token_at = now
@@ -143,6 +169,35 @@ class ContinuousBatchingEngine:
     BATCHED PREFILL: ``prefill_rows`` widens the staging cache so that
     many queued admissions chunk-prefill TOGETHER through one ragged
     dispatch per round instead of one admission at a time.
+
+    SPECULATIVE DECODING: pass ``draft=`` (a smaller ``TransformerLM``
+    over the same vocabulary — ``nn.quantized.Quantizer.quantize(model)``
+    builds the int8 clone PERF.md benchmarks) and each decode
+    iteration becomes draft-propose/target-verify: the draft proposes
+    ``spec_gamma`` tokens for ALL live slots in one ``lax.scan``
+    dispatch (``_propose_fn``), the target scores every proposal in
+    one ragged ``verify_chunk`` dispatch, and each row accepts its own
+    1..gamma+1-token extension (matched proposals plus the target's
+    correction/bonus token) — one target forward now yields several
+    tokens wherever the draft agrees with the target. The draft owns a
+    parallel slot pool + staging cache, allocated and recycled in
+    LOCKSTEP with the target's; admission chunk-prefills the draft's
+    row alongside the target's (the FULL prompt — a prefix-cache hit
+    skips target work only, so on hits the target's final chunk
+    replays idempotently while the draft catches up). Greedy output
+    stays token-identical to the non-speculative engine (and to lone
+    ``model.generate``); with ``temperature > 0`` the engine runs full
+    speculative SAMPLING (accept min(1, p/q), residual on rejection —
+    Leviathan et al. 2023), distributed exactly as the target's
+    tempered softmax, though not bitwise the non-speculative stream
+    (the key schedule differs); ``top_k``/``top_p`` are rejected with
+    a draft (the acceptance identity needs the unfiltered
+    distributions). Compiled shapes depend only on
+    ``(max_slots, spec_gamma)`` — the jit gauge stays flat after
+    warmup with speculation on (tested). Acceptance telemetry:
+    ``stats()["speculation"]``, ``bigdl_serving_spec_*`` instruments,
+    and per-burst ``request/decode_token`` events carrying
+    ``accepted=``.
 
     When to prefer this over ``GenerationService``: mixed or long
     decode lengths under concurrent load (no head-of-line blocking on
@@ -207,7 +262,9 @@ class ContinuousBatchingEngine:
                  admission_window: int = 4,
                  slo_objectives=None,
                  usage_tenants: int = 32,
-                 usage_recent: int = 256):
+                 usage_recent: int = 256,
+                 draft=None,
+                 spec_gamma: int = 4):
         from bigdl_tpu.models.transformer import _validate_sampling
         from bigdl_tpu.observability import serving_engine_instruments
         from bigdl_tpu.observability import memory as obs_memory
@@ -229,6 +286,23 @@ class ContinuousBatchingEngine:
         self.eos_id = eos_id
         self.temperature = temperature
         self.top_k, self.top_p = top_k, top_p
+        self.draft = draft
+        self._spec = None
+        if draft is not None:
+            if draft.vocab_size != model.vocab_size:
+                raise ValueError(
+                    f"draft vocab ({draft.vocab_size}) must match the "
+                    f"target's ({model.vocab_size}) — acceptance "
+                    "compares distributions token-for-token")
+            if temperature > 0.0 and (top_k is not None
+                                      or top_p is not None):
+                raise ValueError(
+                    "speculative sampling accepts with min(1, p/q) "
+                    "over the UNFILTERED tempered distributions; "
+                    "top_k/top_p would break the acceptance identity "
+                    "— drop them or drop the draft")
+            draft.evaluate()
+            self._spec = SpeculationPolicy(spec_gamma)
         self.idle_wait_s = idle_wait_s
         self.service_name = service_name
         self.admission_window = admission_window
@@ -267,6 +341,21 @@ class ContinuousBatchingEngine:
                 f"prefill_chunk {c} exceeds the usable context {cap}")
         self.max_len = cap
         self._cache_len = cache_len
+        # speculation pads every KV row by gamma scratch positions: a
+        # verify round launched at the window's last decodable
+        # position still writes gamma (possibly rejected) proposal
+        # positions past it — headroom instead of a silently-clamping
+        # (= prefix-corrupting) dynamic_update_slice. Scratch beyond a
+        # row's live prefix is position-masked until overwritten,
+        # exactly the slot-reuse argument.
+        phys_len = cache_len + (self._spec.kv_headroom
+                                if self._spec is not None else 0)
+        self._phys_len = phys_len
+        if draft is not None and draft.max_len < cap:
+            raise ValueError(
+                f"draft context ({draft.max_len}) is shorter than the "
+                f"engine's serving window ({cap}); shrink max_len or "
+                "bring a longer-context draft")
 
         self._params = jax.tree.map(jnp.asarray, model.params_dict())
         self._buffers = jax.tree.map(jnp.asarray, model.buffers_dict())
@@ -274,12 +363,27 @@ class ContinuousBatchingEngine:
         # THE pooled cache: one persistent (max_slots, ...) buffer set,
         # donated through every step — updates are in-place for the
         # engine's whole life
-        self._caches = model.init_cache(max_slots, cache_len, dtype=dtype)
+        self._caches = model.init_cache(max_slots, phys_len, dtype=dtype)
         # prefill_rows-wide staging cache for chunked prefill; rows are
         # reused across admissions (stale tail KV is position-masked,
         # never attended)
         self._staging = model.init_cache(self._policy.prefill_rows,
-                                         cache_len, dtype=dtype)
+                                         phys_len, dtype=dtype)
+        if draft is not None:
+            # the draft's slot pool + staging mirror the target's
+            # geometry row-for-row (same phys_len so lifecycle stays
+            # lockstep even though draft head counts/dims may differ)
+            self._d_params = jax.tree.map(jnp.asarray,
+                                          draft.params_dict())
+            self._d_bufs = jax.tree.map(jnp.asarray,
+                                        draft.buffers_dict())
+            d_dtype = draft.tok_embed.dtype
+            self._d_caches = draft.init_cache(max_slots, phys_len,
+                                              dtype=d_dtype)
+            self._d_staging = draft.init_cache(
+                self._policy.prefill_rows, phys_len, dtype=d_dtype)
+        else:
+            self._d_caches = self._d_staging = None
         # prefix-cache KV pool: a third persistent buffer set holding
         # the retained prefixes, plus its host-side radix-trie index.
         # The byte budget is enforced as a row budget fixed here, so
@@ -289,7 +393,7 @@ class ContinuousBatchingEngine:
         self._row_bytes = row_bytes
         #: device KV bytes one cached token position costs — the
         #: exchange rate prefix-reuse savings are credited at
-        self._token_bytes = row_bytes / cache_len
+        self._token_bytes = row_bytes / phys_len
         if prefix_cache_rows is not None:
             pool_rows = max(0, int(prefix_cache_rows))
         elif prefix_cache_bytes is None:
@@ -297,7 +401,7 @@ class ContinuousBatchingEngine:
         else:
             pool_rows = max(0, int(prefix_cache_bytes) // row_bytes)
         if pool_rows > 0:
-            self._pool = model.init_cache(pool_rows, cache_len,
+            self._pool = model.init_cache(pool_rows, phys_len,
                                           dtype=dtype)
             self._prefix = PrefixCache(
                 pool_rows, row_bytes,
@@ -312,6 +416,10 @@ class ContinuousBatchingEngine:
         #: engine (the reused-fraction denominator — per-instance
         #: exact, unlike the shared-label registry counter)
         self._prefilled_tokens = 0
+        #: per-instance speculative tallies (the stats() numerator/
+        #: denominator — the registry counters are shared per label)
+        self._spec_proposed = 0
+        self._spec_accepted = 0
         #: programs that have run at least once — the jit_compiles
         #: fallback when jax's _cache_size probe is unavailable
         self._warm = set()
@@ -354,6 +462,13 @@ class ContinuousBatchingEngine:
         if self._pool is not None:
             pools[f"serving/{service_name}/prefix_pool"] = \
                 lambda e: obs_memory.tree_bytes(e._pool)
+        if self.draft is not None:
+            pools[f"serving/{service_name}/draft_kv_slots"] = \
+                lambda e: obs_memory.tree_bytes(e._d_caches)
+            pools[f"serving/{service_name}/draft_staging"] = \
+                lambda e: obs_memory.tree_bytes(e._d_staging)
+            pools[f"serving/{service_name}/draft_params"] = \
+                lambda e: obs_memory.tree_bytes(e._d_params)
         self._memory_pools = obs_memory.register_owned_pools(self, pools)
         if self._prefix is not None:
             self._memory_pools.append(self._prefix.register_memory_pool(
@@ -397,7 +512,9 @@ class ContinuousBatchingEngine:
 
     # ------------------------------------------------- compiled programs
     def _build_fns(self):
-        from bigdl_tpu.models.transformer import _filter_logits
+        from bigdl_tpu.models.transformer import (
+            _filter_logits, _spec_accept,
+        )
         from bigdl_tpu.nn.module import bind
 
         model = self.model
@@ -458,6 +575,93 @@ class ContinuousBatchingEngine:
         self._chunk_jit = jax.jit(chunk, donate_argnums=(3,))
         self._copy_row_jit = jax.jit(copy_row, donate_argnums=(0,))
         self._sample0_jit = jax.jit(sample0)
+
+        # ---- speculative-decoding programs --------------------------
+        self._propose_jit = self._spec_verify_jit = None
+        self._d_chunk_jit = self._d_sync_jit = None
+        if self.draft is not None:
+            draft = self.draft
+            g = self._spec.gamma
+
+            # the draft proposer IS the standalone speculative path's
+            # cached per-(model, batch, gamma) lax.scan
+            # (transformer._propose_fn): (max_slots,) tokens at
+            # (max_slots,) per-row positions, gamma draft steps, ONE
+            # dispatch, draft KV written as it goes
+            self._propose_jit = draft._propose_fn(self.max_slots, g,
+                                                  sampled=sampled)
+
+            def d_chunk(p, bufs, ids, caches, pos0, last_idx):
+                # the draft's mirror of the ragged admission prefill:
+                # same chunk geometry, its own staging cache; the
+                # gathered logits are discarded (the first token always
+                # samples from the TARGET's prefill logits)
+                with bind(draft, p, bufs, False, None):
+                    return draft.prefill_chunk_at(ids, caches, pos0,
+                                                  last_idx)
+
+            def d_sync(p, bufs, tok, pos, caches):
+                # one ragged draft step re-writing each row's LAST
+                # accepted token's KV at its own position: for rows
+                # that accepted everything this fills the one position
+                # the propose scan never wrote (the gamma-th proposal's
+                # KV); for every other row it rewrites identical values
+                # in place (same token, same position -> same KV), so
+                # one fixed-shape dispatch serves all rows
+                with bind(draft, p, bufs, False, None):
+                    _, caches = draft.decode_step(tok, pos, caches)
+                return caches
+
+            def spec_verify(p, bufs, tok, props, qlogits, pos, caches,
+                            rng, temperature):
+                # ONE ragged target forward scores every row's
+                # proposals (the verify_chunk path): chunk column 0 is
+                # the row's pending token (its KV is written first),
+                # columns 1..g its proposals; logits column j predicts
+                # the token at position pos+j+1. Acceptance is decided
+                # per ROW in-graph so the host transfer is just the
+                # (S, g+1) emit matrix + (S,) accepted counts.
+                chunk = jnp.concatenate(
+                    [tok[:, None], jnp.swapaxes(props, 0, 1)], axis=1)
+                with bind(model, p, bufs, False, None):
+                    logits, caches = model.verify_chunk(chunk, caches,
+                                                        pos)
+                if sampled:
+                    accept, resid, bonus = _spec_accept(
+                        logits, jnp.swapaxes(qlogits, 0, 1),
+                        chunk[:, 1:], temperature, rng)
+                    n_acc = jnp.sum(jnp.cumprod(
+                        accept.astype(jnp.int32), axis=1), axis=1)
+                    # emit column j: the proposal while accepted; at
+                    # the first rejection the residual draw, on full
+                    # acceptance the bonus draw (columns past n_acc
+                    # are never read by the host)
+                    fix = jnp.take_along_axis(
+                        jnp.concatenate([resid, bonus[:, None]],
+                                        axis=1),
+                        n_acc[:, None], axis=1)
+                    cols = jnp.arange(g + 1)[None, :]
+                    padded = jnp.concatenate(
+                        [chunk[:, 1:], jnp.zeros_like(tok)[:, None]],
+                        axis=1)
+                    emit = jnp.where(cols < n_acc[:, None], padded, fix)
+                else:
+                    v_tok = jnp.argmax(logits, axis=-1).astype(
+                        jnp.int32)
+                    match = (chunk[:, 1:] == v_tok[:, :g]).astype(
+                        jnp.int32)
+                    n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+                    # matched proposals ARE the target argmax, so the
+                    # emitted burst is v_tok[:, :n_acc+1] verbatim —
+                    # exactly the tokens the non-speculative engine
+                    # would have argmaxed one step at a time
+                    emit = v_tok
+                return emit, n_acc, caches
+
+            self._d_chunk_jit = jax.jit(d_chunk, donate_argnums=(3,))
+            self._d_sync_jit = jax.jit(d_sync, donate_argnums=(4,))
+            self._spec_verify_jit = jax.jit(spec_verify,
+                                            donate_argnums=(6,))
         # warm the copy signatures NOW (zero rows copied onto zero rows
         # — harmless): the insert/stage/donate copies first fire at a
         # request's FINISH or at the first cache hit, and a compile
@@ -473,11 +677,39 @@ class ContinuousBatchingEngine:
             self._pool = self._copy_row_jit(self._pool, self._caches,
                                             z, z)
             self._warm.update(("copy:stage", "copy:donate"))
+        if self.draft is not None:
+            # the draft staging->slot insert is a fourth copy
+            # signature (draft tree shapes)
+            self._d_caches = self._copy_row_jit(self._d_caches,
+                                                self._d_staging, z, z)
+            self._warm.add("copy:d_insert")
+            # warm the whole speculative round NOW (zero tokens at
+            # position 0 — junk in empty rows, overwritten by every
+            # admission's full-row insert): the sync dispatch is
+            # CONDITIONAL at runtime (it only fires when some row
+            # fully accepts), so left cold it could first compile many
+            # iterations after warmup and read as a recompile storm
+            zt = jnp.zeros((self.max_slots,), jnp.int32)
+            zk = jax.random.PRNGKey(0)
+            t1 = jnp.float32(1.0)
+            props, qlogits, self._d_caches = self._propose_jit(
+                self._d_params, self._d_bufs, zt, zt, self._d_caches,
+                zk, t1)
+            _, _, self._caches = self._spec_verify_jit(
+                self._params, self._buffers, zt, props, qlogits, zt,
+                self._caches, zk, t1)
+            self._d_caches = self._d_sync_jit(
+                self._d_params, self._d_bufs, zt, zt, self._d_caches)
+            self._warm.update(("spec:propose", "spec:verify",
+                               "spec:sync"))
 
     def _compile_total(self) -> int:
-        counts = [_compile_count(f) for f in
-                  (self._step_jit, self._chunk_jit, self._copy_row_jit,
-                   self._sample0_jit)]
+        fns = [self._step_jit, self._chunk_jit, self._copy_row_jit,
+               self._sample0_jit]
+        if self.draft is not None:
+            fns += [self._propose_jit, self._spec_verify_jit,
+                    self._d_chunk_jit, self._d_sync_jit]
+        counts = [_compile_count(f) for f in fns]
         if all(c is None for c in counts):
             # _cache_size absent in this jax build: approximate with
             # the warmed-program count (each program compiles exactly
@@ -686,6 +918,7 @@ class ContinuousBatchingEngine:
         out["jit_compiles"] = self._compile_total()
         out["latency"] = self._latency_summary()
         out["prefix_cache"] = self._prefix_summary()
+        out["speculation"] = self._spec_summary()
         out["usage"] = self._usage.summary()
         out["alerts"] = self.alerts()
         return out
@@ -713,6 +946,24 @@ class ContinuousBatchingEngine:
             "prefilled_tokens": prefilled,
             "reused_fraction": (round(ps["reused_tokens"] / denom, 4)
                                 if denom else 0.0),
+        }
+
+    def _spec_summary(self) -> dict:
+        """The ``stats()["speculation"]`` block: per-instance proposed
+        vs accepted draft-token tallies and the acceptance rate (the
+        gamma-tuning signal — a rate near 1 says raise gamma, a rate
+        near 0 says the draft disagrees with the target and every
+        round degenerates to one corrected token)."""
+        if self._spec is None:
+            return {"enabled": False}
+        prop = self._spec_proposed
+        return {
+            "enabled": True,
+            "gamma": self._spec.gamma,
+            "proposed_tokens": prop,
+            "accepted_tokens": self._spec_accepted,
+            "acceptance_rate": (round(self._spec_accepted / prop, 4)
+                                if prop else 0.0),
         }
 
     def _latency_summary(self) -> dict:
@@ -772,7 +1023,7 @@ class ContinuousBatchingEngine:
             })
         for adm in list(self._adms):
             h = adm.handle
-            in_flight.append({
+            row = {
                 "request_id": h.request_id, "state": "prefill",
                 "age_s": now - h.submitted_at,
                 "prompt_tokens": int(h.prompt.shape[0]),
@@ -782,7 +1033,11 @@ class ContinuousBatchingEngine:
                 "chunks_total": adm.n_chunks,
                 "staging_row": adm.row,
                 "prefix_tokens": adm.base,
-            })
+            }
+            if self.draft is not None:
+                row["draft_chunks_done"] = adm.d_next_chunk
+                row["draft_chunks_total"] = adm.d_n_chunks
+            in_flight.append(row)
         for sid, st in enumerate(list(self._slots)):
             if st is None:
                 continue
@@ -802,6 +1057,7 @@ class ContinuousBatchingEngine:
                 "recent": recent,
                 "latency": self._latency_summary(),
                 "prefix_cache": self._prefix_summary(),
+                "speculation": self._spec_summary(),
                 "alerts": self.alerts()}
 
     def debug_usage(self, top_n: int = 10) -> dict:
@@ -1056,8 +1312,19 @@ class ContinuousBatchingEngine:
         n_chunks = self._policy.n_chunks(tail)
         ids = np.zeros((n_chunks * c,), np.int32)  # right-pad final chunk
         ids[:tail] = h.prompt[base:]
+        d_ids, d_n_chunks = None, 0
+        if self.draft is not None:
+            # the draft prefills the FULL prompt into its own staging
+            # row — the prefix pool holds target KV only, so a hit
+            # skips target chunks but never draft chunks (the draft
+            # cursor then lags and the admission completes when both
+            # caches hold the prompt)
+            d_n_chunks = self._policy.n_chunks(t0)
+            d_ids = np.zeros((d_n_chunks * c,), np.int32)
+            d_ids[:t0] = h.prompt
         self._adms.append(_Admission(h, slot, row, ids, t0, base,
-                                     n_chunks, entry))
+                                     n_chunks, entry, d_ids,
+                                     d_n_chunks))
         h.prefix_tokens = base
         h.admitted_at = time.monotonic()
         rec = getattr(h, "_usage", None)
@@ -1074,37 +1341,64 @@ class ContinuousBatchingEngine:
 
     def _prefill_round(self) -> None:
         """Advance EVERY in-flight admission by one chunk through one
-        ragged dispatch, then complete the ones whose prompt is fully
-        staged (slot insert + first-token sample)."""
+        ragged dispatch — plus, with a draft, one MIRRORED ragged
+        dispatch over the draft staging cache — then complete the ones
+        whose prompt is fully staged in every cache that needs it
+        (slot insert + first-token sample).
+
+        A prefix-cache hit can leave the target cursor finished while
+        the draft still prefills the reused head: those rows REPLAY
+        their final target chunk each round (an idempotent rewrite —
+        same ids, same offset, same KV values) so the fixed-shape
+        dispatch needs no per-row liveness flag and the final-round
+        logits are fresh for the first-token sample whenever the
+        admission actually completes."""
         c = self._policy.chunk
         rows = self._policy.prefill_rows
+        spec = self.draft is not None
         ids = np.zeros((rows, c), np.int32)
         pos0 = np.zeros((rows,), np.int32)
         last = np.full((rows,), c - 1, np.int32)
         finals: List[_Admission] = []
         for a in self._adms:
-            k = a.next_chunk
+            # once the target cursor is past its last chunk (draft
+            # still catching up), clamp to the final chunk: a replay
+            k = min(a.next_chunk, a.n_chunks - 1)
             ids[a.row] = a.ids[k * c:(k + 1) * c]
             pos0[a.row] = a.base + k * c
-            if k == a.n_chunks - 1:
+            if a.next_chunk >= a.n_chunks - 1:
                 # the true last prompt position within the final chunk
                 # — pad positions behind it are written but never
                 # attended (causal mask within the chunk; decode
                 # overwrites position p before attending <= p)
-                last[a.row] = a.tail - 1 - k * c
-                finals.append(a)
+                last[a.row] = a.tail - 1 - (a.n_chunks - 1) * c
+                if not spec or a.d_next_chunk >= a.d_n_chunks - 1:
+                    finals.append(a)
         # a COLD dispatch's wall is dominated by its one-time compile —
         # billing that to whichever tenants happen to arrive first
         # would poison their device-seconds forever, so warmup rounds
         # are excluded from attribution AND the busy tally (both sides
         # skip: conservation holds, goodput reads the warm engine)
         was_warm = "chunk" in self._warm and (
+            not spec or "d_chunk" in self._warm) and (
             not finals or "sample0" in self._warm)
         t_disp = time.monotonic()
         logits, self._staging = self._chunk_jit(
             self._params, self._buffers, jnp.asarray(ids), self._staging,
             jnp.asarray(pos0), jnp.asarray(last))
         self._warm.add("chunk")
+        if spec:
+            d_ids = np.zeros((rows, c), np.int32)
+            d_pos0 = np.zeros((rows,), np.int32)
+            for a in self._adms:
+                dk = a.d_next_chunk
+                d_ids[a.row] = a.d_ids[dk * c:(dk + 1) * c]
+                d_pos0[a.row] = dk * c
+            _, self._d_staging = self._d_chunk_jit(
+                self._d_params, self._d_bufs, jnp.asarray(d_ids),
+                self._d_staging, jnp.asarray(d_pos0),
+                jnp.zeros((rows,), jnp.int32))
+            self._warm.add("d_chunk")
         toks = None
         if finals:
             # the host-side transfer blocks on the sampled tokens —
@@ -1116,29 +1410,42 @@ class ContinuousBatchingEngine:
         wall = time.monotonic() - t_disp
         # pro-rata attribution by REAL tokens each row advanced (the
         # padded tail of a final chunk is engine overhead, not billable
-        # work); weights sum to 1 — the round's full wall is conserved
-        done_by = [(a, min(c, a.tail - a.next_chunk * c))
-                   for a in self._adms]
+        # work; a replayed chunk advances nothing and earns nothing;
+        # draft chunks are real mirrored work); weights sum to 1 — the
+        # round's full wall is conserved
+        done_by = []
+        for a in self._adms:
+            t_done = (min(c, a.tail - a.next_chunk * c)
+                      if a.next_chunk < a.n_chunks else 0)
+            d_done = min(c, a.t0 - a.d_next_chunk * c) if spec else 0
+            done_by.append((a, t_done, d_done))
         if was_warm:
-            total_done = sum(d for _, d in done_by) or 1
+            total_done = sum(t + d for _, t, d in done_by) or 1
             self._usage.charge_dispatch(
                 "prefill", wall,
-                [(getattr(a.handle, "_usage", None), d / total_done)
-                 for a, d in done_by],
+                [(getattr(a.handle, "_usage", None),
+                  (t + d) / total_done)
+                 for a, t, d in done_by],
                 rows_advanced=len(self._adms),
                 capacity_rows=self._policy.prefill_rows)
-        for a, done in done_by:
-            k = a.next_chunk
-            self._prefilled_tokens += done
-            self._ins.prefill_tokens_total.inc(done)
-            rec = getattr(a.handle, "_usage", None)
-            if rec is not None:
-                self._usage.add_prefill(rec, done)
-            self._rec.record("request/prefill_chunk",
-                             a.handle.request_id,
-                             service=self.service_name, chunk=k,
-                             n_chunks=a.n_chunks, tokens=done)
-            a.next_chunk += 1
+        for a, t_done, d_done in done_by:
+            if t_done:
+                k = a.next_chunk
+                # only TARGET prompt tokens count as prefill work —
+                # draft mirroring is engine overhead, and the billing
+                # invariant prefill + prefix_reused == prompt holds
+                self._prefilled_tokens += t_done
+                self._ins.prefill_tokens_total.inc(t_done)
+                rec = getattr(a.handle, "_usage", None)
+                if rec is not None:
+                    self._usage.add_prefill(rec, t_done)
+                self._rec.record("request/prefill_chunk",
+                                 a.handle.request_id,
+                                 service=self.service_name, chunk=k,
+                                 n_chunks=a.n_chunks, tokens=t_done)
+                a.next_chunk += 1
+            if spec:
+                a.d_next_chunk += 1
         for a in finals:
             self._complete_admission(a, int(toks[a.row]))
 
@@ -1150,6 +1457,13 @@ class ContinuousBatchingEngine:
             self._caches, self._staging, jnp.int32(a.slot),
             jnp.int32(a.row))
         self._warm.add("copy:insert")
+        if self.draft is not None:
+            # draft slot state moves in lockstep: the draft's staged
+            # full-prompt KV lands in the SAME slot index
+            self._d_caches = self._copy_row_jit(
+                self._d_caches, self._d_staging, jnp.int32(a.slot),
+                jnp.int32(a.row))
+            self._warm.add("copy:d_insert")
         if a.entry is not None:
             self._prefix.release(a.entry)
             a.entry = None
@@ -1214,6 +1528,8 @@ class ContinuousBatchingEngine:
 
     # --------------------------------------------------------- decode
     def _decode_all(self, active: List[int]) -> None:
+        if self.draft is not None:
+            return self._decode_all_spec(active)
         tok = np.zeros((self.max_slots,), np.int32)
         pos = np.zeros((self.max_slots,), np.int32)
         for sid in active:
@@ -1230,9 +1546,11 @@ class ContinuousBatchingEngine:
         nxt_np = np.asarray(nxt)   # blocks on the fused step
         now = time.monotonic()
         # every advanced row got exactly one token: the step's wall
-        # splits evenly across them (idle slots ride along as padding
-        # — their share is the dispatch's padding waste, not billed).
-        # Warmup steps are excluded like cold prefill rounds above.
+        # splits evenly across them — identical to weighting by
+        # delivered tokens, the speculative path's rule (idle slots
+        # ride along as padding — their share is the dispatch's
+        # padding waste, not billed). Warmup steps are excluded like
+        # cold prefill rounds above.
         if was_warm:
             w = 1.0 / len(active)
             self._usage.charge_dispatch(
@@ -1241,25 +1559,138 @@ class ContinuousBatchingEngine:
                  for sid in active],
                 rows_advanced=len(active), capacity_rows=self.max_slots)
         for sid in active:
+            self._deliver_burst(sid, nxt_np[sid:sid + 1], now)
+
+    def _decode_all_spec(self, active: List[int]) -> None:
+        """Speculative decode over every occupied slot: one draft
+        propose scan + one ragged target verify + one draft sync step
+        — three fixed-shape dispatches for up to ``gamma + 1`` tokens
+        per row. Acceptance is per ROW (a row whose draft guessed well
+        advances further than its neighbors — no min-over-batch
+        conservatism), and eos or the per-request token budget can
+        truncate an extension mid-burst. Compiled shapes depend only
+        on ``(max_slots, gamma)``."""
+        g = self._spec.gamma
+        tok = np.zeros((self.max_slots,), np.int32)
+        pos = np.zeros((self.max_slots,), np.int32)
+        for sid in active:
             st = self._slots[sid]
-            t = int(nxt_np[sid])
+            tok[sid] = st.last_token
+            pos[sid] = st.pos
+        was_warm = ("spec:propose" in self._warm
+                    and "spec:verify" in self._warm)
+        if self.temperature > 0.0:
+            r_draft, r_acc = self._next_key(), self._next_key()
+        else:
+            r_draft = r_acc = self._zero_key
+        t_disp = time.monotonic()
+        props, qlogits, self._d_caches = self._propose_jit(
+            self._d_params, self._d_bufs, jnp.asarray(tok),
+            jnp.asarray(pos), self._d_caches, r_draft, self._temp())
+        emit, n_acc, self._caches = self._spec_verify_jit(
+            self._params, self._buffers, jnp.asarray(tok), props,
+            qlogits, jnp.asarray(pos), self._caches, r_acc,
+            self._temp())
+        emit_np = np.asarray(emit)    # blocks on both dispatches
+        n_np = np.asarray(n_acc)
+        wall = time.monotonic() - t_disp
+        self._warm.update(("spec:propose", "spec:verify"))
+        now = time.monotonic()
+        # draft sync BEFORE the next round can propose: a
+        # FULL-acceptance row is missing exactly one draft KV write
+        # (the propose scan never fed its gamma-th proposal through
+        # the draft), so rewrite each row's last accepted token at its
+        # own position — partial-acceptance rows rewrite identical
+        # values in place, so one fixed-shape ragged dispatch serves
+        # all rows. Skipped entirely when NO row fully accepted (their
+        # scans already wrote everything); the program is warmed at
+        # construction, so the conditional launch can never read as a
+        # post-warmup compile. Enqueued async; the data dependency on
+        # _d_caches orders it against the next propose.
+        if any(int(n_np[sid]) == g for sid in active):
+            sync_tok = np.zeros((self.max_slots,), np.int32)
+            sync_pos = np.zeros((self.max_slots,), np.int32)
+            for sid in active:
+                n_r = int(n_np[sid])
+                sync_tok[sid] = (tok[sid] if n_r == 0
+                                 else int(emit_np[sid, n_r - 1]))
+                sync_pos[sid] = pos[sid] + n_r
+            self._d_caches = self._d_sync_jit(
+                self._d_params, self._d_bufs, jnp.asarray(sync_tok),
+                jnp.asarray(sync_pos), self._d_caches)
+        # burst lengths FIRST (pure), so the dispatch wall is
+        # attributed before any handle can finalize — a late charge
+        # against an already-finalized record would leak out of the
+        # tenant aggregates and break conservation
+        bursts = {}
+        proposed = accepted = 0
+        for sid in active:
+            st = self._slots[sid]
+            n_r = int(n_np[sid])
+            proposed += g
+            accepted += n_r
+            st.handle.spec_proposed += g
+            st.handle.spec_accepted += n_r
+            room = st.handle.max_new_tokens - st.delivered
+            toks = emit_np[sid, :min(n_r + 1, room)]
+            if self.eos_id is not None:
+                hits = np.flatnonzero(toks == self.eos_id)
+                if hits.size:     # eos mid-extension: stop AT it
+                    toks = toks[:hits[0] + 1]
+            bursts[sid] = toks
+        self._spec_proposed += proposed
+        self._spec_accepted += accepted
+        self._ins.spec_proposed_tokens_total.inc(proposed)
+        self._ins.spec_accepted_tokens_total.inc(accepted)
+        if proposed:
+            self._ins.spec_acceptance_ratio.observe(accepted / proposed)
+        if was_warm:
+            # the round's wall splits by each row's DELIVERED tokens:
+            # billing follows useful work, not slot occupancy — and
+            # the weights still sum to 1, so tenant device-second
+            # sums conserve the measured busy tally (tested)
+            total = sum(len(b) for b in bursts.values()) or 1
+            self._usage.charge_dispatch(
+                "decode", wall,
+                [(getattr(self._slots[sid].handle, "_usage", None),
+                  len(b) / total) for sid, b in bursts.items()],
+                rows_advanced=len(active), capacity_rows=self.max_slots)
+        for sid in active:
+            self._deliver_burst(sid, bursts[sid], now)
+
+    def _deliver_burst(self, sid: int, toks, now: float) -> None:
+        """Stream one decode round's extension (1..gamma+1 tokens, in
+        order) into the slot's handle, advancing the slot position by
+        exactly the delivered count — the variable-advance invariant:
+        afterwards the slot's KV covers ``[0, pos)`` and the last
+        delivered token's KV is not yet cached, same as a 1-token
+        step. Observes the inter-token histogram per TOKEN (the burst
+        gap split evenly across its tokens, so histogram count keeps
+        equalling delivered tokens), records ONE ``decode_token``
+        event per burst carrying ``accepted=``, and finishes the row
+        at eos / token budget."""
+        st = self._slots[sid]
+        h = st.handle
+        m = len(toks)
+        gap = (now - st.last_token_at) / m
+        last = int(toks[-1])
+        for t in toks:
             st.delivered += 1
-            st.pos += 1
-            st.last_token = t
-            self._ins.inter_token_seconds.observe(now - st.last_token_at)
-            st.last_token_at = now
-            h = st.handle
-            h._deliver(t, now)
-            rec = getattr(h, "_usage", None)
-            if rec is not None:
-                self._usage.delivered(rec, 1)
-            self._ins.decode_tokens_total.inc()
-            self._rec.record("request/decode_token", h.request_id,
-                             service=self.service_name, slot=sid,
-                             token=t, n=st.delivered)
-            if (self.eos_id is not None and t == self.eos_id) \
-                    or st.delivered >= h.max_new_tokens:
-                self._release(sid, None, "finished")
+            h._deliver(int(t), now)
+            self._ins.inter_token_seconds.observe(gap)
+        st.pos += m
+        st.last_token = last
+        st.last_token_at = now
+        rec = getattr(h, "_usage", None)
+        if rec is not None:
+            self._usage.delivered(rec, m)
+        self._ins.decode_tokens_total.inc(m)
+        self._rec.record("request/decode_token", h.request_id,
+                         service=self.service_name, slot=sid,
+                         token=last, n=st.delivered, accepted=m)
+        if (self.eos_id is not None and last == self.eos_id) \
+                or st.delivered >= h.max_new_tokens:
+            self._release(sid, None, "finished")
 
     # ------------------------------------------------------- plumbing
     def _temp(self):

@@ -17,6 +17,12 @@ Two policies live here, deliberately separate from the device loop:
   Chunked prefill under a per-iteration token budget means admitting a
   10k-token prompt never stalls the decode of already-running requests
   for more than one round's worth of work.
+- ``SpeculationPolicy`` — the draft-propose/target-verify decode
+  config (``engine(draft=..., spec_gamma=...)``): how many tokens the
+  draft model proposes per fused decode round (``gamma``), and the
+  derived shapes the engine's compiled programs depend on (the
+  ``gamma + 1``-wide verify chunk, the extra KV positions every pool
+  row must carry for rejected-proposal scratch writes).
 
 The reference's serving story (optim/PredictionService.scala) bounds
 concurrency with an instance queue; this is the generative analog where
@@ -297,3 +303,43 @@ class PrefillPolicy:
     def n_chunks(self, prompt_len: int) -> int:
         """Chunks a prompt of this length needs (last chunk padded)."""
         return -(-prompt_len // self.chunk)
+
+
+class SpeculationPolicy:
+    """Speculative-decoding config for the engine's fused decode loop:
+    per round the DRAFT model proposes ``gamma`` tokens for every live
+    slot in one ``lax.scan`` dispatch and the TARGET scores all of
+    them in one ragged ``verify_chunk`` forward — each row then
+    accepts a variable-length extension (1..gamma+1 tokens: the
+    matched proposal prefix plus the target's correction/bonus token).
+
+    Compiled-shape contract: every speculative program's shape depends
+    only on ``(max_slots, gamma)`` — the verify chunk is always
+    ``gamma + 1`` wide and the propose scan always ``gamma`` long, so
+    acceptance raggedness is a HOST-side slice, never a recompile.
+
+    ``kv_headroom`` is the extra KV positions every pool row must
+    carry beyond the serving window: a verify round starting at the
+    window's last decodable position still writes ``gamma`` scratch
+    positions of (possibly rejected) proposal KV past it. Rejected
+    scratch is overwritten by the next round before any query can
+    attend it (the same position-mask argument as slot reuse)."""
+
+    def __init__(self, gamma: int = 4):
+        if gamma < 1:
+            raise ValueError(
+                f"spec_gamma must be >= 1 (one proposed token), "
+                f"got {gamma}")
+        self.gamma = gamma
+
+    @property
+    def verify_len(self) -> int:
+        """Width of the ragged verify chunk: the pending token whose
+        KV the round writes first, plus the ``gamma`` proposals."""
+        return self.gamma + 1
+
+    @property
+    def kv_headroom(self) -> int:
+        """Extra cache positions each KV row needs for the scratch
+        writes of a verify round launched at the window edge."""
+        return self.gamma

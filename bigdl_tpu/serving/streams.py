@@ -90,6 +90,16 @@ class RequestHandle:
         #: ``engine.submit(tenant=...)`` after cardinality-cap
         #: resolution (None outside an engine)
         self.tenant: Optional[str] = None
+        #: speculative decoding tallies (engine-stamped per decode
+        #: round; both stay 0 without a draft): draft tokens proposed
+        #: for this request vs accepted by the target's verify — the
+        #: per-request acceptance rate, surfaced in ``timeline()``.
+        #: Multi-token acceptances reach the stream as in-order BURSTS
+        #: (one ``request/decode_token`` recorder event per round,
+        #: carrying ``accepted=``), so ``timeline()``'s ``decode_s /
+        #: (tokens - 1)`` mean inter-token gap stays the true figure
+        self.spec_proposed: int = 0
+        self.spec_accepted: int = 0
         #: the engine's UsageRecord for this request (engine-stamped;
         #: read through ``usage()``)
         self._usage = None
@@ -160,6 +170,11 @@ class RequestHandle:
         - ``tokens``       — tokens delivered
         - ``prefix_tokens`` — prompt tokens reused from the prefix
           cache (prefill skipped for them; 0 on a miss)
+        - ``spec_proposed`` / ``spec_accepted`` — draft tokens
+          proposed vs accepted for this request (0 without a draft);
+          accepted extensions arrive as multi-token bursts, so
+          ``decode_s / (tokens - 1)`` remains the honest mean
+          inter-token gap either way
 
         Final once the request is ``done()`` (the engine stamps each
         boundary as the lifecycle advances), partial before that."""
@@ -174,6 +189,8 @@ class RequestHandle:
             "total_s": gap(self.submitted_at, self.finished_at),
             "tokens": len(self._tokens),
             "prefix_tokens": self.prefix_tokens,
+            "spec_proposed": self.spec_proposed,
+            "spec_accepted": self.spec_accepted,
         }
 
     def usage(self) -> Optional[dict]:

@@ -16,9 +16,12 @@ first run of a new workload, and rows predating a field (inter-token,
 goodput) gate on what both rows actually measured.
 
 Serving rows come from ``bench.py --serving`` (percentiles under
-``detail.engine.{ttft,inter_token}.p99``) and ``bench.py --serving
---shared-prefix`` (``detail.cached.*``); both shapes are understood.
-Stdlib only — runnable from any CI step without the package installed.
+``detail.engine.{ttft,inter_token}.p99``), ``bench.py --serving
+--shared-prefix`` (``detail.cached.*``), and ``bench.py --serving
+--speculative`` (``detail.spec.*`` — the speculative path's
+inter-token p99 is exactly the measure speculation exists to improve,
+so it gates like any other); all three shapes are understood. Stdlib
+only — runnable from any CI step without the package installed.
 
 Usage::
 
@@ -34,8 +37,9 @@ import os
 import sys
 
 #: detail keys that hold a serving result with a ``ttft`` percentile
-#: block, in precedence order (--serving vs --serving --shared-prefix)
-_TTFT_PATHS = ("engine", "cached")
+#: block, in precedence order (--serving vs --serving --shared-prefix
+#: vs --serving --speculative — each row shape carries exactly one)
+_TTFT_PATHS = ("engine", "cached", "spec")
 
 
 def _p99(row: dict, measure: str):

@@ -11,6 +11,7 @@ switch platform post-import via ``jax.config.update`` — XLA_FLAGS is read at
 backend-creation time, which happens on first device use, after this file.
 """
 
+import gc
 import os
 
 flags = os.environ.get("XLA_FLAGS", "")
@@ -30,3 +31,28 @@ def _fixed_seed():
 
     bt_random.set_seed(42)
     yield
+
+
+# Linux defaults vm.max_map_count to 65530, and every jitted executable
+# keeps three anonymous mappings (code / rodata / rwdata) alive for the
+# life of the process. The full tier-1 suite compiles tens of thousands
+# of distinct programs, which marches the map table toward that ceiling;
+# once mmap starts failing, LLVM's JIT segfaults mid-compile (observed
+# deterministically at ~64k maps). jax.clear_caches() after a gc pass
+# unmaps every executable nothing holds anymore (closed engines,
+# torn-down fixtures); still-live jitted closures just recompile on
+# next call. 45k leaves ~20k maps of headroom for the busiest module.
+_MAP_PRESSURE_LIMIT = 45_000
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _shed_jit_map_pressure():
+    yield
+    try:
+        with open("/proc/self/maps") as f:
+            n = sum(1 for _ in f)
+    except OSError:
+        return
+    if n > _MAP_PRESSURE_LIMIT:
+        gc.collect()
+        jax.clear_caches()

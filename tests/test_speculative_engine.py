@@ -1,0 +1,412 @@
+"""Speculative decoding inside the continuous-batching engine.
+
+The acceptance contract under test: with a greedy engine, a DRAFT
+model must never change the output — every request served by the
+speculative engine gets EXACTLY the tokens the non-speculative engine
+(and a lone ``model.generate``) would produce, whatever the draft is
+(int8 clone, unrelated weights), under concurrent load, mid-flight
+admission, prefix-cache hits, and eos landing mid-extension. What the
+draft changes is dispatch count: one fused propose scan + one ragged
+verify per round yields up to ``gamma + 1`` tokens per row. Plus the
+bookkeeping the variable-advance refactor touches: jit-compile gauge
+flat after warmup with speculation on, burst-shaped decode_token
+events (``accepted=``), per-row acceptance telemetry, and
+accepted-token-weighted usage attribution that still conserves the
+measured busy time.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from bigdl_tpu.observability import (
+    MetricRegistry, serving_engine_instruments,
+)
+from bigdl_tpu.observability.events import FlightRecorder
+from bigdl_tpu.serving import (
+    ContinuousBatchingEngine, SpeculationPolicy,
+)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(21)
+    m = TransformerLM(32, embed_dim=16, num_heads=4, num_kv_heads=2,
+                      num_layers=2, max_len=48, use_rope=True)
+    m.evaluate()
+    return m
+
+
+@pytest.fixture(scope="module")
+def draft(lm):
+    """The int8-quantized clone — PERF.md's draft construction: near-
+    perfect agreement with its float source, so acceptance runs high."""
+    from bigdl_tpu.nn.quantized import Quantizer
+
+    d = Quantizer.quantize(lm)
+    d.evaluate()
+    return d
+
+
+def _direct(lm, prompt, n, eos=None):
+    """The per-request oracle: a lone greedy generate, trimmed at the
+    first eos (the engine stops there instead of emitting padding)."""
+    want = np.asarray(
+        lm.generate(jnp.asarray(prompt)[None], n, eos_id=eos))[0]
+    if eos is not None:
+        gen = want[len(prompt):]
+        hits = np.flatnonzero(gen == eos)
+        if hits.size:
+            want = want[:len(prompt) + hits[0] + 1]
+    return want
+
+
+def test_greedy_parity_concurrent_mixed_length_load(lm, draft):
+    """Six mixed-length requests through three slots with an int8
+    draft: every reply token-identical to its lone generate call, and
+    the draft actually pays (accepted proposals > 0)."""
+    import threading
+
+    r = np.random.RandomState(0)
+    reqs = [(r.randint(0, 32, (t0,)), n)
+            for t0, n in [(5, 6), (9, 4), (3, 8), (12, 5), (7, 7),
+                          (4, 10)]]
+    rows = [None] * len(reqs)
+    errs = []
+    with ContinuousBatchingEngine(lm, max_slots=3, prefill_chunk=4,
+                                  draft=draft, spec_gamma=3) as eng:
+        def worker(i, p, n):
+            try:
+                rows[i] = eng.submit(p, n).result(timeout=60)
+            except Exception as e:
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i, p, n))
+                   for i, (p, n) in enumerate(reqs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        st = eng.stats()
+    assert not errs, errs
+    for (p, n), row in zip(reqs, rows):
+        np.testing.assert_array_equal(row, _direct(lm, p, n))
+    sp = st["speculation"]
+    assert sp["enabled"] and sp["gamma"] == 3
+    assert sp["accepted_tokens"] > 0
+    assert 0.0 < sp["acceptance_rate"] <= 1.0
+    # the int8 clone agrees with its source nearly always
+    assert sp["acceptance_rate"] > 0.6
+
+
+def test_unrelated_draft_still_exact(lm):
+    """A draft with DIFFERENT weights rarely agrees with the target —
+    acceptance collapses, output must not move by one token (every
+    rejected proposal is replaced by the target's own argmax)."""
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(99)
+    other = TransformerLM(32, embed_dim=16, num_heads=4,
+                          num_kv_heads=2, num_layers=2, max_len=48,
+                          use_rope=True)
+    other.evaluate()
+    r = np.random.RandomState(5)
+    reqs = [(r.randint(0, 32, (t0,)), n)
+            for t0, n in [(4, 8), (10, 6), (6, 9)]]
+    with ContinuousBatchingEngine(lm, max_slots=2, prefill_chunk=4,
+                                  draft=other, spec_gamma=4) as eng:
+        rows = [eng.submit(p, n).result(timeout=60) for p, n in reqs]
+        sp = eng.stats()["speculation"]
+    for (p, n), row in zip(reqs, rows):
+        np.testing.assert_array_equal(row, _direct(lm, p, n))
+    # the unrelated draft still proposed every round
+    assert sp["proposed_tokens"] > 0
+    assert sp["acceptance_rate"] < 1.0
+
+
+def test_parity_vs_nonspec_engine_and_flat_jit(lm, draft):
+    """The speculative engine vs the NON-speculative engine on the
+    same traffic: token-identical rows, and the speculative engine's
+    compile gauge stays flat once the warmup request has run —
+    compiled shapes depend only on (max_slots, gamma), never on which
+    rows accept how much."""
+    reg = MetricRegistry()
+    r = np.random.RandomState(1)
+    reqs = [(r.randint(0, 32, (t0,)), n)
+            for t0, n in [(6, 8), (11, 5), (4, 12), (8, 7)]]
+    with ContinuousBatchingEngine(lm, max_slots=2, prefill_chunk=4,
+                                  service_name="nospec_ref") as ref:
+        want = [ref.submit(p, n).result(timeout=60) for p, n in reqs]
+    with ContinuousBatchingEngine(lm, max_slots=2, prefill_chunk=4,
+                                  draft=draft, spec_gamma=4,
+                                  registry=reg,
+                                  service_name="spec_jit") as eng:
+        warm_p = r.randint(0, 32, (6,))
+        np.testing.assert_array_equal(
+            eng.submit(warm_p, 5).result(timeout=60),
+            _direct(lm, warm_p, 5))
+        after_warmup = serving_engine_instruments(
+            "spec_jit", reg).jit_compiles.get()
+        assert after_warmup > 0
+        got = [eng.submit(p, n).result(timeout=60) for p, n in reqs]
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+    assert serving_engine_instruments(
+        "spec_jit", reg).jit_compiles.get() == after_warmup, \
+        "speculative decode recompiled after warmup"
+
+
+def test_parity_under_prefix_cache_hits_and_midflight(lm, draft):
+    """Prefix-cache interplay: a reused TARGET prefix means the draft
+    must prefill its own row (the target's final chunk replays while
+    the draft catches up). Shared-head requests — including one
+    admitted mid-decode of another — stay token-identical to lone
+    generate, and the hits actually happen."""
+    head = (np.arange(1, 13, dtype=np.int32) * 5) % 32
+    tails = [np.asarray(t, np.int32) for t in
+             ([7, 9], [3], [7, 9, 11], [1, 2])]
+    prompts = [np.concatenate([head, t]) for t in tails]
+    with ContinuousBatchingEngine(lm, max_slots=2, prefill_chunk=4,
+                                  draft=draft, spec_gamma=3) as eng:
+        rows = [eng.submit(prompts[0], 8).result(timeout=60)]
+        # long decode in flight, short shared-head request joins
+        h_long = eng.submit(prompts[1], 16)
+        it = h_long.tokens()
+        next(it)
+        h_mid = eng.submit(prompts[2], 4)
+        rows.append(h_mid.result(timeout=60))
+        rows.append(h_long.result(timeout=60))
+        rows.append(eng.submit(prompts[3], 6).result(timeout=60))
+        st = eng.stats()
+    expect = [(prompts[0], 8), (prompts[2], 4), (prompts[1], 16),
+              (prompts[3], 6)]
+    for (p, n), row in zip(expect, rows):
+        np.testing.assert_array_equal(row, _direct(lm, p, n))
+    assert st["prefix_cache"]["hits"] >= 1, \
+        "the shared head never hit — the interplay went untested"
+    assert st["speculation"]["accepted_tokens"] > 0
+
+
+def test_eos_mid_extension_truncates(lm, draft):
+    """eos landing INSIDE an accepted multi-token extension must end
+    the stream at (and including) the eos — the tokens the verify
+    round accepted beyond it are discarded, exactly like the
+    non-speculative engine never would have decoded them."""
+    # scan prompts for one whose greedy continuation hits the eos
+    # mid-stream (not first token, not never)
+    eos = None
+    for seed in range(40):
+        p = np.random.RandomState(seed).randint(0, 32, (6,))
+        for cand in range(32):
+            w = _direct(lm, p, 12, eos=cand)
+            gen = w[len(p):]
+            if 2 <= len(gen) < 12 and gen[-1] == cand:
+                eos, prompt, want = cand, p, w
+                break
+        if eos is not None:
+            break
+    assert eos is not None, "no mid-stream eos found in the scan"
+    with ContinuousBatchingEngine(lm, max_slots=2, prefill_chunk=4,
+                                  eos_id=eos, draft=draft,
+                                  spec_gamma=4) as eng:
+        row = eng.submit(prompt, 12).result(timeout=60)
+        tl = eng.submit(prompt, 12).result(timeout=60)  # warm path too
+    np.testing.assert_array_equal(row, want)
+    np.testing.assert_array_equal(tl, want)
+    assert row[-1] == eos
+    assert len(row) < len(prompt) + 12
+
+
+def test_decode_token_events_are_bursts(lm, draft):
+    """Flight-recorder fidelity: one ``request/decode_token`` event
+    per iteration per row, carrying ``accepted=n`` — the per-event
+    accepted counts sum to the delivered decode tokens (so percentile
+    consumers can weight instead of under-counting), and at least one
+    event is a genuine multi-token burst."""
+    rec = FlightRecorder(capacity=4096)
+    with ContinuousBatchingEngine(lm, max_slots=2, prefill_chunk=4,
+                                  draft=draft, spec_gamma=4,
+                                  recorder=rec,
+                                  service_name="spec_ev") as eng:
+        p = np.random.RandomState(3).randint(0, 32, (6,))
+        h = eng.submit(p, 11)
+        row = h.result(timeout=60)
+    np.testing.assert_array_equal(row, _direct(lm, p, 11))
+    evs = [e for e in rec.for_request(h.request_id)
+           if e.kind == "request/decode_token"]
+    assert evs, "no decode_token events recorded"
+    assert all(e.attrs and "accepted" in e.attrs for e in evs)
+    # first token arrives via request/first_token; decode_token bursts
+    # cover the remaining 10
+    assert sum(e.attrs["accepted"] for e in evs) == 10
+    assert max(e.attrs["accepted"] for e in evs) > 1, \
+        "int8 draft never produced a multi-token burst"
+    assert len(evs) < 10, "bursts should need fewer events than tokens"
+    # events carry the running delivered count in order
+    ns = [e.attrs["n"] for e in evs]
+    assert ns == sorted(ns)
+    # the handle's timeline exposes the same acceptance tallies
+    tl = h.timeline()
+    assert tl["spec_proposed"] > 0
+    assert tl["spec_accepted"] <= tl["spec_proposed"]
+
+
+def test_spec_instruments_and_stats_consistency(lm, draft):
+    """The new instruments: proposed/accepted counters match stats(),
+    the acceptance-ratio histogram observed once per speculative
+    round, and counters never go backwards between engines sharing a
+    registry (counter semantics)."""
+    reg = MetricRegistry()
+    with ContinuousBatchingEngine(lm, max_slots=2, prefill_chunk=4,
+                                  draft=draft, spec_gamma=3,
+                                  registry=reg,
+                                  service_name="spec_ins") as eng:
+        p = np.random.RandomState(4).randint(0, 32, (7,))
+        eng.submit(p, 9).result(timeout=60)
+        st = eng.stats()
+    ins = serving_engine_instruments("spec_ins", reg)
+    sp = st["speculation"]
+    assert ins.spec_proposed_tokens_total.get() == sp["proposed_tokens"]
+    assert ins.spec_accepted_tokens_total.get() == sp["accepted_tokens"]
+    assert sp["accepted_tokens"] <= sp["proposed_tokens"]
+    _, ratio_sum, ratio_n = ins.spec_acceptance_ratio.get()
+    assert ratio_n > 0
+    assert 0.0 <= ratio_sum / ratio_n <= 1.0
+    # stats() surfaces the same rate the raw tallies imply
+    assert sp["acceptance_rate"] == pytest.approx(
+        sp["accepted_tokens"] / sp["proposed_tokens"], abs=1e-4)
+
+
+def test_speculation_policy_and_validation(lm, draft):
+    """Config surface: SpeculationPolicy validates gamma, the engine
+    rejects mismatched vocabularies, too-short draft contexts, and
+    top-k/top-p with a draft (the min(1, p/q) identity needs the
+    unfiltered distributions)."""
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils import random as rnd
+
+    with pytest.raises(ValueError, match="spec_gamma"):
+        SpeculationPolicy(0)
+    pol = SpeculationPolicy(5)
+    assert pol.verify_len == 6 and pol.kv_headroom == 5
+
+    with pytest.raises(ValueError, match="spec_gamma"):
+        ContinuousBatchingEngine(lm, draft=draft, spec_gamma=0)
+    rnd.set_seed(1)
+    wrong_vocab = TransformerLM(16, embed_dim=16, num_heads=4,
+                                num_kv_heads=2, num_layers=1,
+                                max_len=48, use_rope=True)
+    with pytest.raises(ValueError, match="vocab"):
+        ContinuousBatchingEngine(lm, draft=wrong_vocab)
+    short_ctx = TransformerLM(32, embed_dim=16, num_heads=4,
+                              num_kv_heads=2, num_layers=1,
+                              max_len=16, use_rope=True)
+    with pytest.raises(ValueError, match="context"):
+        ContinuousBatchingEngine(lm, draft=short_ctx)
+    with pytest.raises(ValueError, match="top_k/top_p"):
+        ContinuousBatchingEngine(lm, draft=draft, temperature=0.8,
+                                 top_k=5)
+    # a gamma-free engine ignores spec plumbing entirely
+    with ContinuousBatchingEngine(lm, max_slots=1,
+                                  prefill_chunk=4) as eng:
+        assert eng.stats()["speculation"] == {"enabled": False}
+
+
+def test_sampled_speculative_serves_and_meters(lm, draft):
+    """temperature > 0 with a draft: full speculative sampling. The
+    stream is not bitwise the non-speculative engine's (different key
+    schedule) but must be well-formed: the right token count, in-vocab
+    ids, and acceptance telemetry flowing."""
+    with ContinuousBatchingEngine(lm, max_slots=2, prefill_chunk=4,
+                                  temperature=0.8, seed=11,
+                                  draft=draft, spec_gamma=3) as eng:
+        p = np.random.RandomState(6).randint(0, 32, (5,))
+        rows = [eng.submit(p, 8).result(timeout=60) for _ in range(3)]
+        sp = eng.stats()["speculation"]
+    for row in rows:
+        assert row.shape == (13,)
+        assert ((row >= 0) & (row < 32)).all()
+        np.testing.assert_array_equal(row[:5], p)
+    assert sp["proposed_tokens"] > 0
+
+
+def test_variable_advance_usage_weighted_by_accepted(lm, draft):
+    """Usage-accounting correctness under variable advance: decode
+    device-seconds split by per-row ACCEPTED tokens — weights still
+    sum to 1, so the per-tenant sums conserve the measured dispatch
+    busy time, and the heavier accepter is billed at least as much
+    decode time per delivered token ratio as conservation implies."""
+    reg = MetricRegistry()
+    r = np.random.RandomState(8)
+    with ContinuousBatchingEngine(lm, max_slots=2, prefill_chunk=4,
+                                  draft=draft, spec_gamma=3,
+                                  registry=reg,
+                                  service_name="spec_usage") as eng:
+        # warmup excluded from attribution either way
+        eng.submit(r.randint(0, 32, (5,)), 4,
+                   tenant="warm").result(timeout=60)
+        hs = [eng.submit(r.randint(0, 32, (t0,)), n, tenant=t)
+              for t0, n, t in ((6, 12, "big"), (9, 3, "small"),
+                               (4, 10, "big"))]
+        for h in hs:
+            h.result(timeout=60)
+        st = eng.stats()
+    usage = st["usage"]
+    busy = usage["goodput"]["device_seconds"]["total"]
+    tenant_sum = sum(a["device_s"] for a in usage["tenants"].values())
+    assert tenant_sum == pytest.approx(busy, abs=2e-5), \
+        "accepted-token weighting broke device-second conservation"
+    # per-request invariants hold under bursts too
+    for h in hs:
+        u = h.usage()
+        assert u["decode_tokens"] == h.timeline()["tokens"]
+        assert u["prefill_tokens"] + u["prefix_reused_tokens"] \
+            == u["prompt_tokens"]
+    big = usage["tenants"]["big"]
+    small = usage["tenants"]["small"]
+    assert big["decode_tokens"] == 22 and small["decode_tokens"] == 3
+    # 22 of 25 tokens -> the big tenant carries most decode billing
+    assert big["device_s"] > small["device_s"]
+
+
+def test_perf_gate_speculative_rows(tmp_path):
+    """CI gate: --speculative rows (percentiles under detail.spec)
+    gate p99 inter-token like any serving row, and rows predating the
+    field are skipped, not failed."""
+    import importlib.util
+    import json
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "perf_gate", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "perf_gate.py"))
+    gate = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gate)
+
+    def row(it_p99, **extra):
+        block = {"ttft": {"p50": 0.001, "p99": 0.002}}
+        if it_p99 is not None:
+            block["inter_token"] = {"p50": it_p99 / 2, "p99": it_p99}
+        block.update(extra)
+        return {"metric": "serving_speculative_tokens_per_sec",
+                "detail": {"device": "cpu", "spec": block,
+                           "workload": {"kind": "speculative",
+                                        "requests": 24, "gamma": 8}}}
+
+    hist = tmp_path / "h.jsonl"
+
+    def run(rows):
+        hist.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+        return gate.main(["--history", str(hist)])
+
+    # steady rows pass; a 2x inter-token regression fails
+    assert run([row(0.001), row(0.0011)]) == 0
+    assert run([row(0.001), row(0.002)]) == 1
+    # an old row predating inter_token: skipped (TTFT still gates)
+    assert run([row(None), row(0.001)]) == 0

@@ -240,6 +240,38 @@ def test_engine_conservation_tenants_and_flat_jit(lm, reg, rec):
             >= top[2]["device_s"]
 
 
+def test_engine_conservation_under_speculative_decode(lm, reg, rec):
+    """Variable-advance conservation: with a draft, decode dispatch
+    walls split by per-row ACCEPTED tokens instead of evenly — the
+    weights must still sum to 1 (tenant sums equal the measured busy
+    time), cold/warmup dispatches stay excluded from both sides, and
+    the per-request token identities survive multi-token bursts."""
+    from bigdl_tpu.nn.quantized import Quantizer
+
+    draft = Quantizer.quantize(lm)
+    draft.evaluate()
+    r = np.random.RandomState(9)
+    with _engine(lm, reg, service_name="usage_spec", draft=draft,
+                 spec_gamma=3) as eng:
+        reqs = [(5, 9, "alice"), (8, 4, "bob"), (4, 11, "alice")]
+        handles = [eng.submit(r.randint(0, 32, (t0,)), n, tenant=t)
+                   for t0, n, t in reqs]
+        for h in handles:
+            h.result(timeout=120)
+        st = eng.stats()
+    usage = st["usage"]
+    assert _conserves(usage)
+    assert st["speculation"]["accepted_tokens"] > 0
+    for h, (t0, n, _) in zip(handles, reqs):
+        u = h.usage()
+        assert u["decode_tokens"] == h.timeline()["tokens"] == n
+        assert u["prefill_tokens"] + u["prefix_reused_tokens"] == t0
+    # tenant decode-token sums line up despite burst delivery
+    want = {"alice": 20, "bob": 4}
+    for t, tokens in want.items():
+        assert usage["tenants"][t]["decode_tokens"] == tokens
+
+
 def test_prefix_reuse_savings_credit(lm, reg, rec):
     head = np.arange(1, 17, dtype=np.int32) % 32
     tails = [np.asarray([7, 9], np.int32), np.asarray([3], np.int32)]
