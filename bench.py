@@ -234,6 +234,14 @@ def bench_main(argv=None):
                         "with its float source ~90%% of the time, so "
                         "a wide gamma amortizes dispatch overhead "
                         "hardest)")
+    p.add_argument("--tp", type=int, default=0, metavar="N",
+                   help="with --serving: tensor-parallel A/B — the "
+                        "same Poisson workload through the engine "
+                        "SHARDED over an N-way model-axis device mesh "
+                        "(host-device mesh on CPU) vs the plain "
+                        "single-device engine; emits both paths' TTFT "
+                        "and inter-token percentiles + greedy token "
+                        "parity into bench_history.jsonl")
     p.add_argument("--trace", action="store_true",
                    help="also dump bench_trace.json — the run's span "
                         "trees + flight-recorder events as Chrome "
@@ -251,6 +259,19 @@ def bench_main(argv=None):
     p.add_argument("--rate", type=float, default=20.0,
                    help="--serving: Poisson arrival rate (req/s)")
     args = p.parse_args(argv)
+
+    if args.serving and args.tp and args.tp > 1:
+        # the host-device mesh for --serving --tp: XLA reads this at
+        # backend creation (first device use is below), so setting it
+        # here still takes effect — on CPU it yields exactly tp
+        # virtual devices, on real accelerators it is inert. Gated on
+        # --serving: forcing virtual devices under a training bench
+        # would divide its intra-op threads and poison the trend row.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={args.tp}")
 
     import jax
 
@@ -436,11 +457,21 @@ def _serving_bench(args, dev):
     (>1.0: the draft pays for itself), and detail carries both paths'
     inter-token p50/p99, the acceptance rate, and the greedy
     token-parity flag; perf_gate gates the speculative row's p99
-    inter-token (and TTFT / goodput) between comparable runs."""
+    inter-token (and TTFT / goodput) between comparable runs.
+
+    `--serving --tp N`: the tensor-parallel A/B — the same Poisson
+    workload through the engine SHARDED over an N-way model-axis
+    device mesh (a host-device mesh on CPU: the flag forces N virtual
+    host devices) vs the plain single-device engine. vs_baseline is
+    the inter-token p50 ratio unsharded/sharded (on CPU expect < 1.0
+    — collectives cost and host compute doesn't shrink; the row
+    tracks that overhead and pins greedy token parity + the sharded
+    mesh/pool attribution block). perf_gate gates the sharded row's
+    p99 TTFT / inter-token / goodput between comparable runs."""
     from bigdl_tpu.models.transformer import TransformerLM
     from bigdl_tpu.serving.benchmark import (
         run_poisson_comparison, run_shared_prefix_comparison,
-        run_speculative_comparison,
+        run_speculative_comparison, run_tp_comparison,
     )
     from bigdl_tpu.utils import random as rnd
     from bigdl_tpu.version import __version__
@@ -451,7 +482,28 @@ def _serving_bench(args, dev):
                           num_layers=2, max_len=128, use_rope=True)
     model.evaluate()
     prof = _start_profile(args.profile)
-    if args.speculative:
+    if args.tp and args.tp > 1:
+        res = run_tp_comparison(
+            model, tp=args.tp, n_requests=args.requests,
+            rate_hz=args.rate, max_slots=4, prefill_chunk=8,
+            prefill_rows=2, log=log)
+        result = {
+            "metric": "serving_tp_tokens_per_sec",
+            "value": res["sharded"]["tokens_per_sec"],
+            "unit": "tokens/sec",
+            # vs_baseline > 1.0: the sharded path's steady-state
+            # decode gap is shorter than single-device (expect < 1.0
+            # on a CPU host mesh, where collectives cost and compute
+            # doesn't shrink — the row exists to track the overhead)
+            "vs_baseline": res["inter_token_p50_ratio"],
+            "detail": {
+                "version": __version__,
+                "device": str(getattr(dev, "device_kind", dev.platform)),
+                **res,
+            },
+        }
+        _record_tp_metrics(res)
+    elif args.speculative:
         res = run_speculative_comparison(
             model, n_requests=args.requests, rate_hz=args.rate,
             max_slots=4, prefill_chunk=8, prefill_rows=2,
@@ -586,22 +638,13 @@ def _record_shared_prefix_metrics(res):
         # the same one the snapshot dump renders
         ins = obs.serving_bench_instruments()
         for path in ("cached", "uncached"):
-            r = res[path]
-            ins.tokens_per_sec.labels(path).set(r["tokens_per_sec"])
-            if r["ttft"]["p50"] is not None:
-                ins.ttft_p50.labels(path).set(r["ttft"]["p50"])
-                ins.ttft_p99_by_path.labels(path).set(r["ttft"]["p99"])
-            if r.get("inter_token", {}).get("p99") is not None:
-                ins.inter_token_p99.labels(path).set(
-                    r["inter_token"]["p99"])
+            _record_path_metrics(ins, res[path], path)
         if res.get("ttft_p50_speedup") is not None:
             ins.prefix_ttft_p50_speedup().set(res["ttft_p50_speedup"])
         pc = res["cached"].get("prefix_cache", {})
         if pc.get("enabled"):
             ins.prefix_hit_rate().set(pc["hit_rate"])
             ins.prefix_reused_fraction().set(pc["reused_fraction"])
-        for path in ("cached", "uncached"):
-            _record_goodput_metrics(ins, res[path], path)
     except Exception as e:
         print(f"[bench] shared-prefix metrics registry update failed: "
               f"{e}", file=sys.stderr)
@@ -617,18 +660,7 @@ def _record_speculative_metrics(res):
 
         ins = obs.serving_bench_instruments()
         for path, key in (("spec_on", "spec"), ("spec_off", "nospec")):
-            r = res[key]
-            ins.tokens_per_sec.labels(path).set(r["tokens_per_sec"])
-            if r["latency"]["p50"] is not None:
-                ins.latency_p50.labels(path).set(r["latency"]["p50"])
-                ins.latency_p99.labels(path).set(r["latency"]["p99"])
-            if r["ttft"]["p50"] is not None:
-                ins.ttft_p50.labels(path).set(r["ttft"]["p50"])
-                ins.ttft_p99_by_path.labels(path).set(r["ttft"]["p99"])
-            if r.get("inter_token", {}).get("p99") is not None:
-                ins.inter_token_p99.labels(path).set(
-                    r["inter_token"]["p99"])
-            _record_goodput_metrics(ins, r, path)
+            _record_path_metrics(ins, res[key], path)
         if res.get("acceptance_rate") is not None:
             ins.spec_acceptance_rate().set(res["acceptance_rate"])
         if res.get("inter_token_p50_speedup") is not None:
@@ -649,6 +681,39 @@ def _record_goodput_metrics(ins, block, path):
             g["tokens_per_device_second"])
     if g.get("padding_waste_mean") is not None:
         ins.padding_waste_mean.labels(path).set(g["padding_waste_mean"])
+
+
+def _record_path_metrics(ins, r, path):
+    """Mirror ONE serving-comparison leg's standard result block
+    (throughput, latency / TTFT / inter-token percentiles, goodput)
+    into the ``path``-labelled bench gauges — the shared body of every
+    per-variant recorder, so a gauge added here reaches all of them."""
+    ins.tokens_per_sec.labels(path).set(r["tokens_per_sec"])
+    if r.get("latency", {}).get("p50") is not None:
+        ins.latency_p50.labels(path).set(r["latency"]["p50"])
+        ins.latency_p99.labels(path).set(r["latency"]["p99"])
+    if r.get("ttft", {}).get("p50") is not None:
+        ins.ttft_p50.labels(path).set(r["ttft"]["p50"])
+        ins.ttft_p99_by_path.labels(path).set(r["ttft"]["p99"])
+    if r.get("inter_token", {}).get("p99") is not None:
+        ins.inter_token_p99.labels(path).set(r["inter_token"]["p99"])
+    _record_goodput_metrics(ins, r, path)
+
+
+def _record_tp_metrics(res):
+    """Mirror the tensor-parallel A/B into the observability registry
+    under ``path`` labels (``tp_sharded`` / ``tp_unsharded``). Never
+    lets telemetry break the bench."""
+    try:
+        from bigdl_tpu import observability as obs
+
+        ins = obs.serving_bench_instruments()
+        for path, key in (("tp_sharded", "sharded"),
+                          ("tp_unsharded", "unsharded")):
+            _record_path_metrics(ins, res[key], path)
+    except Exception as e:
+        print(f"[bench] tp metrics registry update failed: {e}",
+              file=sys.stderr)
 
 
 def _record_serving_metrics(res):

@@ -20,8 +20,12 @@ POINT is the serving machinery, not the prose):
      names; the /debug/usage table — tokens, device-seconds, KV
      byte-seconds, goodput — round-tripped over HTTP), and on-demand
      /debug/profile capture (--profile-seconds N)
+  7. --tp N: the SAME engine tensor-parallel over an N-way model-axis
+     device mesh (Megatron-sharded params, heads-sharded KV pools,
+     SPMD dispatches; N virtual host devices on CPU) — topology and
+     per-device pool bytes printed from stats()["mesh"]
 
-Run: python -m bigdl_tpu.example.serving.serve [--tokens 24]
+Run: python -m bigdl_tpu.example.serving.serve [--tokens 24] [--tp 2]
 """
 
 from __future__ import annotations
@@ -47,7 +51,32 @@ def main(argv=None):
                         "the acceptance rate from stats()")
     p.add_argument("--gamma", type=int, default=4,
                    help="--draft: tokens proposed per decode round")
+    p.add_argument("--tp", type=int, default=0, metavar="N",
+                   help="run the continuous-batching engine TENSOR-"
+                        "PARALLEL over an N-way model-axis device "
+                        "mesh (params Megatron-sharded, KV pools "
+                        "sharded on heads, SPMD dispatches) — N must "
+                        "divide the demo model's 4 KV heads; on a "
+                        "CPU host the flag forces N virtual devices")
     args = p.parse_args(argv)
+
+    import os
+    import sys
+
+    if (args.tp and args.tp > 1 and argv is None
+            and "xla_force_host_platform_device_count"
+            not in os.environ.get("XLA_FLAGS", "")):
+        # XLA reads this flag at backend creation, which importing the
+        # package has ALREADY triggered — too late to set in-process.
+        # Command-line runs re-exec themselves with the flag so a CPU
+        # host gets its N virtual devices; programmatic callers set
+        # XLA_FLAGS (or bring a real multi-device backend) themselves.
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.tp}")
+        os.execv(sys.executable,
+                 [sys.executable, "-m", "bigdl_tpu.example.serving.serve"]
+                 + sys.argv[1:])
 
     import jax.numpy as jnp
 
@@ -141,6 +170,21 @@ def main(argv=None):
         # in one scan, the target verifies them in one ragged
         # dispatch, and greedy output stays token-identical
         engine_kw = dict(draft=draft, spec_gamma=args.gamma)
+    if args.tp and args.tp > 1:
+        # tensor-parallel serving: one mesh, same engine API — params
+        # load Megatron-sharded, every KV pool shards its heads dim,
+        # and each compiled program runs SPMD with jit-inserted
+        # collectives; tokens match the single-device engine exactly
+        from bigdl_tpu.parallel.engine import Engine as MeshEngine
+
+        devs = jax.devices()
+        if len(devs) < args.tp:
+            raise SystemExit(
+                f"--tp {args.tp} needs {args.tp} devices but only "
+                f"{len(devs)} are visible (is XLA_FLAGS being "
+                "overridden before startup?)")
+        engine_kw["mesh"] = MeshEngine.create_mesh(
+            [("model", args.tp)], devices=devs[:args.tp])
     with ContinuousBatchingEngine(model, max_slots=2, prefill_chunk=8,
                                   eos_id=0, **engine_kw) as engine, \
             obs.start_http_server(host="127.0.0.1",
@@ -178,6 +222,15 @@ def main(argv=None):
                   f"accepted {sp['accepted_tokens']}/"
                   f"{sp['proposed_tokens']} proposals "
                   f"({sp['acceptance_rate']:.0%} acceptance rate)")
+        if args.tp and args.tp > 1:
+            ms = engine.stats()["mesh"]
+            kv = ms["pools"]["kv_slots"]
+            print(f"[tp]        {ms['model_shards']}-way model mesh "
+                  f"over {ms['devices']} devices; kv_slots "
+                  f"{kv['physical_bytes'] // 1024} KB global, "
+                  f"{kv['bytes_per_device'] // 1024} KB/device "
+                  f"(sharded={kv['sharded']}); tokens identical to "
+                  "the single-device engine")
 
         # who owns the HBM: the engine registered its KV slot pool,
         # prefill staging, prefix pool, and params as named memory

@@ -264,6 +264,28 @@ class TransformerLM(Module):
                     batch, max_len, dtype, sharding=sharding)
                 for i in range(self.num_layers)]
 
+    @property
+    def num_kv_heads(self) -> int:
+        """KV head count of the attention stack (uniform across blocks
+        — the constructor builds every block from one config). The
+        dimension tensor-parallel serving shards the KV pools along."""
+        return self.block0.attn.num_kv_heads
+
+    def kv_cache_sharding(self, mesh, model_axis: str = "model"):
+        """NamedSharding for this model's ``init_cache`` buffers on a
+        tensor-parallel ``mesh``: the ``(B, H_kv, T, D)`` caches shard
+        their HEADS dimension along ``model_axis`` — the layout the
+        column-parallel QKV projection (``transformer_tp_rules``)
+        writes with no collective, because each device computes
+        exactly its own heads' K/V. Every compiled prefill / decode /
+        verify entry point then runs SPMD from the input shardings
+        alone (GSPMD places the row-parallel all-reduces); raises when
+        the head count does not divide the axis size."""
+        from bigdl_tpu.parallel.tp import kv_pool_sharding
+
+        return kv_pool_sharding(mesh, self.num_kv_heads,
+                                model_axis=model_axis)
+
     def prefill(self, ids, caches, pos0: int = 0):
         """Batched prompt prefill: one causal pass over ids (B, T0) that
         populates every block's KV cache and returns the LAST position's
@@ -732,7 +754,8 @@ class TransformerLM(Module):
                                           jnp.int32(t0 + i), caches)
         return jnp.stack(ids, axis=1)
 
-    def _propose_fn(self, b: int, gamma: int, sampled: bool = False):
+    def _propose_fn(self, b: int, gamma: int, sampled: bool = False,
+                    cache_sharding=None, repl_sharding=None):
         """Cached jitted draft proposer: gamma step->choose iterations as
         ONE lax.scan dispatch (argmax when greedy, tempered categorical
         when ``sampled``), writing the input tokens' KV as it goes.
@@ -743,9 +766,13 @@ class TransformerLM(Module):
         the scan carry just holds the vector) — the serving engine
         proposes for every live slot at its own depth through this
         same program. One factory for both modes so the proposal scan
-        can never diverge between them."""
+        can never diverge between them. ``cache_sharding`` (with
+        ``repl_sharding`` for the token/logit outputs) PINS the
+        output layouts for SPMD callers — the sharded serving engine's
+        draft caches then cycle through the scan in one stable layout
+        instead of whatever GSPMD would pick per compile."""
         per_model = _SPEC_JIT.setdefault(self, {})
-        key = ("propose", b, gamma, sampled)
+        key = ("propose", b, gamma, sampled, cache_sharding)
         fn = per_model.get(key)
         if fn is not None:
             return fn
@@ -770,7 +797,11 @@ class TransformerLM(Module):
                     body, carry, None, length=gamma)
                 return toks, qlogits, caches
 
-        fn = jax.jit(propose, donate_argnums=(4,))
+        kw = {}
+        if cache_sharding is not None:
+            kw["out_shardings"] = (repl_sharding, repl_sharding,
+                                   cache_sharding)
+        fn = jax.jit(propose, donate_argnums=(4,), **kw)
         per_model[key] = fn
         return fn
 

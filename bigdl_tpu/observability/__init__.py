@@ -93,7 +93,8 @@ from bigdl_tpu.observability.instruments import (
 from bigdl_tpu.observability.accounting import UsageLedger, UsageRecord
 from bigdl_tpu.observability.memory import (
     DeviceMemoryMonitor, default_monitor, pool_sizes, register_pool,
-    register_owned_pools, static_pools, tree_bytes, unregister_pool,
+    register_owned_pools, static_pools, tree_bytes, tree_device_bytes,
+    unregister_pool,
 )
 from bigdl_tpu.observability.profiler import (
     ProfilerBusy, ProfilerUnavailable, capture,
@@ -123,7 +124,7 @@ __all__ = [
     "UsageLedger", "UsageRecord",
     "DeviceMemoryMonitor", "default_monitor", "pool_sizes",
     "register_pool", "register_owned_pools", "static_pools",
-    "tree_bytes", "unregister_pool",
+    "tree_bytes", "tree_device_bytes", "unregister_pool",
     "ProfilerBusy", "ProfilerUnavailable", "capture",
     "RecompileWatchdog", "SloObjective", "SloWatchdog",
     "enable", "disable", "enabled",

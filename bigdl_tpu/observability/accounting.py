@@ -183,10 +183,13 @@ class UsageLedger:
                  slot_row_bytes: int = 0, staging_row_bytes: int = 0,
                  token_bytes: float = 0.0,
                  default_tenant: str = "default",
-                 overflow_tenant: str = "other"):
+                 overflow_tenant: str = "other",
+                 devices: int = 1):
         if max_tenants < 1:
             raise ValueError(
                 f"max_tenants must be >= 1, got {max_tenants}")
+        if devices < 1:
+            raise ValueError(f"devices must be >= 1, got {devices}")
         from bigdl_tpu.observability.events import default_recorder
         from bigdl_tpu.observability.instruments import (
             tenant_usage_instruments,
@@ -201,6 +204,13 @@ class UsageLedger:
         #: device KV bytes one cached token position occupies
         #: (row_bytes / cache_len) — the prefix-savings exchange rate
         self.token_bytes = float(token_bytes)
+        #: devices one dispatch occupies (the SPMD mesh size for a
+        #: tensor-parallel engine, 1 otherwise): every charged wall
+        #: second becomes ``devices`` device-seconds on BOTH the
+        #: per-tenant and the busy side, so conservation holds and
+        #: tokens-per-device-second honestly divides by the hardware
+        #: the sharded dispatch actually occupied
+        self.devices = int(devices)
         self._rec = recorder if recorder is not None \
             else default_recorder()
         self._ins = instruments
@@ -287,7 +297,9 @@ class UsageLedger:
         if kind not in self._busy:
             raise ValueError(f"unknown dispatch kind {kind!r}; "
                              f"expected one of {KINDS}")
-        wall_s = max(0.0, float(wall_s))
+        # one SPMD dispatch occupies every mesh device for its wall:
+        # the billable quantity is wall x devices, on both sides
+        wall_s = max(0.0, float(wall_s)) * self.devices
         attr = ("device_prefill_s" if kind == "prefill"
                 else "device_decode_s")
         for rec, w in shares:
@@ -458,6 +470,7 @@ class UsageLedger:
             "totals": self.totals(),
             "goodput": self.goodput(),
             "max_tenants": self.max_tenants,
+            "devices": self.devices,
         }
         if top_n:
             out["top_requests"] = self.top_requests(top_n)

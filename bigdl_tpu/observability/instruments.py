@@ -317,6 +317,26 @@ def serving_engine_instruments(service: str = "engine",
             "Delivered tokens per host-measured device-dispatch "
             "second, cumulative — the engine's goodput headline",
             labelnames=lbl).labels(service),
+        mesh_devices=r.gauge(
+            "bigdl_serving_mesh_devices",
+            "Devices in the engine's SPMD mesh (1 for a single-device "
+            "engine): every compiled dispatch occupies all of them, "
+            "and usage device-seconds scale by this factor",
+            labelnames=lbl).labels(service),
+        mesh_model_shards=r.gauge(
+            "bigdl_serving_mesh_model_shards",
+            "Size of the mesh's model (tensor-parallel) axis — the "
+            "way count KV heads and Megatron column/row weights are "
+            "split (1 when unsharded)", labelnames=lbl).labels(service),
+        # UNBOUND family: the engine binds (service, pool) per
+        # persistent buffer set it owns
+        mesh_pool_bytes_per_device=r.gauge(
+            "bigdl_serving_mesh_pool_bytes_per_device",
+            "Per-device byte footprint of one engine device pool "
+            "(physical shard bytes / mesh devices): what ONE chip's "
+            "HBM actually pays for the pool — a replicated pool "
+            "reports its full size, an evenly model-sharded pool "
+            "reports 1/Nth", labelnames=("service", "pool")),
     )
 
 

@@ -152,6 +152,28 @@ def tree_bytes(tree) -> int:
                for leaf in jax.tree.leaves(tree))
 
 
+def tree_device_bytes(tree) -> int:
+    """PHYSICAL bytes a pytree commits across every device: each
+    leaf's addressable shards summed. Equals ``tree_bytes`` for
+    single-device and evenly-sharded arrays, but counts a REPLICATED
+    leaf once per device holding a copy — the HBM actually spent,
+    where the logical ``nbytes`` would undercount it N-ways (a
+    mesh-sharded engine's params mix both). Shard metadata only — no
+    device sync."""
+    if tree is None:
+        return 0
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            total += sum(int(s.data.nbytes) for s in shards)
+        else:
+            total += int(getattr(leaf, "nbytes", 0))
+    return total
+
+
 def _live_array_bytes(devices):
     """Fallback attribution for backends without ``memory_stats``:
     walk ``jax.live_arrays()`` and charge each array's PER-DEVICE

@@ -13,6 +13,13 @@ the Megatron-style column/row split for the transformer stack:
   out_proj / fc2        -> shard dim 1 (row parallel)
   tok_embed  (vocab, d) -> shard dim 0
   everything else       -> replicated
+
+``kv_pool_spec`` / ``kv_pool_sharding`` lay out slot-pooled KV caches
+(``(rows, H_kv, T, D)``) along the model axis on the heads dimension —
+the layout the column-parallel QKV projection writes with ZERO
+communication (each device computes exactly its own heads' K/V), used
+by the serving engine's SPMD decode loop
+(``bigdl_tpu.serving.engine.ContinuousBatchingEngine(mesh=...)``).
 """
 
 from __future__ import annotations
@@ -48,19 +55,29 @@ def spec_for_params(params, rules: List[Tuple[str, P]], default: P = P()):
 
 
 def transformer_tp_rules(model_axis: str = "model", data_axis: str = None):
-    """Megatron-style rules for TransformerLM param paths. Pass ``data_axis``
-    to additionally FSDP-shard the replicated leaves' first dim (zero-style)."""
-    mp = model_axis
+    """Megatron-style rules for TransformerLM param paths. Pass
+    ``data_axis`` to ADDITIONALLY shard each weight matrix over that
+    axis on the dimension the model split leaves free (the zero-style
+    2-D ``fsdp x tp`` layout: qkv/fc1 become ``P(model, data)``,
+    out_proj/fc2 ``P(data, model)``), and to shard the otherwise-
+    replicated positional table's first dim. Every sharded dimension
+    must divide by its mesh-axis size (embed_dim, mlp hidden,
+    qkv-out, and — with ``data_axis`` — vocab_size and max_len)."""
+    mp, dp = model_axis, data_axis
     rules = [
-        (r"attn/qkv/~params/weight$", P(mp, None)),
+        (r"attn/qkv/~params/weight$", P(mp, dp)),
         (r"attn/qkv/~params/bias$", P(mp)),
-        (r"fc1/~params/weight$", P(mp, None)),
+        (r"fc1/~params/weight$", P(mp, dp)),
         (r"fc1/~params/bias$", P(mp)),
-        (r"attn/out_proj/~params/weight$", P(None, mp)),
-        (r"fc2/~params/weight$", P(None, mp)),
-        (r"~params/tok_embed$", P(mp, None)),
-        (r"head/~params/weight$", P(mp, None)),
+        (r"attn/out_proj/~params/weight$", P(dp, mp)),
+        (r"fc2/~params/weight$", P(dp, mp)),
+        (r"~params/tok_embed$", P(mp, dp)),
+        (r"head/~params/weight$", P(mp, dp)),
     ]
+    if dp is not None:
+        # the learned positional table is the one big replicated leaf
+        # left; zero-style, its rows spread over the data axis
+        rules.append((r"~params/pos_embed$", P(dp, None)))
     return rules
 
 
@@ -75,3 +92,39 @@ def shard_params(params, mesh, rules, default=P()):
         return jax.device_put(p, NamedSharding(mesh, s))
 
     return walk(params, specs)
+
+
+def replicate(tree, mesh):
+    """device_put every leaf fully replicated over ``mesh`` — host
+    inputs and buffers entering an SPMD program with a committed,
+    call-stable layout (one compiled signature, no per-call GSPMD
+    resharding guesswork)."""
+    sharding = NamedSharding(mesh, P())
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def kv_pool_spec(model_axis: str = "model") -> P:
+    """PartitionSpec for a slot-pooled KV cache buffer
+    ``(rows, H_kv, T, D)``: heads sharded along the model axis,
+    rows/time/head-dim replicated — matches the column-parallel QKV
+    split, so cache writes need no collective."""
+    return P(None, model_axis, None, None)
+
+
+def kv_pool_sharding(mesh, num_kv_heads: int,
+                     model_axis: str = "model") -> NamedSharding:
+    """NamedSharding for ``TransformerLM.init_cache`` pool buffers,
+    validating that the KV head count divides the model-axis size (an
+    uneven head split would leave ragged shards and break the
+    zero-communication cache-write layout)."""
+    if model_axis not in mesh.axis_names:
+        raise ValueError(
+            f"mesh axes {tuple(mesh.axis_names)} have no "
+            f"{model_axis!r} axis to shard KV heads over")
+    shards = int(mesh.shape[model_axis])
+    if num_kv_heads % shards != 0:
+        raise ValueError(
+            f"num_kv_heads ({num_kv_heads}) must divide evenly over "
+            f"the {shards}-way {model_axis!r} mesh axis; choose a "
+            f"mesh the head count divides or bring more KV heads")
+    return NamedSharding(mesh, kv_pool_spec(model_axis))

@@ -15,7 +15,11 @@ dispatch with token-granular continuous batching —
   the target verifies them in one ragged dispatch, and each row
   accepts its own variable-length extension — greedy output stays
   token-identical, decode dispatches per token drop by the acceptance
-  rate (``SpeculationPolicy``).
+  rate (``SpeculationPolicy``). Pass ``mesh=`` (a model-axis device
+  mesh) for TENSOR-PARALLEL serving: params Megatron-shard, every KV
+  pool shards its heads dimension, and each compiled program runs as
+  one SPMD dispatch with jit-inserted collectives — token-identical
+  to the unsharded engine, jit gauge still flat.
 - ``PrefixCache`` (``prefix_cache``): the host-side radix-trie index
   over token-id prefixes mapping to retained KV pool rows — a new
   request whose prompt shares a cached prefix skips prefill for the
@@ -71,7 +75,7 @@ from bigdl_tpu.serving.streams import (
 from bigdl_tpu.serving.benchmark import (
     poisson_workload, repeated_text_workload, run_poisson_comparison,
     run_shared_prefix_comparison, run_speculative_comparison,
-    shared_prefix_workload,
+    run_tp_comparison, shared_prefix_workload,
 )
 
 __all__ = [
@@ -83,4 +87,5 @@ __all__ = [
     "poisson_workload", "run_poisson_comparison",
     "shared_prefix_workload", "run_shared_prefix_comparison",
     "repeated_text_workload", "run_speculative_comparison",
+    "run_tp_comparison",
 ]

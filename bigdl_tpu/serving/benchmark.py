@@ -28,6 +28,16 @@ decode — inter-token p50/p99 both ways, the draft acceptance rate,
 and greedy token parity (a draft must never change the output, only
 how many dispatches it costs).
 
+``--serving --tp N`` runs the TENSOR-PARALLEL A/B
+(:func:`run_tp_comparison`): the same Poisson workload replayed
+through the engine sharded over an ``N``-way model-axis device mesh
+(``engine(mesh=...)`` — Megatron param split, heads-sharded KV pools,
+SPMD dispatches) vs the plain single-device engine — TTFT and
+inter-token percentiles both ways, the sharded run's mesh/pool block,
+and greedy token parity (a mesh changes where the math runs, never
+the tokens). Hermetic on a CPU host-device mesh; the same call
+measures real ICI scaling on hardware.
+
 ``scripts/perf_gate.py`` turns consecutive rows of any variant into a
 CI regression gate.
 """
@@ -94,6 +104,53 @@ def _usage_blocks(stats: dict) -> dict:
             "tokens_per_device_second": a["tokens_per_device_second"]}
         for t, a in (u.get("tenants") or {}).items()}
     return {"goodput": u.get("goodput"), "tenants": tenants}
+
+
+def _engine_replay(model, workload, warm_prompt, warm_tokens,
+                   stats_keys, log, label, **engine_kw) -> dict:
+    """One ENGINE leg of an A/B comparison (the speculative,
+    shared-prefix, and tensor-parallel variants all replay the same
+    way): build the engine, warm every executable outside the
+    measurement window, open-loop replay the workload, and return the
+    standard result block — latency / TTFT / inter-token percentiles,
+    delivered-token throughput, the usage/goodput blocks, alerts, the
+    per-request output rows (keyed by ``id(req)``, for the caller's
+    token-parity check), plus the ``engine.stats()`` entries named by
+    ``stats_keys``."""
+    from bigdl_tpu.serving import ContinuousBatchingEngine
+
+    engine = ContinuousBatchingEngine(model, **engine_kw)
+    ttft: List[float] = []
+    itl: List[float] = []
+    rows: dict = {}
+    tlock = threading.Lock()
+
+    def collect(handle, req):
+        row = handle.result()
+        with tlock:
+            rows[id(req)] = row
+            if handle.first_token_at is not None:
+                ttft.append(handle.first_token_at - handle.submitted_at)
+            _append_itl(itl, handle)
+        return row.shape[0] - req["prompt"].shape[0]
+
+    log(f"[serving-bench] {label} replay ({engine.service_name})...")
+    with engine:
+        engine.submit(warm_prompt, warm_tokens).result(timeout=300)
+        res = _replay(
+            workload,
+            lambda req: engine.submit(req["prompt"], req["n"],
+                                      tenant=req.get("tenant")),
+            collect)
+        stats = engine.stats()
+    res["ttft"] = _percentiles(ttft)
+    res["inter_token"] = _percentiles(itl)
+    for key in stats_keys:
+        res[key] = stats[key]
+    res.update(_usage_blocks(stats))
+    res["alerts"] = stats["alerts"]
+    res["rows"] = rows
+    return res
 
 
 def _replay(workload, submit_fn, collect_fn) -> dict:
@@ -214,8 +271,6 @@ def run_speculative_comparison(model, draft=None, n_requests: int = 24,
     token-identical greedy outputs (they must: a draft changes dispatch
     count, never tokens). This is the decode-throughput claim of
     speculative serving, measured."""
-    from bigdl_tpu.serving import ContinuousBatchingEngine
-
     log = log or (lambda *a, **k: None)
     if draft is None:
         from bigdl_tpu.nn.quantized import Quantizer
@@ -235,42 +290,12 @@ def run_speculative_comparison(model, draft=None, n_requests: int = 24,
         np.int32)
 
     def run_path(name: str, **engine_kw) -> dict:
-        engine = ContinuousBatchingEngine(
-            model, max_slots=max_slots, prefill_chunk=prefill_chunk,
+        return _engine_replay(
+            model, wl, warm_prompt, 4,
+            ("speculation", "jit_compiles"), log, "speculative",
+            max_slots=max_slots, prefill_chunk=prefill_chunk,
             prefill_rows=prefill_rows, eos_id=eos_id,
             registry=registry, service_name=name, **engine_kw)
-        ttft: List[float] = []
-        itl: List[float] = []
-        rows: dict = {}
-        tlock = threading.Lock()
-
-        def collect(handle, req):
-            row = handle.result()
-            with tlock:
-                rows[id(req)] = row
-                if handle.first_token_at is not None:
-                    ttft.append(handle.first_token_at
-                                - handle.submitted_at)
-                _append_itl(itl, handle)
-            return row.shape[0] - req["prompt"].shape[0]
-
-        log(f"[serving-bench] speculative replay ({name})...")
-        with engine:
-            # warm every executable outside the measurement window
-            engine.submit(warm_prompt, 4).result(timeout=300)
-            res = _replay(
-                wl, lambda req: engine.submit(req["prompt"], req["n"],
-                                              tenant=req.get("tenant")),
-                collect)
-            stats = engine.stats()
-        res["ttft"] = _percentiles(ttft)
-        res["inter_token"] = _percentiles(itl)
-        res["speculation"] = stats["speculation"]
-        res["jit_compiles"] = stats["jit_compiles"]
-        res.update(_usage_blocks(stats))
-        res["alerts"] = stats["alerts"]
-        res["rows"] = rows
-        return res
 
     spec = run_path("bench_spec_on", draft=draft, spec_gamma=gamma)
     nospec = run_path("bench_spec_off")
@@ -313,8 +338,6 @@ def run_shared_prefix_comparison(model, n_requests: int = 24,
     hit-rate block, the p50/p99 TTFT speedups, and whether the two
     paths produced token-identical greedy outputs (they must). This is
     the O(prompt) → O(novel-suffix) TTFT claim, measured."""
-    from bigdl_tpu.serving import ContinuousBatchingEngine
-
     log = log or (lambda *a, **k: None)
     vocab = model.vocab_size
     # fit tail + decode inside the ENGINE's serving window: a sampled
@@ -341,43 +364,15 @@ def run_shared_prefix_comparison(model, n_requests: int = 24,
             0, vocab, (template_len,)), np.int32)
 
     def run_path(name: str, **engine_kw) -> dict:
-        engine = ContinuousBatchingEngine(
-            model, max_slots=max_slots, prefill_chunk=prefill_chunk,
+        # the warm prompt is a NON-template one, so the compile cost
+        # lands outside the measurement and the template cache starts
+        # cold for both paths
+        return _engine_replay(
+            model, wl, warm_prompt, 2, ("prefix_cache",), log,
+            "shared-prefix",
+            max_slots=max_slots, prefill_chunk=prefill_chunk,
             prefill_rows=prefill_rows, eos_id=eos_id,
             registry=registry, service_name=name, **engine_kw)
-        ttft: List[float] = []
-        itl: List[float] = []
-        rows: dict = {}
-        tlock = threading.Lock()
-
-        def collect(handle, req):
-            row = handle.result()
-            with tlock:
-                rows[id(req)] = row
-                if handle.first_token_at is not None:
-                    ttft.append(handle.first_token_at
-                                - handle.submitted_at)
-                _append_itl(itl, handle)
-            return row.shape[0] - req["prompt"].shape[0]
-
-        log(f"[serving-bench] shared-prefix replay ({name})...")
-        with engine:
-            # warm the executables with a NON-template prompt so the
-            # compile cost lands outside the measurement and the
-            # template cache starts cold for both paths
-            engine.submit(warm_prompt, 2).result(timeout=300)
-            res = _replay(
-                wl, lambda req: engine.submit(req["prompt"], req["n"],
-                                              tenant=req.get("tenant")),
-                collect)
-            stats = engine.stats()
-        res["ttft"] = _percentiles(ttft)
-        res["inter_token"] = _percentiles(itl)
-        res["prefix_cache"] = stats["prefix_cache"]
-        res.update(_usage_blocks(stats))
-        res["alerts"] = stats["alerts"]
-        res["rows"] = rows
-        return res
 
     cached = run_path("bench_prefix_on")
     uncached = run_path("bench_prefix_off", prefix_cache_bytes=0)
@@ -401,6 +396,82 @@ def run_shared_prefix_comparison(model, n_requests: int = 24,
                          "prefill_rows": prefill_rows,
                          "n_templates": n_templates,
                          "template_len": template_len}}
+
+
+def run_tp_comparison(model, tp: int = 2, n_requests: int = 16,
+                      rate_hz: float = 30.0, max_slots: int = 4,
+                      prefill_chunk: int = 8, prefill_rows: int = 2,
+                      eos_id: Optional[int] = None, seed: int = 0,
+                      registry=None, log=None, mesh=None,
+                      model_axis: str = "model") -> dict:
+    """Replay ONE Poisson workload through the engine twice — SHARDED
+    over a ``tp``-way model-axis device mesh (params Megatron-split,
+    KV pools sharded on heads, SPMD dispatches) vs the plain
+    single-device engine, everything else identical — and report
+    TTFT / inter-token / latency percentiles for both, the sharded
+    run's mesh block and jit-compile count, and whether the two paths
+    produced token-identical greedy outputs (they must: a mesh changes
+    WHERE the math runs, never the tokens). On a CPU host this is the
+    hermetic host-device-mesh A/B ``bench.py --serving --tp`` emits;
+    on real hardware the same call measures actual ICI scaling."""
+    import jax
+
+    from bigdl_tpu.parallel.engine import Engine
+
+    log = log or (lambda *a, **k: None)
+    if mesh is None:
+        devices = jax.devices()
+        if len(devices) < tp:
+            try:
+                devices = jax.devices("cpu")
+            except RuntimeError:
+                pass
+        if len(devices) < tp:
+            raise ValueError(
+                f"tp={tp} needs {tp} devices but only {len(devices)} "
+                f"are visible; on CPU set XLA_FLAGS="
+                f"--xla_force_host_platform_device_count={tp}")
+        mesh = Engine.create_mesh([(model_axis, tp)],
+                                  devices=devices[:tp])
+    vocab = model.vocab_size
+    wl = poisson_workload(n_requests, rate_hz, vocab,
+                          decode_lens=(4, min(24, model.max_len // 2)),
+                          seed=seed)
+    warm_prompt = np.asarray(
+        np.random.RandomState(seed + 1).randint(0, vocab, (12,)),
+        np.int32)
+
+    def run_path(name: str, **engine_kw) -> dict:
+        return _engine_replay(
+            model, wl, warm_prompt, 4, ("mesh", "jit_compiles"), log,
+            "tensor-parallel",
+            max_slots=max_slots, prefill_chunk=prefill_chunk,
+            prefill_rows=prefill_rows, eos_id=eos_id,
+            registry=registry, service_name=name, **engine_kw)
+
+    sharded = run_path("bench_tp_sharded", mesh=mesh,
+                       model_axis=model_axis)
+    unsharded = run_path("bench_tp_unsharded")
+    parity = all(
+        np.array_equal(sharded["rows"][id(req)],
+                       unsharded["rows"][id(req)])
+        for req in wl)
+    for r in (sharded, unsharded):
+        del r["rows"]
+
+    def ratio(block, key):
+        a, b = unsharded[block][key], sharded[block][key]
+        return round(a / b, 4) if a and b else None
+
+    return {"sharded": sharded, "unsharded": unsharded,
+            "ttft_p50_ratio": ratio("ttft", "p50"),
+            "inter_token_p50_ratio": ratio("inter_token", "p50"),
+            "inter_token_p99_ratio": ratio("inter_token", "p99"),
+            "token_parity": bool(parity),
+            "workload": {"kind": "tensor_parallel", "tp": int(tp),
+                         "requests": n_requests, "rate_hz": rate_hz,
+                         "seed": seed, "max_slots": max_slots,
+                         "prefill_rows": prefill_rows}}
 
 
 def run_poisson_comparison(model, n_requests: int = 16,

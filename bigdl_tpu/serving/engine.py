@@ -36,6 +36,14 @@ analog of the RDMA paper's persistent dataflow, arxiv 1805.08430):
   immediately for the next queued request (eviction ≡ slot reuse; the
   stale KV is overwritten before it can ever be attended — decode
   writes position p before masking attention to ``<= p``).
+- the engine optionally runs TENSOR-PARALLEL (``mesh=``): params are
+  Megatron-sharded over the mesh's model axis
+  (``parallel.tp.transformer_tp_rules`` / ``shard_params``), all four
+  device pools shard their KV-heads dimension along the same axis,
+  and every compiled program above becomes ONE SPMD dispatch with
+  jit-inserted collectives — models larger than one device's HBM
+  serve at full interconnect bandwidth while the host-side control
+  flow stays mesh-oblivious.
 - decode is optionally SPECULATIVE (``draft=``): per iteration a
   cheaper draft model proposes ``spec_gamma`` tokens for ALL live
   slots in one ``lax.scan`` dispatch (its own slot-pooled KV cache,
@@ -199,6 +207,25 @@ class ContinuousBatchingEngine:
     and per-burst ``request/decode_token`` events carrying
     ``accepted=``.
 
+    TENSOR-PARALLEL SERVING: pass ``mesh=`` (a ``jax.sharding.Mesh``
+    with a ``model_axis`` axis — ``parallel.Engine.create_mesh([(
+    "model", N)])``) and the whole engine runs SPMD: params load
+    Megatron-sharded (``tp_rules``, default
+    ``parallel.tp.transformer_tp_rules(model_axis)``), every device
+    pool — KV slots, prefill staging, prefix pool, draft pools —
+    shards its KV-heads dimension along the model axis (the layout
+    the column-parallel QKV writes with no collective;
+    ``num_kv_heads`` must divide the axis size), host inputs enter
+    replicated, and jit/GSPMD inserts the row-parallel all-reduces
+    into the SAME compiled programs. Host-side control flow
+    (scheduler, streams, ledger, recorder) is mesh-oblivious; greedy
+    output stays token-identical to the unsharded engine (tested on a
+    host-device CPU mesh), the jit gauge stays flat, and usage
+    device-seconds scale by the mesh size (one dispatch occupies
+    every device). ``stats()["mesh"]`` reports topology plus per-pool
+    logical/physical/per-device bytes; ``bigdl_serving_mesh_*``
+    gauges carry the same figures.
+
     When to prefer this over ``GenerationService``: mixed or long
     decode lengths under concurrent load (no head-of-line blocking on
     batch completion, slots recycle per token), streaming clients
@@ -264,7 +291,10 @@ class ContinuousBatchingEngine:
                  usage_tenants: int = 32,
                  usage_recent: int = 256,
                  draft=None,
-                 spec_gamma: int = 4):
+                 spec_gamma: int = 4,
+                 mesh=None,
+                 tp_rules=None,
+                 model_axis: str = "model"):
         from bigdl_tpu.models.transformer import _validate_sampling
         from bigdl_tpu.observability import serving_engine_instruments
         from bigdl_tpu.observability import memory as obs_memory
@@ -357,18 +387,56 @@ class ContinuousBatchingEngine:
                 f"engine's serving window ({cap}); shrink max_len or "
                 "bring a longer-context draft")
 
+        # ---- tensor-parallel mesh (SPMD serving) -----------------------
+        # With a mesh, EVERY compiled program below runs as one SPMD
+        # dispatch: params are Megatron-sharded (transformer_tp_rules /
+        # shard_params), all four device pools (slot KV, staging,
+        # prefix pool, draft pools) shard their KV-HEADS dim along the
+        # model axis (the layout the column-parallel QKV writes with
+        # no collective), host inputs enter replicated, and jit/GSPMD
+        # places the row-parallel all-reduces. Host-side control flow
+        # (scheduler, streams, ledger, recorder) stays mesh-oblivious.
+        self.mesh = mesh
+        self.model_axis = model_axis
+        self._kv_shard = self._d_kv_shard = self._repl = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            from bigdl_tpu.parallel.tp import transformer_tp_rules
+
+            self._kv_shard = model.kv_cache_sharding(
+                mesh, model_axis=model_axis)
+            if draft is not None:
+                try:
+                    self._d_kv_shard = draft.kv_cache_sharding(
+                        mesh, model_axis=model_axis)
+                except ValueError as e:
+                    raise ValueError(
+                        f"draft model cannot shard over this mesh: "
+                        f"{e}") from None
+            self._repl = NamedSharding(mesh, PartitionSpec())
+            if tp_rules is None:
+                tp_rules = transformer_tp_rules(model_axis)
+        self._tp_rules = tp_rules
+
         self._params = jax.tree.map(jnp.asarray, model.params_dict())
         self._buffers = jax.tree.map(jnp.asarray, model.buffers_dict())
+        if mesh is not None:
+            from bigdl_tpu.parallel.tp import replicate, shard_params
+
+            self._params = shard_params(self._params, mesh, tp_rules)
+            self._buffers = replicate(self._buffers, mesh)
         dtype = model.tok_embed.dtype
         # THE pooled cache: one persistent (max_slots, ...) buffer set,
         # donated through every step — updates are in-place for the
         # engine's whole life
-        self._caches = model.init_cache(max_slots, phys_len, dtype=dtype)
+        self._caches = model.init_cache(max_slots, phys_len, dtype=dtype,
+                                        sharding=self._kv_shard)
         # prefill_rows-wide staging cache for chunked prefill; rows are
         # reused across admissions (stale tail KV is position-masked,
         # never attended)
         self._staging = model.init_cache(self._policy.prefill_rows,
-                                         phys_len, dtype=dtype)
+                                         phys_len, dtype=dtype,
+                                         sharding=self._kv_shard)
         if draft is not None:
             # the draft's slot pool + staging mirror the target's
             # geometry row-for-row (same phys_len so lifecycle stays
@@ -377,11 +445,24 @@ class ContinuousBatchingEngine:
                                           draft.params_dict())
             self._d_bufs = jax.tree.map(jnp.asarray,
                                         draft.buffers_dict())
+            if mesh is not None:
+                from bigdl_tpu.parallel.tp import (
+                    replicate, shard_params,
+                )
+
+                # same rule set: an int8 clone shares the float
+                # source's param paths; unmatched leaves (quantizer
+                # scales, layernorms) replicate — correct either way
+                self._d_params = shard_params(self._d_params, mesh,
+                                              tp_rules)
+                self._d_bufs = replicate(self._d_bufs, mesh)
             d_dtype = draft.tok_embed.dtype
-            self._d_caches = draft.init_cache(max_slots, phys_len,
-                                              dtype=d_dtype)
+            self._d_caches = draft.init_cache(
+                max_slots, phys_len, dtype=d_dtype,
+                sharding=self._d_kv_shard)
             self._d_staging = draft.init_cache(
-                self._policy.prefill_rows, phys_len, dtype=d_dtype)
+                self._policy.prefill_rows, phys_len, dtype=d_dtype,
+                sharding=self._d_kv_shard)
         else:
             self._d_caches = self._d_staging = None
         # prefix-cache KV pool: a third persistent buffer set holding
@@ -402,12 +483,19 @@ class ContinuousBatchingEngine:
             pool_rows = max(0, int(prefix_cache_bytes) // row_bytes)
         if pool_rows > 0:
             self._pool = model.init_cache(pool_rows, phys_len,
-                                          dtype=dtype)
+                                          dtype=dtype,
+                                          sharding=self._kv_shard)
             self._prefix = PrefixCache(
                 pool_rows, row_bytes,
                 min_tokens=(prefix_min_tokens
                             if prefix_min_tokens is not None else c),
-                token_bytes=self._token_bytes)
+                token_bytes=self._token_bytes,
+                # pool rows shard over the MODEL axis only: each
+                # device's share is logical / model_shards (a 2-D
+                # mesh's data axis replicates them, so mesh.size
+                # would undercount)
+                devices=(int(mesh.shape[model_axis])
+                         if mesh is not None else 1))
         else:
             self._pool = None
             self._prefix = None
@@ -435,14 +523,21 @@ class ContinuousBatchingEngine:
             service=service_name, registry=registry, recorder=self._rec,
             instruments=self._ins, max_tenants=usage_tenants,
             recent=usage_recent, slot_row_bytes=row_bytes,
-            staging_row_bytes=row_bytes, token_bytes=self._token_bytes)
+            staging_row_bytes=row_bytes, token_bytes=self._token_bytes,
+            devices=(int(mesh.size) if mesh is not None else 1))
         self._queue = AdmissionQueue(
             queue_capacity, recorder=self._rec,
             wait_histogram=self._ins.queue_wait_seconds)
         self._slots: List[Optional[_SlotState]] = [None] * max_slots
         self._adms: List[_Admission] = []
         self._key = jax.random.PRNGKey(seed)
-        self._zero_key = jax.random.PRNGKey(0)
+        self._zero_key = self._h2d(jax.random.PRNGKey(0))
+        #: the compiled programs' temperature operand, committed once
+        #: (it is fixed per engine) — rebuilding a replicated scalar
+        #: per decode iteration would put a host->mesh transfer in the
+        #: hot loop for a constant
+        self._temp_const = self._h2d(jnp.float32(
+            self.temperature if self.temperature > 0.0 else 1.0))
 
         self._ins.slots.set(max_slots, force=True)
 
@@ -451,28 +546,37 @@ class ContinuousBatchingEngine:
         # this engine owns, registered under weakrefs (the monitor must
         # never keep a dead engine's KV pools alive). Names are keyed
         # by service_name; a same-named successor engine takes them over.
-        pools = {
-            f"serving/{service_name}/kv_slots":
-                lambda e: obs_memory.tree_bytes(e._caches),
-            f"serving/{service_name}/prefill_staging":
-                lambda e: obs_memory.tree_bytes(e._staging),
-            f"serving/{service_name}/params":
-                lambda e: obs_memory.tree_bytes(e._params),
-        }
-        if self._pool is not None:
-            pools[f"serving/{service_name}/prefix_pool"] = \
-                lambda e: obs_memory.tree_bytes(e._pool)
-        if self.draft is not None:
-            pools[f"serving/{service_name}/draft_kv_slots"] = \
-                lambda e: obs_memory.tree_bytes(e._d_caches)
-            pools[f"serving/{service_name}/draft_staging"] = \
-                lambda e: obs_memory.tree_bytes(e._d_staging)
-            pools[f"serving/{service_name}/draft_params"] = \
-                lambda e: obs_memory.tree_bytes(e._d_params)
+        # attribution is PHYSICAL: tree_device_bytes sums every leaf's
+        # per-device shards, so a mesh engine's sharded KV pools report
+        # their true global footprint while replicated leaves (most of
+        # params) count once per device — identical to tree_bytes for
+        # an unsharded engine, honest for an SPMD one. Figures are
+        # SNAPSHOTTED here, the one moment the donated trees cannot be
+        # mid-dispatch (shapes/shardings never change afterwards):
+        # walking a live donated tree's shards from a monitor/HTTP
+        # thread could observe an already-deleted buffer and raise.
+        self._pool_bytes = self._snapshot_pool_bytes()
+
+        def pool_reader(key):
+            return lambda e: e._pool_bytes[key]["physical_bytes"]
+
+        pools = {f"serving/{service_name}/{key}": pool_reader(key)
+                 for key in self._pool_bytes}
         self._memory_pools = obs_memory.register_owned_pools(self, pools)
         if self._prefix is not None:
             self._memory_pools.append(self._prefix.register_memory_pool(
                 f"serving/{service_name}/prefix_kv_in_use"))
+
+        # mesh topology gauges + per-pool per-device footprint
+        n_dev = int(mesh.size) if mesh is not None else 1
+        shards = (int(mesh.shape[model_axis])
+                  if mesh is not None else 1)
+        self._ins.mesh_devices.set(n_dev, force=True)
+        self._ins.mesh_model_shards.set(shards, force=True)
+        for pool_name, summary in self._pool_bytes.items():
+            self._ins.mesh_pool_bytes_per_device.labels(
+                service_name, pool_name).set(
+                    summary["bytes_per_device"], force=True)
 
         # watchdogs, sampled once per loop iteration: compiles that keep
         # growing after warmup break the engine's shape-stability
@@ -571,10 +675,26 @@ class ContinuousBatchingEngine:
                     axis=-1).astype(jnp.int32)
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
-        self._step_jit = jax.jit(step, donate_argnums=(4,))
-        self._chunk_jit = jax.jit(chunk, donate_argnums=(3,))
-        self._copy_row_jit = jax.jit(copy_row, donate_argnums=(0,))
-        self._sample0_jit = jax.jit(sample0)
+        # On a mesh, output shardings are PINNED: every program's cache
+        # tree leaves with the same NamedSharding it entered with (and
+        # scalars/logits leave replicated), so the donated buffers
+        # cycle through the loop in ONE stable layout. Left to GSPMD's
+        # own choice, a copy/step output can drift (e.g. to
+        # replicated), and the next dispatch's changed input sharding
+        # compiles a fresh signature — a gauge-visible leak. kv/draft
+        # pools share the spec (heads along the model axis), so one
+        # prefix broadcast covers every cache tree.
+        kv, repl = self._kv_shard, self._repl
+
+        def _jit(fn, donate, out=None):
+            if self.mesh is None:
+                return jax.jit(fn, donate_argnums=donate)
+            return jax.jit(fn, donate_argnums=donate, out_shardings=out)
+
+        self._step_jit = _jit(step, (4,), (repl, kv))
+        self._chunk_jit = _jit(chunk, (3,), (repl, kv))
+        self._copy_row_jit = _jit(copy_row, (0,), kv)
+        self._sample0_jit = _jit(sample0, (), repl)
 
         # ---- speculative-decoding programs --------------------------
         self._propose_jit = self._spec_verify_jit = None
@@ -588,8 +708,10 @@ class ContinuousBatchingEngine:
             # (transformer._propose_fn): (max_slots,) tokens at
             # (max_slots,) per-row positions, gamma draft steps, ONE
             # dispatch, draft KV written as it goes
-            self._propose_jit = draft._propose_fn(self.max_slots, g,
-                                                  sampled=sampled)
+            self._propose_jit = draft._propose_fn(
+                self.max_slots, g, sampled=sampled,
+                cache_sharding=self._d_kv_shard,
+                repl_sharding=self._repl)
 
             def d_chunk(p, bufs, ids, caches, pos0, last_idx):
                 # the draft's mirror of the ragged admission prefill:
@@ -658,10 +780,10 @@ class ContinuousBatchingEngine:
                     emit = v_tok
                 return emit, n_acc, caches
 
-            self._d_chunk_jit = jax.jit(d_chunk, donate_argnums=(3,))
-            self._d_sync_jit = jax.jit(d_sync, donate_argnums=(4,))
-            self._spec_verify_jit = jax.jit(spec_verify,
-                                            donate_argnums=(6,))
+            self._d_chunk_jit = _jit(d_chunk, (3,), (repl, kv))
+            self._d_sync_jit = _jit(d_sync, (4,), kv)
+            self._spec_verify_jit = _jit(spec_verify, (6,),
+                                         (repl, repl, kv))
         # warm the copy signatures NOW (zero rows copied onto zero rows
         # — harmless): the insert/stage/donate copies first fire at a
         # request's FINISH or at the first cache hit, and a compile
@@ -689,9 +811,13 @@ class ContinuousBatchingEngine:
             # CONDITIONAL at runtime (it only fires when some row
             # fully accepts), so left cold it could first compile many
             # iterations after warmup and read as a recompile storm
-            zt = jnp.zeros((self.max_slots,), jnp.int32)
-            zk = jax.random.PRNGKey(0)
-            t1 = jnp.float32(1.0)
+            # warmed inputs take the SAME layout runtime inputs will
+            # (replicated-committed on a mesh, via _h2d): a layout
+            # mismatch would make the first real dispatch a second
+            # compile — exactly the flatness the gauge polices
+            zt = self._h2d(jnp.zeros((self.max_slots,), jnp.int32))
+            zk = self._h2d(jax.random.PRNGKey(0))
+            t1 = self._h2d(jnp.float32(1.0))
             props, qlogits, self._d_caches = self._propose_jit(
                 self._d_params, self._d_bufs, zt, zt, self._d_caches,
                 zk, t1)
@@ -702,6 +828,75 @@ class ContinuousBatchingEngine:
                 self._d_params, self._d_bufs, zt, zt, self._d_caches)
             self._warm.update(("spec:propose", "spec:verify",
                                "spec:sync"))
+
+    def _h2d(self, x):
+        """Host value → device array; on a mesh, committed REPLICATED.
+        Every per-iteration host input (token/position vectors, chunk
+        ids, RNG keys, the temperature scalar) funnels through here so
+        compiled signatures see ONE stable input layout — GSPMD never
+        has to guess a fresh sharding per call, and the jit gauge
+        stays flat."""
+        x = jnp.asarray(x)
+        if self._repl is not None:
+            x = jax.device_put(x, self._repl)
+        return x
+
+    def _pool_trees(self) -> dict:
+        """Short name → live buffer tree for every persistent device
+        pool this engine owns (the mesh-summary / per-device gauge
+        enumeration; keys match the ``serving/<name>/<pool>`` registry
+        suffixes)."""
+        out = {"kv_slots": self._caches,
+               "prefill_staging": self._staging,
+               "params": self._params}
+        if self._pool is not None:
+            out["prefix_pool"] = self._pool
+        if self.draft is not None:
+            out["draft_kv_slots"] = self._d_caches
+            out["draft_staging"] = self._d_staging
+            out["draft_params"] = self._d_params
+        return out
+
+    def _mesh_summary(self) -> dict:
+        """The ``stats()["mesh"]`` block: topology (axis names/sizes,
+        device count, which axis shards the model) and per-pool byte
+        attribution — logical bytes (the array's global shape),
+        physical bytes (shards summed across devices; replicated
+        leaves count once per device), and the per-device share one
+        chip's HBM actually pays. Pool shapes and shardings are
+        load-independent, so the figures are computed ONCE at
+        construction (``_snapshot_pool_bytes``) — also why this is
+        safe from HTTP/debug threads: reading a live donated tree's
+        shards mid-dispatch could observe a deleted buffer."""
+        n = int(self.mesh.size) if self.mesh is not None else 1
+        out = {"enabled": self.mesh is not None, "devices": n,
+               "pools": dict(self._pool_bytes)}
+        if self.mesh is not None:
+            out["axes"] = {str(a): int(s)
+                           for a, s in self.mesh.shape.items()}
+            out["model_axis"] = self.model_axis
+            out["model_shards"] = int(self.mesh.shape[self.model_axis])
+        return out
+
+    def _snapshot_pool_bytes(self) -> dict:
+        """Per-pool byte attribution, computed at construction while
+        no loop thread can be mid-donation (every later reader serves
+        this snapshot — the buffers' shapes and shardings never change
+        for the engine's life)."""
+        from bigdl_tpu.observability import memory as obs_memory
+
+        n = int(self.mesh.size) if self.mesh is not None else 1
+        pools = {}
+        for name, tree in self._pool_trees().items():
+            logical = obs_memory.tree_bytes(tree)
+            physical = obs_memory.tree_device_bytes(tree)
+            pools[name] = {
+                "logical_bytes": logical,
+                "physical_bytes": physical,
+                "bytes_per_device": physical // n,
+                "sharded": bool(n > 1 and physical < logical * n),
+            }
+        return pools
 
     def _compile_total(self) -> int:
         fns = [self._step_jit, self._chunk_jit, self._copy_row_jit,
@@ -919,6 +1114,7 @@ class ContinuousBatchingEngine:
         out["latency"] = self._latency_summary()
         out["prefix_cache"] = self._prefix_summary()
         out["speculation"] = self._spec_summary()
+        out["mesh"] = self._mesh_summary()
         out["usage"] = self._usage.summary()
         out["alerts"] = self.alerts()
         return out
@@ -1058,6 +1254,7 @@ class ContinuousBatchingEngine:
                 "latency": self._latency_summary(),
                 "prefix_cache": self._prefix_summary(),
                 "speculation": self._spec_summary(),
+                "mesh": self._mesh_summary(),
                 "alerts": self.alerts()}
 
     def debug_usage(self, top_n: int = 10) -> dict:
@@ -1384,8 +1581,8 @@ class ContinuousBatchingEngine:
             not finals or "sample0" in self._warm)
         t_disp = time.monotonic()
         logits, self._staging = self._chunk_jit(
-            self._params, self._buffers, jnp.asarray(ids), self._staging,
-            jnp.asarray(pos0), jnp.asarray(last))
+            self._params, self._buffers, self._h2d(ids), self._staging,
+            self._h2d(pos0), self._h2d(last))
         self._warm.add("chunk")
         if spec:
             d_ids = np.zeros((rows, c), np.int32)
@@ -1395,9 +1592,9 @@ class ContinuousBatchingEngine:
                 d_ids[a.row] = a.d_ids[dk * c:(dk + 1) * c]
                 d_pos0[a.row] = dk * c
             _, self._d_staging = self._d_chunk_jit(
-                self._d_params, self._d_bufs, jnp.asarray(d_ids),
-                self._d_staging, jnp.asarray(d_pos0),
-                jnp.zeros((rows,), jnp.int32))
+                self._d_params, self._d_bufs, self._h2d(d_ids),
+                self._d_staging, self._h2d(d_pos0),
+                self._h2d(np.zeros((rows,), np.int32)))
             self._warm.add("d_chunk")
         toks = None
         if finals:
@@ -1539,8 +1736,8 @@ class ContinuousBatchingEngine:
         was_warm = "step" in self._warm   # cold = compile in the wall
         t_disp = time.monotonic()
         nxt, self._caches = self._step_jit(
-            self._params, self._buffers, jnp.asarray(tok),
-            jnp.asarray(pos), self._caches, self._next_key(),
+            self._params, self._buffers, self._h2d(tok),
+            self._h2d(pos), self._caches, self._next_key(),
             self._temp())
         self._warm.add("step")
         nxt_np = np.asarray(nxt)   # blocks on the fused step
@@ -1584,12 +1781,13 @@ class ContinuousBatchingEngine:
         else:
             r_draft = r_acc = self._zero_key
         t_disp = time.monotonic()
+        tok_d, pos_d = self._h2d(tok), self._h2d(pos)
         props, qlogits, self._d_caches = self._propose_jit(
-            self._d_params, self._d_bufs, jnp.asarray(tok),
-            jnp.asarray(pos), self._d_caches, r_draft, self._temp())
+            self._d_params, self._d_bufs, tok_d, pos_d,
+            self._d_caches, r_draft, self._temp())
         emit, n_acc, self._caches = self._spec_verify_jit(
-            self._params, self._buffers, jnp.asarray(tok), props,
-            qlogits, jnp.asarray(pos), self._caches, r_acc,
+            self._params, self._buffers, tok_d, props,
+            qlogits, pos_d, self._caches, r_acc,
             self._temp())
         emit_np = np.asarray(emit)    # blocks on both dispatches
         n_np = np.asarray(n_acc)
@@ -1616,8 +1814,8 @@ class ContinuousBatchingEngine:
                                  else int(emit_np[sid, n_r - 1]))
                 sync_pos[sid] = pos[sid] + n_r
             self._d_caches = self._d_sync_jit(
-                self._d_params, self._d_bufs, jnp.asarray(sync_tok),
-                jnp.asarray(sync_pos), self._d_caches)
+                self._d_params, self._d_bufs, self._h2d(sync_tok),
+                self._h2d(sync_pos), self._d_caches)
         # burst lengths FIRST (pure), so the dispatch wall is
         # attributed before any handle can finalize — a late charge
         # against an already-finalized record would leak out of the
@@ -1694,14 +1892,13 @@ class ContinuousBatchingEngine:
 
     # ------------------------------------------------------- plumbing
     def _temp(self):
-        return jnp.float32(self.temperature
-                           if self.temperature > 0.0 else 1.0)
+        return self._temp_const
 
     def _next_key(self):
         if self.temperature <= 0.0:
             return self._zero_key  # greedy: the key is never consumed
         self._key, sub = jax.random.split(self._key)
-        return sub
+        return self._h2d(sub)
 
     def _release(self, sid: int, error: Optional[Exception],
                  reason: str) -> None:

@@ -102,14 +102,22 @@ class PrefixCache:
     """
 
     def __init__(self, rows: int, row_bytes: int,
-                 min_tokens: int = 1, token_bytes: float = 0.0):
+                 min_tokens: int = 1, token_bytes: float = 0.0,
+                 devices: int = 1):
         if rows < 0:
             raise ValueError(f"rows must be >= 0, got {rows}")
         if min_tokens < 1:
             raise ValueError(
                 f"min_tokens must be >= 1, got {min_tokens}")
+        if devices < 1:
+            raise ValueError(f"devices must be >= 1, got {devices}")
         self.rows = rows
         self.row_bytes = int(row_bytes)
+        #: devices the pool's rows are sharded across (the serving
+        #: mesh size; 1 unsharded) — ``row_bytes`` stays the LOGICAL
+        #: per-row footprint, ``stats()`` derives the per-device share
+        #: one chip's HBM pays for the occupied rows
+        self.devices = int(devices)
         #: prefixes shorter than this are never matched or donated —
         #: a few shared tokens are not worth a row or a copy dispatch
         self.min_tokens = min_tokens
@@ -392,6 +400,9 @@ class PrefixCache:
                 "rows": self.rows,
                 "bytes": len(self._entries) * self.row_bytes,
                 "capacity_bytes": self.rows * self.row_bytes,
+                "devices": self.devices,
+                "bytes_per_device": (len(self._entries)
+                                     * self.row_bytes) // self.devices,
                 "hits": self.hits,
                 "misses": self.misses,
                 "hit_rate": round(self.hits / looked, 4) if looked else 0.0,

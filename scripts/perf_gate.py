@@ -17,11 +17,14 @@ goodput) gate on what both rows actually measured.
 
 Serving rows come from ``bench.py --serving`` (percentiles under
 ``detail.engine.{ttft,inter_token}.p99``), ``bench.py --serving
---shared-prefix`` (``detail.cached.*``), and ``bench.py --serving
+--shared-prefix`` (``detail.cached.*``), ``bench.py --serving
 --speculative`` (``detail.spec.*`` — the speculative path's
 inter-token p99 is exactly the measure speculation exists to improve,
-so it gates like any other); all three shapes are understood. Stdlib
-only — runnable from any CI step without the package installed.
+so it gates like any other), and ``bench.py --serving --tp``
+(``detail.sharded.*`` — the tensor-parallel engine's latencies, gated
+against the previous sharded run of the same mesh width); all four
+shapes are understood. Stdlib only — runnable from any CI step
+without the package installed.
 
 Usage::
 
@@ -38,8 +41,9 @@ import sys
 
 #: detail keys that hold a serving result with a ``ttft`` percentile
 #: block, in precedence order (--serving vs --serving --shared-prefix
-#: vs --serving --speculative — each row shape carries exactly one)
-_TTFT_PATHS = ("engine", "cached", "spec")
+#: vs --serving --speculative vs --serving --tp — each row shape
+#: carries exactly one)
+_TTFT_PATHS = ("engine", "cached", "spec", "sharded")
 
 
 def _p99(row: dict, measure: str):
