@@ -18,8 +18,11 @@ POINT is the serving machinery, not the prose):
      HBM attribution (KV slots / staging / prefix pool / params),
      per-tenant usage accounting (requests submitted under tenant
      names; the /debug/usage table — tokens, device-seconds, KV
-     byte-seconds, goodput — round-tripped over HTTP), and on-demand
-     /debug/profile capture (--profile-seconds N)
+     byte-seconds, goodput — round-tripped over HTTP), on-demand
+     /debug/profile capture (--profile-seconds N), the dispatch cost
+     model (per-kind MFU + roofline class from stats()["cost"], loop-
+     phase bubble breakdown from stats()["loop"]), and the live
+     /debug/dashboard sparkline page (URL printed on startup)
   7. --tp N: the SAME engine tensor-parallel over an N-way model-axis
      device mesh (Megatron-sharded params, heads-sharded KV pools,
      SPMD dispatches; N virtual host devices on CPU) — topology and
@@ -190,9 +193,13 @@ def main(argv=None):
             obs.start_http_server(host="127.0.0.1",
                                   healthz=engine.healthz,
                                   debug_requests=engine.debug_requests,
-                                  debug_usage=engine.debug_usage
+                                  debug_usage=engine.debug_usage,
+                                  debug_timeseries=engine.debug_timeseries,
+                                  dashboard=engine.dashboard
                                   ) as server:
         base = f"http://127.0.0.1:{server.port}"
+        print(f"[engine]    live dashboard: {base}/debug/dashboard "
+              "(SVG sparklines, self-refreshing, no metrics stack)")
         # each request bills a tenant: the usage ledger attributes
         # queue wait, tokens, KV byte-seconds, and pro-rata dispatch
         # device-seconds to it (unknown names past the cardinality
@@ -265,6 +272,28 @@ def main(argv=None):
               f"burner {top.get('request_id')} "
               f"({top.get('tenant')}, "
               f"{top.get('device_s', 0) * 1e3:.1f} ms)")
+
+        # how WELL the device time was spent: per-dispatch-kind MFU +
+        # roofline class (FLOPs from XLA's lowered cost analysis —
+        # extracted once, zero extra compiles), and the loop-phase
+        # breakdown attributing device-idle time to named host bubbles
+        st = engine.stats()
+        for kind, c in sorted(st["cost"]["kinds"].items()):
+            if not c["dispatches"]:
+                continue
+            print(f"[cost]      {kind:<8} {c['roofline']:>13} "
+                  f"(intensity {c['arithmetic_intensity']:.1f} "
+                  f"FLOP/B vs ridge {c['ridge_intensity']:.1f}), "
+                  f"mfu {c['mfu']:.2%}, membw {c['membw_util']:.2%} "
+                  f"[{c['flops_source']}]")
+        lp = st["loop"]
+        bars = ", ".join(f"{ph}={fr:.0%}"
+                         for ph, fr in sorted(lp["fractions"].items(),
+                                              key=lambda kv: -kv[1])
+                         if fr >= 0.005)
+        print(f"[loop]      {lp['iterations']} iterations, device idle "
+              f"{lp['device_idle_fraction']:.0%} of loop time; "
+              f"phases: {bars}")
 
         if args.profile_seconds > 0:
             # zero-redeploy profiling: one bounded capture over HTTP

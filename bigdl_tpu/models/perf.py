@@ -111,6 +111,11 @@ def _transformer_perf(batch_size, iterations, warmup, dtype, log,
     step = jax.jit(ts.step, donate_argnums=(0, 1, 2))
     ids = jax.random.randint(jax.random.PRNGKey(0), (batch_size, seq_len),
                              0, vocab)
+    # lower BEFORE warmup: donation invalidates these exact buffers, and
+    # cost_analysis on the lowered program compiles nothing
+    from bigdl_tpu.observability.costmodel import program_cost
+    cost = program_cost(step, params, buffers, slots, ids, ids, lrs,
+                        jax.random.PRNGKey(0))
     t0 = time.perf_counter()
     for _ in range(max(1, warmup)):
         loss, params, buffers, slots = step(params, buffers, slots, ids, ids,
@@ -135,6 +140,10 @@ def _transformer_perf(batch_size, iterations, warmup, dtype, log,
          "records_per_sec": round(tok_per_sec, 2),
          "ms_per_iter": round(1000.0 * elapsed / iterations, 3),
          "loss": loss_v}
+    if cost is not None:
+        s["flops_per_iter"] = cost["flops"]
+        s["bytes_per_iter"] = cost["bytes"]
+        s["cost_source"] = cost["source"]
     log(f"[perf] transformer_lm batch={batch_size} seq={seq_len}: "
         f"{tok_per_sec:.0f} tokens/s ({s['ms_per_iter']:.1f} ms/iter)")
     return s
@@ -199,6 +208,12 @@ def run_perf(model_name: str = None, batch_size: int = 32,
     lrs = ts.current_lrs()
     step = jax.jit(ts.step, donate_argnums=(0, 1, 2))
 
+    # lower BEFORE warmup: donation invalidates these exact buffers, and
+    # cost_analysis on the lowered program compiles nothing
+    from bigdl_tpu.observability.costmodel import program_cost
+    cost = program_cost(step, params, buffers, slots, x, y, lrs,
+                        jax.random.PRNGKey(0))
+
     t0 = time.perf_counter()
     for _ in range(max(1, warmup)):
         loss, params, buffers, slots = step(params, buffers, slots, x, y, lrs,
@@ -228,6 +243,10 @@ def run_perf(model_name: str = None, batch_size: int = 32,
         "ms_per_iter": round(1000.0 * elapsed / iterations, 3),
         "loss": loss_v,
     }
+    if cost is not None:
+        summary["flops_per_iter"] = cost["flops"]
+        summary["bytes_per_iter"] = cost["bytes"]
+        summary["cost_source"] = cost["source"]
     log(f"[perf] {model_name} batch={batch_size}: "
         f"{rec_per_sec:.1f} records/s ({summary['ms_per_iter']:.1f} ms/iter)")
     return summary

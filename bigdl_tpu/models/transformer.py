@@ -26,6 +26,11 @@ _BEAM_SCAN_JIT = weakref.WeakKeyDictionary()
 _SPEC_JIT = weakref.WeakKeyDictionary()
 
 
+def _tree_leaves(tree):
+    """Array leaves of a nested params/buffers dict (cost helpers)."""
+    return jax.tree_util.tree_leaves(tree)
+
+
 def _filter_logits(logits, temperature, top_k, top_p):
     """Tempered logits with standard top-k / nucleus (top-p) filtering
     applied (in that order, HF-style) — disallowed tokens get -inf so
@@ -285,6 +290,57 @@ class TransformerLM(Module):
 
         return kv_pool_sharding(mesh, self.num_kv_heads,
                                 model_axis=model_axis)
+
+    # ------------------------------------------------ analytic cost model
+    def param_count(self) -> int:
+        """Total parameter count (all leaves of ``params_dict``)."""
+        import math
+
+        total = 0
+        for leaf in _tree_leaves(self.params_dict()):
+            total += int(math.prod(leaf.shape)) if leaf.shape else 1
+        return total
+
+    def matmul_param_count(self) -> int:
+        """Parameters that participate in per-token matmuls: everything
+        except the embedding tables (token lookup is a gather, learned
+        positions are an add), **plus** the tied output head when
+        ``tie_embeddings`` re-uses ``tok_embed`` as a ``D x V``
+        projection — the analytic-FLOPs numerator."""
+        emb = self.vocab_size * self.embed_dim
+        pos = 0 if self.use_rope else self.max_len * self.embed_dim
+        mat = self.param_count() - emb - pos
+        if self.tie_embeddings:
+            mat += emb  # tok_embed doubles as the output projection
+        return mat
+
+    def analytic_flops(self, tokens: int, context: int) -> float:
+        """Analytic forward FLOPs for ``tokens`` positions attending
+        over ``context`` cached positions: the standard transformer
+        estimate ``2 x matmul-params`` per token plus the attention
+        score/value matmuls ``4 x layers x embed_dim x context`` per
+        token.  Spec-aware by construction — a verify pass is just
+        ``tokens = rows x (gamma + 1)`` at the same context; a decode
+        step is ``tokens = rows`` — and the fallback when XLA's
+        ``cost_analysis`` reports nothing."""
+        per_tok = (2.0 * self.matmul_param_count()
+                   + 4.0 * self.num_layers * self.embed_dim
+                   * max(0, int(context)))
+        return float(per_tok * max(0, int(tokens)))
+
+    def analytic_bytes(self, tokens: int, context: int,
+                       dtype_bytes: int = 4) -> float:
+        """Analytic HBM traffic for the same pass: one read of every
+        parameter, plus KV-cache traffic — one K/V write per new token
+        and a ``context``-deep K/V read per token attended."""
+        param_bytes = 0
+        for leaf in _tree_leaves(self.params_dict()):
+            param_bytes += int(getattr(leaf, "nbytes", 0) or 0)
+        head_dim = self.embed_dim // self.block0.attn.num_heads
+        kv_tok = 2 * self.num_layers * self.num_kv_heads * head_dim \
+            * dtype_bytes
+        t, c = max(0, int(tokens)), max(0, int(context))
+        return float(param_bytes + kv_tok * t * (1 + c))
 
     def prefill(self, ids, caches, pos0: int = 0):
         """Batched prompt prefill: one causal pass over ids (B, T0) that

@@ -38,11 +38,24 @@ equivalent for one-process-per-host JAX):
   (burn-rate evaluation of latency objectives over the TTFT /
   inter-token / queue-wait histograms) — alert gauges, flight-recorder
   events, and the engine's degraded-``/healthz`` state.
+- **Cost model** (``costmodel``): per-dispatch FLOPs/bytes extracted
+  once from XLA's ``cost_analysis`` on the lowered (never compiled)
+  programs, with analytic transformer fallbacks and a per-device-kind
+  peak table (env-overridable) — achieved FLOP/s, arithmetic
+  intensity, compute-vs-memory-bound roofline class, and the
+  ``bigdl_serving_mfu`` / ``bigdl_serving_membw_util`` gauges, plus
+  the ``LoopPhaseAccumulator`` attributing device-idle time to named
+  engine-loop bubbles.
+- **Time series** (``timeseries``): a background ``TimeSeriesSampler``
+  snapshotting gauges/derived rates into bounded rings behind
+  ``GET /debug/timeseries``, rendered as a self-contained SVG-sparkline
+  dashboard at ``GET /debug/dashboard``.
 - **Exporters** (``exporters``): Prometheus text rendering, a
   stdlib-only ``/metrics`` + ``/healthz`` HTTP endpoint with
   ``/debug/events`` + ``/debug/requests`` + ``/debug/trace`` +
-  ``/debug/memory`` + ``/debug/profile`` routes, and a bridge
-  mirroring the registry into ``visualization`` TensorBoard writers.
+  ``/debug/memory`` + ``/debug/profile`` + ``/debug/timeseries`` +
+  ``/debug/dashboard`` routes, and a bridge mirroring the registry
+  into ``visualization`` TensorBoard writers.
 
 Wired through the stack: ``Optimizer``/``DistriOptimizer`` (step time,
 throughput, loss, lr, grad norm, JIT compiles, checkpoint latency),
@@ -91,6 +104,13 @@ from bigdl_tpu.observability.instruments import (
     watchdog_instruments,
 )
 from bigdl_tpu.observability.accounting import UsageLedger, UsageRecord
+from bigdl_tpu.observability.costmodel import (
+    DispatchCostModel, LoopPhaseAccumulator, device_peaks, peak_flops,
+    program_cost,
+)
+from bigdl_tpu.observability.timeseries import (
+    TimeSeriesSampler, render_dashboard,
+)
 from bigdl_tpu.observability.memory import (
     DeviceMemoryMonitor, default_monitor, pool_sizes, register_pool,
     register_owned_pools, static_pools, tree_bytes, tree_device_bytes,
@@ -122,6 +142,9 @@ __all__ = [
     "serving_instruments", "tenant_usage_instruments",
     "train_instruments", "watchdog_instruments",
     "UsageLedger", "UsageRecord",
+    "DispatchCostModel", "LoopPhaseAccumulator", "device_peaks",
+    "peak_flops", "program_cost",
+    "TimeSeriesSampler", "render_dashboard",
     "DeviceMemoryMonitor", "default_monitor", "pool_sizes",
     "register_pool", "register_owned_pools", "static_pools",
     "tree_bytes", "tree_device_bytes", "unregister_pool",

@@ -395,10 +395,15 @@ class UsageLedger:
     # -------------------------------------------------------- snapshots
     def device_time(self) -> dict:
         """Measured dispatch busy seconds by kind — the conservation
-        reference the per-tenant device-second sums must match."""
+        reference the per-tenant device-second sums must match.
+        Nanosecond (9dp) rounding: these figures are compared against
+        independently-rounded sums at 1e-6 relative tolerance, and
+        microsecond rounding noise across a handful of terms is the
+        same order as that budget."""
         with self._lock:
-            out = {k: round(v, 6) for k, v in self._busy.items()}
-        out["total"] = round(sum(out.values()), 6)
+            out = {k: round(v, 9) for k, v in self._busy.items()}
+            total = sum(self._busy.values())
+        out["total"] = round(total, 9)
         return out
 
     def goodput(self) -> dict:
@@ -406,7 +411,7 @@ class UsageLedger:
         wall-weighted occupancy utilization, mean per-dispatch padding
         waste, and delivered tokens per device-second."""
         with self._lock:
-            busy = {k: round(v, 6) for k, v in self._busy.items()}
+            busy = {k: round(v, 9) for k, v in self._busy.items()}
             total = sum(self._busy.values())
             util = (self._weighted_rows / self._weighted_capacity
                     if self._weighted_capacity else 0.0)
@@ -415,7 +420,7 @@ class UsageLedger:
             tokens = self._tokens_delivered
             dispatches = self._dispatches
         return {
-            "device_seconds": {**busy, "total": round(total, 6)},
+            "device_seconds": {**busy, "total": round(total, 9)},
             "dispatches": dispatches,
             "utilization": round(util, 4),
             "padding_waste_mean": round(waste, 4),
@@ -431,7 +436,9 @@ class UsageLedger:
             snap = {t: dict(agg) for t, agg in self._tenants.items()}
         for agg in snap.values():
             agg["queue_wait_s"] = round(agg["queue_wait_s"], 6)
-            agg["device_s"] = round(agg["device_s"], 6)
+            # 9dp: per-tenant device_s sums are conservation-checked
+            # against device_time() at 1e-6 relative — see there
+            agg["device_s"] = round(agg["device_s"], 9)
             agg["kv_byte_seconds"] = round(agg["kv_byte_seconds"], 3)
             agg["tokens_per_device_second"] = (
                 round(agg["decode_tokens"] / agg["device_s"], 2)
@@ -448,7 +455,7 @@ class UsageLedger:
                     out[k] += agg[k]
             out["in_flight"] = self._open
         out["queue_wait_s"] = round(out["queue_wait_s"], 6)
-        out["device_s"] = round(out["device_s"], 6)
+        out["device_s"] = round(out["device_s"], 9)
         out["kv_byte_seconds"] = round(out["kv_byte_seconds"], 3)
         return out
 

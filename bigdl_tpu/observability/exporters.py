@@ -145,6 +145,14 @@ class MetricsHTTPServer:
       ``jax.profiler`` capture; responds with the artifact directory
       (501 when the backend cannot capture, 409 while another capture
       is in flight).
+    - ``GET /debug/timeseries[?metric=&n=]`` — the engine's background
+      sampler rings (MFU, tokens/s, slot occupancy, queue depth,
+      acceptance rate, alerts) as JSON; wire
+      ``ContinuousBatchingEngine.debug_timeseries`` here.
+    - ``GET /debug/dashboard`` — one self-contained HTML page (inline
+      SVG sparklines, zero external assets) over the same rings plus
+      the live roofline and loop-phase blocks; wire
+      ``ContinuousBatchingEngine.dashboard`` here.
 
     ``recorder``/``tracer`` default to the process defaults, resolved
     per request (a swapped default redirects the endpoints too)."""
@@ -156,7 +164,9 @@ class MetricsHTTPServer:
                  debug_requests: Optional[Callable[[], dict]] = None,
                  debug_memory: Optional[Callable[[], dict]] = None,
                  debug_usage: Optional[Callable[[int], dict]] = None,
-                 profiler: Optional[Callable[[float], str]] = None):
+                 profiler: Optional[Callable[[float], str]] = None,
+                 debug_timeseries=None,
+                 dashboard: Optional[Callable[[], str]] = None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         from bigdl_tpu.observability import events as _events
@@ -218,6 +228,15 @@ class MetricsHTTPServer:
                     self.send_header(
                         "Content-Disposition",
                         f'attachment; filename="{download}"')
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_html(self, text: str, status: int = 200):
+                body = text.encode()
+                self.send_response(status)
+                self.send_header("Content-Type",
+                                 "text/html; charset=utf-8")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -285,6 +304,37 @@ class MetricsHTTPServer:
                 elif path == "/debug/profile":
                     payload, status = run_profile(query)
                     self._send_json(payload, status=status)
+                elif path == "/debug/timeseries":
+                    try:
+                        if debug_timeseries is None:
+                            self._send_json(
+                                {"metrics": {},
+                                 "note": "no timeseries source attached "
+                                         "(pass debug_timeseries=)"})
+                        else:
+                            from urllib.parse import parse_qs
+                            q = parse_qs(query)
+                            metric = q.get("metric", [None])[0]
+                            n_raw = q.get("n", [None])[0]
+                            n = int(n_raw) if n_raw is not None else None
+                            self._send_json(
+                                debug_timeseries(metric=metric, n=n))
+                    except Exception as e:
+                        self._send_json({"error": str(e)}, status=500)
+                elif path == "/debug/dashboard":
+                    try:
+                        if dashboard is None:
+                            self._send_html(
+                                "<!doctype html><html><body><p>no "
+                                "dashboard source attached (pass "
+                                "dashboard=)</p></body></html>")
+                        else:
+                            self._send_html(dashboard())
+                    except Exception as e:
+                        self._send_html(
+                            "<!doctype html><html><body><pre>dashboard "
+                            "error: %s</pre></body></html>"
+                            % str(e), status=500)
                 elif path == "/healthz":
                     status, payload = 200, {"status": "ok"}
                     if healthz is not None:
@@ -349,7 +399,9 @@ def start_http_server(port: int = 0,
                       debug_requests: Optional[Callable[[], dict]] = None,
                       debug_memory: Optional[Callable[[], dict]] = None,
                       debug_usage: Optional[Callable[[int], dict]] = None,
-                      profiler: Optional[Callable[[float], str]] = None
+                      profiler: Optional[Callable[[float], str]] = None,
+                      debug_timeseries=None,
+                      dashboard: Optional[Callable[[], str]] = None
                       ) -> MetricsHTTPServer:
     """Convenience wrapper: start and return a MetricsHTTPServer."""
     return MetricsHTTPServer(registry=registry, host=host, port=port,
@@ -358,7 +410,9 @@ def start_http_server(port: int = 0,
                              debug_requests=debug_requests,
                              debug_memory=debug_memory,
                              debug_usage=debug_usage,
-                             profiler=profiler)
+                             profiler=profiler,
+                             debug_timeseries=debug_timeseries,
+                             dashboard=dashboard)
 
 
 # -------------------------------------------------------- TensorBoard bridge

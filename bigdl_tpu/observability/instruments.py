@@ -328,6 +328,52 @@ def serving_engine_instruments(service: str = "engine",
             "Size of the mesh's model (tensor-parallel) axis — the "
             "way count KV heads and Megatron column/row weights are "
             "split (1 when unsharded)", labelnames=lbl).labels(service),
+        mfu_prefill=r.gauge(
+            "bigdl_serving_mfu",
+            "Model FLOPs utilization by dispatch kind: achieved "
+            "FLOP/s per device (cost-model FLOPs per dispatch x warm "
+            "dispatches / warm wall / mesh devices) over the device "
+            "kind's peak — the 'how close to the hardware ceiling' "
+            "headline the roofline classification reads",
+            labelnames=("service", "kind")).labels(service, "prefill"),
+        mfu_decode=r.gauge(
+            "bigdl_serving_mfu",
+            "Model FLOPs utilization by dispatch kind: achieved "
+            "FLOP/s per device (cost-model FLOPs per dispatch x warm "
+            "dispatches / warm wall / mesh devices) over the device "
+            "kind's peak — the 'how close to the hardware ceiling' "
+            "headline the roofline classification reads",
+            labelnames=("service", "kind")).labels(service, "decode"),
+        membw_util_prefill=r.gauge(
+            "bigdl_serving_membw_util",
+            "HBM bandwidth utilization by dispatch kind: achieved "
+            "bytes/s per device over the device kind's peak HBM "
+            "bandwidth — near 1 with low MFU is the memory-bound "
+            "signature",
+            labelnames=("service", "kind")).labels(service, "prefill"),
+        membw_util_decode=r.gauge(
+            "bigdl_serving_membw_util",
+            "HBM bandwidth utilization by dispatch kind: achieved "
+            "bytes/s per device over the device kind's peak HBM "
+            "bandwidth — near 1 with low MFU is the memory-bound "
+            "signature",
+            labelnames=("service", "kind")).labels(service, "decode"),
+        loop_idle_fraction=r.gauge(
+            "bigdl_serving_loop_device_idle_fraction",
+            "Share of accounted engine-loop wall the device sat idle "
+            "(1 - warm dispatch wall / accounted loop wall) — the "
+            "total the stats()['loop'] phase breakdown decomposes "
+            "into named host-side bubbles", labelnames=lbl
+        ).labels(service),
+        # UNBOUND family: the engine binds (service, phase) per named
+        # loop phase it times
+        loop_phase_seconds=r.counter(
+            "bigdl_serving_loop_phase_seconds_total",
+            "Cumulative engine-loop wall attributed to one named "
+            "host-side phase (sweep, admission, prefill_dispatch, "
+            "decode_dispatch, deliver, observe) — the denominator of "
+            "the stats()['loop'] fractions",
+            labelnames=("service", "phase")),
         # UNBOUND family: the engine binds (service, pool) per
         # persistent buffer set it owns
         mesh_pool_bytes_per_device=r.gauge(

@@ -1,0 +1,238 @@
+"""Bounded in-process time series + the stdlib HTML dashboard.
+
+:class:`TimeSeriesSampler` runs one daemon thread that, every
+``interval_s``, reads a set of named zero-arg sources (gauge getters,
+derived rates, anything cheap and thread-safe) and appends
+``(monotonic_ts, value)`` points into per-metric bounded rings.  The
+engine serves the rings as JSON at ``GET /debug/timeseries?metric=&n=``
+and renders them at ``GET /debug/dashboard`` via
+:func:`render_dashboard` — one self-contained HTML document with inline
+SVG sparklines, no external assets, viewable from ``curl`` output saved
+to a file on an air-gapped pod.
+
+Design points:
+
+* **Bounded**: each ring is a ``deque(maxlen=capacity)`` — a week-long
+  soak holds the same memory as a minute-long smoke test.
+* **Counter rates**: a source registered with ``rate=True`` is read as
+  a cumulative counter and stored as its per-second first difference
+  (first sample primes the baseline and stores nothing).
+* **Disabled-registry no-op**: when the associated registry is
+  disabled the sampler thread stays parked and ``sample()`` records
+  nothing, matching the zero-overhead contract of the rest of the
+  observability stack.
+* **Lifecycle**: ``start()``/``stop()`` are idempotent; the engine
+  starts the sampler with its loop thread and joins it in ``stop()``,
+  so tests can assert no leaked threads.
+"""
+
+from __future__ import annotations
+
+import html
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, Optional
+
+__all__ = ["TimeSeriesSampler", "render_dashboard"]
+
+
+class TimeSeriesSampler:
+    """Background sampler: named sources -> bounded (ts, value) rings.
+
+    ``registry`` is optional; when given and disabled, sampling is a
+    no-op.  Sources must be cheap, thread-safe, and may return ``None``
+    to skip a point (e.g. MFU before the first warm dispatch).
+    """
+
+    def __init__(self, interval_s: float = 1.0, capacity: int = 600,
+                 registry=None):
+        self.interval_s = float(interval_s)
+        self.capacity = int(capacity)
+        self._registry = registry
+        self._sources: Dict[str, tuple] = {}  # name -> (fn, rate)
+        self._rings: Dict[str, deque] = {}
+        self._last_raw: Dict[str, tuple] = {}  # rate baseline (ts, raw)
+        self._lock = threading.Lock()
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- sources -------------------------------------------------------
+    def add_source(self, name: str, fn: Callable[[], Optional[float]],
+                   rate: bool = False) -> "TimeSeriesSampler":
+        """Register ``name``; ``rate=True`` differentiates a cumulative
+        counter into per-second deltas.  Returns self for chaining."""
+        with self._lock:
+            self._sources[name] = (fn, bool(rate))
+            self._rings.setdefault(name, deque(maxlen=self.capacity))
+        return self
+
+    @property
+    def enabled(self) -> bool:
+        reg = self._registry
+        return bool(getattr(reg, "enabled", True)) if reg is not None \
+            else True
+
+    # -- sampling ------------------------------------------------------
+    def sample(self, now: Optional[float] = None) -> None:
+        """Take one pass over every source (no-op when disabled)."""
+        if not self.enabled:
+            return
+        ts = time.monotonic() if now is None else float(now)
+        with self._lock:
+            items = list(self._sources.items())
+        for name, (fn, rate) in items:
+            try:
+                raw = fn()
+            except Exception:
+                continue
+            if raw is None:
+                continue
+            raw = float(raw)
+            if rate:
+                prev = self._last_raw.get(name)
+                self._last_raw[name] = (ts, raw)
+                if prev is None:
+                    continue
+                dt = ts - prev[0]
+                if dt <= 0.0:
+                    continue
+                value = (raw - prev[1]) / dt
+            else:
+                value = raw
+            with self._lock:
+                self._rings[name].append((ts, value))
+
+    # -- lifecycle -----------------------------------------------------
+    @property
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def start(self) -> "TimeSeriesSampler":
+        if self.running:
+            return self
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="bigdl-timeseries", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop_evt.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+        self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop_evt.wait(self.interval_s):
+            self.sample()
+
+    # -- reads ---------------------------------------------------------
+    def snapshot(self, metric: Optional[str] = None,
+                 n: Optional[int] = None) -> dict:
+        """JSON-ready view: ``{"interval_s", "capacity", "metrics":
+        {name: {"points": [[ts, value], ...], "last": value}}}``.
+        ``metric`` filters to one ring; ``n`` keeps the newest n
+        points."""
+        with self._lock:
+            names = ([metric] if metric is not None
+                     else sorted(self._rings))
+            out = {}
+            for name in names:
+                ring = self._rings.get(name)
+                if ring is None:
+                    continue
+                pts = list(ring)
+                if n is not None and n >= 0:
+                    pts = pts[-n:]
+                out[name] = {
+                    "points": [[round(t, 3), v] for t, v in pts],
+                    "last": pts[-1][1] if pts else None,
+                }
+        return {"interval_s": self.interval_s, "capacity": self.capacity,
+                "metrics": out}
+
+
+def _sparkline(points, width: int = 280, height: int = 48) -> str:
+    """One inline-SVG sparkline for a [[ts, value], ...] series."""
+    vals = [p[1] for p in points if p[1] is not None]
+    if len(vals) < 2:
+        return ("<svg width='%d' height='%d'><text x='4' y='%d' "
+                "class='empty'>no data yet</text></svg>"
+                % (width, height, height // 2 + 4))
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    pad = 3
+    step = (width - 2 * pad) / (len(vals) - 1)
+    pts = " ".join(
+        "%.1f,%.1f" % (pad + i * step,
+                       height - pad - (v - lo) / span * (height - 2 * pad))
+        for i, v in enumerate(vals))
+    return ("<svg width='%d' height='%d' viewBox='0 0 %d %d'>"
+            "<polyline fill='none' stroke='#2b6cb0' stroke-width='1.5' "
+            "points='%s'/></svg>" % (width, height, width, height, pts))
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "–"
+    if isinstance(v, float):
+        if v != 0 and (abs(v) >= 1e5 or abs(v) < 1e-3):
+            return "%.3e" % v
+        return "%.4g" % v
+    return str(v)
+
+
+def render_dashboard(snapshot: dict, title: str = "engine",
+                     extra: Optional[dict] = None) -> str:
+    """Render a sampler snapshot (plus optional ``extra`` blocks like
+    alerts / cost / loop summaries) into ONE self-contained HTML page:
+    stdlib string formatting, inline CSS, inline SVG sparklines, zero
+    external assets."""
+    extra = extra or {}
+    cards = []
+    for name in sorted(snapshot.get("metrics", {})):
+        series = snapshot["metrics"][name]
+        cards.append(
+            "<div class='card'><div class='name'>%s</div>"
+            "<div class='last'>%s</div>%s</div>"
+            % (html.escape(name), _fmt(series.get("last")),
+               _sparkline(series.get("points", []))))
+    blocks = []
+    for key in sorted(extra):
+        val = extra[key]
+        if val is None:
+            continue
+        try:
+            import json as _json
+            body = html.escape(_json.dumps(val, indent=2, default=str))
+        except Exception:
+            body = html.escape(repr(val))
+        blocks.append("<details open><summary>%s</summary><pre>%s</pre>"
+                      "</details>" % (html.escape(str(key)), body))
+    return (
+        "<!doctype html><html><head><meta charset='utf-8'>"
+        "<meta http-equiv='refresh' content='5'>"
+        "<title>bigdl_tpu dashboard — %(title)s</title><style>"
+        "body{font-family:system-ui,sans-serif;margin:1.2em;"
+        "background:#fafafa;color:#222}"
+        "h1{font-size:1.2em}"
+        ".grid{display:flex;flex-wrap:wrap;gap:12px}"
+        ".card{background:#fff;border:1px solid #ddd;border-radius:6px;"
+        "padding:8px 12px}"
+        ".name{font-size:.8em;color:#555}"
+        ".last{font-size:1.3em;font-weight:600}"
+        ".empty{fill:#999;font-size:.7em}"
+        "pre{background:#fff;border:1px solid #ddd;border-radius:6px;"
+        "padding:8px;font-size:.8em;overflow-x:auto}"
+        "</style></head><body>"
+        "<h1>bigdl_tpu dashboard — %(title)s</h1>"
+        "<div class='grid'>%(cards)s</div>%(blocks)s"
+        "<p style='color:#888;font-size:.75em'>self-contained page, "
+        "auto-refreshes every 5s; raw data at "
+        "<code>/debug/timeseries</code></p>"
+        "</body></html>"
+        % {"title": html.escape(title), "cards": "".join(cards),
+           "blocks": "".join(blocks)})

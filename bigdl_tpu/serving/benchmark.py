@@ -148,6 +148,8 @@ def _engine_replay(model, workload, warm_prompt, warm_tokens,
     for key in stats_keys:
         res[key] = stats[key]
     res.update(_usage_blocks(stats))
+    res["cost"] = stats.get("cost")
+    res["loop"] = stats.get("loop")
     res["alerts"] = stats["alerts"]
     res["rows"] = rows
     return res
@@ -517,6 +519,8 @@ def run_poisson_comparison(model, n_requests: int = 16,
         stats = engine.stats()
         eng["alerts"] = stats["alerts"]
         eng.update(_usage_blocks(stats))
+        eng["cost"] = stats.get("cost")
+        eng["loop"] = stats.get("loop")
     eng["ttft"] = _percentiles(ttft)
     eng["inter_token"] = _percentiles(itl)
 

@@ -77,6 +77,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bigdl_tpu.observability.costmodel import (
+    DispatchCostModel, LoopPhaseAccumulator, device_peaks, program_cost,
+)
+from bigdl_tpu.observability.timeseries import (
+    TimeSeriesSampler, render_dashboard,
+)
 from bigdl_tpu.serving.prefix_cache import PrefixCache
 from bigdl_tpu.serving.scheduler import (
     AdmissionQueue, PrefillPolicy, SpeculationPolicy,
@@ -294,7 +300,9 @@ class ContinuousBatchingEngine:
                  spec_gamma: int = 4,
                  mesh=None,
                  tp_rules=None,
-                 model_axis: str = "model"):
+                 model_axis: str = "model",
+                 timeseries_interval_s: float = 1.0,
+                 timeseries_capacity: int = 600):
         from bigdl_tpu.models.transformer import _validate_sampling
         from bigdl_tpu.observability import serving_engine_instruments
         from bigdl_tpu.observability import memory as obs_memory
@@ -577,6 +585,47 @@ class ContinuousBatchingEngine:
             self._ins.mesh_pool_bytes_per_device.labels(
                 service_name, pool_name).set(
                     summary["bytes_per_device"], force=True)
+
+        # ---- dispatch cost model / loop-phase attribution --------------
+        # static per-kind FLOPs/bytes extracted ONCE here via
+        # jitted.lower(...).cost_analysis(): lowering only traces — no
+        # compile, no execution, donated buffers stay live — so the
+        # extraction adds zero device programs and the jit-compile
+        # gauge stays flat. When XLA reports nothing the analytic
+        # transformer formulas take over (flops_source: "analytic").
+        self._cost = DispatchCostModel(
+            device_peaks(self._cost_device()), devices=n_dev)
+        self._loop_obs = LoopPhaseAccumulator()
+        self._iter_disp = {"prefill": 0.0, "decode": 0.0}
+        self._extract_program_costs()
+        #: counter children + flushed totals for the per-phase series
+        self._loop_phase_counters = {
+            p: self._ins.loop_phase_seconds.labels(service_name, p)
+            for p in LoopPhaseAccumulator.PHASES}
+        self._loop_flushed = {p: 0.0
+                              for p in LoopPhaseAccumulator.PHASES}
+        # background gauge/rate sampler behind /debug/timeseries and
+        # /debug/dashboard; started with the loop thread, joined in
+        # stop() — bounded rings, no-op when the registry is disabled
+        self._ts = TimeSeriesSampler(
+            interval_s=timeseries_interval_s,
+            capacity=timeseries_capacity, registry=registry)
+        self._ts.add_source("mfu", lambda: self._cost.rates("decode")[0])
+        self._ts.add_source(
+            "mfu_prefill", lambda: self._cost.rates("prefill")[0])
+        self._ts.add_source("tokens_per_sec",
+                            self._ins.decode_tokens_total.get, rate=True)
+        self._ts.add_source(
+            "slot_occupancy",
+            lambda: (sum(s is not None for s in self._slots)
+                     / max(1, self.max_slots)))
+        self._ts.add_source("queue_depth", lambda: len(self._queue))
+        if self._spec is not None:
+            self._ts.add_source(
+                "acceptance_rate",
+                lambda: (self._spec_accepted / self._spec_proposed
+                         if self._spec_proposed else None))
+        self._ts.add_source("alerts", lambda: float(len(self.alerts())))
 
         # watchdogs, sampled once per loop iteration: compiles that keep
         # growing after warmup break the engine's shape-stability
@@ -913,6 +962,85 @@ class ContinuousBatchingEngine:
             return len(self._warm)
         return sum(c or 0 for c in counts)
 
+    # --------------------------------------------------- dispatch costs
+    def _cost_device(self):
+        """The device whose peak table entry prices this engine's
+        dispatches: mesh device 0 when sharded, local device 0
+        otherwise."""
+        if self.mesh is not None:
+            return self.mesh.devices.flat[0]
+        return jax.local_devices()[0]
+
+    def _extract_program_costs(self) -> None:
+        """Price every dispatch kind ONCE: sum XLA ``cost_analysis``
+        over the kind's programs (prefill = target chunk [+ draft
+        chunk]; decode = fused step, or propose + verify under
+        speculation), lowered against the live buffers — tracing only,
+        zero compiles, zero executions.  Any program the backend will
+        not price drops the whole kind to the analytic transformer
+        formulas at a representative context of half the cache."""
+        S, rows = self.max_slots, self._policy.prefill_rows
+        c = self._policy.chunk
+        zt = self._h2d(jnp.zeros((S,), jnp.int32))
+        zk = self._h2d(jax.random.PRNGKey(0))
+        t1 = self._temp_const
+        ids = self._h2d(jnp.zeros((rows, c), jnp.int32))
+        rpos = self._h2d(jnp.zeros((rows,), jnp.int32))
+        progs = {"prefill": [(self._chunk_jit,
+                              (self._params, self._buffers, ids,
+                               self._staging, rpos, rpos))]}
+        if self.draft is None:
+            progs["decode"] = [(self._step_jit,
+                                (self._params, self._buffers, zt, zt,
+                                 self._caches, zk, t1))]
+        else:
+            progs["prefill"].append(
+                (self._d_chunk_jit,
+                 (self._d_params, self._d_bufs, ids, self._d_staging,
+                  rpos, rpos)))
+            try:
+                props_sd, qlog_sd, _ = jax.eval_shape(
+                    self._propose_jit, self._d_params, self._d_bufs,
+                    zt, zt, self._d_caches, zk, t1)
+            except Exception:
+                props_sd = qlog_sd = None
+            progs["decode"] = [
+                (self._propose_jit,
+                 (self._d_params, self._d_bufs, zt, zt, self._d_caches,
+                  zk, t1))]
+            if props_sd is not None:
+                progs["decode"].append(
+                    (self._spec_verify_jit,
+                     (self._params, self._buffers, zt, props_sd,
+                      qlog_sd, zt, self._caches, zk, t1)))
+        ctx = self._phys_len // 2
+        g = self._spec.gamma if self._spec is not None else 0
+        analytic = {
+            "prefill": (rows * c, ctx),
+            "decode": (S * (g + 1) if g else S, ctx),
+        }
+        cache_itemsize = int(jax.tree.leaves(self._caches)[0]
+                             .dtype.itemsize)
+        for kind, entries in progs.items():
+            costs = [program_cost(fn, *args) for fn, args in entries]
+            if all(cst is not None for cst in costs):
+                self._cost.set_program_cost(
+                    kind, sum(cst["flops"] for cst in costs),
+                    sum(cst["bytes"] for cst in costs), "xla")
+                continue
+            tokens, c_ctx = analytic[kind]
+            flops = self.model.analytic_flops(tokens, c_ctx)
+            byts = self.model.analytic_bytes(tokens, c_ctx,
+                                             cache_itemsize)
+            if self.draft is not None:
+                # the draft's share of the kind: its own chunk during
+                # prefill, gamma propose steps during decode
+                d_tok = rows * c if kind == "prefill" else S * g
+                flops += self.draft.analytic_flops(d_tok, c_ctx)
+                byts += self.draft.analytic_bytes(d_tok, c_ctx,
+                                                  cache_itemsize)
+            self._cost.set_program_cost(kind, flops, byts, "analytic")
+
     # ------------------------------------------------------- lifecycle
     def start(self) -> "ContinuousBatchingEngine":
         """Start the loop thread (idempotent; ``submit`` auto-starts)."""
@@ -926,6 +1054,7 @@ class ContinuousBatchingEngine:
                 self._thread = threading.Thread(
                     target=self._loop, name="serving-engine", daemon=True)
                 self._thread.start()
+            self._ts.start()
         return self
 
     def stop(self, drain: bool = True,
@@ -944,6 +1073,7 @@ class ContinuousBatchingEngine:
                     break
                 time.sleep(0.002)
         self._stop_evt.set()
+        self._ts.stop()
         with self._wake:
             self._wake.notify_all()
         if self._thread is not None:
@@ -1105,7 +1235,12 @@ class ContinuousBatchingEngine:
         to this engine); ``usage`` adds the ledger's per-tenant
         attribution table and the engine goodput block (device
         seconds by kind, occupancy-weighted utilization, padding
-        waste, tokens per device-second)."""
+        waste, tokens per device-second); ``cost`` adds the dispatch
+        cost model's per-kind FLOPs/bytes, achieved FLOP/s and
+        bytes/s, MFU/bandwidth-utilization fractions, and the
+        compute-vs-memory-bound roofline class; ``loop`` adds the
+        loop-phase breakdown attributing the device-idle fraction to
+        named host-side bubbles."""
         out = {k: int(self._counter(k).get() - base)
                for k, base in self._stats_base.items()}
         out["active_slots"] = sum(s is not None for s in self._slots)
@@ -1116,6 +1251,8 @@ class ContinuousBatchingEngine:
         out["speculation"] = self._spec_summary()
         out["mesh"] = self._mesh_summary()
         out["usage"] = self._usage.summary()
+        out["cost"] = self._cost.summary()
+        out["loop"] = self._loop_obs.summary()
         out["alerts"] = self.alerts()
         return out
 
@@ -1267,6 +1404,28 @@ class ContinuousBatchingEngine:
         return {"service": self.service_name,
                 **self._usage.summary(top_n=top_n)}
 
+    def debug_timeseries(self, metric: Optional[str] = None,
+                         n: Optional[int] = None) -> dict:
+        """The ``GET /debug/timeseries?metric=&n=`` payload: the
+        background sampler's bounded rings (MFU, tokens/s, slot
+        occupancy, queue depth, acceptance rate, alert count) as
+        ``{metric: {points: [[monotonic_ts, value], ...], last}}``.
+        Snapshot semantics — safe from HTTP threads."""
+        return {"service": self.service_name,
+                "running": self._ts.running,
+                **self._ts.snapshot(metric=metric, n=n)}
+
+    def dashboard(self) -> str:
+        """The ``GET /debug/dashboard`` page: one self-contained HTML
+        document (inline CSS + SVG sparklines, zero external assets)
+        over the sampler rings, plus the live cost/roofline, loop
+        bubble, and alert blocks."""
+        return render_dashboard(
+            self._ts.snapshot(), title=self.service_name,
+            extra={"alerts": self.alerts() or None,
+                   "cost": self._cost.summary(),
+                   "loop": self._loop_obs.summary()})
+
     # ------------------------------------------------------- loop body
     def _loop(self):
         from bigdl_tpu.observability import trace
@@ -1352,6 +1511,13 @@ class ContinuousBatchingEngine:
     def _iterate(self) -> bool:
         now = time.monotonic()
         worked = False
+        lo = self._loop_obs
+        # per-iteration dispatch scratch: _prefill_round /
+        # _decode_all* accumulate their dispatch walls here so the
+        # boundary-measured host segments below can subtract them out
+        # — phase seconds then sum to the iteration wall by
+        # construction
+        self._iter_disp = {"prefill": 0.0, "decode": 0.0}
 
         # 1. running slots: cancellation + deadline eviction
         for sid, st in enumerate(self._slots):
@@ -1383,6 +1549,8 @@ class ContinuousBatchingEngine:
         # 2. queued requests: mid-queue deadline/cancel sweep
         for h, err in self._queue.sweep(now):
             self._finish_dropped(h, err)
+        t_sweep = time.monotonic()
+        lo.add("sweep", t_sweep - now)
 
         # 3. admission: prefix-aware intake + batched chunked-prefill
         #    rounds under this iteration's budget — every round
@@ -1395,6 +1563,11 @@ class ContinuousBatchingEngine:
                 break
             self._prefill_round()
             worked = True
+        t_adm = time.monotonic()
+        # the prefill dispatch walls were phase-attributed inside
+        # _prefill_round; the segment's remainder is host admission work
+        lo.add("admission",
+               max(0.0, t_adm - t_sweep - self._iter_disp["prefill"]))
 
         # 4. one fused decode step over every occupied slot
         active = [sid for sid, st in enumerate(self._slots)
@@ -1402,6 +1575,11 @@ class ContinuousBatchingEngine:
         if active:
             self._decode_all(active)
             worked = True
+        t_dec = time.monotonic()
+        # decode-segment remainder = sampling transfers + stream
+        # delivery around the dispatch ("deliver" bubble)
+        lo.add("deliver",
+               max(0.0, t_dec - t_adm - self._iter_disp["decode"]))
 
         # 5. load gauges + watchdog sampling (one probe read and one
         #    histogram snapshot per objective — iteration-rate cheap)
@@ -1411,6 +1589,25 @@ class ContinuousBatchingEngine:
         ins.jit_compiles.set(self._compile_total())
         self._recompile_wd.sample()
         self._slo_wd.sample()
+        mfu_d, bw_d = self._cost.rates("decode")
+        if mfu_d is not None:
+            ins.mfu_decode.set(mfu_d)
+        if bw_d is not None:
+            ins.membw_util_decode.set(bw_d)
+        mfu_p, bw_p = self._cost.rates("prefill")
+        if mfu_p is not None:
+            ins.mfu_prefill.set(mfu_p)
+        if bw_p is not None:
+            ins.membw_util_prefill.set(bw_p)
+        lo.iteration()
+        lo.add("observe", time.monotonic() - t_dec)
+        snap = lo.summary()
+        for p, child in self._loop_phase_counters.items():
+            delta = snap["phases"][p] - self._loop_flushed[p]
+            if delta > 0.0:
+                child.inc(delta)
+                self._loop_flushed[p] += delta
+        ins.loop_idle_fraction.set(snap["device_idle_fraction"])
         return worked
 
     # ------------------------------------------------ admission stages
@@ -1605,6 +1802,12 @@ class ContinuousBatchingEngine:
                 logits, self._next_key(), self._temp()))
             self._warm.add("sample0")
         wall = time.monotonic() - t_disp
+        # the same warm-only wall feeds the usage ledger, the cost
+        # model, and the loop-phase busy pool — one measurement, three
+        # views, so roofline/idle/goodput figures reconcile exactly
+        self._iter_disp["prefill"] += wall
+        self._loop_obs.dispatch("prefill_dispatch", wall, warm=was_warm)
+        self._cost.charge("prefill", wall, warm=was_warm)
         # pro-rata attribution by REAL tokens each row advanced (the
         # padded tail of a final chunk is engine overhead, not billable
         # work; a replayed chunk advances nothing and earns nothing;
@@ -1742,6 +1945,12 @@ class ContinuousBatchingEngine:
         self._warm.add("step")
         nxt_np = np.asarray(nxt)   # blocks on the fused step
         now = time.monotonic()
+        # same warm-only wall to ledger, cost model, and loop busy —
+        # one measurement, three reconciling views
+        self._iter_disp["decode"] += now - t_disp
+        self._loop_obs.dispatch("decode_dispatch", now - t_disp,
+                                warm=was_warm)
+        self._cost.charge("decode", now - t_disp, warm=was_warm)
         # every advanced row got exactly one token: the step's wall
         # splits evenly across them — identical to weighting by
         # delivered tokens, the speculative path's rule (idle slots
@@ -1794,6 +2003,11 @@ class ContinuousBatchingEngine:
         wall = time.monotonic() - t_disp
         self._warm.update(("spec:propose", "spec:verify"))
         now = time.monotonic()
+        # same warm-only wall to ledger, cost model, and loop busy —
+        # one measurement, three reconciling views
+        self._iter_disp["decode"] += wall
+        self._loop_obs.dispatch("decode_dispatch", wall, warm=was_warm)
+        self._cost.charge("decode", wall, warm=was_warm)
         # draft sync BEFORE the next round can propose: a
         # FULL-acceptance row is missing exactly one draft KV write
         # (the propose scan never fed its gamma-th proposal through

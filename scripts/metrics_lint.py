@@ -12,12 +12,16 @@ exit 1):
    (``.counter("bigdl_...")`` / ``.gauge(...)`` / ``.histogram(...)``)
    OUTSIDE that module — the fix is always to add an
    ``*_instruments`` entry and call it.
-2. DOC DRIFT: every name registered IN that module must appear in the
-   instrument table of ``docs/programming-guide/observability.md`` —
-   an operator reading the docs sees every series a scrape can emit.
-   The table may spell names exactly, expand one ``{a,b,c}``
-   alternation, or end in ``*`` for a family prefix
-   (``bigdl_bench_*``).
+2. DOC DRIFT (both directions): every name registered IN that module
+   must appear in the instrument table of
+   ``docs/programming-guide/observability.md`` — an operator reading
+   the docs sees every series a scrape can emit — and every name the
+   table documents must still be registered there, so a renamed or
+   deleted instrument cannot leave a ghost row promising a series no
+   scrape will ever emit. The table may spell names exactly, expand
+   one ``{a,b,c}`` alternation, or end in ``*`` for a family prefix
+   (``bigdl_bench_*``); a wildcard row is satisfied by any registered
+   name under its prefix.
 
 Scopes deliberately skipped by the registration check: ``tests/``
 (tests mint throwaway names against throwaway registries), ``docs/``
@@ -135,6 +139,21 @@ def doc_drift(root: str):
     return [n for n in registered_names(root) if not covered(n)]
 
 
+def reverse_drift(root: str):
+    """Yield documented table names/patterns with no registered
+    counterpart: an exact (or ``{a,b,c}``-expanded) name must be
+    registered verbatim; a ``prefix*`` wildcard row needs at least one
+    registered name under its prefix."""
+    names = set(registered_names(root))
+
+    def alive(pat):
+        if pat.endswith("*"):
+            return any(n.startswith(pat[:-1]) for n in names)
+        return pat in names
+
+    return sorted(p for p in documented_patterns(root) if not alive(p))
+
+
 def main(argv=None) -> int:
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     p = argparse.ArgumentParser(
@@ -154,14 +173,20 @@ def main(argv=None) -> int:
         print(f"[metrics-lint] {'/'.join(ALLOWED)}: {name!r} is "
               f"registered but missing from the instrument table in "
               f"{'/'.join(DOCS_GUIDE)} (add a table row)")
-    if violations or undocumented:
+    ghosts = reverse_drift(args.root)
+    for name in ghosts:
+        print(f"[metrics-lint] {'/'.join(DOCS_GUIDE)}: {name!r} is "
+              f"documented in the instrument table but no longer "
+              f"registered in {'/'.join(ALLOWED)} (drop the row or "
+              f"restore the instrument)")
+    if violations or undocumented or ghosts:
         print(f"[metrics-lint] FAIL: {len(violations)} out-of-place "
               f"registration(s), {len(undocumented)} undocumented "
-              "instrument(s)")
+              f"instrument(s), {len(ghosts)} ghost doc row(s)")
         return 1
     print("[metrics-lint] ok: all bigdl_* metrics registered in "
           + "/".join(ALLOWED) + " and documented in "
-          + "/".join(DOCS_GUIDE))
+          + "/".join(DOCS_GUIDE) + " (both directions)")
     return 0
 
 

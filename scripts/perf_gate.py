@@ -12,8 +12,11 @@ usage ledger — higher is better, so the regression direction flips).
 Anything that prevents a comparison — no history, a single row,
 unparsable lines, rows without the measurement — exits 0 with an
 explanation: the gate blocks measured regressions, it never blocks the
-first run of a new workload, and rows predating a field (inter-token,
-goodput) gate on what both rows actually measured.
+first run of a new workload, rows predating a field (inter-token,
+goodput) gate on what both rows actually measured, and a row whose
+only workload-matching history ran on a different device kind is
+skipped with a printed notice — a CPU-fallback round never gates
+against a TPU baseline (or vice versa).
 
 Serving rows come from ``bench.py --serving`` (percentiles under
 ``detail.engine.{ttft,inter_token}.p99``), ``bench.py --serving
@@ -142,6 +145,18 @@ def main(argv=None) -> int:
     prev = next((r for r in reversed(serving[:-1])
                  if signature(r) == sig), None)
     if prev is None:
+        # a workload match on DIFFERENT hardware is not a regression
+        # baseline — say so explicitly (a CPU-fallback round after a TPU
+        # round would otherwise read as a mystery "first run")
+        cross = next((r for r in reversed(serving[:-1])
+                      if (signature(r)[0], signature(r)[2])
+                      == (sig[0], sig[2])), None)
+        if cross is not None:
+            print(f"[perf-gate] skip: newest {newest.get('metric')} row "
+                  f"ran on {sig[1]!r} but the only comparable history is "
+                  f"from {signature(cross)[1]!r} — cross-device_kind "
+                  "comparison refused; gate passes")
+            return 0
         print(f"[perf-gate] no earlier row comparable to "
               f"{newest.get('metric')} (signature {sig}); first run "
               "passes")

@@ -415,6 +415,7 @@ def test_metrics_lint_doc_drift_check(tmp_path, capsys):
     ins.mkdir(parents=True)
     (ins / "instruments.py").write_text(
         'r.counter("bigdl_serving_tenant_requests_total", "x")\n'
+        'r.counter("bigdl_serving_tenant_decode_tokens_total", "x")\n'
         'r.gauge("bigdl_widget_spin_rate", "x")\n'
         'r.gauge("bigdl_bench_extra_thing", "x")\n')
     docs = tmp_path / "docs" / "programming-guide"
@@ -435,9 +436,27 @@ def test_metrics_lint_doc_drift_check(tmp_path, capsys):
     doc.write_text(doc.read_text()
                    + "| `bigdl_widget_spin_rate` | gauge |\n")
     assert lint.main(["--root", str(tmp_path)]) == 0
-    # the real tree is clean (the tier-1 wiring in
+    # REVERSE direction: a table row whose instrument was deleted (or
+    # renamed) is a ghost — it promises a series no scrape will emit
+    doc.write_text(doc.read_text()
+                   + "| `bigdl_deleted_thing_total` | counter |\n"
+                   + "| `bigdl_ghost_family_*` | gauge |\n")
+    assert lint.main(["--root", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "bigdl_deleted_thing_total" in out
+    assert "bigdl_ghost_family_*" in out
+    assert "ghost doc row" in out
+    # restoring the instruments clears it — wildcard rows are satisfied
+    # by ANY registered name under the prefix
+    (ins / "instruments.py").write_text(
+        (ins / "instruments.py").read_text()
+        + 'r.counter("bigdl_deleted_thing_total", "x")\n'
+        + 'r.gauge("bigdl_ghost_family_width", "x")\n')
+    assert lint.main(["--root", str(tmp_path)]) == 0
+    # the real tree is clean BOTH directions (the tier-1 wiring in
     # test_resource_observability runs the registration check; this
-    # pins the drift side against HEAD's docs)
-    assert lint.doc_drift(lint.os.path.dirname(
-        lint.os.path.dirname(lint.os.path.abspath(
-            lint.__file__)))) == []
+    # pins the drift sides against HEAD's docs)
+    repo = lint.os.path.dirname(lint.os.path.dirname(
+        lint.os.path.abspath(lint.__file__)))
+    assert lint.doc_drift(repo) == []
+    assert lint.reverse_drift(repo) == []
