@@ -260,6 +260,15 @@ def bench_main(argv=None):
     p.add_argument("--templates", type=int, default=4,
                    help="--shared-prefix: number of shared prompt "
                         "templates")
+    p.add_argument("--working-set", type=int, default=0, metavar="N",
+                   help="with --serving --shared-prefix: sweep the "
+                        "shared-template working set up to N templates "
+                        "round-robin against a 2-row device pool, host "
+                        "tier sized to the working set vs device-only "
+                        "vs cache-disabled — emits the hit-rate-cliff "
+                        "A/B (per-point hit rate + TTFT, token parity, "
+                        "jit-flat and ledger-conservation flags) into "
+                        "bench_history.jsonl")
     p.add_argument("--speculative", action="store_true",
                    help="with --serving: repeated-text workload "
                         "replayed with an int8-draft speculative "
@@ -501,6 +510,18 @@ def _serving_bench(args, dev):
     `scripts/perf_gate.py` gates CI on the p99 TTFT of consecutive
     comparable rows.
 
+    `--serving --shared-prefix --working-set N`: the tiered-cache
+    sweep — round-robin template workloads at working sets from inside
+    to N-templates past a 2-row device pool, each replayed through a
+    host-tier engine (host rows = working set), a device-only engine,
+    and a cache-disabled oracle. value is the headline tiered hit rate
+    at the deepest point, vs_baseline the tiered/device-only hit-rate
+    gain there (the device-only leg LRU-thrashes once the working set
+    exceeds its rows; the bar is >=2x at >=4x the budget), and detail
+    carries the per-point sweep plus token-parity / jit-flat /
+    ledger-conservation flags. perf_gate gates the headline hit rate
+    (higher-is-better) and the tiered leg's p50/p99 TTFT.
+
     `--serving --speculative`: the speculative A/B — one repeated-text
     Poisson workload replayed through the engine with an int8-clone
     draft (gamma proposals per fused round) vs the plain engine.
@@ -523,6 +544,7 @@ def _serving_bench(args, dev):
     from bigdl_tpu.serving.benchmark import (
         run_poisson_comparison, run_shared_prefix_comparison,
         run_speculative_comparison, run_tp_comparison,
+        run_working_set_sweep,
     )
     from bigdl_tpu.utils import random as rnd
     from bigdl_tpu.version import __version__
@@ -575,6 +597,28 @@ def _serving_bench(args, dev):
             },
         }
         _record_speculative_metrics(res)
+    elif args.shared_prefix and args.working_set:
+        res = run_working_set_sweep(
+            model, working_sets=(2, max(4, args.working_set)),
+            device_rows=2, rate_hz=args.rate, max_slots=4,
+            prefill_chunk=8, prefill_rows=2, template_len=16, log=log)
+        result = {
+            "metric": "serving_tiered_prefix_hit_rate",
+            "value": res["headline"]["tiered_hit_rate"],
+            "unit": "fraction",
+            # vs_baseline > 1.0: the host tier holds the hit rate the
+            # device-only cache loses past its budget (the acceptance
+            # bar is >=2x at a working set >=4x the device pool)
+            "vs_baseline": res["headline"]["hit_rate_gain"],
+            "detail": {
+                "version": __version__,
+                "device": str(getattr(dev, "device_kind", dev.platform)),
+                **_row_stamps(dev),
+                **_cost_fields(res["tiered"]),
+                **res,
+            },
+        }
+        _record_working_set_metrics(res)
     elif args.shared_prefix:
         res = run_shared_prefix_comparison(
             model, n_requests=args.requests, rate_hz=args.rate,
@@ -706,6 +750,27 @@ def _record_shared_prefix_metrics(res):
             ins.prefix_reused_fraction().set(pc["reused_fraction"])
     except Exception as e:
         print(f"[bench] shared-prefix metrics registry update failed: "
+              f"{e}", file=sys.stderr)
+
+
+def _record_working_set_metrics(res):
+    """Mirror the working-set sweep's HEADLINE point into the
+    observability registry (``path`` label: tiered / device_only) so
+    live scrapes and bench snapshots share one schema. Never lets
+    telemetry break the bench."""
+    try:
+        from bigdl_tpu import observability as obs
+
+        ins = obs.serving_bench_instruments()
+        for path in ("tiered", "device_only"):
+            _record_path_metrics(ins, res[path], path)
+        head = res.get("headline") or {}
+        if head.get("tiered_hit_rate") is not None:
+            ins.tiered_hit_rate().set(head["tiered_hit_rate"])
+        if head.get("hit_rate_gain") is not None:
+            ins.tiered_hit_rate_gain().set(head["hit_rate_gain"])
+    except Exception as e:
+        print(f"[bench] working-set metrics registry update failed: "
               f"{e}", file=sys.stderr)
 
 

@@ -15,7 +15,8 @@ POINT is the serving machinery, not the prose):
      to engine liveness (503 once the decode loop dies; a watchdog
      alert degrades the body while staying 200), /debug/requests TTFT
      breakdowns, /debug/trace Chrome trace, /debug/memory per-pool
-     HBM attribution (KV slots / staging / prefix pool / params),
+     HBM attribution (KV slots / staging / tiered prefix pool —
+     device rows AND host-RAM spill — / params),
      per-tenant usage accounting (requests submitted under tenant
      names; the /debug/usage table — tokens, device-seconds, KV
      byte-seconds, goodput — round-tripped over HTTP), on-demand
@@ -188,6 +189,11 @@ def main(argv=None):
                 "overridden before startup?)")
         engine_kw["mesh"] = MeshEngine.create_mesh(
             [("model", args.tp)], devices=devs[:args.tp])
+    # tiered prefix cache: a tiny device pool forces LRU eviction to
+    # DEMOTE rows into pinned host RAM instead of dropping them; a
+    # revisit of a demoted prefix promotes it back asynchronously
+    engine_kw.setdefault("prefix_cache_rows", 2)
+    engine_kw.setdefault("prefix_host_rows", 8)
     with ContinuousBatchingEngine(model, max_slots=2, prefill_chunk=8,
                                   eos_id=0, **engine_kw) as engine, \
             obs.start_http_server(host="127.0.0.1",
@@ -252,6 +258,16 @@ def main(argv=None):
               f"engine pools (KB): "
               + ", ".join(f"{k}={v // 1024}"
                           for k, v in sorted(eng_pools.items())))
+        # the tiered prefix cache shows up as TWO pools: device rows
+        # in prefix_kv_in_use, demoted rows in prefix_host_kv
+        pc = engine.stats()["prefix_cache"]
+        print(f"[prefix]    device tier "
+              f"{eng_pools.get('prefix_kv_in_use', 0) // 1024} KB "
+              f"({pc['entries']} rows), host tier "
+              f"{eng_pools.get('prefix_host_kv', 0) // 1024} KB "
+              f"({pc['host_entries']} rows); hits "
+              f"{pc['hits']} ({pc['host_hits']} from host), "
+              f"demoted {pc['demotions']}, promoted {pc['promotions']}")
 
         # who consumed the device: the per-tenant usage table, the
         # goodput block, and the top requests by device-seconds —

@@ -258,6 +258,37 @@ def serving_engine_instruments(service: str = "engine",
             "bigdl_serving_prefix_cache_entries",
             "Prefix-cache entries currently retained", labelnames=lbl
         ).labels(service),
+        prefix_host_hits_total=r.counter(
+            "bigdl_serving_prefix_host_hits_total",
+            "Prefix-cache hits served from the host tier (row demoted "
+            "to host RAM, promoted back to the device pool before "
+            "admission) — the hits the device budget alone would have "
+            "missed", labelnames=lbl).labels(service),
+        prefix_host_demoted_total=r.counter(
+            "bigdl_serving_prefix_host_demoted_total",
+            "Device-pool LRU victims demoted into pinned host buffers "
+            "(one bulk d2h copy per row) instead of dropped",
+            labelnames=lbl).labels(service),
+        prefix_host_promoted_total=r.counter(
+            "bigdl_serving_prefix_host_promoted_total",
+            "Host-tier rows copied back into the device pool on a "
+            "trie hit (async device_put overlapped with the request's "
+            "queue wait)", labelnames=lbl).labels(service),
+        prefix_host_evicted_total=r.counter(
+            "bigdl_serving_prefix_host_evicted_total",
+            "Host-tier entries evicted (LRU among unpinned) to make "
+            "room under the host byte budget — only here does a "
+            "prefix truly leave the cache", labelnames=lbl
+        ).labels(service),
+        prefix_host_cache_bytes=r.gauge(
+            "bigdl_serving_prefix_host_cache_bytes",
+            "Host RAM bytes of KV currently retained by the prefix "
+            "cache's host tier (demoted rows x per-row footprint)",
+            labelnames=lbl).labels(service),
+        prefix_host_cache_entries=r.gauge(
+            "bigdl_serving_prefix_host_cache_entries",
+            "Prefix-cache entries currently resident in the host tier",
+            labelnames=lbl).labels(service),
         spec_proposed_tokens_total=r.counter(
             "bigdl_serving_spec_proposed_tokens_total",
             "Draft tokens proposed by the speculative decode loop "
@@ -603,6 +634,16 @@ def serving_bench_instruments(registry: Optional[MetricRegistry] = None
             "bigdl_bench_serving_prefix_reused_fraction",
             "Fraction of prompt tokens served from the prefix cache "
             "instead of prefilled"),
+        tiered_hit_rate=lambda: r.gauge(
+            "bigdl_bench_serving_tiered_hit_rate",
+            "Tiered (host-spill) prefix-cache hit rate at the "
+            "working-set sweep's headline point — the deepest working "
+            "set past the device budget"),
+        tiered_hit_rate_gain=lambda: r.gauge(
+            "bigdl_bench_serving_tiered_hit_rate_gain",
+            "Headline tiered hit rate over the device-only hit rate "
+            "at the same working set (>1.0: the host tier holds what "
+            "LRU thrash loses; the acceptance bar is >=2x)"),
         spec_acceptance_rate=lambda: r.gauge(
             "bigdl_bench_serving_spec_acceptance_rate",
             "Draft-token acceptance rate over the speculative bench "

@@ -111,6 +111,32 @@ def kv_pool_spec(model_axis: str = "model") -> P:
     return P(None, model_axis, None, None)
 
 
+def fetch_to_host(tree):
+    """One bulk device->host move of a buffer tree: a single blocking
+    ``device_get`` per leaf, no per-chunk round trips ("RPC Considered
+    Harmful": serialize once, move once). For a mesh-sharded leaf each
+    device ships ONLY its own shard — per-link transfer bytes scale
+    down with the mesh — and the shards reassemble into one contiguous
+    host ndarray, so the host copy is layout-free and can later be
+    ``put_from_host`` under ANY sharding. Used by the serving engine
+    to demote prefix-KV rows into the host tier."""
+    import numpy as np
+
+    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+
+
+def put_from_host(tree, sharding=None):
+    """The reverse move: one async ``device_put`` per leaf, started
+    immediately and overlapped with whatever the caller does next
+    (the engine starts it while the request still waits in the
+    admission queue). With ``sharding`` (e.g. the KV pool's heads-
+    sharded NamedSharding) each device receives ONLY its shard slice.
+    Returns the (possibly still in-flight) device tree."""
+    if sharding is None:
+        return jax.tree.map(jax.device_put, tree)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
+
+
 def kv_pool_sharding(mesh, num_kv_heads: int,
                      model_axis: str = "model") -> NamedSharding:
     """NamedSharding for ``TransformerLM.init_cache`` pool buffers,

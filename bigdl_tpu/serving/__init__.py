@@ -75,7 +75,7 @@ from bigdl_tpu.serving.streams import (
 from bigdl_tpu.serving.benchmark import (
     poisson_workload, repeated_text_workload, run_poisson_comparison,
     run_shared_prefix_comparison, run_speculative_comparison,
-    run_tp_comparison, shared_prefix_workload,
+    run_tp_comparison, run_working_set_sweep, shared_prefix_workload,
 )
 
 __all__ = [
@@ -87,5 +87,5 @@ __all__ = [
     "poisson_workload", "run_poisson_comparison",
     "shared_prefix_workload", "run_shared_prefix_comparison",
     "repeated_text_workload", "run_speculative_comparison",
-    "run_tp_comparison",
+    "run_tp_comparison", "run_working_set_sweep",
 ]

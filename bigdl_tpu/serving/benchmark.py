@@ -107,7 +107,8 @@ def _usage_blocks(stats: dict) -> dict:
 
 
 def _engine_replay(model, workload, warm_prompt, warm_tokens,
-                   stats_keys, log, label, **engine_kw) -> dict:
+                   stats_keys, log, label, after_warm=None,
+                   **engine_kw) -> dict:
     """One ENGINE leg of an A/B comparison (the speculative,
     shared-prefix, and tensor-parallel variants all replay the same
     way): build the engine, warm every executable outside the
@@ -116,7 +117,10 @@ def _engine_replay(model, workload, warm_prompt, warm_tokens,
     delivered-token throughput, the usage/goodput blocks, alerts, the
     per-request output rows (keyed by ``id(req)``, for the caller's
     token-parity check), plus the ``engine.stats()`` entries named by
-    ``stats_keys``."""
+    ``stats_keys``. ``after_warm(engine)`` runs between the warm
+    request and the replay — a probe point for baselines that must
+    exclude warmup (e.g. the jit-compile gauge the tiered-cache sweep
+    asserts flat across demote/promote traffic)."""
     from bigdl_tpu.serving import ContinuousBatchingEngine
 
     engine = ContinuousBatchingEngine(model, **engine_kw)
@@ -137,6 +141,8 @@ def _engine_replay(model, workload, warm_prompt, warm_tokens,
     log(f"[serving-bench] {label} replay ({engine.service_name})...")
     with engine:
         engine.submit(warm_prompt, warm_tokens).result(timeout=300)
+        if after_warm is not None:
+            after_warm(engine)
         res = _replay(
             workload,
             lambda req: engine.submit(req["prompt"], req["n"],
@@ -200,19 +206,29 @@ def _replay(workload, submit_fn, collect_fn) -> dict:
 def shared_prefix_workload(n_requests: int, rate_hz: float, vocab: int,
                            n_templates: int = 4, template_len: int = 96,
                            tail_lens=(4, 12), decode_lens=(4, 16),
-                           seed: int = 0) -> List[dict]:
+                           seed: int = 0,
+                           template_order: str = "random") -> List[dict]:
     """Sample a PREFIX-HEAVY open-loop workload: every prompt is one of
     ``n_templates`` shared heads (a system prompt / few-shot template)
     followed by a short random tail — the traffic shape the engine's
     prefix cache exists for. Same arrival/replay semantics as
-    :func:`poisson_workload`."""
+    :func:`poisson_workload`. ``template_order="cycle"`` visits the
+    templates round-robin instead of uniformly at random — the LRU
+    worst case (every revisit is exactly ``n_templates`` requests
+    away), which the working-set sweep uses to expose the device-only
+    hit-rate cliff."""
+    if template_order not in ("random", "cycle"):
+        raise ValueError(
+            f"template_order must be 'random' or 'cycle', "
+            f"got {template_order!r}")
     r = np.random.RandomState(seed)
     templates = [r.randint(0, vocab, (template_len,)).astype(np.int32)
                  for _ in range(n_templates)]
     at = np.cumsum(r.exponential(1.0 / rate_hz, n_requests))
     out = []
     for i in range(n_requests):
-        ti = int(r.randint(0, n_templates))
+        ti = (i % n_templates if template_order == "cycle"
+              else int(r.randint(0, n_templates)))
         tail = r.randint(0, vocab, (int(r.randint(
             tail_lens[0], tail_lens[1] + 1)),)).astype(np.int32)
         out.append({
@@ -398,6 +414,178 @@ def run_shared_prefix_comparison(model, n_requests: int = 24,
                          "prefill_rows": prefill_rows,
                          "n_templates": n_templates,
                          "template_len": template_len}}
+
+
+def run_working_set_sweep(model, working_sets=(2, 8),
+                          device_rows: int = 2,
+                          requests_per_template: int = 3,
+                          rate_hz: float = 40.0, max_slots: int = 4,
+                          prefill_chunk: int = 8,
+                          prefill_rows: int = 2,
+                          template_len: int = 16,
+                          eos_id: Optional[int] = None, seed: int = 0,
+                          registry=None, log=None) -> dict:
+    """Sweep the shared-prefix WORKING SET past the device budget and
+    measure where each cache tier's hit rate falls off. Each point
+    replays one round-robin template workload (``working_set``
+    templates ≫ ``device_rows`` pool rows is the LRU worst case: every
+    revisit is exactly ``working_set`` requests away) through THREE
+    engines — host tier sized to the working set, device-only, and
+    cache-disabled — everything else identical. The device-only leg
+    collapses once the working set exceeds ``device_rows`` (LRU
+    thrashes: a template is always evicted before its revisit); the
+    tiered leg holds the hit rate because evictions demote to host RAM
+    and revisits promote back. Per point the sweep also checks the
+    invariants the tiers must not bend: token parity of both cached
+    legs against the cache-disabled oracle, the jit-compile gauge flat
+    from warmup through every demote/promote, and usage-ledger
+    device-seconds conservation (per-tenant sums == measured dispatch
+    total) with promotions in flight."""
+    log = log or (lambda *a, **k: None)
+    vocab = model.vocab_size
+    window = (model.max_len // prefill_chunk) * prefill_chunk
+    room = window - template_len
+    if room < 2:
+        raise ValueError(
+            f"template_len {template_len} leaves only {room} of the "
+            f"engine's {window}-token serving window for tail + decode")
+    tail_hi = max(1, min(4, room // 2))
+    decode_hi = max(1, min(8, room - tail_hi))
+    warm_prompt = np.asarray(
+        np.random.RandomState(seed + 1).randint(
+            0, vocab, (template_len,)), np.int32)
+
+    def leg(name, wl, probe, **engine_kw):
+        res = _engine_replay(
+            model, wl, warm_prompt, 2,
+            ("prefix_cache", "jit_compiles"), log, name,
+            after_warm=probe, max_slots=max_slots,
+            prefill_chunk=prefill_chunk, prefill_rows=prefill_rows,
+            eos_id=eos_id, registry=registry, service_name=name,
+            **engine_kw)
+        tenant_s = sum(t["device_s"] for t in res["tenants"].values())
+        total_s = res["goodput"]["device_seconds"]["total"]
+        res["ledger_conserved"] = bool(
+            abs(tenant_s - total_s) <= 1e-6 * max(total_s, 1e-9))
+        return res
+
+    points = []
+    for ws in working_sets:
+        n_req = max(int(ws) * max(2, requests_per_template), 8)
+        wl = shared_prefix_workload(
+            n_req, rate_hz, vocab, n_templates=int(ws),
+            template_len=template_len,
+            tail_lens=(min(2, tail_hi), tail_hi),
+            decode_lens=(min(4, decode_hi), decode_hi),
+            seed=seed + int(ws), template_order="cycle")
+        baseline = {}
+
+        def probe(eng, _b=baseline):
+            _b["jit"] = eng.stats()["jit_compiles"]
+
+        legs = {}
+        # the host tier absorbs the DONATION working set: every request
+        # donates its own template+tail entry (the trie matches revisits
+        # against any same-template predecessor's head), so the hot set
+        # is the request count, not the template count
+        for name, kw in (
+                ("tiered", {"prefix_cache_rows": device_rows,
+                            "prefix_host_rows": n_req}),
+                ("device_only", {"prefix_cache_rows": device_rows}),
+                ("disabled", {"prefix_cache_bytes": 0})):
+            baseline.clear()
+            r = leg(f"ws{ws}_{name}", wl, probe, **kw)
+            r["jit_flat"] = bool(r["jit_compiles"] == baseline["jit"])
+            legs[name] = r
+        parity = all(
+            np.array_equal(legs[a]["rows"][id(req)],
+                           legs["disabled"]["rows"][id(req)])
+            for a in ("tiered", "device_only") for req in wl)
+        for r in legs.values():
+            del r["rows"]
+
+        def trim(r):
+            pc = r["prefix_cache"]
+            out = {"ttft": r["ttft"], "latency": r["latency"],
+                   "tokens_per_sec": r["tokens_per_sec"],
+                   "jit_flat": r["jit_flat"],
+                   "ledger_conserved": r["ledger_conserved"]}
+            if pc.get("enabled"):
+                out.update(
+                    hit_rate=pc["hit_rate"], hits=pc["hits"],
+                    misses=pc["misses"],
+                    reused_tokens=pc["reused_tokens"],
+                    capacity_bytes=pc["capacity_bytes"])
+                if pc.get("host_rows"):
+                    out.update(
+                        host_hits=pc["host_hits"],
+                        demotions=pc["demotions"],
+                        promotions=pc["promotions"],
+                        host_evictions=pc["host_evictions"],
+                        host_capacity_bytes=pc["host_capacity_bytes"])
+            return out
+
+        points.append({
+            "working_set": int(ws),
+            "ws_to_budget": round(int(ws) / device_rows, 2),
+            "requests": n_req,
+            "token_parity": bool(parity),
+            "tiered": trim(legs["tiered"]),
+            "device_only": trim(legs["device_only"]),
+            "disabled": trim(legs["disabled"]),
+            # full blocks the headline promotes (cost classification,
+            # goodput, steady-state gap) — per-point only the trims
+            "_tiered_full": {k: legs["tiered"][k] for k in
+                             ("cost", "loop", "goodput", "inter_token")},
+        })
+        log(f"[serving-bench] working-set {ws}: tiered hit-rate "
+            f"{points[-1]['tiered'].get('hit_rate')} vs device-only "
+            f"{points[-1]['device_only'].get('hit_rate')}")
+
+    # headline = the deepest point past the budget (the cliff the host
+    # tier exists to hold); falls back to the last point
+    past = [p for p in points if p["ws_to_budget"] >= 4.0]
+    head = (past or points)[-1]
+    dev_hr = head["device_only"].get("hit_rate") or 0.0
+    tier_hr = head["tiered"].get("hit_rate") or 0.0
+    tiered_full = {**head["tiered"], **head.pop("_tiered_full")}
+    for p in points:
+        p.pop("_tiered_full", None)
+    return {
+        "points": points,
+        # the headline point's legs at top level: perf_gate reads
+        # detail.tiered.{ttft,inter_token,goodput} like any other
+        # engine leg, detail.headline.tiered_hit_rate for the
+        # higher-is-better gate
+        "tiered": tiered_full,
+        "device_only": head["device_only"],
+        "headline": {
+            "working_set": head["working_set"],
+            "ws_to_budget": head["ws_to_budget"],
+            "tiered_hit_rate": tier_hr,
+            "device_only_hit_rate": dev_hr,
+            "hit_rate_gain": (round(tier_hr / dev_hr, 2)
+                              if dev_hr > 0 else None),
+            "tiered_ttft_p50_s": head["tiered"]["ttft"]["p50"],
+            "device_only_ttft_p50_s": head["device_only"]["ttft"]["p50"],
+            "token_parity": head["token_parity"],
+            "jit_flat": bool(head["tiered"]["jit_flat"]),
+            "ledger_conserved": bool(
+                head["tiered"]["ledger_conserved"]),
+        },
+        "workload": {"kind": "working_set_sweep",
+                     "device_rows": device_rows,
+                     "working_sets": [int(w) for w in working_sets],
+                     # scalars for perf_gate's signature (it ignores
+                     # the list): two sweeps compare only when they
+                     # sweep the same depth
+                     "max_working_set": int(max(working_sets)),
+                     "n_points": len(list(working_sets)),
+                     "requests_per_template": requests_per_template,
+                     "rate_hz": rate_hz, "seed": seed,
+                     "max_slots": max_slots,
+                     "prefill_rows": prefill_rows,
+                     "template_len": template_len}}
 
 
 def run_tp_comparison(model, tp: int = 2, n_requests: int = 16,

@@ -291,6 +291,8 @@ class ContinuousBatchingEngine:
                  prefill_rows: int = 1,
                  prefix_cache_bytes: Optional[int] = None,
                  prefix_cache_rows: Optional[int] = None,
+                 prefix_host_bytes: Optional[int] = None,
+                 prefix_host_rows: Optional[int] = None,
                  prefix_min_tokens: Optional[int] = None,
                  admission_window: int = 4,
                  slo_objectives=None,
@@ -489,6 +491,15 @@ class ContinuousBatchingEngine:
             pool_rows = 2 * max_slots
         else:
             pool_rows = max(0, int(prefix_cache_bytes) // row_bytes)
+        # host tier behind the device pool: evicted rows spill to
+        # pinned host buffers instead of dropping (row budget derived
+        # from its own byte budget; 0 = tier off, eviction drops)
+        if prefix_host_rows is not None:
+            host_rows = max(0, int(prefix_host_rows))
+        elif prefix_host_bytes is None:
+            host_rows = 0
+        else:
+            host_rows = max(0, int(prefix_host_bytes) // row_bytes)
         if pool_rows > 0:
             self._pool = model.init_cache(pool_rows, phys_len,
                                           dtype=dtype,
@@ -503,11 +514,20 @@ class ContinuousBatchingEngine:
                 # mesh's data axis replicates them, so mesh.size
                 # would undercount)
                 devices=(int(mesh.shape[model_axis])
-                         if mesh is not None else 1))
+                         if mesh is not None else 1),
+                host_rows=host_rows)
         else:
             self._pool = None
             self._prefix = None
         self._prefix_evictions_seen = 0
+        self._prefix_demotions_seen = 0
+        self._prefix_host_evictions_seen = 0
+        #: host->device promotions in flight, keyed by entry identity:
+        #: {"entry", "tree" (async device_put result), "touched"
+        #: (iteration stamp)} — each record holds a pin on its entry,
+        #: so the host buffer can never be evicted mid-transfer
+        self._promotions: dict = {}
+        self._promotions_max = max(4, 2 * self._policy.prefill_rows)
         #: host-side prompt-token tally actually prefilled by THIS
         #: engine (the reused-fraction denominator — per-instance
         #: exact, unlike the shared-label registry counter)
@@ -574,6 +594,10 @@ class ContinuousBatchingEngine:
         if self._prefix is not None:
             self._memory_pools.append(self._prefix.register_memory_pool(
                 f"serving/{service_name}/prefix_kv_in_use"))
+            if self._prefix.host_rows > 0:
+                self._memory_pools.append(
+                    self._prefix.register_host_memory_pool(
+                        f"serving/{service_name}/prefix_host_kv"))
 
         # mesh topology gauges + per-pool per-device footprint
         n_dev = int(mesh.size) if mesh is not None else 1
@@ -745,6 +769,22 @@ class ContinuousBatchingEngine:
         self._copy_row_jit = _jit(copy_row, (0,), kv)
         self._sample0_jit = _jit(sample0, (), repl)
 
+        # ---- host-tier transfer program ------------------------------
+        # demotion source: ONE jitted slice lifting a pool row out as a
+        # (1, ...) tree the engine bulk-copies to host (src NOT donated
+        # — the pool lives on). Raw jnp indexing here would compile an
+        # anonymous executable per call site; a named program keeps the
+        # transfer on a warmed signature like every other copy.
+        self._take_row_jit = None
+        if self._prefix is not None and self._prefix.host_rows > 0:
+            def take_row(src, row):
+                return jax.tree.map(
+                    lambda s: jax.lax.dynamic_slice(
+                        s, (row,) + (0,) * (s.ndim - 1),
+                        (1,) + s.shape[1:]), src)
+
+            self._take_row_jit = _jit(take_row, (), kv)
+
         # ---- speculative-decoding programs --------------------------
         self._propose_jit = self._spec_verify_jit = None
         self._d_chunk_jit = self._d_sync_jit = None
@@ -848,6 +888,22 @@ class ContinuousBatchingEngine:
             self._pool = self._copy_row_jit(self._pool, self._caches,
                                             z, z)
             self._warm.update(("copy:stage", "copy:donate"))
+        if self._take_row_jit is not None:
+            # warm the demote slice AND the promote scatter (a fourth
+            # copy_row signature: (1, ...) src tree -> pool). The warm
+            # promote input is built EXACTLY the way real promotions
+            # build theirs — host ndarrays through device_put under the
+            # pool's sharding — so the first real promotion lands on
+            # this signature instead of compiling a new one.
+            from bigdl_tpu.parallel.tp import put_from_host
+
+            _ = self._take_row_jit(self._pool, z)
+            host_proto = jax.tree.map(
+                lambda s: np.zeros((1,) + s.shape[1:], s.dtype),
+                self._pool)
+            one_row = put_from_host(host_proto, self._kv_shard)
+            self._pool = self._copy_row_jit(self._pool, one_row, z, z)
+            self._warm.update(("copy:demote", "copy:promote"))
         if self.draft is not None:
             # the draft staging->slot insert is a fourth copy
             # signature (draft tree shapes)
@@ -950,6 +1006,8 @@ class ContinuousBatchingEngine:
     def _compile_total(self) -> int:
         fns = [self._step_jit, self._chunk_jit, self._copy_row_jit,
                self._sample0_jit]
+        if self._take_row_jit is not None:
+            fns.append(self._take_row_jit)
         if self.draft is not None:
             fns += [self._propose_jit, self._spec_verify_jit,
                     self._d_chunk_jit, self._d_sync_jit]
@@ -1088,6 +1146,8 @@ class ContinuousBatchingEngine:
         err = EngineStopped("engine stopped before the request finished")
         for h in self._queue.drain():
             self._finish_handle(h, err, "stopped")
+        for key in list(self._promotions):
+            self._drop_promotion(key)
         for a in self._adms:
             if a.entry is not None:
                 self._prefix.release(a.entry)
@@ -1465,6 +1525,8 @@ class ContinuousBatchingEngine:
         self._write_postmortem(e, states)
         err = EngineStopped(f"engine loop crashed: {e!r}")
         err.__cause__ = e
+        for key in list(self._promotions):
+            self._drop_promotion(key)
         for a in self._adms:
             if a.entry is not None:
                 self._prefix.release(a.entry)
@@ -1636,6 +1698,8 @@ class ContinuousBatchingEngine:
         scorer = None
         if self._prefix is not None and self.admission_window > 1:
             c = self._policy.chunk
+            if self._promotions:
+                self._prune_promotions(now)
 
             def scorer(h):
                 # score by the USABLE (capped, chunk-aligned) reuse —
@@ -1646,6 +1710,12 @@ class ContinuousBatchingEngine:
                 # admission doesn't re-walk the trie.
                 e, m = self._prefix.lookup(h.prompt)
                 h._prefix_probe = (e, m, self._prefix.generation)
+                if e is not None and e.tier == "host":
+                    # host-tier match: start the async device_put NOW,
+                    # overlapping this candidate's remaining queue wait
+                    # — by its admission the transfer has (usually)
+                    # already landed
+                    self._begin_promotion(e)
                 return (min(m, h.prompt.shape[0] - 1) // c) * c
         while len(self._adms) < self._policy.prefill_rows:
             slot = self._free_slot()
@@ -1684,21 +1754,32 @@ class ContinuousBatchingEngine:
                 # with it the numerics — matches a cold prefill's, and
                 # the padded tail write can never overflow the cache
                 base = (min(matched, t0 - 1) // c) * c
+            from_host = base > 0 and e.tier == "host"
+            if from_host and not self._promote_entry(e):
+                # the host row could not be made device-resident
+                # (transfer unavailable, every pool row pinned, or the
+                # buffer raced away) — a CLEAN miss, never a copy from
+                # a reused or uninitialized row
+                base, e = 0, None
             if base > 0:
                 entry = e
-                self._prefix.record_hit(entry, base)
+                self._prefix.record_hit(entry, base, host=from_host)
                 self._prefix.acquire(entry)
                 self._staging = self._copy_row_jit(
                     self._staging, self._pool, jnp.int32(row),
                     jnp.int32(entry.row))
                 self._warm.add("copy:stage")
                 self._ins.prefix_hits_total.inc()
+                if from_host:
+                    self._ins.prefix_host_hits_total.inc()
+                    self._sync_prefix_gauges()
                 self._ins.prefix_reused_tokens_total.inc(base)
                 self._rec.record("request/prefix_hit", h.request_id,
                                  service=self.service_name,
                                  matched_tokens=base,
                                  raw_matched_tokens=matched,
-                                 tail_tokens=t0 - base)
+                                 tail_tokens=t0 - base,
+                                 tier="host" if from_host else "device")
             else:
                 self._prefix.record_miss()
                 self._ins.prefix_misses_total.inc()
@@ -1911,6 +1992,10 @@ class ContinuousBatchingEngine:
             return
         row = self._prefix.donate(tokens)
         if row is not None:
+            # the claimed row may still hold a DEMOTED victim's KV —
+            # the bulk d2h spill must land before this copy overwrites
+            # it (the engine-side half of the eviction-demotes contract)
+            self._resolve_pending_demotion()
             self._pool = self._copy_row_jit(
                 self._pool, self._caches, jnp.int32(row),
                 jnp.int32(sid))
@@ -1918,6 +2003,11 @@ class ContinuousBatchingEngine:
             self._rec.record("request/prefix_donated", request_id,
                              service=self.service_name,
                              tokens=int(tokens.shape[0]), pool_row=row)
+        self._sync_prefix_gauges()
+
+    def _sync_prefix_gauges(self) -> None:
+        """Publish the prefix cache's flow deltas and occupancy, both
+        tiers (device pool + host spill)."""
         ev = self._prefix.evictions
         if ev > self._prefix_evictions_seen:
             self._ins.prefix_evicted_total.inc(
@@ -1925,6 +2015,133 @@ class ContinuousBatchingEngine:
             self._prefix_evictions_seen = ev
         self._ins.prefix_cache_bytes.set(self._prefix.bytes_in_use)
         self._ins.prefix_cache_entries.set(len(self._prefix))
+        if self._prefix.host_rows > 0:
+            dm = self._prefix.demotions
+            if dm > self._prefix_demotions_seen:
+                self._ins.prefix_host_demoted_total.inc(
+                    dm - self._prefix_demotions_seen)
+                self._prefix_demotions_seen = dm
+            hev = self._prefix.host_evictions
+            if hev > self._prefix_host_evictions_seen:
+                self._ins.prefix_host_evicted_total.inc(
+                    hev - self._prefix_host_evictions_seen)
+                self._prefix_host_evictions_seen = hev
+            self._ins.prefix_host_cache_bytes.set(
+                self._prefix.host_bytes_in_use)
+            self._ins.prefix_host_cache_entries.set(
+                self._prefix.stats()["host_entries"])
+
+    # ------------------------------------------------ host-tier moves
+    def _resolve_pending_demotion(self) -> None:
+        """Complete the demotion a row claim left open: one jitted
+        slice lifts the victim's pool row out, one bulk ``device_get``
+        parks it on host (each mesh device ships only its own shard),
+        and the cache attaches the buffer. Must run BEFORE the claimed
+        row is overwritten — its KV is the source."""
+        pend = self._prefix.pop_pending_demotion()
+        if pend is None:
+            return
+        from bigdl_tpu.parallel.tp import fetch_to_host
+
+        victim, vrow = pend
+        try:
+            one = self._take_row_jit(self._pool, jnp.int32(vrow))
+            self._warm.add("copy:demote")
+            buf = fetch_to_host(one)
+        except Exception:
+            # a failed spill degrades to the old drop semantics — the
+            # entry is removed, never left pointing at garbage
+            buf = None
+        self._prefix.complete_demotion(victim, buf)
+
+    def _begin_promotion(self, entry) -> None:
+        """Start (or touch) the async host→device transfer for a
+        host-tier entry a queued candidate's lookup landed on. The
+        ``device_put`` returns immediately — the copy overlaps the
+        request's remaining queue wait — and the record PINS the entry
+        so its host buffer cannot be evicted mid-flight."""
+        key = id(entry)
+        now = time.monotonic()
+        rec = self._promotions.get(key)
+        if rec is not None:
+            rec["touched"] = now
+            return
+        if entry.host_buf is None:
+            return  # spill copy still pending; next score retries
+        if len(self._promotions) >= self._promotions_max:
+            # bound in-flight transfers (device bytes + host pins):
+            # drop the stalest record, releasing its pin
+            stalest = min(self._promotions,
+                          key=lambda k: self._promotions[k]["touched"])
+            self._drop_promotion(stalest)
+        from bigdl_tpu.parallel.tp import put_from_host
+
+        self._prefix.acquire(entry)
+        tree = put_from_host(entry.host_buf, self._kv_shard)
+        self._promotions[key] = {"entry": entry, "tree": tree,
+                                 "touched": now}
+
+    def _drop_promotion(self, key) -> None:
+        rec = self._promotions.pop(key, None)
+        if rec is not None:
+            self._prefix.release(rec["entry"])
+
+    def _prune_promotions(self, now: float) -> None:
+        """Retire promotion records whose entry left the host tier
+        (promoted by another admission, or dropped) and ones no scorer
+        has touched recently (their request was cancelled or timed
+        out) — a record's pin must never outlive its usefulness, or
+        the host LRU cannot evict."""
+        for key in [k for k, r in self._promotions.items()
+                    if r["entry"].tier != "host"
+                    or now - r["touched"] > 30.0]:
+            self._drop_promotion(key)
+
+    def _promote_entry(self, entry) -> bool:
+        """Make a host-tier entry device-resident for the admission
+        consuming it: claim a pool row (evict-or-demote, exactly the
+        donation discipline), land the transferred ``(1, ...)`` tree
+        with one warmed scatter, and flip the entry's tier. Uses the
+        overlapped transfer when the scorer started one, else starts a
+        blocking one here (window=1 engines never score). False means
+        the promotion fell through — the caller treats the probe as a
+        clean miss."""
+        rec = self._promotions.pop(id(entry), None)
+        if entry.tier != "host":
+            # raced: another admission promoted it first — its pool
+            # row is live, directly consumable
+            if rec is not None:
+                self._prefix.release(entry)
+            return entry.tier == "device"
+        if rec is None:
+            if entry.host_buf is None:
+                return False
+            # pin for the promotion's duration: allocate_row()'s
+            # evict-or-demote sweep must not reclaim this entry's
+            # host buffer out from under its own transfer (the
+            # overlapped path pinned at _begin_promotion)
+            self._prefix.acquire(entry)
+        try:
+            if rec is not None:
+                tree = rec["tree"]
+            else:
+                from bigdl_tpu.parallel.tp import put_from_host
+
+                tree = put_from_host(entry.host_buf, self._kv_shard)
+            row = self._prefix.allocate_row()
+            if row is None:
+                return False  # every device row pinned: clean miss
+            # the claimed row may itself hold a freshly demoted
+            # victim's KV — spill it before the scatter overwrites it
+            self._resolve_pending_demotion()
+            self._pool = self._copy_row_jit(
+                self._pool, tree, jnp.int32(row), jnp.int32(0))
+            self._warm.add("copy:promote")
+            self._prefix.promote(entry, row)
+            self._ins.prefix_host_promoted_total.inc()
+            return True
+        finally:
+            self._prefix.release(entry)
 
     # --------------------------------------------------------- decode
     def _decode_all(self, active: List[int]) -> None:

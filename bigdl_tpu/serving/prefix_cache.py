@@ -29,6 +29,21 @@ copy consumer may still be in flight. The byte budget is enforced as a
 row budget (``rows * row_bytes``) fixed at construction — compiled
 shapes stay load-independent.
 
+**Host tier** (``host_rows > 0``): instead of dropping the device-pool
+LRU victim outright, eviction *demotes* it — the entry stays in the
+trie, flips ``tier`` to ``"host"``, and parks its KV in a pinned host
+buffer (one bulk device-to-host copy the ENGINE performs via
+``pop_pending_demotion`` / ``complete_demotion`` before the pool row is
+reused). The host tier has its own row budget and its own LRU; a
+lookup landing on a host entry is the engine's cue to start an async
+``device_put`` promotion that overlaps the request's queue wait, then
+``allocate_row`` + ``promote`` flip the entry back to device residency
+so the unchanged chunk-aligned reuse path consumes it. The total
+retained prefix set thus scales with host RAM, not HBM — BigDL's
+spill-to-block-manager memory hierarchy recast for KV. Every tier
+transition (demote, host-evict, promote) bumps ``generation``, so the
+stale-probe guard covers host rows exactly like device rows.
+
 Thread contract: the engine's loop thread is the only mutator;
 ``stats()`` / ``snapshot()`` may be called from HTTP/debug threads (an
 internal lock covers the races).
@@ -45,9 +60,13 @@ import numpy as np
 class PrefixEntry:
     """One retained prefix: ``tokens`` (the exact token ids whose KV the
     pool row holds, positions ``0..length-1``), the pool ``row`` that
-    holds them, and the LRU/ref-count bookkeeping."""
+    holds them, and the LRU/ref-count bookkeeping. ``tier`` says where
+    the KV currently lives: ``"device"`` (a pool row) or ``"host"``
+    (``host_buf``, an engine-opaque pinned host copy of the row;
+    ``row`` is ``-1`` while demoted so stale use fails loudly)."""
 
-    __slots__ = ("tokens", "row", "refs", "last_used", "hits")
+    __slots__ = ("tokens", "row", "refs", "last_used", "hits", "tier",
+                 "host_buf")
 
     def __init__(self, tokens: np.ndarray, row: int, stamp: int):
         self.tokens = tokens
@@ -55,6 +74,8 @@ class PrefixEntry:
         self.refs = 0
         self.last_used = stamp
         self.hits = 0
+        self.tier = "device"
+        self.host_buf = None
 
     @property
     def length(self) -> int:
@@ -62,7 +83,8 @@ class PrefixEntry:
 
     def __repr__(self):
         return (f"PrefixEntry(len={self.length}, row={self.row}, "
-                f"refs={self.refs}, hits={self.hits})")
+                f"tier={self.tier}, refs={self.refs}, "
+                f"hits={self.hits})")
 
 
 class _Node:
@@ -99,13 +121,26 @@ class PrefixCache:
     request: ``donate(tokens)`` returns the pool row to copy the slot's
     KV into (or None when covered / unevictable), possibly evicting an
     LRU ``refs == 0`` entry to make room.
+
+    With ``host_rows > 0`` the evicted victim is DEMOTED instead of
+    dropped: ``donate`` (or ``allocate_row``) parks it as a host-tier
+    entry and records a pending demotion the engine must resolve —
+    ``pop_pending_demotion()`` names the entry and the pool row still
+    holding its KV, the engine bulk-copies that row to host, and
+    ``complete_demotion(entry, host_buf)`` attaches the buffer (or
+    drops the entry when the copy failed). The reverse move is
+    ``allocate_row()`` + ``promote(entry, row)`` after the engine has
+    ``device_put`` the host buffer back into the pool row.
     """
 
     def __init__(self, rows: int, row_bytes: int,
                  min_tokens: int = 1, token_bytes: float = 0.0,
-                 devices: int = 1):
+                 devices: int = 1, host_rows: int = 0):
         if rows < 0:
             raise ValueError(f"rows must be >= 0, got {rows}")
+        if host_rows < 0:
+            raise ValueError(
+                f"host_rows must be >= 0, got {host_rows}")
         if min_tokens < 1:
             raise ValueError(
                 f"min_tokens must be >= 1, got {min_tokens}")
@@ -125,18 +160,31 @@ class PrefixCache:
         #: (row_bytes / cache_len — the engine passes it); the
         #: exchange rate behind the ``bytes_saved`` savings credit
         self.token_bytes = float(token_bytes)
+        #: host-tier row budget (0 disables the tier: eviction drops)
+        self.host_rows = int(host_rows) if rows > 0 else 0
         self._root = _Node()
         self._entries: List[PrefixEntry] = []
+        self._host_entries: List[PrefixEntry] = []
         self._free_rows = list(range(rows))
+        #: the one demotion ``donate``/``allocate_row`` may leave open:
+        #: ``(entry, pool_row)`` — the engine MUST resolve it (bulk d2h
+        #: copy of ``pool_row`` + ``complete_demotion``) before the row
+        #: is overwritten by the copy the allocation was made for
+        self._pending_demotion: Optional[
+            Tuple[PrefixEntry, int]] = None
         self._stamp = 0
         self._lock = threading.Lock()
-        #: bumped on every structural change (insert/evict) — lets a
-        #: caller validate a cached ``lookup`` result before acting on
-        #: it (a stale entry may have been evicted and its row reused)
+        #: bumped on every structural change (insert/evict/demote/
+        #: promote/host-evict) — lets a caller validate a cached
+        #: ``lookup`` result before acting on it (a stale entry may
+        #: have been evicted and its row reused, or changed tier)
         self.generation = 0
         # cumulative flow (monotonic, for stats deltas)
         self.hits = 0
         self.misses = 0
+        #: subset of ``hits`` served out of the host tier (the entry
+        #: needed a promotion before its row was consumable)
+        self.host_hits = 0
         self.reused_tokens = 0
         #: device KV bytes reuse avoided recomputing + rewriting —
         #: the cache's cumulative savings credit (reused positions x
@@ -145,6 +193,10 @@ class PrefixCache:
         self.bytes_saved = 0
         self.donations = 0
         self.evictions = 0
+        # host-tier flow
+        self.demotions = 0
+        self.promotions = 0
+        self.host_evictions = 0
 
     def __len__(self) -> int:
         with self._lock:
@@ -158,6 +210,18 @@ class PrefixCache:
     def bytes_in_use(self) -> int:
         with self._lock:
             return len(self._entries) * self.row_bytes
+
+    @property
+    def host_capacity_bytes(self) -> int:
+        return self.host_rows * self.row_bytes
+
+    @property
+    def host_bytes_in_use(self) -> int:
+        """Host RAM the demoted rows occupy (buffers actually attached
+        — a demotion pending its d2h copy holds no host bytes yet)."""
+        with self._lock:
+            return sum(self.row_bytes for e in self._host_entries
+                       if e.host_buf is not None)
 
     # ------------------------------------------------------------ match
     def lookup(self, prompt: np.ndarray
@@ -215,15 +279,20 @@ class PrefixCache:
                 return None, 0
             return best, best_len
 
-    def record_hit(self, entry: PrefixEntry, reused_tokens: int) -> None:
+    def record_hit(self, entry: PrefixEntry, reused_tokens: int,
+                   host: bool = False) -> None:
         """Commit an admission's hit: LRU touch, per-entry and global
         hit counts, and the chunk-aligned reused-token figure the
-        engine actually skipped prefill for."""
+        engine actually skipped prefill for. ``host=True`` marks a hit
+        the engine served via a host-tier promotion — the tier split
+        behind the ``bigdl_serving_prefix_host_hits_total`` counter."""
         with self._lock:
             self._stamp += 1
             entry.last_used = self._stamp
             entry.hits += 1
             self.hits += 1
+            if host:
+                self.host_hits += 1
             self.reused_tokens += int(reused_tokens)
             self.bytes_saved += int(reused_tokens * self.token_bytes)
 
@@ -280,15 +349,9 @@ class PrefixCache:
                 self._stamp += 1
                 covered.last_used = self._stamp
                 return None
-            if self._free_rows:
-                row = self._free_rows.pop()
-            else:
-                victim = self._lru_unpinned()
-                if victim is None:
-                    return None
-                self._remove(victim)
-                self.evictions += 1
-                row = victim.row
+            row = self._take_row()
+            if row is None:
+                return None
             self._stamp += 1
             self.generation += 1
             entry = PrefixEntry(tokens, row, self._stamp)
@@ -296,6 +359,121 @@ class PrefixCache:
             self._entries.append(entry)
             self.donations += 1
             return row
+
+    def _take_row(self) -> Optional[int]:
+        """Claim a device pool row (lock held): a free row, else the
+        LRU ``refs == 0`` device entry's — demoting the victim into
+        the host tier when it has room (the entry stays in the trie,
+        its d2h copy left pending for the engine), dropping it
+        otherwise. Returns None when every entry is pinned."""
+        if self._free_rows:
+            return self._free_rows.pop()
+        victim = self._lru_unpinned()
+        if victim is None:
+            return None
+        row = victim.row
+        self.evictions += 1
+        if self.host_rows > 0 and self._make_host_room():
+            # demote: same trie node, new tier; the engine owes the
+            # bulk device->host copy of `row` before reusing it
+            self._entries.remove(victim)
+            victim.tier = "host"
+            victim.row = -1
+            victim.host_buf = None
+            self._host_entries.append(victim)
+            self._pending_demotion = (victim, row)
+        else:
+            self._remove(victim)
+        return row
+
+    def _make_host_room(self) -> bool:
+        """Ensure the host tier can absorb one more entry (lock held),
+        evicting host-LRU ``refs == 0`` entries past the budget.
+        False when the tier is full of pinned entries — the demotion
+        then degrades to a plain drop, never an over-budget spill."""
+        while len(self._host_entries) >= self.host_rows:
+            cand = [e for e in self._host_entries if e.refs == 0]
+            if not cand:
+                return False
+            hv = min(cand, key=lambda e: e.last_used)
+            self._host_entries.remove(hv)
+            self._trie_remove(hv)
+            hv.host_buf = None
+            self.host_evictions += 1
+            # a probe (or in-flight promotion) that captured `hv`
+            # re-validates and resolves to a clean miss
+            self.generation += 1
+        return True
+
+    # ------------------------------------------------- tier transitions
+    def pop_pending_demotion(
+            self) -> Optional[Tuple[PrefixEntry, int]]:
+        """The demotion the last ``donate``/``allocate_row`` left open:
+        ``(entry, pool_row)`` — ``pool_row`` still holds the demoted
+        entry's KV and is about to be overwritten, so the caller must
+        d2h-copy it NOW and then ``complete_demotion``. Clears the
+        pending slot."""
+        with self._lock:
+            pend, self._pending_demotion = self._pending_demotion, None
+            return pend
+
+    def complete_demotion(self, entry: PrefixEntry,
+                          host_buf) -> None:
+        """Attach the bulk-copied host buffer to a demoted entry. A
+        ``None`` buffer means the copy was not performed (transfer
+        failed / tier raced away) — the entry is dropped so a later
+        promotion can never read uninitialized host memory."""
+        with self._lock:
+            if host_buf is None:
+                if entry in self._host_entries:
+                    self._host_entries.remove(entry)
+                    self._trie_remove(entry)
+                    self.generation += 1
+                return
+            if entry not in self._host_entries:
+                return  # host-evicted (or promoted) since the demote
+            entry.host_buf = host_buf
+            self.demotions += 1
+
+    def allocate_row(self) -> Optional[int]:
+        """Claim a device pool row for a promotion (free row, else
+        evict-or-demote the device LRU — exactly ``donate``'s row
+        discipline, without inserting anything). May leave a pending
+        demotion the caller must resolve; bumps ``generation`` so any
+        probe taken before the eviction re-validates."""
+        with self._lock:
+            if self.rows == 0:
+                return None
+            row = self._take_row()
+            if row is not None:
+                self.generation += 1
+            return row
+
+    def promote(self, entry: PrefixEntry, row: int) -> None:
+        """Flip a host-tier entry back to device residency in pool row
+        ``row`` (the caller has already ``device_put`` the host buffer
+        into that row). Drops the host buffer, LRU-touches the entry,
+        and bumps ``generation`` — probes that captured the entry as
+        host-tier re-validate before acting."""
+        with self._lock:
+            if entry.tier != "host" or entry not in self._host_entries:
+                raise RuntimeError(
+                    f"promote() of a non-host entry: {entry!r}")
+            self._host_entries.remove(entry)
+            entry.tier = "device"
+            entry.row = int(row)
+            entry.host_buf = None
+            self._entries.append(entry)
+            self._stamp += 1
+            entry.last_used = self._stamp
+            self.promotions += 1
+            self.generation += 1
+
+    def release_row(self, row: int) -> None:
+        """Return an ``allocate_row`` row unused (the promotion it was
+        claimed for fell through after the claim)."""
+        with self._lock:
+            self._free_rows.append(int(row))
 
     def _covering_entry(self, tokens: np.ndarray
                         ) -> Optional[PrefixEntry]:
@@ -345,6 +523,9 @@ class PrefixCache:
 
     def _remove(self, entry: PrefixEntry) -> None:
         self._entries.remove(entry)
+        self._trie_remove(entry)
+
+    def _trie_remove(self, entry: PrefixEntry) -> None:
         # walk to the entry's node, clearing the marker; structural
         # merge of pass-through nodes is skipped — the trie is bounded
         # by rows * key-length and rebuilt nodes are reused by the next
@@ -388,6 +569,17 @@ class PrefixCache:
             self, {name: lambda c: c.bytes_in_use})
         return names[0]
 
+    def register_host_memory_pool(self, name: str) -> str:
+        """Same attribution for the HOST tier: the pinned host-RAM
+        bytes the demoted rows occupy, alongside the device pools in
+        the one registry — ``/debug/memory`` answers "who owns the
+        spill" exactly like "who owns the HBM"."""
+        from bigdl_tpu.observability import memory as obs_memory
+
+        names = obs_memory.register_owned_pools(
+            self, {name: lambda c: c.host_bytes_in_use})
+        return names[0]
+
     # ------------------------------------------------------------ stats
     def stats(self) -> dict:
         """Operational snapshot: occupancy, byte budget, and cumulative
@@ -395,6 +587,8 @@ class PrefixCache:
         and ``/debug/requests`` both render this)."""
         with self._lock:
             looked = self.hits + self.misses
+            host_bytes = sum(self.row_bytes for e in self._host_entries
+                             if e.host_buf is not None)
             return {
                 "entries": len(self._entries),
                 "rows": self.rows,
@@ -410,12 +604,24 @@ class PrefixCache:
                 "bytes_saved": self.bytes_saved,
                 "donations": self.donations,
                 "evictions": self.evictions,
+                # host tier
+                "host_rows": self.host_rows,
+                "host_entries": len(self._host_entries),
+                "host_bytes": host_bytes,
+                "host_capacity_bytes": self.host_rows * self.row_bytes,
+                "host_hits": self.host_hits,
+                "device_hits": self.hits - self.host_hits,
+                "demotions": self.demotions,
+                "promotions": self.promotions,
+                "host_evictions": self.host_evictions,
             }
 
     def snapshot(self) -> List[dict]:
-        """Per-entry debug view (LRU order, oldest first)."""
+        """Per-entry debug view, both tiers (LRU order, oldest
+        first)."""
         with self._lock:
-            return [{"length": e.length, "row": e.row, "refs": e.refs,
-                     "hits": e.hits, "last_used": e.last_used}
-                    for e in sorted(self._entries,
+            return [{"length": e.length, "row": e.row, "tier": e.tier,
+                     "refs": e.refs, "hits": e.hits,
+                     "last_used": e.last_used}
+                    for e in sorted(self._entries + self._host_entries,
                                     key=lambda e: e.last_used)]

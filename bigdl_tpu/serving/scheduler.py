@@ -156,7 +156,17 @@ class AdmissionQueue:
         REORDERS within the window, admission stays work-conserving).
         Starvation is bounded: after ``window`` consecutive pops bypass
         the queue head, the next pop is forced FCFS, so the head waits
-        at most ``window`` extra admissions."""
+        at most ``window`` extra admissions.
+
+        The scorer MAY carry side effects: the engine's prefix scorer
+        starts the async host→device promotion the moment a candidate's
+        trie walk lands on a host-tier row, so the transfer overlaps
+        the rest of the candidate's QUEUE WAIT (``pop_ready`` calls the
+        scorer once per live windowed candidate per pop — candidates
+        put back at the head keep their in-flight transfer and are
+        re-scored, not re-started, on the next pop). By the admission
+        that finally consumes the entry, the copy has usually landed
+        and the reuse path proceeds exactly as a device-tier hit."""
         now = time.monotonic() if now is None else now
         dropped: List[Tuple[RequestHandle, Exception]] = []
         with self._lock:

@@ -25,9 +25,13 @@ Serving rows come from ``bench.py --serving`` (percentiles under
 inter-token p99 is exactly the measure speculation exists to improve,
 so it gates like any other), and ``bench.py --serving --tp``
 (``detail.sharded.*`` — the tensor-parallel engine's latencies, gated
-against the previous sharded run of the same mesh width); all four
-shapes are understood. Stdlib only — runnable from any CI step
-without the package installed.
+against the previous sharded run of the same mesh width), and
+``bench.py --serving --shared-prefix --working-set N``
+(``detail.tiered.*`` plus ``detail.headline.tiered_hit_rate`` — the
+tiered prefix-cache sweep additionally gates the headline hit rate,
+higher-is-better, and the tiered leg's p50 TTFT); all five shapes are
+understood. Stdlib only — runnable from any CI step without the
+package installed.
 
 Usage::
 
@@ -44,9 +48,9 @@ import sys
 
 #: detail keys that hold a serving result with a ``ttft`` percentile
 #: block, in precedence order (--serving vs --serving --shared-prefix
-#: vs --serving --speculative vs --serving --tp — each row shape
-#: carries exactly one)
-_TTFT_PATHS = ("engine", "cached", "spec", "sharded")
+#: vs --serving --speculative vs --serving --tp vs --serving
+#: --shared-prefix --working-set — each row shape carries exactly one)
+_TTFT_PATHS = ("engine", "cached", "spec", "sharded", "tiered")
 
 
 def _p99(row: dict, measure: str):
@@ -82,6 +86,24 @@ def goodput_tokens_per_device_second(row: dict):
         if g is not None:
             return float(g)
     return None
+
+
+def tiered_hit_rate(row: dict):
+    """The tiered prefix-cache sweep row's headline hit rate (host
+    tier ON, at the deepest working-set point past the device budget),
+    or None for every other row shape and for rows predating the
+    sweep. Higher is better — the gate inverts the direction."""
+    head = (row.get("detail") or {}).get("headline") or {}
+    hr = head.get("tiered_hit_rate")
+    return float(hr) if hr is not None else None
+
+
+def tiered_ttft_p50(row: dict):
+    """The tiered row's p50 TTFT in seconds (the latency the promoted
+    rows must keep buying), or None for rows without a tiered leg."""
+    block = (row.get("detail") or {}).get("tiered") or {}
+    p50 = (block.get("ttft") or {}).get("p50")
+    return float(p50) if p50 is not None else None
 
 
 def signature(row: dict):
@@ -170,6 +192,12 @@ def main(argv=None) -> int:
         ("p99 inter-token", inter_token_p99, 1e3, "ms", False),
         ("goodput", goodput_tokens_per_device_second, 1.0,
          "tok/dev-s", True),
+        # tiered prefix-cache sweep rows only (skip-if-absent, like
+        # every field younger than the history): the host tier must
+        # keep buying its hit rate AND the promoted rows must keep
+        # buying their TTFT
+        ("tiered hit rate", tiered_hit_rate, 100.0, "%", True),
+        ("tiered p50 TTFT", tiered_ttft_p50, 1e3, "ms", False),
     )
     for label, reader, scale, unit, higher_better in measures:
         new_v, old_v = reader(newest), reader(prev)
