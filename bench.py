@@ -281,6 +281,15 @@ def bench_main(argv=None):
                         "with its float source ~90%% of the time, so "
                         "a wide gamma amortizes dispatch overhead "
                         "hardest)")
+    p.add_argument("--fleet", type=int, default=0, metavar="N",
+                   help="with --serving: multi-replica fleet A/B — one "
+                        "shared-prefix Poisson storm through N spawn-"
+                        "worker engine replicas routed by prefix "
+                        "affinity vs round-robin, plus the mid-storm "
+                        "drain drill; emits the affinity TTFT p50 "
+                        "speedup, fleet hit-rate gain, zero-loss drain "
+                        "verdict and token parity into "
+                        "bench_history.jsonl")
     p.add_argument("--tp", type=int, default=0, metavar="N",
                    help="with --serving: tensor-parallel A/B — the "
                         "same Poisson workload through the engine "
@@ -531,6 +540,19 @@ def _serving_bench(args, dev):
     token-parity flag; perf_gate gates the speculative row's p99
     inter-token (and TTFT / goodput) between comparable runs.
 
+    `--serving --fleet N`: the multi-replica fleet A/B — one shared-
+    prefix Poisson storm replayed through N spawn-worker engine
+    replicas (each its own process, model, engine, budget-bound prefix
+    trie) routed by the PrefixAffinityRouter vs round-robin, plus the
+    mid-storm drain drill (one replica drains and rejoins; zero lost
+    requests is the bar). value/vs_baseline is the affinity-vs-round-
+    robin client TTFT p50 speedup (>1.0: content-aware routing lands
+    first tokens sooner), and detail carries both legs' percentiles,
+    the fleet hit rates, the routing tallies, the drain block, and
+    the token-parity verdict against a single-replica reference.
+    perf_gate gates the speedup, the fleet hit rate, and the affinity
+    leg's p99 TTFT between comparable rows.
+
     `--serving --tp N`: the tensor-parallel A/B — the same Poisson
     workload through the engine SHARDED over an N-way model-axis
     device mesh (a host-device mesh on CPU: the flag forces N virtual
@@ -550,6 +572,39 @@ def _serving_bench(args, dev):
     from bigdl_tpu.version import __version__
 
     log = lambda *a, **k: print(*a, file=sys.stderr, **k)  # noqa: E731
+    if args.fleet and args.fleet > 1:
+        # the fleet bench spawns its own worker processes (each builds
+        # the recipe model on the shared seed) — no parent-side model
+        from bigdl_tpu.serving.fleet import run_fleet_comparison
+
+        prof = _start_profile(args.profile)
+        res = run_fleet_comparison(
+            n_replicas=args.fleet, n_requests=args.requests,
+            rate_hz=args.rate, log=log)
+        result = {
+            "metric": "serving_fleet_ttft_p50_speedup",
+            "value": res["ttft_p50_speedup"],
+            "unit": "ratio",
+            # vs_baseline > 1.0: the affinity leg's median first token
+            # lands sooner than round-robin's on the same storm
+            "vs_baseline": res["ttft_p50_speedup"],
+            "detail": {
+                "version": __version__,
+                "device": str(getattr(dev, "device_kind", dev.platform)),
+                **_row_stamps(dev),
+                **res,
+            },
+        }
+        _record_fleet_metrics(res)
+        art = _finish_profile(prof)
+        if art is not None:
+            result["detail"]["profile_artifact"] = art
+        result["detail"]["memory"] = _memory_snapshot()
+        _dump_prometheus_snapshot()
+        if args.trace:
+            _dump_chrome_trace()
+        print(json.dumps(result))
+        return
     rnd.set_seed(7)
     model = TransformerLM(128, embed_dim=64, num_heads=4, num_kv_heads=2,
                           num_layers=2, max_len=128, use_rope=True)
@@ -793,6 +848,28 @@ def _record_speculative_metrics(res):
     except Exception as e:
         print(f"[bench] speculative metrics registry update failed: "
               f"{e}", file=sys.stderr)
+
+
+def _record_fleet_metrics(res):
+    """Mirror the fleet A/B into the observability registry (``path``
+    label: fleet_affinity / fleet_round_robin) so live scrapes and
+    bench snapshots share one schema. Never lets telemetry break the
+    bench."""
+    try:
+        from bigdl_tpu import observability as obs
+
+        ins = obs.serving_bench_instruments()
+        for path, key in (("fleet_affinity", "affinity"),
+                          ("fleet_round_robin", "round_robin")):
+            _record_path_metrics(ins, res[key], path)
+        if res.get("ttft_p50_speedup") is not None:
+            ins.fleet_ttft_p50_speedup().set(res["ttft_p50_speedup"])
+        hit = (res.get("affinity", {}).get("fleet") or {}).get("hit_rate")
+        if hit is not None:
+            ins.fleet_hit_rate().set(hit)
+    except Exception as e:
+        print(f"[bench] fleet metrics registry update failed: {e}",
+              file=sys.stderr)
 
 
 def _record_goodput_metrics(ins, block, path):

@@ -28,8 +28,16 @@ POINT is the serving machinery, not the prose):
      device mesh (Megatron-sharded params, heads-sharded KV pools,
      SPMD dispatches; N virtual host devices on CPU) — topology and
      per-device pool bytes printed from stats()["mesh"]
+  8. --fleet N: the multi-replica fleet instead — N in-process engine
+     replicas behind a ReplicaSupervisor and the HTTP front door;
+     POST /v1/generate streams tokens as SSE (the meta event says
+     which replica the prefix-affinity router picked and why), the
+     per-replica routing table prints from GET /v1/replicas, one
+     replica drains mid-demo (traffic reroutes, then it rejoins), and
+     GET /v1/stats reports the fleet-wide prefix hit rate
 
 Run: python -m bigdl_tpu.example.serving.serve [--tokens 24] [--tp 2]
+     python -m bigdl_tpu.example.serving.serve --fleet 3
 """
 
 from __future__ import annotations
@@ -62,7 +70,16 @@ def main(argv=None):
                         "sharded on heads, SPMD dispatches) — N must "
                         "divide the demo model's 4 KV heads; on a "
                         "CPU host the flag forces N virtual devices")
+    p.add_argument("--fleet", type=int, default=0, metavar="N",
+                   help="run the MULTI-REPLICA demo instead: N in-"
+                        "process engine replicas behind the "
+                        "ReplicaSupervisor + HTTP front door — SSE "
+                        "streaming with routing metadata, the per-"
+                        "replica routing table, a mid-demo drain/"
+                        "rejoin, and the fleet-wide prefix hit rate")
     args = p.parse_args(argv)
+    if args.fleet and args.fleet > 1:
+        return _fleet_demo(args)
 
     import os
     import sys
@@ -332,6 +349,122 @@ def main(argv=None):
     print(f"[metrics]   GET /metrics -> {len(body.splitlines())} lines, "
           f"e.g. {'; '.join(shown)}")
     return rows
+
+
+def _fleet_demo(args):
+    """``--fleet N``: the horizontal-scale walkthrough. Everything a
+    fleet operator touches, over HTTP where a client would: SSE
+    streaming with routing metadata, the routing table, a drain/rejoin
+    drill, and the fleet-wide prefix hit rate."""
+    import json
+    import urllib.request
+
+    import numpy as np
+
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.serving import ContinuousBatchingEngine
+    from bigdl_tpu.serving.fleet import (
+        FleetFrontDoor, InProcessReplica, ReplicaSupervisor,
+    )
+    from bigdl_tpu.utils import random as rnd
+
+    n_rep = args.fleet
+    rnd.set_seed(0)
+    model = TransformerLM(args.vocab, embed_dim=32, num_heads=4,
+                          num_kv_heads=2, num_layers=2, max_len=96,
+                          use_rope=True)
+    model.evaluate()
+    replicas = [
+        InProcessReplica(
+            f"r{i}",
+            ContinuousBatchingEngine(model, max_slots=2, prefill_chunk=8,
+                                     prefill_rows=2, prefix_cache_rows=4,
+                                     service_name=f"fleet-demo-r{i}"))
+        for i in range(n_rep)]
+
+    r = np.random.RandomState(0)
+    templates = [r.randint(1, args.vocab, (24,)).tolist()
+                 for _ in range(2 * n_rep)]
+
+    def post(base, prompt, tenant):
+        """One streaming POST /v1/generate; returns (meta, n_tokens)."""
+        body = json.dumps({"prompt_ids": prompt,
+                           "max_new_tokens": min(args.tokens, 8),
+                           "tenant": tenant, "stream": True}).encode()
+        req = urllib.request.Request(
+            f"{base}/v1/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        meta, toks = None, 0
+        with urllib.request.urlopen(req) as resp:
+            event = None
+            for raw in resp:
+                ln = raw.decode().strip()
+                if ln.startswith("event: "):
+                    event = ln[7:]
+                elif ln.startswith("data: "):
+                    payload = json.loads(ln[6:])
+                    if event == "meta":
+                        meta = payload
+                    elif event is None:
+                        toks += 1
+                    event = None
+        return meta, toks
+
+    with ReplicaSupervisor(replicas, chunk=8,
+                           fleet_name="demo") as sup, \
+            FleetFrontDoor(sup) as door:
+        base = f"http://127.0.0.1:{door.port}"
+        print(f"[fleet]     {n_rep} in-process replicas behind {base}")
+
+        # one pass over the templates, then a revisit: the second
+        # visit of each template lands on the SAME replica (affinity)
+        # and hits the prefix KV its first visit left there
+        for lap in range(2):
+            for ti, tpl in enumerate(templates):
+                tail = r.randint(1, args.vocab, (3,)).tolist()
+                meta, toks = post(base, tpl + tail, f"tpl-{ti}")
+                if lap == 1:
+                    print(f"[route]     tpl-{ti} -> {meta['replica']} "
+                          f"({meta['route']}), {toks} tokens streamed")
+
+        table = json.loads(urllib.request.urlopen(
+            f"{base}/v1/replicas").read())
+        print(f"[table]     ring: {table['vnodes']} vnodes/replica, "
+              f"chunk {table['chunk']} tokens")
+        for rid in sorted(table["per_replica"]):
+            own = table["ownership"].get(rid, 0.0)
+            c = table["per_replica"][rid]
+            print(f"[table]       {rid}: {own:.0%} of keyspace, "
+                  f"{c['affinity']} affinity + {c['spilled']} spilled "
+                  "requests")
+
+        # the drain drill: r0 leaves rotation (in-flight finishes, new
+        # traffic routes away), serves nothing, then rejoins
+        sup.drain("r0", reason="operator")
+        sup.drain_wait("r0", timeout=30)
+        meta, _ = post(base, templates[0] + [1, 2], "drill")
+        hz = json.loads(urllib.request.urlopen(
+            f"{base}/healthz").read())
+        print(f"[drain]     r0 draining: /healthz {hz['status']} "
+              f"(live {hz['live']}); tpl-0 rerouted to "
+              f"{meta['replica']} ({meta['route']})")
+        sup.rejoin("r0")
+        hz = json.loads(urllib.request.urlopen(
+            f"{base}/healthz").read())
+        print(f"[rejoin]    r0 back: /healthz {hz['status']} "
+              f"(live {hz['live']})")
+
+        stats = json.loads(urllib.request.urlopen(
+            f"{base}/v1/stats").read())
+        pc = stats["prefix_cache"]
+        print(f"[stats]     fleet prefix hit rate "
+              f"{pc['hit_rate']:.0%} ({pc['hits']}/{pc['lookups']} "
+              f"lookups), {pc['reused_tokens']} tokens served from "
+              f"cache across {len(stats['replicas'])} replicas")
+        body = urllib.request.urlopen(f"{base}/metrics").read().decode()
+    shown = [ln for ln in body.splitlines()
+             if ln.startswith("bigdl_fleet_routed_total")]
+    print(f"[metrics]   GET /metrics -> e.g. {'; '.join(shown)}")
 
 
 if __name__ == "__main__":

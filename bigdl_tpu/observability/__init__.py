@@ -97,8 +97,8 @@ from bigdl_tpu.observability.exporters import (
 )
 from bigdl_tpu.observability.instruments import (
     FRACTION_BUCKETS, OCCUPANCY_BUCKETS, OccupancyStats, TIME_BUCKETS,
-    bench_instruments, engine_instruments, generation_instruments,
-    memory_instruments, parallel_instruments,
+    bench_instruments, engine_instruments, fleet_instruments,
+    generation_instruments, memory_instruments, parallel_instruments,
     serving_bench_instruments, serving_engine_instruments,
     serving_instruments, tenant_usage_instruments, train_instruments,
     watchdog_instruments,
@@ -136,8 +136,9 @@ __all__ = [
     "render_prometheus", "start_http_server", "write_prometheus",
     "FRACTION_BUCKETS", "OCCUPANCY_BUCKETS", "OccupancyStats",
     "TIME_BUCKETS",
-    "bench_instruments", "engine_instruments", "generation_instruments",
-    "memory_instruments", "parallel_instruments",
+    "bench_instruments", "engine_instruments", "fleet_instruments",
+    "generation_instruments", "memory_instruments",
+    "parallel_instruments",
     "serving_bench_instruments", "serving_engine_instruments",
     "serving_instruments", "tenant_usage_instruments",
     "train_instruments", "watchdog_instruments",

@@ -653,6 +653,76 @@ def serving_bench_instruments(registry: Optional[MetricRegistry] = None
             "Speculation-on vs -off engine inter-token p50 speedup on "
             "the repeated-text workload (>1.0: the draft pays for "
             "itself)"),
+        fleet_ttft_p50_speedup=lambda: r.gauge(
+            "bigdl_bench_serving_fleet_ttft_p50_speedup",
+            "Prefix-affinity vs round-robin client TTFT p50 speedup "
+            "on the multi-replica fleet storm (>1.0: routing by "
+            "content lands first tokens sooner)"),
+        fleet_hit_rate=lambda: r.gauge(
+            "bigdl_bench_serving_fleet_hit_rate",
+            "Fleet-wide prefix-cache hit rate on the affinity leg of "
+            "the multi-replica storm (sum of hits over lookups across "
+            "replicas)"),
+    )
+
+
+def fleet_instruments(fleet: str = "fleet",
+                      registry: Optional[MetricRegistry] = None
+                      ) -> SimpleNamespace:
+    """Multi-replica serving-fleet instruments
+    (``bigdl_tpu.serving.fleet``), labelled by ``fleet`` — the control
+    plane's view: how many replicas are taking traffic vs draining,
+    where the router sent each request (affinity hit vs spill vs
+    round-robin), the drain/rejoin flow, and each replica's admission
+    backlog as the router's load signal. The per-replica families are
+    returned UNBOUND (``.labels(fleet, replica)`` at the call site) —
+    replica ids are dynamic."""
+    r = registry or default_registry()
+    lbl = ("fleet",)
+    return SimpleNamespace(
+        replicas_live=r.gauge(
+            "bigdl_fleet_replicas_live",
+            "Replicas currently accepting routed traffic",
+            labelnames=lbl).labels(fleet),
+        replicas_draining=r.gauge(
+            "bigdl_fleet_replicas_draining",
+            "Replicas draining (in-flight finishing, new traffic "
+            "routed away)", labelnames=lbl).labels(fleet),
+        requests_total=r.counter(
+            "bigdl_fleet_requests_total",
+            "Requests accepted by the fleet front door / supervisor",
+            labelnames=lbl).labels(fleet),
+        routed_total=r.counter(
+            "bigdl_fleet_routed_total",
+            "Routing decisions by kind: affinity (consistent-hash "
+            "target took it), spilled (target saturated or the forced-"
+            "spill bound fired -> least-loaded), round_robin (affinity "
+            "disabled)", labelnames=("fleet", "route")),
+        rerouted_total=r.counter(
+            "bigdl_fleet_rerouted_total",
+            "Submissions re-routed after the chosen replica refused "
+            "(drain/stop race)", labelnames=lbl).labels(fleet),
+        drains_total=r.counter(
+            "bigdl_fleet_drains_total",
+            "Replica drains by reason (degraded watchdog alerts / "
+            "crashed 503 / operator)", labelnames=("fleet", "reason")),
+        rejoins_total=r.counter(
+            "bigdl_fleet_rejoins_total",
+            "Drained replicas returned to rotation", labelnames=lbl
+        ).labels(fleet),
+        disconnects_total=r.counter(
+            "bigdl_fleet_client_disconnects_total",
+            "Streaming clients that vanished mid-response (request "
+            "cancelled, slot freed)", labelnames=lbl).labels(fleet),
+        replica_queue_depth=r.gauge(
+            "bigdl_fleet_replica_queue_depth",
+            "One replica's admission-queue depth as last polled (the "
+            "router's least-loaded signal)",
+            labelnames=("fleet", "replica")),
+        replica_active_slots=r.gauge(
+            "bigdl_fleet_replica_active_slots",
+            "One replica's occupied decode slots as last polled",
+            labelnames=("fleet", "replica")),
     )
 
 

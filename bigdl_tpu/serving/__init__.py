@@ -69,8 +69,8 @@ from bigdl_tpu.serving.scheduler import (
     AdmissionQueue, PrefillPolicy, SpeculationPolicy,
 )
 from bigdl_tpu.serving.streams import (
-    EngineStopped, QueueFull, RequestCancelled, RequestError,
-    RequestHandle, RequestTimedOut,
+    EngineDraining, EngineStopped, QueueFull, RequestCancelled,
+    RequestError, RequestHandle, RequestTimedOut,
 )
 from bigdl_tpu.serving.benchmark import (
     poisson_workload, repeated_text_workload, run_poisson_comparison,
@@ -83,7 +83,7 @@ __all__ = [
     "PrefixCache", "PrefixEntry",
     "AdmissionQueue", "PrefillPolicy", "SpeculationPolicy",
     "RequestHandle", "RequestError", "RequestCancelled",
-    "RequestTimedOut", "QueueFull", "EngineStopped",
+    "RequestTimedOut", "QueueFull", "EngineStopped", "EngineDraining",
     "poisson_workload", "run_poisson_comparison",
     "shared_prefix_workload", "run_shared_prefix_comparison",
     "repeated_text_workload", "run_speculative_comparison",

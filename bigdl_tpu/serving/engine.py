@@ -88,7 +88,8 @@ from bigdl_tpu.serving.scheduler import (
     AdmissionQueue, PrefillPolicy, SpeculationPolicy,
 )
 from bigdl_tpu.serving.streams import (
-    EngineStopped, RequestCancelled, RequestHandle, RequestTimedOut,
+    EngineDraining, EngineStopped, RequestCancelled, RequestHandle,
+    RequestTimedOut,
 )
 
 
@@ -686,6 +687,7 @@ class ContinuousBatchingEngine:
         self._thread: Optional[threading.Thread] = None
         self._lifecycle = threading.Lock()
         self._crashed: Optional[BaseException] = None
+        self._draining = False
 
     # ------------------------------------------------- compiled programs
     def _build_fns(self):
@@ -1159,6 +1161,36 @@ class ContinuousBatchingEngine:
                 self._finish_handle(st.handle, err, "stopped")
                 self._slots[sid] = None
 
+    def drain(self) -> None:
+        """Stop admitting NEW requests while everything already
+        submitted (queued, prefilling, decoding) runs to completion —
+        the loop keeps iterating, the slots empty out on their own.
+        Further ``submit`` calls raise ``EngineDraining`` until
+        ``resume()``; a fleet supervisor uses this pair to take a
+        degraded replica out of rotation without dropping a single
+        in-flight request. Idempotent; observable as
+        ``healthz()["draining"]``."""
+        if self._draining:
+            return
+        self._draining = True
+        self._rec.record("engine/drain", self.service_name,
+                         service=self.service_name,
+                         in_flight=len(self._queue) + len(self._adms)
+                         + sum(s is not None for s in self._slots))
+
+    def resume(self) -> None:
+        """Lift a ``drain()``: the engine admits new requests again
+        (the rejoin half of the fleet drain lifecycle). Idempotent."""
+        if not self._draining:
+            return
+        self._draining = False
+        self._rec.record("engine/resume", self.service_name,
+                         service=self.service_name)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
     def __enter__(self):
         return self.start()
 
@@ -1192,6 +1224,10 @@ class ContinuousBatchingEngine:
         metered consumption."""
         if self._crashed is not None:
             raise EngineStopped("engine loop crashed") from self._crashed
+        if self._draining:
+            raise EngineDraining(
+                "engine is draining: in-flight requests are finishing "
+                "but new submissions are refused (resume() to rejoin)")
         prompt = np.asarray(prompt_ids, np.int32)
         if prompt.ndim != 1:
             raise ValueError("submit takes ONE request (1-D prompt), "
@@ -1393,6 +1429,12 @@ class ContinuousBatchingEngine:
                                and self._thread.is_alive()),
             "active_slots": sum(s is not None for s in self._slots),
             "queue_depth": len(self._queue),
+            # machine-readable drain state: a fleet supervisor keys on
+            # status (degraded -> drain) + draining (rejoin gate) + the
+            # in-flight count (drain completion), never on body prose
+            "draining": self._draining,
+            "in_flight": (len(self._queue) + len(self._adms)
+                          + sum(s is not None for s in self._slots)),
             "alerts": alerts,
         }
 

@@ -1,0 +1,67 @@
+"""bigdl_tpu.serving.fleet — multi-replica serving behind one door.
+
+The horizontal-scale layer over the continuous-batching engine:
+BigDL's driver/executor split (arxiv 1804.05839) recast for
+inference — one control plane owning N engine replicas, one data
+plane streaming tokens to clients over held connections (arxiv
+1805.08430: stream one-way, never per-token request/response).
+
+- ``PrefixAffinityRouter`` (``router``): consistent-hashes each
+  prompt's first prefix-cache chunk onto a virtual-node ring so
+  template-sharing requests land where the trie already holds their
+  KV — every template's cache cost is paid on ONE replica fleet-wide.
+  Saturated targets spill to the least-loaded replica, and a
+  forced-spill bound (the admission queue's bounded-bypass pattern at
+  ring scale) stops one hot template from pinning its owner.
+- ``ReplicaSupervisor`` (``supervisor``): owns the replicas
+  (``InProcessReplica`` wrappers or ``multiprocessing``
+  ``WorkerReplica`` processes), polls ``healthz()`` + load gauges,
+  DRAINS what degrades or crashes (in-flight finishes, new traffic
+  routes away), rejoins what recovers, and routes ``submit()`` calls
+  through the ring. ``bigdl_fleet_*`` instruments cover the whole
+  control plane.
+- ``FleetFrontDoor`` (``frontdoor``): the stdlib-only HTTP door —
+  ``POST /v1/generate`` streams tokens as Server-Sent Events off the
+  replica handle's iterator (client disconnect cancels the request
+  and frees the slot), ``GET /v1/stats`` aggregates per-replica
+  ``stats()`` plus the fleet prefix hit rate and routing table.
+- ``run_fleet_comparison`` (``benchmark``): the hermetic
+  multi-process affinity-vs-round-robin storm behind
+  ``bench.py --serving --fleet N``.
+
+Quick start::
+
+    from bigdl_tpu.serving import ContinuousBatchingEngine
+    from bigdl_tpu.serving.fleet import (
+        FleetFrontDoor, InProcessReplica, ReplicaSupervisor,
+    )
+
+    replicas = [InProcessReplica(f"r{i}",
+                                 ContinuousBatchingEngine(model))
+                for i in range(3)]
+    with ReplicaSupervisor(replicas) as sup, \
+         FleetFrontDoor(sup, port=8080) as door:
+        ...  # POST /v1/generate, GET /v1/stats on door.port
+"""
+
+from bigdl_tpu.serving.fleet.benchmark import run_fleet_comparison
+from bigdl_tpu.serving.fleet.frontdoor import (
+    FleetFrontDoor, start_front_door,
+)
+from bigdl_tpu.serving.fleet.router import (
+    NoLiveReplicas, PrefixAffinityRouter, RouteDecision,
+)
+from bigdl_tpu.serving.fleet.supervisor import (
+    InProcessReplica, ReplicaSupervisor, Routed,
+)
+from bigdl_tpu.serving.fleet.worker import (
+    WorkerHandle, WorkerReplica, spawn_worker_fleet,
+)
+
+__all__ = [
+    "PrefixAffinityRouter", "RouteDecision", "NoLiveReplicas",
+    "ReplicaSupervisor", "InProcessReplica", "Routed",
+    "WorkerReplica", "WorkerHandle", "spawn_worker_fleet",
+    "FleetFrontDoor", "start_front_door",
+    "run_fleet_comparison",
+]

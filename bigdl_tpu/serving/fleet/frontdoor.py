@@ -1,0 +1,265 @@
+"""The fleet's HTTP front door: streaming inference over stdlib HTTP.
+
+One ``ThreadingHTTPServer`` (the ``MetricsHTTPServer`` idiom — no
+framework, no dependencies) in front of a ``ReplicaSupervisor``:
+
+- ``POST /v1/generate`` — body ``{"prompt_ids": [...],
+  "max_new_tokens": N, "tenant": ..., "priority": "high|normal|low",
+  "stream": true}``. The streaming default answers with Server-Sent
+  Events driven directly by the replica handle's token iterator — one
+  held connection, tokens flowing one way as the engine decodes
+  (PAPERS.md, "RPC Considered Harmful" — never a per-token
+  request/response):
+
+  ``event: meta``  — ``{request_id, replica, route}`` (where the
+  router placed it, first thing on the wire);
+  ``data:`` lines — ``{"token": t, "index": i}`` per decoded token;
+  ``event: done`` — the terminal summary (token count, timeline).
+
+  A client that disappears mid-stream is detected by the failed
+  socket write and the request is CANCELLED into the engine — the
+  slot frees immediately instead of decoding tokens nobody will read
+  (``bigdl_fleet_client_disconnects_total``). ``"stream": false``
+  returns one JSON body after completion. Backpressure maps to HTTP:
+  ``QueueFull`` -> 429, fleet down -> 503, bad request -> 400.
+- ``GET /v1/stats`` — the supervisor's fleet-wide aggregate: per-
+  replica ``stats()``, the fleet prefix hit rate, the routing table.
+- ``GET /v1/replicas`` — just the routing table (the ``serve.py
+  --fleet`` demo's table source).
+- ``GET /healthz`` — 200 with the fleet health dict; 503 once no
+  replica can take traffic (same crashed-loop convention as the
+  engine endpoint).
+- ``GET /metrics`` — Prometheus text, ``bigdl_fleet_*`` included.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional
+
+from bigdl_tpu.observability.exporters import (
+    PROMETHEUS_CONTENT_TYPE, render_prometheus,
+)
+from bigdl_tpu.observability.metrics import default_registry
+from bigdl_tpu.serving.fleet.router import NoLiveReplicas
+from bigdl_tpu.serving.streams import (
+    EngineDraining, EngineStopped, QueueFull, RequestCancelled,
+    RequestTimedOut,
+)
+
+__all__ = ["FleetFrontDoor", "start_front_door"]
+
+_MAX_BODY = 8 << 20  # refuse absurd request bodies before parsing
+
+
+class FleetFrontDoor:
+    """Serve a ``ReplicaSupervisor`` over HTTP. ``port=0`` binds an
+    ephemeral port — read it back from ``.port``. Context manager;
+    ``close()`` stops the listener (the supervisor's lifecycle stays
+    the caller's)."""
+
+    def __init__(self, supervisor, host: str = "127.0.0.1",
+                 port: int = 0, registry=None):
+        from http.server import (
+            BaseHTTPRequestHandler, ThreadingHTTPServer,
+        )
+
+        sup = supervisor
+        ins = sup._ins
+        get_registry = (lambda: registry) if registry is not None \
+            else default_registry
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _send_json(self, payload, status: int = 200):
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            # ------------------------------------------------ streaming
+            def _sse(self, event: Optional[str], payload: dict) -> None:
+                chunk = b""
+                if event:
+                    chunk += b"event: " + event.encode() + b"\n"
+                chunk += b"data: " + json.dumps(payload).encode() \
+                    + b"\n\n"
+                self.wfile.write(chunk)
+                self.wfile.flush()
+
+            def _generate(self, req: dict) -> None:
+                prompt = req.get("prompt_ids")
+                if not isinstance(prompt, list) or not prompt \
+                        or not all(isinstance(t, int) for t in prompt):
+                    return self._send_json(
+                        {"error": "prompt_ids must be a non-empty "
+                                  "list of ints"}, 400)
+                try:
+                    n = int(req.get("max_new_tokens", 32))
+                except (TypeError, ValueError):
+                    return self._send_json(
+                        {"error": "max_new_tokens must be an int"}, 400)
+                stream = bool(req.get("stream", True))
+                try:
+                    routed = sup.submit(
+                        prompt, n, tenant=req.get("tenant"),
+                        priority=req.get("priority", "normal"),
+                        timeout_s=req.get("timeout_s"))
+                except QueueFull as e:
+                    return self._send_json(
+                        {"error": f"fleet saturated: {e}"}, 429)
+                except (NoLiveReplicas, EngineStopped,
+                        EngineDraining) as e:
+                    return self._send_json(
+                        {"error": f"fleet unavailable: {e}"}, 503)
+                except ValueError as e:
+                    return self._send_json({"error": str(e)}, 400)
+                h = routed.handle
+                meta = {"request_id": getattr(h, "request_id", None),
+                        "replica": routed.replica,
+                        "route": routed.route}
+                if not stream:
+                    return self._collect(h, meta)
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                # SSE is an unbounded stream: no Content-Length; close
+                # delimits the body
+                self.send_header("Connection", "close")
+                self.end_headers()
+                delivered = 0
+                try:
+                    self._sse("meta", meta)
+                    for tok in h.tokens():
+                        self._sse(None, {"token": int(tok),
+                                         "index": delivered})
+                        delivered += 1
+                    self._sse("done", {**meta, "tokens": delivered,
+                                       "timeline": h.timeline()})
+                except (BrokenPipeError, ConnectionResetError,
+                        OSError):
+                    # the client vanished mid-stream: cancel into the
+                    # engine so the slot frees NOW instead of decoding
+                    # to an audience of zero
+                    h.cancel()
+                    ins.disconnects_total.inc()
+                except RequestCancelled:
+                    try:
+                        self._sse("error", {**meta,
+                                            "error": "cancelled",
+                                            "tokens": delivered})
+                    except OSError:
+                        pass
+                except (RequestTimedOut, EngineStopped) as e:
+                    try:
+                        self._sse("error", {
+                            **meta, "error": type(e).__name__,
+                            "detail": str(e), "tokens": delivered})
+                    except OSError:
+                        pass
+
+            def _collect(self, h, meta: dict) -> None:
+                try:
+                    toks = h.result(timeout=None) \
+                        if hasattr(h, "result") else list(h.tokens())
+                    toks = [int(t) for t in toks]
+                except RequestCancelled:
+                    return self._send_json(
+                        {**meta, "error": "cancelled"}, 499)
+                except RequestTimedOut as e:
+                    return self._send_json(
+                        {**meta, "error": "timeout",
+                         "detail": str(e)}, 504)
+                except EngineStopped as e:
+                    return self._send_json(
+                        {**meta, "error": "engine stopped",
+                         "detail": str(e)}, 503)
+                # in-process handles' result() includes the prompt —
+                # normalize to generated-only via the timeline count
+                tl = h.timeline() if hasattr(h, "timeline") else {}
+                gen = tl.get("tokens")
+                if gen is not None and len(toks) > gen:
+                    toks = toks[-gen:]
+                self._send_json({**meta, "tokens": toks,
+                                 "timeline": tl})
+
+            # ------------------------------------------------- requests
+            def do_POST(self):  # noqa: N802 (stdlib handler contract)
+                path = self.path.partition("?")[0]
+                if path != "/v1/generate":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    if not 0 < length <= _MAX_BODY:
+                        return self._send_json(
+                            {"error": "missing or oversized body"}, 400)
+                    req = json.loads(self.rfile.read(length))
+                    if not isinstance(req, dict):
+                        raise ValueError("body must be a JSON object")
+                except (ValueError, json.JSONDecodeError) as e:
+                    return self._send_json(
+                        {"error": f"bad request body: {e}"}, 400)
+                self._generate(req)
+
+            def do_GET(self):  # noqa: N802 (stdlib handler contract)
+                path = self.path.partition("?")[0]
+                if path == "/v1/stats":
+                    try:
+                        self._send_json(sup.stats())
+                    except Exception as e:
+                        self._send_json({"error": str(e)}, 500)
+                elif path == "/v1/replicas":
+                    self._send_json(sup.routing_table())
+                elif path == "/healthz":
+                    try:
+                        self._send_json(sup.healthz())
+                    except Exception as e:
+                        self._send_json(
+                            {"status": "unhealthy", "error": str(e)},
+                            503)
+                elif path == "/metrics":
+                    body = render_prometheus(get_registry()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     PROMETHEUS_CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+            def log_message(self, *args):  # silence request spam
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="fleet-front-door",
+            daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def start_front_door(supervisor, host: str = "127.0.0.1",
+                     port: int = 0, registry=None) -> FleetFrontDoor:
+    """Convenience wrapper: start and return a ``FleetFrontDoor``."""
+    return FleetFrontDoor(supervisor, host=host, port=port,
+                          registry=registry)

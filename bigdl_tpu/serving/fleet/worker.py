@@ -1,0 +1,441 @@
+"""Out-of-process replicas: one engine per worker process.
+
+The bench's deployment shape (and the template for a real multi-host
+fleet): each replica is a ``multiprocessing`` *spawn* worker that
+builds its own model + ``ContinuousBatchingEngine`` on its own device
+slice (a fresh process means a fresh XLA client — on CPU each worker
+gets its own host device; on real hardware ``env`` pins
+``JAX_PLATFORMS`` / visible-device flags per worker). The parent talks
+to it over one duplex ``Pipe`` with a tiny message protocol, streaming
+tokens one-way as they decode — never per-token request/response
+(PAPERS.md, "RPC Considered Harmful"):
+
+parent -> worker   ``{op: submit|cancel|healthz|stats|drain|resume|stop}``
+worker -> parent   ``{ev: ready|token|done|error|reply|bye}``
+
+``WorkerReplica`` implements the supervisor's replica protocol;
+``WorkerHandle`` mirrors the ``RequestHandle`` streaming surface
+(``tokens()`` / ``result()`` / ``cancel()``) with TTFT stamped on the
+PARENT's clock at first-token receipt — monotonic clocks don't agree
+across processes, and the router's A/B numbers must be measured where
+the client sits.
+
+Model/engine config crosses the fork as plain dicts (spawn pickles
+them), so every worker built from the same ``cfg`` + seed holds a
+bit-identical model — the fleet bench's token-parity oracle relies on
+it.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import queue as queue_mod
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from bigdl_tpu.serving.streams import (
+    EngineDraining, EngineStopped, QueueFull, RequestCancelled,
+    RequestError, RequestTimedOut,
+)
+
+__all__ = ["WorkerHandle", "WorkerReplica", "spawn_worker_fleet"]
+
+_ERRORS = {
+    "RequestCancelled": RequestCancelled,
+    "RequestTimedOut": RequestTimedOut,
+    "RequestError": RequestError,
+    "QueueFull": QueueFull,
+    "EngineStopped": EngineStopped,
+    "EngineDraining": EngineDraining,
+}
+
+
+def _worker_main(conn, cfg: dict) -> None:
+    """Worker entry point (spawn target — must stay top-level).
+
+    Applies ``cfg["env"]`` BEFORE importing jax (device-slice pinning
+    has to precede backend init), builds the seeded model + engine,
+    acks ``ready``, then serves the op loop until ``stop``/EOF."""
+    import os
+
+    for k, v in (cfg.get("env") or {}).items():
+        os.environ[k] = str(v)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.serving import ContinuousBatchingEngine
+    from bigdl_tpu.utils import random as rnd
+
+    send_lock = threading.Lock()
+
+    def send(msg: dict) -> None:
+        with send_lock:
+            try:
+                conn.send(msg)
+            except (OSError, EOFError, BrokenPipeError):
+                pass
+
+    try:
+        rnd.set_seed(cfg.get("seed", 7))
+        model = TransformerLM(**cfg["model"])
+        model.evaluate()
+        eng = ContinuousBatchingEngine(
+            model, service_name=cfg.get("service", "worker"),
+            **(cfg.get("engine") or {}))
+        eng.start()
+    except Exception as e:
+        send({"ev": "ready", "error": repr(e)})
+        return
+    send({"ev": "ready"})
+
+    handles: Dict[str, object] = {}
+    cancelled: set = set()
+
+    def submit_and_pump(rid: str, msg: dict) -> None:
+        # runs on its own thread: a blocking put on a full admission
+        # queue must never stall the op loop (healthz polls keep
+        # answering mid-storm)
+        toks: List[int] = []
+        try:
+            h = eng.submit(
+                np.asarray(msg["prompt"], np.int32),
+                msg["max_new"], tenant=msg.get("tenant"),
+                timeout_s=msg.get("timeout_s"),
+                block=msg.get("block", True))
+        except Exception as e:
+            send({"ev": "error", "rid": rid,
+                  "kind": type(e).__name__, "msg": str(e),
+                  "tokens": []})
+            return
+        handles[rid] = h
+        if rid in cancelled:  # cancel raced the blocking submit
+            cancelled.discard(rid)
+            h.cancel()
+        try:
+            for tok in h.tokens():
+                toks.append(int(tok))
+                send({"ev": "token", "rid": rid, "tok": int(tok)})
+            send({"ev": "done", "rid": rid, "tokens": toks,
+                  "timeline": h.timeline()})
+        except Exception as e:
+            send({"ev": "error", "rid": rid,
+                  "kind": type(e).__name__, "msg": str(e),
+                  "tokens": toks})
+        finally:
+            handles.pop(rid, None)
+
+    while True:
+        try:
+            msg = conn.recv()
+        except (EOFError, OSError):
+            break
+        op = msg.get("op")
+        if op == "submit":
+            threading.Thread(target=submit_and_pump,
+                             args=(msg["rid"], msg),
+                             daemon=True).start()
+        elif op == "cancel":
+            h = handles.get(msg["rid"])
+            if h is not None:
+                h.cancel()
+            else:
+                cancelled.add(msg["rid"])
+        elif op in ("healthz", "stats"):
+            try:
+                payload = (eng.healthz() if op == "healthz"
+                           else eng.stats())
+                send({"ev": "reply", "seq": msg["seq"],
+                      "payload": payload})
+            except Exception as e:
+                send({"ev": "reply", "seq": msg["seq"],
+                      "kind": type(e).__name__, "error": str(e)})
+        elif op in ("drain", "resume"):
+            getattr(eng, op)()
+            send({"ev": "reply", "seq": msg["seq"], "payload": True})
+        elif op == "stop":
+            try:
+                eng.stop(drain=msg.get("drain", True),
+                         timeout=msg.get("timeout", 10.0))
+            finally:
+                send({"ev": "bye"})
+            break
+    conn.close()
+
+
+class WorkerHandle:
+    """Parent-side view of one streaming request in a worker."""
+
+    def __init__(self, rid: str, replica: "WorkerReplica"):
+        self.request_id = rid
+        self._replica = replica
+        self._q: "queue_mod.Queue" = queue_mod.Queue()
+        self.submitted_at = time.monotonic()
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self._tokens: List[int] = []
+        self._timeline: Optional[dict] = None
+        self._error: Optional[tuple] = None
+        self._done_evt = threading.Event()
+
+    # fed by the replica's reader thread
+    def _push(self, msg: dict) -> None:
+        ev = msg["ev"]
+        if ev == "token":
+            if self.first_token_at is None:
+                self.first_token_at = time.monotonic()
+            self._tokens.append(msg["tok"])
+        elif ev == "done":
+            self._timeline = msg.get("timeline")
+            self.finished_at = time.monotonic()
+            self._done_evt.set()
+        elif ev == "error":
+            self._error = (msg.get("kind", "RequestError"),
+                           msg.get("msg", ""))
+            self.finished_at = time.monotonic()
+            self._done_evt.set()
+        self._q.put(msg)
+
+    def _raise_error(self):
+        kind, text = self._error
+        raise _ERRORS.get(kind, RequestError)(text)
+
+    def tokens(self):
+        """Stream generated token ids as the worker delivers them
+        (terminal errors raise after the delivered prefix, matching
+        ``RequestHandle.tokens()``)."""
+        i = 0
+        while True:
+            # replay anything already received, then block for more
+            if i < len(self._tokens):
+                yield self._tokens[i]
+                i += 1
+                continue
+            if self._done_evt.is_set() and self._q.empty():
+                if self._error is not None:
+                    self._raise_error()
+                return
+            try:
+                self._q.get(timeout=0.1)
+            except queue_mod.Empty:
+                if not self._replica.alive():
+                    self._error = self._error or (
+                        "EngineStopped", "worker process died")
+                    self._done_evt.set()
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until terminal; returns the GENERATED token ids (the
+        parity row — prompt not included)."""
+        if not self._done_evt.wait(timeout):
+            raise RequestTimedOut(
+                f"no terminal event within {timeout}s")
+        if self._error is not None:
+            self._raise_error()
+        return list(self._tokens)
+
+    def cancel(self) -> None:
+        self._replica._send({"op": "cancel", "rid": self.request_id})
+
+    def done(self) -> bool:
+        return self._done_evt.is_set()
+
+    def tokens_so_far(self) -> List[int]:
+        return list(self._tokens)
+
+    def timeline(self) -> dict:
+        """The worker engine's own timeline, augmented with the
+        parent-measured TTFT (``client_ttft_s``) — the number the
+        fleet bench reports, since it includes routing + IPC."""
+        tl = dict(self._timeline or {})
+        if self.first_token_at is not None:
+            tl["client_ttft_s"] = self.first_token_at \
+                - self.submitted_at
+        if self.finished_at is not None:
+            tl["client_total_s"] = self.finished_at - self.submitted_at
+        return tl
+
+
+class WorkerReplica:
+    """Supervisor replica protocol over one spawn worker process."""
+
+    def __init__(self, rid: str, cfg: dict,
+                 start_timeout: float = 120.0):
+        self.id = rid
+        self._cfg = dict(cfg)
+        self._cfg.setdefault("service", rid)
+        self._start_timeout = start_timeout
+        self._proc: Optional[mp.process.BaseProcess] = None
+        self._conn = None
+        self._reader: Optional[threading.Thread] = None
+        self._send_lock = threading.Lock()
+        self._reply_lock = threading.Lock()
+        self._replies: "queue_mod.Queue" = queue_mod.Queue()
+        self._handles: Dict[str, WorkerHandle] = {}
+        self._handles_lock = threading.Lock()
+        self._seq = 0
+        self._next_rid = 0
+        self._ready = threading.Event()
+        self._ready_error: Optional[str] = None
+
+    # ------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        if self._proc is not None and self._proc.is_alive():
+            return
+        ctx = mp.get_context("spawn")
+        self._conn, child = ctx.Pipe(duplex=True)
+        self._proc = ctx.Process(
+            target=_worker_main, args=(child, self._cfg),
+            name=f"fleet-{self.id}", daemon=True)
+        self._proc.start()
+        child.close()
+        self._reader = threading.Thread(
+            target=self._read_loop, name=f"fleet-{self.id}-reader",
+            daemon=True)
+        self._reader.start()
+        deadline = time.monotonic() + self._start_timeout
+        while not self._ready.wait(0.2):
+            if not self._proc.is_alive():
+                raise EngineStopped(
+                    f"worker {self.id} died during startup "
+                    f"(exitcode {self._proc.exitcode})")
+            if time.monotonic() > deadline:
+                raise EngineStopped(
+                    f"worker {self.id} did not come up within "
+                    f"{self._start_timeout}s")
+        if self._ready_error is not None:
+            raise EngineStopped(
+                f"worker {self.id} failed to start: "
+                f"{self._ready_error}")
+
+    def alive(self) -> bool:
+        return self._proc is not None and self._proc.is_alive()
+
+    def stop(self, timeout: float = 15.0) -> None:
+        if self._proc is None:
+            return
+        try:
+            self._send({"op": "stop", "drain": True,
+                        "timeout": max(0.0, timeout - 5.0)})
+        except Exception:
+            pass
+        self._proc.join(timeout=timeout)
+        if self._proc.is_alive():
+            self._proc.terminate()
+            self._proc.join(timeout=5)
+        self._fail_all("worker stopped")
+
+    # ---------------------------------------------------------- plumbing
+    def _send(self, msg: dict) -> None:
+        with self._send_lock:
+            if self._conn is None:
+                raise EngineStopped(f"worker {self.id} not started")
+            try:
+                self._conn.send(msg)
+            except (OSError, EOFError, BrokenPipeError) as e:
+                raise EngineStopped(
+                    f"worker {self.id} pipe closed") from e
+
+    def _read_loop(self) -> None:
+        while True:
+            try:
+                msg = self._conn.recv()
+            except (EOFError, OSError):
+                break
+            ev = msg.get("ev")
+            if ev == "ready":
+                self._ready_error = msg.get("error")
+                self._ready.set()
+            elif ev in ("token", "done", "error"):
+                with self._handles_lock:
+                    h = self._handles.get(msg["rid"])
+                    if ev in ("done", "error"):
+                        self._handles.pop(msg["rid"], None)
+                if h is not None:
+                    h._push(msg)
+            elif ev == "reply":
+                self._replies.put(msg)
+            elif ev == "bye":
+                break
+        self._fail_all("worker pipe closed")
+
+    def _fail_all(self, why: str) -> None:
+        with self._handles_lock:
+            pending, self._handles = dict(self._handles), {}
+        for h in pending.values():
+            h._push({"ev": "error", "kind": "EngineStopped",
+                     "msg": why})
+
+    def _call(self, op: str, timeout: float = 30.0):
+        """One control round-trip (serialized: one outstanding call)."""
+        with self._reply_lock:
+            self._seq += 1
+            seq = self._seq
+            self._send({"op": op, "seq": seq})
+            deadline = time.monotonic() + timeout
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise EngineStopped(
+                        f"worker {self.id}: no {op} reply in "
+                        f"{timeout}s")
+                try:
+                    msg = self._replies.get(timeout=min(remaining, 0.5))
+                except queue_mod.Empty:
+                    if not self.alive():
+                        raise EngineStopped(
+                            f"worker {self.id} process died")
+                    continue
+                if msg.get("seq") != seq:
+                    continue  # stale reply from a timed-out call
+                if "error" in msg:
+                    raise _ERRORS.get(msg.get("kind", ""),
+                                      EngineStopped)(msg["error"])
+                return msg.get("payload")
+
+    # ------------------------------------------------ replica protocol
+    def submit(self, prompt_ids, max_new_tokens: int,
+               tenant: Optional[str] = None,
+               timeout_s: Optional[float] = None,
+               block: bool = True) -> WorkerHandle:
+        if not self.alive():
+            raise EngineStopped(f"worker {self.id} process died")
+        self._next_rid += 1
+        rid = f"{self.id}-{self._next_rid}"
+        h = WorkerHandle(rid, self)
+        with self._handles_lock:
+            self._handles[rid] = h
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        self._send({"op": "submit", "rid": rid,
+                    "prompt": [int(t) for t in prompt],
+                    "max_new": int(max_new_tokens), "tenant": tenant,
+                    "timeout_s": timeout_s, "block": block})
+        return h
+
+    def healthz(self) -> dict:
+        return self._call("healthz", timeout=10.0)
+
+    def stats(self) -> dict:
+        return self._call("stats", timeout=30.0)
+
+    def drain(self) -> None:
+        self._call("drain", timeout=10.0)
+
+    def resume(self) -> None:
+        self._call("resume", timeout=10.0)
+
+
+def spawn_worker_fleet(n: int, model: dict, engine: Optional[dict]
+                       = None, seed: int = 7,
+                       env: Optional[dict] = None,
+                       prefix: str = "r") -> List[WorkerReplica]:
+    """Build (NOT start) ``n`` same-seed worker replicas — the
+    supervisor's ``start()`` brings them up. Same ``model``/``seed``
+    in every worker means bit-identical params, so any replica's
+    greedy output is every replica's greedy output (the fleet bench's
+    token-parity invariant)."""
+    cfg = {"model": dict(model), "engine": dict(engine or {}),
+           "seed": seed, "env": dict(env or {})}
+    return [WorkerReplica(f"{prefix}{i}", dict(cfg, service=f"{prefix}{i}"))
+            for i in range(n)]

@@ -44,6 +44,12 @@ class EngineStopped(RuntimeError):
     """The engine stopped (or crashed) before the request completed."""
 
 
+class EngineDraining(RuntimeError):
+    """The engine is draining: new submissions are refused while the
+    requests already in flight run to completion (``engine.drain()``;
+    a fleet router treats this as "route elsewhere and retry")."""
+
+
 #: terminal sentinel on the token stream
 _DONE = object()
 

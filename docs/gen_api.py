@@ -26,6 +26,9 @@ PACKAGES = [
     ("bigdl_tpu.dlframes", "DataFrame estimator layer"),
     ("bigdl_tpu.models", "Model zoo"),
     ("bigdl_tpu.serving", "Continuous-batching inference engine"),
+    ("bigdl_tpu.serving.fleet",
+     "Multi-replica serving fleet: supervisor, affinity router, "
+     "HTTP front door"),
     ("bigdl_tpu.observability", "Metrics registry, tracing, exporters"),
     ("bigdl_tpu.visualization", "TrainSummary / ValidationSummary"),
     ("bigdl_tpu.utils", "Serialization, import/export, config"),

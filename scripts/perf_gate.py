@@ -29,8 +29,13 @@ against the previous sharded run of the same mesh width), and
 ``bench.py --serving --shared-prefix --working-set N``
 (``detail.tiered.*`` plus ``detail.headline.tiered_hit_rate`` — the
 tiered prefix-cache sweep additionally gates the headline hit rate,
-higher-is-better, and the tiered leg's p50 TTFT); all five shapes are
-understood. Stdlib only — runnable from any CI step without the
+higher-is-better, and the tiered leg's p50 TTFT), and ``bench.py
+--serving --fleet N`` (``detail.affinity.*`` — the multi-replica A/B
+additionally gates the fleet-wide prefix hit rate run-to-run and the
+affinity-vs-round-robin TTFT p50 speedup as an absolute floor: the
+speedup is itself a within-run A/B ratio, so it must stay >= 1.0
+rather than within a band of the previous row's value); all six
+shapes are understood. Stdlib only — runnable from any CI step without the
 package installed.
 
 Usage::
@@ -49,8 +54,10 @@ import sys
 #: detail keys that hold a serving result with a ``ttft`` percentile
 #: block, in precedence order (--serving vs --serving --shared-prefix
 #: vs --serving --speculative vs --serving --tp vs --serving
-#: --shared-prefix --working-set — each row shape carries exactly one)
-_TTFT_PATHS = ("engine", "cached", "spec", "sharded", "tiered")
+#: --shared-prefix --working-set vs --serving --fleet — each row shape
+#: carries exactly one)
+_TTFT_PATHS = ("engine", "cached", "spec", "sharded", "tiered",
+               "affinity")
 
 
 def _p99(row: dict, measure: str):
@@ -104,6 +111,33 @@ def tiered_ttft_p50(row: dict):
     block = (row.get("detail") or {}).get("tiered") or {}
     p50 = (block.get("ttft") or {}).get("p50")
     return float(p50) if p50 is not None else None
+
+
+def fleet_ttft_speedup(row: dict):
+    """The fleet A/B row's affinity-vs-round-robin client TTFT p50
+    speedup (>1.0: content-aware routing lands first tokens sooner),
+    or None for every other row shape. Keyed off the ``affinity`` leg
+    block — shared-prefix rows carry a ``ttft_p50_speedup`` too, but
+    it measures cache-on-vs-off, not routing. Gated as a floor (must
+    stay >= 1.0), not run-to-run: the value is already a within-run
+    A/B ratio, so comparing it against the previous row's ratio
+    double-normalizes two noisy small-sample p50s."""
+    detail = row.get("detail") or {}
+    if not detail.get("affinity"):
+        return None
+    sp = detail.get("ttft_p50_speedup")
+    return float(sp) if sp is not None else None
+
+
+def fleet_hit_rate(row: dict):
+    """The fleet A/B row's fleet-wide prefix hit rate on the affinity
+    leg (hits over lookups summed across replicas), or None for every
+    other row shape and for rows predating the field. Higher is
+    better — the gate inverts the direction."""
+    fl = ((row.get("detail") or {}).get("affinity") or {}).get("fleet") \
+        or {}
+    hr = fl.get("hit_rate")
+    return float(hr) if hr is not None else None
 
 
 def signature(row: dict):
@@ -198,6 +232,10 @@ def main(argv=None) -> int:
         # buying their TTFT
         ("tiered hit rate", tiered_hit_rate, 100.0, "%", True),
         ("tiered p50 TTFT", tiered_ttft_p50, 1e3, "ms", False),
+        # fleet A/B rows only (skip-if-absent): the fleet must keep
+        # buying its affinity hit rate (deterministic per workload, so
+        # run-to-run ratio gating is stable)
+        ("fleet hit rate", fleet_hit_rate, 100.0, "%", True),
     )
     for label, reader, scale, unit, higher_better in measures:
         new_v, old_v = reader(newest), reader(prev)
@@ -223,6 +261,20 @@ def main(argv=None) -> int:
         else:
             print(f"[perf-gate] ok: {verdict} within the "
                   f"+{args.threshold:.0%} budget")
+    # fleet A/B rows: the speedup is already a within-run ratio
+    # (affinity vs round-robin on the same storm), so it gates as an
+    # absolute floor — affinity must still beat round-robin — instead
+    # of a band around the previous row's equally-noisy ratio
+    sp = fleet_ttft_speedup(newest)
+    if sp is not None:
+        verdict = (f"fleet TTFT speedup {sp:.3f}x for "
+                   f"{newest.get('metric')} {span}")
+        if sp < 1.0:
+            print(f"[perf-gate] FAIL: {verdict} — affinity routing no "
+                  "longer beats round-robin (floor 1.0x)")
+            failed = True
+        else:
+            print(f"[perf-gate] ok: {verdict} clears the 1.0x floor")
     return 1 if failed else 0
 
 

@@ -1,0 +1,412 @@
+"""Serving fleet (bigdl_tpu/serving/fleet/): supervisor, engine drain
+lifecycle, and the HTTP front door.
+
+The contracts under test: ``engine.drain()`` refuses new admissions
+while in-flight requests finish (and ``healthz()`` reports it machine-
+readably); the ``ReplicaSupervisor`` auto-drains a degraded/crashed
+replica and rejoins it on a clean probe (operator drains stay down);
+fleet routing never changes tokens (parity with a lone
+``model.generate``); draining a replica mid-flight loses nothing; and
+the SSE front door streams tokens, maps backpressure to HTTP codes,
+and CANCELS a request whose client disconnects mid-decode so the slot
+frees (the regression the ``bigdl_fleet_client_disconnects_total``
+counter exists for). Everything in-process — the multi-process worker
+path is exercised by ``bench.py --serving --fleet``."""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.observability import MetricRegistry
+from bigdl_tpu.serving import (
+    ContinuousBatchingEngine, EngineDraining,
+)
+from bigdl_tpu.serving.fleet import (
+    FleetFrontDoor, InProcessReplica, NoLiveReplicas, ReplicaSupervisor,
+)
+
+VOCAB = 32
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(21)
+    m = TransformerLM(VOCAB, embed_dim=16, num_heads=4, num_kv_heads=2,
+                      num_layers=2, max_len=48, use_rope=True)
+    m.evaluate()
+    return m
+
+
+def _direct(lm, prompt, n):
+    return np.asarray(
+        lm.generate(jnp.asarray(np.asarray(prompt))[None], n))[0]
+
+
+# --------------------------------------------------------------- engine
+def test_engine_healthz_is_machine_readable(lm):
+    with ContinuousBatchingEngine(lm, max_slots=1,
+                                  prefill_chunk=4) as eng:
+        hz = eng.healthz()
+        assert hz["status"] == "ok"
+        assert hz["draining"] is False
+        assert hz["alerts"] == []
+        assert hz["in_flight"] == 0
+        h = eng.submit(np.asarray([1, 2, 3]), 4)
+        assert eng.healthz()["in_flight"] >= 1
+        h.result(timeout=60)
+
+
+def test_engine_drain_refuses_new_lets_inflight_finish(lm):
+    p = np.asarray([5, 1, 2, 3])
+    with ContinuousBatchingEngine(lm, max_slots=1,
+                                  prefill_chunk=4) as eng:
+        h = eng.submit(p, 12)
+        eng.drain()
+        assert eng.draining and eng.healthz()["draining"] is True
+        with pytest.raises(EngineDraining):
+            eng.submit(np.asarray([1, 2]), 4)
+        # the in-flight request is untouched by the drain
+        np.testing.assert_array_equal(h.result(timeout=60),
+                                      _direct(lm, p, 12))
+        eng.drain()   # idempotent
+        eng.resume()
+        assert not eng.draining
+        h2 = eng.submit(np.asarray([2, 4]), 4)
+        np.testing.assert_array_equal(
+            h2.result(timeout=60), _direct(lm, np.asarray([2, 4]), 4))
+
+
+# ----------------------------------------------------------- supervisor
+class FakeReplica:
+    """Replica-protocol stub: scripted health, recorded lifecycle
+    calls, optional submit refusal — the supervisor's control plane
+    tested with no engines at all."""
+
+    def __init__(self, rid, status="ok"):
+        self.id = rid
+        self.status = status      # str, or an Exception to raise
+        self.calls = []
+        self.submitted = []
+        self.refuse = None        # exception submit() should raise
+
+    def healthz(self):
+        if isinstance(self.status, Exception):
+            raise self.status
+        return {"status": self.status, "alerts": [], "draining": False,
+                "queue_depth": 0, "active_slots": len(self.submitted)}
+
+    def submit(self, prompt_ids, max_new_tokens, tenant=None,
+               timeout_s=None, block=True):
+        if self.refuse is not None:
+            raise self.refuse
+        self.submitted.append(list(np.asarray(prompt_ids)))
+        return f"handle-{self.id}-{len(self.submitted)}"
+
+    def stats(self):
+        return {"finished": len(self.submitted)}
+
+    def drain(self):
+        self.calls.append("drain")
+
+    def resume(self):
+        self.calls.append("resume")
+
+    def start(self):
+        self.calls.append("start")
+
+    def stop(self):
+        self.calls.append("stop")
+
+
+def _fake_fleet(n=2, **kw):
+    reps = [FakeReplica(f"r{i}") for i in range(n)]
+    kw.setdefault("poll_interval", 999.0)  # poll_once() drives tests
+    kw.setdefault("registry", MetricRegistry())
+    kw.setdefault("chunk", 4)
+    return reps, ReplicaSupervisor(reps, **kw)
+
+
+def test_supervisor_auto_drains_degraded_and_rejoins():
+    (r0, r1), sup = _fake_fleet()
+    with sup:
+        assert sup.healthz()["status"] == "ok"
+        r0.status = "degraded"
+        sup.poll_once()
+        assert sup.router.draining == ["r0"]
+        assert "drain" in r0.calls
+        hz = sup.healthz()
+        assert hz["status"] == "degraded"
+        assert hz["drain_reasons"] == {"r0": "degraded"}
+        r0.status = "ok"
+        sup.poll_once()
+        assert sup.router.draining == []
+        assert "resume" in r0.calls
+
+
+def test_supervisor_drains_crashed_probe_and_recovers():
+    (r0, r1), sup = _fake_fleet()
+    with sup:
+        r0.status = RuntimeError("decode loop died")
+        sup.poll_once()
+        assert sup.healthz()["drain_reasons"] == {"r0": "crashed"}
+        r0.status = "ok"
+        sup.poll_once()
+        assert sup.healthz()["status"] == "ok"
+
+
+def test_operator_drain_never_auto_rejoins():
+    (r0, r1), sup = _fake_fleet()
+    with sup:
+        sup.drain("r0")
+        sup.poll_once()   # probe is clean, but the drain was manual
+        assert sup.router.draining == ["r0"]
+        sup.rejoin("r0")
+        assert sup.router.draining == []
+    with pytest.raises(KeyError):
+        sup.drain("nope")
+
+
+def test_submit_reroutes_when_the_target_refuses():
+    (r0, r1), sup = _fake_fleet()
+    with sup:
+        # find a prompt whose ring owner is r0, then make r0 refuse
+        p = next([i, i + 1, 2, 3] for i in range(64)
+                 if sup.router.owner(
+                     sup.router.key_for([i, i + 1, 2, 3])) == "r0")
+        r0.refuse = EngineDraining("draining")
+        routed = sup.submit(p, 4)
+        assert routed.replica == "r1" and routed.route == "spilled"
+        assert r1.submitted and not r0.submitted
+        # both refusing exhausts the fleet: the error propagates
+        r1.refuse = EngineDraining("draining")
+        with pytest.raises(EngineDraining):
+            sup.submit(p, 4)
+
+
+def test_all_drained_raises_no_live_replicas():
+    (r0, r1), sup = _fake_fleet()
+    with sup:
+        sup.drain("r0")
+        sup.drain("r1")
+        with pytest.raises(NoLiveReplicas):
+            sup.submit([1, 2, 3], 4)
+        with pytest.raises(NoLiveReplicas):
+            sup.healthz()
+
+
+def test_round_robin_policy_cycles():
+    (r0, r1), sup = _fake_fleet(policy="round_robin")
+    with sup:
+        seen = [sup.submit([9, 9, 9], 2).replica for _ in range(4)]
+        assert seen == ["r0", "r1", "r0", "r1"]
+        assert all(rt == "round_robin" for rt in
+                   (sup.submit([1, 2], 2).route,))
+
+
+def test_fake_fleet_stats_aggregate():
+    (r0, r1), sup = _fake_fleet()
+    with sup:
+        sup.submit([1, 2, 3], 2)
+        st = sup.stats()
+        assert st["finished"] == 1
+        assert set(st["replicas"]) == {"r0", "r1"}
+        assert "routing" in st and "prefix_cache" in st
+
+
+# ----------------------------------------------- in-process fleet + HTTP
+def _engine_fleet(lm, n=2, **eng_kw):
+    eng_kw.setdefault("max_slots", 2)
+    eng_kw.setdefault("prefill_chunk", 4)
+    reps = [InProcessReplica(
+        f"r{i}", ContinuousBatchingEngine(lm, **eng_kw))
+        for i in range(n)]
+    return reps, ReplicaSupervisor(
+        reps, chunk=4, poll_interval=0.05, registry=MetricRegistry())
+
+
+def test_fleet_routing_never_changes_tokens(lm):
+    r = np.random.RandomState(3)
+    reqs = [(r.randint(0, VOCAB, (t0,)), n)
+            for t0, n in [(5, 6), (9, 4), (3, 8), (7, 5), (5, 6),
+                          (9, 4)]]
+    reps, sup = _engine_fleet(lm)
+    with sup:
+        routed = [sup.submit(p, n) for p, n in reqs]
+        for (p, n), rt in zip(reqs, routed):
+            np.testing.assert_array_equal(
+                rt.handle.result(timeout=60), _direct(lm, p, n))
+    # affinity: requests sharing a ring key always land on one replica
+    by_key = {}
+    for (p, n), rt in zip(reqs, routed):
+        if rt.route == "affinity":
+            by_key.setdefault(sup.router.key_for(p), set()).add(
+                rt.replica)
+    assert all(len(v) == 1 for v in by_key.values())
+
+
+def test_drain_mid_flight_loses_nothing(lm):
+    r = np.random.RandomState(8)
+    reqs = [(r.randint(0, VOCAB, (6,)), 10) for _ in range(4)]
+    reps, sup = _engine_fleet(lm, max_slots=1)
+    with sup:
+        routed = [sup.submit(p, n) for p, n in reqs]
+        victim = routed[0].replica
+        sup.drain(victim, reason="degraded")   # requests in flight
+        for (p, n), rt in zip(reqs, routed):
+            np.testing.assert_array_equal(
+                rt.handle.result(timeout=60), _direct(lm, p, n))
+        assert sup.drain_wait(victim, timeout=30)
+        sup.rejoin(victim)
+        rt = sup.submit(reqs[0][0], 4)
+        np.testing.assert_array_equal(
+            rt.handle.result(timeout=60), _direct(lm, reqs[0][0], 4))
+
+
+def _post(base, payload):
+    req = urllib.request.Request(
+        f"{base}/v1/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=60)
+
+
+def _read_sse(resp):
+    events = []
+    event = None
+    for raw in resp:
+        ln = raw.decode().strip()
+        if ln.startswith("event: "):
+            event = ln[7:]
+        elif ln.startswith("data: "):
+            events.append((event, json.loads(ln[6:])))
+            event = None
+    return events
+
+
+def test_front_door_sse_round_trip(lm):
+    p = [3, 1, 4, 1, 5]
+    reps, sup = _engine_fleet(lm)
+    with sup, FleetFrontDoor(sup) as door:
+        base = f"http://127.0.0.1:{door.port}"
+        with _post(base, {"prompt_ids": p, "max_new_tokens": 8,
+                          "tenant": "t0"}) as resp:
+            assert resp.headers["Content-Type"] == "text/event-stream"
+            events = _read_sse(resp)
+        assert events[0][0] == "meta"
+        assert events[0][1]["replica"] in ("r0", "r1")
+        assert events[0][1]["route"] in ("affinity", "spilled")
+        toks = [e[1]["token"] for e in events if e[0] is None]
+        assert events[-1][0] == "done"
+        assert events[-1][1]["tokens"] == len(toks) == 8
+        want = _direct(lm, np.asarray(p), 8)
+        assert toks == want[len(p):].tolist()
+
+        # non-streaming: one JSON body, generated tokens only
+        with _post(base, {"prompt_ids": p, "max_new_tokens": 6,
+                          "stream": False}) as resp:
+            out = json.loads(resp.read())
+        assert out["tokens"] == _direct(
+            lm, np.asarray(p), 6)[len(p):].tolist()
+
+        # stats + replicas + healthz round-trip
+        st = json.loads(urllib.request.urlopen(
+            f"{base}/v1/stats", timeout=30).read())
+        assert st["finished"] >= 2 and "prefix_cache" in st
+        table = json.loads(urllib.request.urlopen(
+            f"{base}/v1/replicas", timeout=30).read())
+        assert table["replicas"] == ["r0", "r1"]
+        hz = json.loads(urllib.request.urlopen(
+            f"{base}/healthz", timeout=30).read())
+        assert hz["status"] == "ok"
+
+
+def test_front_door_maps_errors_to_http_codes(lm):
+    reps, sup = _engine_fleet(lm)
+    with sup, FleetFrontDoor(sup) as door:
+        base = f"http://127.0.0.1:{door.port}"
+        for payload in ({"prompt_ids": []},
+                        {"prompt_ids": "nope"},
+                        {"prompt_ids": [1, 2], "max_new_tokens": "x"}):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(base, payload)
+            assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/nope", timeout=30)
+        assert ei.value.code == 404
+        # every replica draining -> 503 on generate AND on healthz
+        sup.drain("r0")
+        sup.drain("r1")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, {"prompt_ids": [1, 2], "max_new_tokens": 2})
+        assert ei.value.code == 503
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/healthz", timeout=30)
+        assert ei.value.code == 503
+        sup.rejoin("r0")
+        sup.rejoin("r1")
+
+
+def test_client_disconnect_mid_decode_cancels_and_frees_slot(lm):
+    """The SSE regression: a client that vanishes mid-stream must cost
+    the fleet nothing — the failed write cancels the request, the
+    engine records the cancellation, and the (only) slot is reusable
+    immediately."""
+    reps, sup = _engine_fleet(lm, n=1, max_slots=1)
+    eng = reps[0].engine
+    with sup, FleetFrontDoor(sup) as door:
+        body = json.dumps({"prompt_ids": [2, 7, 1], "max_new_tokens": 40,
+                           "stream": True})
+        raw = (f"POST /v1/generate HTTP/1.1\r\nHost: t\r\n"
+               f"Content-Type: application/json\r\n"
+               f"Content-Length: {len(body)}\r\n\r\n{body}")
+        s = socket.create_connection(("127.0.0.1", door.port),
+                                     timeout=30)
+        s.sendall(raw.encode())
+        buf = b""
+        while buf.count(b"data: ") < 3:   # provably mid-decode
+            chunk = s.recv(4096)
+            assert chunk, f"stream ended early: {buf!r}"
+            buf += chunk
+        # hard disconnect: RST on close so the server's next write fails
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                     b"\x01\x00\x00\x00\x00\x00\x00\x00")
+        s.close()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if eng.stats().get("cancelled", 0) >= 1:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("disconnect never cancelled the request")
+        # the slot is free again: a fresh request completes correctly
+        p = np.asarray([4, 4, 2])
+        rt = sup.submit(p, 6)
+        np.testing.assert_array_equal(rt.handle.result(timeout=60),
+                                      _direct(lm, p, 6))
+
+
+def test_front_door_low_priority_maps_queue_full_to_429(lm):
+    reps, sup = _engine_fleet(lm, n=1, max_slots=1, queue_capacity=1)
+    with sup, FleetFrontDoor(sup) as door:
+        base = f"http://127.0.0.1:{door.port}"
+        # one request provably IN the slot (first token streamed)...
+        slot = sup.submit(np.asarray([1, 2, 3, 4]), 20)
+        next(slot.handle.tokens())
+        # ...one filling the only queue row...
+        queued = sup.submit(np.asarray([2, 2, 2]), 4)
+        # ...so a low-priority arrival cannot be admitted
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, {"prompt_ids": [5, 6], "max_new_tokens": 2,
+                         "priority": "low"})
+        assert ei.value.code == 429
+        for h in (slot, queued):
+            h.handle.result(timeout=60)
