@@ -70,6 +70,12 @@ def main(argv=None):
                         "sharded on heads, SPMD dispatches) — N must "
                         "divide the demo model's 4 KV heads; on a "
                         "CPU host the flag forces N virtual devices")
+    p.add_argument("--quantized", action="store_true",
+                   help="run the continuous-batching engine with int8 "
+                        "KV pools (per-row/head scale sidecars, "
+                        "dequantize fused into the attention read) "
+                        "and int8 weights, and print membw_util + "
+                        "pool bytes next to the fp engine's figures")
     p.add_argument("--fleet", type=int, default=0, metavar="N",
                    help="run the MULTI-REPLICA demo instead: N in-"
                         "process engine replicas behind the "
@@ -211,6 +217,36 @@ def main(argv=None):
     # revisit of a demoted prefix promotes it back asynchronously
     engine_kw.setdefault("prefix_cache_rows", 2)
     engine_kw.setdefault("prefix_host_rows", 8)
+    fp_before = None
+    if args.quantized:
+        # measure the FP engine on the same traffic first, so the
+        # quantized engine below prints an honest before/after pair
+        # (membw_util from the cost model, pool bytes from the
+        # memory-pool registry)
+        from bigdl_tpu.observability import memory as obs_memory
+
+        rq = np.random.RandomState(7)
+        with ContinuousBatchingEngine(model, max_slots=2,
+                                      prefill_chunk=8, eos_id=0,
+                                      prefix_cache_rows=2,
+                                      prefix_host_rows=8,
+                                      service_name="fp-ref") as fp_eng:
+            for L, nn_ in ((6, n), (10, n // 2), (8, n // 2)):
+                fp_eng.submit(rq.randint(0, args.vocab, (L,)),
+                              nn_).result(timeout=120)
+            fp_st = fp_eng.stats()
+            fp_before = {
+                "membw": fp_st["cost"]["overall"]["membw_util"],
+                "row_bytes": fp_st["quantization"]["kv_row_bytes"],
+                "pool_kb": sum(
+                    v for k, v in obs_memory.pool_sizes().items()
+                    if k.startswith("serving/fp-ref/")) // 1024,
+            }
+        # int8 end to end: every KV pool stores codes + scale
+        # sidecars (dequantize fused into the attention read), params
+        # go through the Quantizer clone
+        engine_kw["kv_dtype"] = "int8"
+        engine_kw["weights_dtype"] = "int8"
     with ContinuousBatchingEngine(model, max_slots=2, prefill_chunk=8,
                                   eos_id=0, **engine_kw) as engine, \
             obs.start_http_server(host="127.0.0.1",
@@ -319,6 +355,16 @@ def main(argv=None):
                   f"FLOP/B vs ridge {c['ridge_intensity']:.1f}), "
                   f"mfu {c['mfu']:.2%}, membw {c['membw_util']:.2%} "
                   f"[{c['flops_source']}]")
+        if fp_before is not None:
+            qz = st["quantization"]
+            q_pool_kb = sum(eng_pools.values()) // 1024
+            print(f"[quant]     int8 kv+weights: row "
+                  f"{qz['kv_row_bytes']} B vs fp "
+                  f"{qz['fp_row_bytes']} B "
+                  f"({qz['row_bytes_ratio']:.2f}x); engine pools "
+                  f"{q_pool_kb} KB vs fp {fp_before['pool_kb']} KB; "
+                  f"membw_util {st['cost']['overall']['membw_util']:.2%}"
+                  f" vs fp {fp_before['membw']:.2%}")
         lp = st["loop"]
         bars = ", ".join(f"{ph}={fr:.0%}"
                          for ph, fr in sorted(lp["fractions"].items(),

@@ -262,11 +262,17 @@ class TransformerLM(Module):
 
     # ------------------------------------------------- KV-cache decoding
     def init_cache(self, batch: int, max_len: int, dtype=jnp.float32,
-                   sharding=None):
+                   sharding=None, kv_dtype=None):
         """Per-block attention KV caches for incremental decoding;
-        ``sharding`` allocates each buffer directly with that layout."""
+        ``sharding`` allocates each buffer directly with that layout.
+        ``kv_dtype="int8"`` allocates the QUANTIZED per-block form
+        ``(k_q, v_q, k_scale, v_scale)`` — int8 codes plus f32 scale
+        sidecars (see ``MultiHeadAttention.init_cache``); every
+        prefill / decode / verify entry point detects the form per
+        block, so callers treat both cache trees opaquely."""
         return [getattr(self, f"block{i}").attn.init_cache(
-                    batch, max_len, dtype, sharding=sharding)
+                    batch, max_len, dtype, sharding=sharding,
+                    kv_dtype=kv_dtype)
                 for i in range(self.num_layers)]
 
     @property

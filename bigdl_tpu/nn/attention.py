@@ -74,6 +74,60 @@ def dot_product_attention(q, k, v, causal: bool = False, mask=None,
     return jnp.einsum("bhqk,bhkd->bhqd", w, v)
 
 
+def quantize_kv(x):
+    """Symmetric per-(row, head, position) int8 quantization of a KV
+    block ``x`` (..., T, D): scale = max|x| over D / 127 (1.0/127
+    where the slice is all-zero, so zeros round-trip to zeros),
+    q = round(x / scale) clipped to [-127, 127]. Returns
+    ``(q int8, scale f32)`` with scale shaped (..., T, 1) — the
+    sidecar that rides next to each quantized cache buffer.
+
+    Deterministic: identical float inputs quantize to identical bytes,
+    which is what keeps prefix-cache reuse token-identical and a
+    demote→promote round-trip bit-identical under quantized serving."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0, amax, 1.0) / 127.0
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q, scale, dtype=jnp.float32):
+    """Inverse of :func:`quantize_kv`: int8 codes × their per-position
+    scales, cast to ``dtype``. Called INSIDE the fused attention math
+    (never on the persistent pools), so the only full-precision view of
+    a quantized cache is the transient one XLA fuses into the score
+    einsum."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def _write_kv(cache, k_t, v_t, write):
+    """Write one K/V block into ``cache`` via ``write(buf, block)`` and
+    return ``(new_cache, k_read, v_read)`` — the buffers attention must
+    attend over. The float 2-tuple form writes the block as-is and
+    reads the raw buffers; the quantized 4-tuple form
+    ``(k_q, v_q, k_scale, v_scale)`` quantizes the incoming block and
+    writes int8 codes + scales (the scale sidecar shares ``write``'s
+    index math: same rank, last dim 1), then returns dequantized
+    views — so what is attended is EXACTLY what is stored, and a warm
+    prefix-cache hit replays the same numerics as the cold pass."""
+    if len(cache) == 2:
+        k_cache, v_cache = cache
+        k_cache = write(k_cache, k_t.astype(k_cache.dtype))
+        v_cache = write(v_cache, v_t.astype(v_cache.dtype))
+        return (k_cache, v_cache), k_cache, v_cache
+    k_q, v_q, k_s, v_s = cache
+    kq, ks = quantize_kv(k_t)
+    vq, vs = quantize_kv(v_t)
+    k_q = write(k_q, kq)
+    v_q = write(v_q, vq)
+    k_s = write(k_s, ks.astype(k_s.dtype))
+    v_s = write(v_s, vs.astype(v_s.dtype))
+    return ((k_q, v_q, k_s, v_s),
+            dequantize_kv(k_q, k_s, k_t.dtype),
+            dequantize_kv(v_q, v_s, v_t.dtype))
+
+
 def rotary_embedding(x, positions, base: float = 10000.0):
     """RoPE: rotate interleaved feature pairs of x (..., T, D) by
     per-position angles (RoFormer). ``positions`` is (T,) absolute
@@ -164,15 +218,36 @@ class MultiHeadAttention(Module):
         return jnp.repeat(k, rep, 1), jnp.repeat(v, rep, 1)
 
     def init_cache(self, batch: int, max_len: int, dtype=jnp.float32,
-                   sharding=None):
+                   sharding=None, kv_dtype=None):
         """Zero KV cache for incremental decoding: (k, v) each
         (B, H_kv, max_len, D). ``sharding`` allocates the buffers
         directly with that layout (no single-device materialization, no
-        tracing) — the long-context sharded-cache serving path."""
+        tracing) — the long-context sharded-cache serving path.
+
+        ``kv_dtype="int8"`` returns the QUANTIZED cache form instead:
+        ``(k_q, v_q, k_scale, v_scale)`` with int8 code buffers of the
+        same (B, H_kv, max_len, D) shape and f32 scale sidecars
+        (B, H_kv, max_len, 1) — one symmetric scale per (row, head,
+        position), written/read by :func:`quantize_kv` /
+        :func:`dequantize_kv` inside the attention paths. Scale
+        sidecars keep rank 4 with heads at dim 1, so a heads-sharded
+        pool layout (parallel/tp.py ``kv_pool_spec``) applies to the
+        whole tree unchanged."""
         shape = (batch, self.num_kv_heads, max_len, self.head_dim)
-        mk = (lambda: jnp.zeros(shape, dtype, device=sharding)) \
-            if sharding is not None else (lambda: jnp.zeros(shape, dtype))
-        return mk(), mk()
+
+        def mk(shp, dt):
+            return jnp.zeros(shp, dt, device=sharding) \
+                if sharding is not None else jnp.zeros(shp, dt)
+
+        if kv_dtype is None:
+            return mk(shape, dtype), mk(shape, dtype)
+        if str(kv_dtype) != "int8":
+            raise ValueError(
+                f"kv_dtype must be None (full precision) or 'int8', "
+                f"got {kv_dtype!r}")
+        sshape = shape[:-1] + (1,)
+        return (mk(shape, jnp.int8), mk(shape, jnp.int8),
+                mk(sshape, jnp.float32), mk(sshape, jnp.float32))
 
     def _split_kv_step(self, qkv):
         kv_dim = self.num_kv_heads * self.head_dim
@@ -207,34 +282,31 @@ class MultiHeadAttention(Module):
                 positions = jnp.asarray(pos)[None]
                 q = self._rope(q, positions)
                 k_t = self._rope(k_t, positions)
-        k_cache, v_cache = cache
         if ragged:
-            write = jax.vmap(lambda c, t, p: jax.lax.dynamic_update_slice(
-                c, t, (0, p, 0)))
-            k_cache = write(k_cache, k_t.astype(k_cache.dtype), pos)
-            v_cache = write(v_cache, v_t.astype(v_cache.dtype), pos)
+            write = lambda c, blk: jax.vmap(
+                lambda ci, ti, p: jax.lax.dynamic_update_slice(
+                    ci, ti, (0, p, 0)))(c, blk, pos)
         else:
-            k_cache = jax.lax.dynamic_update_slice(
-                k_cache, k_t.astype(k_cache.dtype), (0, 0, pos, 0))
-            v_cache = jax.lax.dynamic_update_slice(
-                v_cache, v_t.astype(v_cache.dtype), (0, 0, pos, 0))
+            write = lambda c, blk: jax.lax.dynamic_update_slice(
+                c, blk, (0, 0, pos, 0))
+        cache, k_read, v_read = _write_kv(cache, k_t, v_t, write)
         h_kv = self.num_kv_heads
         rep = self.num_heads // h_kv
         qg = q.reshape(b, h_kv, rep, self.head_dim)  # 1-token axis folded
         scale = 1.0 / math.sqrt(self.head_dim)
-        s = jnp.einsum("bgrd,bgtd->bgrt", qg, k_cache,
+        s = jnp.einsum("bgrd,bgtd->bgrt", qg, k_read,
                        preferred_element_type=jnp.float32) * scale
         if ragged:
-            live = jnp.arange(k_cache.shape[2])[None, :] <= pos[:, None]
+            live = jnp.arange(k_read.shape[2])[None, :] <= pos[:, None]
             s = jnp.where(live[:, None, None, :], s, -jnp.inf)
         else:
-            live = jnp.arange(k_cache.shape[2]) <= pos
+            live = jnp.arange(k_read.shape[2]) <= pos
             s = jnp.where(live[None, None, None, :], s, -jnp.inf)
-        p = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
-        o = jnp.einsum("bgrt,bgtd->bgrd", p, v_cache)
+        p = jax.nn.softmax(s, axis=-1).astype(v_read.dtype)
+        o = jnp.einsum("bgrt,bgtd->bgrd", p, v_read)
         o = o.reshape(b, self.embed_dim).astype(x_t.dtype)
         o = self.out_proj(o).reshape(b, 1, -1)
-        return o, (k_cache, v_cache)
+        return o, cache
 
     def forward_prefill(self, x, cache, pos0: int = 0):
         """Batched prompt prefill: one causal pass over x (B, T0, C) that
@@ -254,30 +326,32 @@ class MultiHeadAttention(Module):
         if self.rotary:
             positions = pos0 + jnp.arange(t)
             q, k = self._rope(q, positions), self._rope(k, positions)
-        k_cache, v_cache = cache
-        if pos0 + t > k_cache.shape[2]:
+        if pos0 + t > cache[0].shape[2]:
             # dynamic_update_slice would silently CLAMP the write start,
             # corrupting the prefix — fail at trace time instead
             raise ValueError(
                 f"prefill of {t} tokens at pos0={pos0} overflows the "
-                f"{k_cache.shape[2]}-long KV cache")
-        k_cache = jax.lax.dynamic_update_slice(
-            k_cache, k.astype(k_cache.dtype), (0, 0, pos0, 0))
-        v_cache = jax.lax.dynamic_update_slice(
-            v_cache, v.astype(v_cache.dtype), (0, 0, pos0, 0))
-        if pos0:
+                f"{cache[0].shape[2]}-long KV cache")
+        write = lambda c, blk: jax.lax.dynamic_update_slice(
+            c, blk, (0, 0, pos0, 0))
+        cache, k_read, v_read = _write_kv(cache, k, v, write)
+        if pos0 or len(cache) == 4:
             # attend over cached prefix + new block; dot_product_attention's
             # causal mask (tril offset tk - tq = pos0) lets query i see
-            # exactly keys [0, pos0 + i]
-            k = jax.lax.slice_in_dim(k_cache, 0, pos0 + t, axis=2) \
+            # exactly keys [0, pos0 + i]. A QUANTIZED cache takes this
+            # branch even at pos0 == 0: attending the dequantized stored
+            # rows (not the pre-quantization block) keeps the cold pass
+            # numerically identical to every later warm read of the same
+            # rows — the prefix-cache reuse invariant.
+            k = jax.lax.slice_in_dim(k_read, 0, pos0 + t, axis=2) \
                 .astype(q.dtype)
-            v = jax.lax.slice_in_dim(v_cache, 0, pos0 + t, axis=2) \
+            v = jax.lax.slice_in_dim(v_read, 0, pos0 + t, axis=2) \
                 .astype(q.dtype)
         kx, vx = self._expand_kv(k, v)
         o = dot_product_attention(q, kx, vx, causal=True)
         o = o.transpose(0, 2, 1, 3).reshape(b, t, self.embed_dim)
         o = self.out_proj(o.reshape(b * t, self.embed_dim)).reshape(b, t, -1)
-        return o, (k_cache, v_cache)
+        return o, cache
 
     def forward_chunk(self, x, cache, pos0):
         """Chunked continuation prefill with a TRACED ``pos0``: a fixed
@@ -316,24 +390,21 @@ class MultiHeadAttention(Module):
             else:
                 positions = pos0 + jnp.arange(t)
                 q, k = self._rope(q, positions), self._rope(k, positions)
-        k_cache, v_cache = cache
         if ragged:
-            write = jax.vmap(lambda c, blk, p: jax.lax.dynamic_update_slice(
-                c, blk, (0, p, 0)))
-            k_cache = write(k_cache, k.astype(k_cache.dtype), pos0)
-            v_cache = write(v_cache, v.astype(v_cache.dtype), pos0)
+            write = lambda c, blk: jax.vmap(
+                lambda ci, bi, p: jax.lax.dynamic_update_slice(
+                    ci, bi, (0, p, 0)))(c, blk, pos0)
         else:
-            k_cache = jax.lax.dynamic_update_slice(
-                k_cache, k.astype(k_cache.dtype), (0, 0, pos0, 0))
-            v_cache = jax.lax.dynamic_update_slice(
-                v_cache, v.astype(v_cache.dtype), (0, 0, pos0, 0))
+            write = lambda c, blk: jax.lax.dynamic_update_slice(
+                c, blk, (0, 0, pos0, 0))
+        cache, k_read, v_read = _write_kv(cache, k, v, write)
         h_kv = self.num_kv_heads
         rep = self.num_heads // h_kv
         qg = q.reshape(b, h_kv, rep, t, self.head_dim)
         scale = 1.0 / math.sqrt(self.head_dim)
-        s = jnp.einsum("bgrtd,bgTd->bgrtT", qg, k_cache,
+        s = jnp.einsum("bgrtd,bgTd->bgrtT", qg, k_read,
                        preferred_element_type=jnp.float32) * scale
-        ln = k_cache.shape[2]
+        ln = k_read.shape[2]
         if ragged:
             live = (jnp.arange(ln)[None, None, :]
                     <= (pos0[:, None] + jnp.arange(t)[None])[:, :, None])
@@ -341,11 +412,11 @@ class MultiHeadAttention(Module):
         else:
             live = jnp.arange(ln)[None, :] <= (pos0 + jnp.arange(t))[:, None]
             s = jnp.where(live[None, None, None], s, -jnp.inf)
-        p = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
-        o = jnp.einsum("bgrtT,bgTd->bgrtd", p, v_cache)
+        p = jax.nn.softmax(s, axis=-1).astype(v_read.dtype)
+        o = jnp.einsum("bgrtT,bgTd->bgrtd", p, v_read)
         o = o.transpose(0, 3, 1, 2, 4).reshape(b, t, self.embed_dim)
         o = self.out_proj(o.reshape(b * t, self.embed_dim).astype(x.dtype))
-        return o.reshape(b, t, -1), (k_cache, v_cache)
+        return o.reshape(b, t, -1), cache
 
     def _rope(self, x, positions):
         return rotary_embedding(x, positions, self.rotary_base) \

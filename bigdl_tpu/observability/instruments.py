@@ -289,6 +289,23 @@ def serving_engine_instruments(service: str = "engine",
             "bigdl_serving_prefix_host_cache_entries",
             "Prefix-cache entries currently resident in the host tier",
             labelnames=lbl).labels(service),
+        quantized_kv=r.gauge(
+            "bigdl_serving_quantized_kv",
+            "1 when every persistent KV pool (slots, staging, prefix "
+            "pool + host tier, draft pools) stores int8 rows with f32 "
+            "scale sidecars (engine kv_dtype='int8'); 0 full precision",
+            labelnames=lbl).labels(service),
+        quantized_weights=r.gauge(
+            "bigdl_serving_quantized_weights",
+            "1 when the target model serves through the int8 "
+            "Quantizer clone (engine weights_dtype='int8'); 0 full "
+            "precision", labelnames=lbl).labels(service),
+        kv_row_bytes=r.gauge(
+            "bigdl_serving_kv_row_bytes",
+            "Physical bytes of ONE slot's KV row across all layers — "
+            "including the scale sidecars under kv_dtype='int8' — the "
+            "honest per-row cost behind pool budgets and the "
+            "quantized-capacity claim", labelnames=lbl).labels(service),
         spec_proposed_tokens_total=r.counter(
             "bigdl_serving_spec_proposed_tokens_total",
             "Draft tokens proposed by the speculative decode loop "
@@ -663,6 +680,31 @@ def serving_bench_instruments(registry: Optional[MetricRegistry] = None
             "Fleet-wide prefix-cache hit rate on the affinity leg of "
             "the multi-replica storm (sum of hits over lookups across "
             "replicas)"),
+        quant_inter_token_p50_speedup=lambda: r.gauge(
+            "bigdl_bench_serving_quant_inter_token_p50_speedup",
+            "Int8-vs-fp engine inter-token p50 speedup on the "
+            "quantized A/B workload (>1.0: halved KV/weight bytes "
+            "lift the membw-bound decode)"),
+        quant_inter_token_p99_speedup=lambda: r.gauge(
+            "bigdl_bench_serving_quant_inter_token_p99_speedup",
+            "Int8-vs-fp engine inter-token p99 speedup on the "
+            "quantized A/B workload"),
+        quant_logit_div_rel=lambda: r.gauge(
+            "bigdl_bench_serving_quant_logit_div_rel",
+            "Quality gate: max per-token logit divergence of the "
+            "int8 engine vs fp on identical seeds, relative to the "
+            "fp logit scale (teacher-forced greedy horizon)"),
+        quant_acceptance_delta=lambda: r.gauge(
+            "bigdl_bench_serving_quant_acceptance_delta",
+            "Quality gate: spec-decode acceptance-rate delta, fp-KV "
+            "minus int8-KV engine under the same int8 draft and "
+            "workload — SIGNED, positive means quantizing the cache "
+            "lost acceptance (one-sided bar: < 0.05)"),
+        quant_row_bytes_ratio=lambda: r.gauge(
+            "bigdl_bench_serving_quant_row_bytes_ratio",
+            "Physical KV row bytes (int8 rows + scale sidecar) over "
+            "the fp-equivalent row bytes (~0.5: capacity per HBM "
+            "byte doubles)"),
     )
 
 

@@ -73,7 +73,8 @@ from bigdl_tpu.serving.streams import (
     RequestError, RequestHandle, RequestTimedOut,
 )
 from bigdl_tpu.serving.benchmark import (
-    poisson_workload, repeated_text_workload, run_poisson_comparison,
+    poisson_workload, quantized_quality_report, repeated_text_workload,
+    run_poisson_comparison, run_quantized_comparison,
     run_shared_prefix_comparison, run_speculative_comparison,
     run_tp_comparison, run_working_set_sweep, shared_prefix_workload,
 )
@@ -88,4 +89,5 @@ __all__ = [
     "shared_prefix_workload", "run_shared_prefix_comparison",
     "repeated_text_workload", "run_speculative_comparison",
     "run_tp_comparison", "run_working_set_sweep",
+    "quantized_quality_report", "run_quantized_comparison",
 ]

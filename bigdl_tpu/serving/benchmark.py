@@ -28,6 +28,17 @@ decode — inter-token p50/p99 both ways, the draft acceptance rate,
 and greedy token parity (a draft must never change the output, only
 how many dispatches it costs).
 
+``--serving --quantized`` runs the QUANTIZED A/B
+(:func:`run_quantized_comparison`): one repeated-text Poisson workload
+replayed through the engine with int8 KV pools + int8 weights vs full
+precision — inter-token p50/p99 both ways, the cost model's
+membw-utilization pair (decode is memory-bound, so halved bytes is
+the claim), physical row bytes both ways, and the QUALITY gate: a
+deterministic teacher-forced per-token logit-divergence report
+(:func:`quantized_quality_report`) plus the speculative
+acceptance-rate delta between fp-KV and int8-KV runs under the same
+int8 draft.
+
 ``--serving --tp N`` runs the TENSOR-PARALLEL A/B
 (:func:`run_tp_comparison`): the same Poisson workload replayed
 through the engine sharded over an ``N``-way model-axis device mesh
@@ -334,6 +345,224 @@ def run_speculative_comparison(model, draft=None, n_requests: int = 24,
                 spec["speculation"].get("acceptance_rate"),
             "token_parity": bool(parity),
             "workload": {"kind": "speculative",
+                         "requests": n_requests, "rate_hz": rate_hz,
+                         "seed": seed, "max_slots": max_slots,
+                         "prefill_rows": prefill_rows,
+                         "gamma": gamma}}
+
+
+def quantized_quality_report(model, prompts=None, horizon: int = 16,
+                             kv_dtype: str = "int8",
+                             weights_dtype: Optional[str] = "int8",
+                             n_prompts: int = 6, prompt_len: int = 8,
+                             seed: int = 0) -> dict:
+    """Per-token numerics gate for quantized serving: roll the FLOAT
+    model greedily for ``horizon`` tokens per prompt, then (a)
+    teacher-force the quantized path (int8 KV cache via
+    ``kv_dtype``, optionally the int8 ``Quantizer`` weight clone) down
+    the SAME trajectory and measure per-token logit divergence, and
+    (b) free-run the quantized path greedily and measure how long its
+    output prefix agrees with the float rollout. Deterministic per
+    (model, prompts, horizon) — :func:`quantize_kv` rounds the same
+    floats to the same bytes every time — so the figures gate cleanly
+    run-to-run in ``perf_gate.py``.
+
+    Returns ``logit_div_max`` / ``logit_div_mean`` (absolute),
+    ``logit_div_rel`` (max divergence over the float run's own max
+    |logit| — the scale-free ceiling the gate reads), and
+    ``greedy_match_fraction`` (mean common-prefix length / horizon)."""
+    import jax.numpy as jnp
+
+    model.evaluate()
+    if weights_dtype is not None and str(weights_dtype) == "int8":
+        from bigdl_tpu.nn.quantized import Quantizer
+
+        qmodel = Quantizer.quantize(model)
+    else:
+        qmodel = model
+    qmodel.evaluate()
+    vocab = model.vocab_size
+    window = model.max_len
+    horizon = max(2, min(horizon, window - prompt_len - 1))
+    if prompts is None:
+        r = np.random.RandomState(seed)
+        prompts = [r.randint(0, vocab, (prompt_len,)).astype(np.int32)
+                   for _ in range(n_prompts)]
+
+    def greedy_roll(m, ids, kv, forced=None):
+        """Greedy rollout (or teacher-forced when ``forced`` is the
+        token list to feed) returning (tokens, per-step logits)."""
+        c = m.init_cache(1, window, kv_dtype=kv)
+        lg, c = m.prefill(ids, c)
+        logits = [np.asarray(lg).reshape(-1)]
+        toks = [int(np.argmax(logits[-1]))]
+        pos = ids.shape[1]
+        for i in range(horizon - 1):
+            nxt = forced[i] if forced is not None else toks[-1]
+            lg, c = m.decode_step(jnp.asarray([nxt]), jnp.int32(pos), c)
+            logits.append(np.asarray(lg).reshape(-1))
+            toks.append(int(np.argmax(logits[-1])))
+            pos += 1
+        return toks, logits
+
+    div_max, fp_scale = 0.0, 0.0
+    div_means, match = [], []
+    for p in prompts:
+        ids = jnp.asarray(np.asarray(p, np.int32))[None]
+        fp_toks, fp_logits = greedy_roll(model, ids, None)
+        fp_scale = max(fp_scale,
+                       max(float(np.max(np.abs(l))) for l in fp_logits))
+        _, q_logits = greedy_roll(qmodel, ids, kv_dtype,
+                                  forced=fp_toks)
+        d = [float(np.max(np.abs(a - b)))
+             for a, b in zip(fp_logits, q_logits)]
+        div_max = max(div_max, max(d))
+        div_means.append(float(np.mean(d)))
+        q_toks, _ = greedy_roll(qmodel, ids, kv_dtype)
+        k = 0
+        for a, b in zip(fp_toks, q_toks):
+            if a != b:
+                break
+            k += 1
+        match.append(k / len(fp_toks))
+    return {
+        "kv_dtype": kv_dtype,
+        "weights_dtype": (weights_dtype or "fp"),
+        "prompts": len(prompts), "horizon": horizon,
+        "vocab": vocab,
+        "logit_div_max": round(div_max, 6),
+        "logit_div_mean": round(float(np.mean(div_means)), 6),
+        "logit_div_rel": (round(div_max / fp_scale, 6)
+                          if fp_scale else 0.0),
+        "greedy_match_fraction": round(float(np.mean(match)), 4),
+    }
+
+
+def run_quantized_comparison(model, n_requests: int = 24,
+                             rate_hz: float = 30.0,
+                             max_slots: int = 4,
+                             prefill_chunk: int = 8,
+                             prefill_rows: int = 2,
+                             gamma: int = 4,
+                             eos_id: Optional[int] = None,
+                             seed: int = 0, registry=None,
+                             log=None) -> dict:
+    """Replay ONE repeated-text Poisson workload through the engine
+    twice — int8 KV pools + int8 weights (``kv_dtype=weights_dtype=
+    "int8"``) vs full precision, everything else identical — and
+    report inter-token/TTFT/latency percentiles for both, the
+    membw-utilization pair the cost model attributes (decode is
+    memory-bound, so halving the streamed bytes is exactly what this
+    row must show), the capacity block (physical row bytes both ways),
+    and the QUALITY gate: the per-token logit-divergence report
+    (:func:`quantized_quality_report`, deterministic) plus the
+    speculative acceptance-rate delta measured by replaying the same
+    workload under an int8 draft with fp vs int8 KV (the draft must
+    keep agreeing with the target when the cache quantizes). Token
+    parity is asserted WITHIN each numerics regime — speculation must
+    not change tokens whether the cache is fp or int8 — never across
+    regimes (int8 rounds differently; the quality report bounds that
+    drift instead)."""
+    log = log or (lambda *a, **k: None)
+    from bigdl_tpu.nn.quantized import Quantizer
+
+    vocab = model.vocab_size
+    window = (model.max_len // prefill_chunk) * prefill_chunk
+    decode_hi = max(8, min(24, window // 2 - 16))
+    wl = repeated_text_workload(
+        n_requests, rate_hz, vocab,
+        prompt_lens=(8, min(16, window - decode_hi - 1)),
+        decode_lens=(min(8, decode_hi), decode_hi), seed=seed)
+    warm_prompt = np.asarray(
+        np.random.RandomState(seed + 1).randint(0, vocab, (12,)),
+        np.int32)
+    log("[serving-bench] quantizing the int8 draft clone...")
+    draft = Quantizer.quantize(model)
+    draft.evaluate()
+
+    def run_path(name: str, **engine_kw) -> dict:
+        return _engine_replay(
+            model, wl, warm_prompt, 4,
+            ("speculation", "quantization", "jit_compiles"), log,
+            "quantized", max_slots=max_slots,
+            prefill_chunk=prefill_chunk, prefill_rows=prefill_rows,
+            eos_id=eos_id, registry=registry, service_name=name,
+            **engine_kw)
+
+    quant = run_path("bench_quant_on", kv_dtype="int8",
+                     weights_dtype="int8")
+    fp = run_path("bench_quant_off")
+    # acceptance-delta probe: the SAME draft over the SAME workload,
+    # fp KV vs int8 KV (weights fp in both, so the cache is the ONLY
+    # thing that moves) — quantizing the cache must not change how
+    # often the target agrees with its draft (delta ~ 0). The plain
+    # kv-only leg exists so each spec leg has a same-numerics
+    # non-speculative twin to assert token parity against.
+    kv8 = run_path("bench_quant_kv_only", kv_dtype="int8")
+    spec_fp = run_path("bench_quant_spec_fp", draft=draft,
+                       spec_gamma=gamma)
+    spec_q = run_path("bench_quant_spec_int8", draft=draft,
+                      spec_gamma=gamma, kv_dtype="int8")
+    parity_fp = all(
+        np.array_equal(fp["rows"][id(r)], spec_fp["rows"][id(r)])
+        for r in wl)
+    parity_q = all(
+        np.array_equal(kv8["rows"][id(r)], spec_q["rows"][id(r)])
+        for r in wl)
+    for r in (quant, fp, kv8, spec_fp, spec_q):
+        del r["rows"]
+    log("[serving-bench] quantized quality report "
+        "(teacher-forced logit divergence)...")
+    quality = quantized_quality_report(model, horizon=min(16, window // 2))
+    acc_fp = spec_fp["speculation"].get("acceptance_rate")
+    acc_q = spec_q["speculation"].get("acceptance_rate")
+    quality["acceptance_rate_fp"] = acc_fp
+    quality["acceptance_rate_int8"] = acc_q
+    # SIGNED, positive = the int8 cache LOST acceptance. One-sided by
+    # design: shared rounding noise correlates the int8 draft with an
+    # int8-cached target, so acceptance typically RISES under
+    # quantization — a throughput win the gate must not punish; only a
+    # drop (the draft disagreeing with what it will serve) is a
+    # regression
+    quality["acceptance_delta"] = (round(acc_fp - acc_q, 4)
+                                   if acc_fp is not None
+                                   and acc_q is not None else None)
+
+    def ratio(key, base=None, new=None):
+        a = (base or fp)["inter_token"][key]
+        b = (new or quant)["inter_token"][key]
+        return round(a / b, 4) if a and b else None
+
+    def membw(leg):
+        return ((leg.get("cost") or {}).get("overall")
+                or {}).get("membw_util")
+
+    qz = quant["quantization"]
+    return {"quantized": quant, "fp_baseline": fp, "kv_only": kv8,
+            "spec_fp": spec_fp, "spec_int8": spec_q,
+            "inter_token_p50_speedup": ratio("p50"),
+            "inter_token_p99_speedup": ratio("p99"),
+            # the full quantized stack under its draft vs the fp stack
+            # under the same draft: a risen acceptance rate turns into
+            # longer accepted bursts, so the int8 cache can improve the
+            # inter-token TAIL even where raw int8 math doesn't pay
+            # (CPU)
+            "spec_inter_token_p50_speedup":
+                ratio("p50", base=spec_fp, new=spec_q),
+            "spec_inter_token_p99_speedup":
+                ratio("p99", base=spec_fp, new=spec_q),
+            "membw_util": {"fp": membw(fp), "quantized": membw(quant)},
+            "capacity": {
+                "kv_row_bytes": qz["kv_row_bytes"],
+                "fp_row_bytes": qz["fp_row_bytes"],
+                "row_bytes_ratio": qz["row_bytes_ratio"],
+                "capacity_multiplier":
+                    (round(qz["fp_row_bytes"] / qz["kv_row_bytes"], 4)
+                     if qz["kv_row_bytes"] else None)},
+            "quality": quality,
+            "token_parity_spec_fp": bool(parity_fp),
+            "token_parity_spec_int8": bool(parity_q),
+            "workload": {"kind": "quantized",
                          "requests": n_requests, "rate_hz": rate_hz,
                          "seed": seed, "max_slots": max_slots,
                          "prefill_rows": prefill_rows,

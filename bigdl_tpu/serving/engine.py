@@ -305,7 +305,9 @@ class ContinuousBatchingEngine:
                  tp_rules=None,
                  model_axis: str = "model",
                  timeseries_interval_s: float = 1.0,
-                 timeseries_capacity: int = 600):
+                 timeseries_capacity: int = 600,
+                 kv_dtype: Optional[str] = None,
+                 weights_dtype: Optional[str] = None):
         from bigdl_tpu.models.transformer import _validate_sampling
         from bigdl_tpu.observability import serving_engine_instruments
         from bigdl_tpu.observability import memory as obs_memory
@@ -321,6 +323,24 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"admission_window must be >= 1, got {admission_window}")
         _validate_sampling(temperature > 0.0, top_k, top_p)
+        for name, val in (("kv_dtype", kv_dtype),
+                          ("weights_dtype", weights_dtype)):
+            if val is not None and str(val) != "int8":
+                raise ValueError(
+                    f"{name} must be None (full precision) or 'int8', "
+                    f"got {val!r}")
+        self.kv_dtype = "int8" if kv_dtype is not None else None
+        self.weights_dtype = "int8" if weights_dtype is not None else None
+        if self.weights_dtype == "int8":
+            # serve through the int8 clone (nn/quantized Quantizer):
+            # Linear weights become int8 codes + per-channel scales in
+            # BUFFERS, so the memory-bound decode matmuls stream half
+            # the bytes. The clone shares the float source's param
+            # paths; under a mesh its int8 buffers replicate (same
+            # argument as the int8 draft — correct either way).
+            from bigdl_tpu.nn.quantized import Quantizer
+
+            model = Quantizer.quantize(model)
         model.evaluate()
         self.model = model
         self.max_slots = max_slots
@@ -441,13 +461,15 @@ class ContinuousBatchingEngine:
         # donated through every step — updates are in-place for the
         # engine's whole life
         self._caches = model.init_cache(max_slots, phys_len, dtype=dtype,
-                                        sharding=self._kv_shard)
+                                        sharding=self._kv_shard,
+                                        kv_dtype=self.kv_dtype)
         # prefill_rows-wide staging cache for chunked prefill; rows are
         # reused across admissions (stale tail KV is position-masked,
         # never attended)
         self._staging = model.init_cache(self._policy.prefill_rows,
                                          phys_len, dtype=dtype,
-                                         sharding=self._kv_shard)
+                                         sharding=self._kv_shard,
+                                         kv_dtype=self.kv_dtype)
         if draft is not None:
             # the draft's slot pool + staging mirror the target's
             # geometry row-for-row (same phys_len so lifecycle stays
@@ -470,16 +492,22 @@ class ContinuousBatchingEngine:
             d_dtype = draft.tok_embed.dtype
             self._d_caches = draft.init_cache(
                 max_slots, phys_len, dtype=d_dtype,
-                sharding=self._d_kv_shard)
+                sharding=self._d_kv_shard, kv_dtype=self.kv_dtype)
             self._d_staging = draft.init_cache(
                 self._policy.prefill_rows, phys_len, dtype=d_dtype,
-                sharding=self._d_kv_shard)
+                sharding=self._d_kv_shard, kv_dtype=self.kv_dtype)
         else:
             self._d_caches = self._d_staging = None
         # prefix-cache KV pool: a third persistent buffer set holding
         # the retained prefixes, plus its host-side radix-trie index.
         # The byte budget is enforced as a row budget fixed here, so
         # every compiled shape stays load-independent.
+        # summed over the LIVE cache leaves, so under kv_dtype="int8"
+        # this is the true quantized physical cost — int8 code buffers
+        # PLUS the f32 scale sidecars — and everything derived from it
+        # (token_bytes, pool/host row budgets, PrefixCache accounting,
+        # the ledger's KV byte-seconds and bytes_saved credits) stays
+        # honest without a special case
         row_bytes = sum(int(leaf.nbytes) // max_slots
                         for leaf in jax.tree.leaves(self._caches))
         self._row_bytes = row_bytes
@@ -504,7 +532,8 @@ class ContinuousBatchingEngine:
         if pool_rows > 0:
             self._pool = model.init_cache(pool_rows, phys_len,
                                           dtype=dtype,
-                                          sharding=self._kv_shard)
+                                          sharding=self._kv_shard,
+                                          kv_dtype=self.kv_dtype)
             self._prefix = PrefixCache(
                 pool_rows, row_bytes,
                 min_tokens=(prefix_min_tokens
@@ -569,6 +598,18 @@ class ContinuousBatchingEngine:
             self.temperature if self.temperature > 0.0 else 1.0))
 
         self._ins.slots.set(max_slots, force=True)
+        # numerics telemetry: which dtypes the hot path runs, plus the
+        # honest per-row physical bytes (scale sidecars included) next
+        # to the full-precision row the same geometry would cost — the
+        # before/after pair behind the quantized-capacity claim
+        self._fp_row_bytes = int(
+            2 * model.num_layers * model.num_kv_heads * phys_len
+            * model.block0.attn.head_dim * jnp.dtype(dtype).itemsize)
+        self._ins.quantized_kv.set(
+            1 if self.kv_dtype else 0, force=True)
+        self._ins.quantized_weights.set(
+            1 if self.weights_dtype else 0, force=True)
+        self._ins.kv_row_bytes.set(row_bytes, force=True)
 
         # ---- resource observability -----------------------------------
         # per-pool HBM attribution: every persistent device buffer set
@@ -1345,6 +1386,7 @@ class ContinuousBatchingEngine:
         out["latency"] = self._latency_summary()
         out["prefix_cache"] = self._prefix_summary()
         out["speculation"] = self._spec_summary()
+        out["quantization"] = self._quant_summary()
         out["mesh"] = self._mesh_summary()
         out["usage"] = self._usage.summary()
         out["cost"] = self._cost.summary()
@@ -1375,6 +1417,23 @@ class ContinuousBatchingEngine:
             "prefilled_tokens": prefilled,
             "reused_fraction": (round(ps["reused_tokens"] / denom, 4)
                                 if denom else 0.0),
+        }
+
+    def _quant_summary(self) -> dict:
+        """The ``stats()["quantization"]`` block: which numerics the
+        hot path runs and what one KV slot row physically costs —
+        ``kv_row_bytes`` (scale sidecars included) next to
+        ``fp_row_bytes`` (the same geometry at full precision), whose
+        ratio is the capacity multiplier quantization bought (rows per
+        HBM byte scale by its inverse)."""
+        return {
+            "kv_dtype": self.kv_dtype or "fp",
+            "weights_dtype": self.weights_dtype or "fp",
+            "kv_row_bytes": int(self._row_bytes),
+            "fp_row_bytes": int(self._fp_row_bytes),
+            "row_bytes_ratio": (round(self._row_bytes
+                                      / self._fp_row_bytes, 4)
+                                if self._fp_row_bytes else 1.0),
         }
 
     def _spec_summary(self) -> dict:

@@ -34,9 +34,20 @@ higher-is-better, and the tiered leg's p50 TTFT), and ``bench.py
 additionally gates the fleet-wide prefix hit rate run-to-run and the
 affinity-vs-round-robin TTFT p50 speedup as an absolute floor: the
 speedup is itself a within-run A/B ratio, so it must stay >= 1.0
-rather than within a band of the previous row's value); all six
-shapes are understood. Stdlib only — runnable from any CI step without the
-package installed.
+rather than within a band of the previous row's value), and
+``bench.py --serving --quantized`` (``detail.quantized.*`` — the
+int8-KV/int8-weight engine's latencies gate run-to-run like any
+other leg; the fp leg rides along as ``detail.fp_baseline`` under a
+name deliberately OUTSIDE the path precedence so the quantized leg is
+what gates. The quantized row additionally carries the numerics
+quality gate, enforced as absolute ceilings rather than run-to-run
+bands: the per-token logit divergence relative to the fp logit scale
+must stay under ``_QUANT_LOGIT_DIV_CEILING`` and the speculative
+acceptance-rate delta between the int8-KV and fp-KV engines — signed,
+one-sided: only an acceptance LOSS gates — must stay under
+``_QUANT_ACCEPT_DELTA_CEILING``; a numerics regression fails CI, not
+prod); all seven shapes are understood. Stdlib only — runnable from
+any CI step without the package installed.
 
 Usage::
 
@@ -54,10 +65,18 @@ import sys
 #: detail keys that hold a serving result with a ``ttft`` percentile
 #: block, in precedence order (--serving vs --serving --shared-prefix
 #: vs --serving --speculative vs --serving --tp vs --serving
-#: --shared-prefix --working-set vs --serving --fleet — each row shape
-#: carries exactly one)
+#: --shared-prefix --working-set vs --serving --fleet vs --serving
+#: --quantized — each row shape carries exactly one; the quantized
+#: row's fp leg is named ``fp_baseline`` so it stays out of this scan)
 _TTFT_PATHS = ("engine", "cached", "spec", "sharded", "tiered",
-               "affinity")
+               "affinity", "quantized")
+
+#: absolute quality ceilings for --serving --quantized rows: int8
+#: numerics must stay this close to fp on the same seeds. Ceilings,
+#: not run-to-run bands — a quality number has a meaningful absolute
+#: scale, unlike a latency that shifts with the host.
+_QUANT_LOGIT_DIV_CEILING = 0.25
+_QUANT_ACCEPT_DELTA_CEILING = 0.05
 
 
 def _p99(row: dict, measure: str):
@@ -138,6 +157,35 @@ def fleet_hit_rate(row: dict):
         or {}
     hr = fl.get("hit_rate")
     return float(hr) if hr is not None else None
+
+
+def quantized_logit_div_rel(row: dict):
+    """The quantized A/B row's quality-gate headline: max per-token
+    logit divergence of the int8 engine vs fp on identical seeds,
+    relative to the fp logit scale (scale-free, so one ceiling holds
+    across model sizes). None for every other row shape and for rows
+    predating the field."""
+    detail = row.get("detail") or {}
+    if not detail.get("quantized"):
+        return None
+    dv = (detail.get("quality") or {}).get("logit_div_rel")
+    return float(dv) if dv is not None else None
+
+
+def quantized_acceptance_delta(row: dict):
+    """The quantized A/B row's speculative acceptance-rate delta —
+    SIGNED, fp-KV minus int8-KV under the same int8 draft and
+    workload, so positive means quantizing the cache LOST acceptance.
+    The ceiling is one-sided on purpose: shared rounding noise
+    correlates the int8 draft with an int8-cached target, so
+    acceptance typically rises under quantization — a win the gate
+    must not punish. None for every other row shape and for rows
+    predating the field."""
+    detail = row.get("detail") or {}
+    if not detail.get("quantized"):
+        return None
+    dv = (detail.get("quality") or {}).get("acceptance_delta")
+    return float(dv) if dv is not None else None
 
 
 def signature(row: dict):
@@ -275,6 +323,27 @@ def main(argv=None) -> int:
             failed = True
         else:
             print(f"[perf-gate] ok: {verdict} clears the 1.0x floor")
+    # quantized A/B rows: numerics quality gates as absolute ceilings
+    # (a quality number has a meaningful scale of its own; gating it
+    # against the previous row would let a slow drift walk the
+    # numerics off a cliff one ok-sized step at a time)
+    for label, reader, ceiling in (
+            ("quantized logit divergence", quantized_logit_div_rel,
+             _QUANT_LOGIT_DIV_CEILING),
+            ("quantized spec acceptance delta",
+             quantized_acceptance_delta, _QUANT_ACCEPT_DELTA_CEILING)):
+        qv = reader(newest)
+        if qv is None:
+            continue
+        verdict = (f"{label} {qv:.4f} for {newest.get('metric')} "
+                   f"{span}")
+        if qv > ceiling:
+            print(f"[perf-gate] FAIL: {verdict} exceeds the absolute "
+                  f"{ceiling} ceiling")
+            failed = True
+        else:
+            print(f"[perf-gate] ok: {verdict} under the absolute "
+                  f"{ceiling} ceiling")
     return 1 if failed else 0
 
 
