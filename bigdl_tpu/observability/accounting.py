@@ -81,8 +81,8 @@ class UsageRecord:
                  "prefill_tokens", "prefix_reused_tokens",
                  "prefix_bytes_saved", "decode_tokens",
                  "device_prefill_s", "device_decode_s",
-                 "kv_byte_seconds", "outcome",
-                 "_staging_since", "_slot_since")
+                 "kv_byte_seconds", "outcome", "preemptions",
+                 "_staging_since", "_slot_since", "_requeued_at")
 
     def __init__(self, request_id: str, tenant: str,
                  prompt_tokens: int, max_new_tokens: int,
@@ -111,9 +111,17 @@ class UsageRecord:
         self.kv_byte_seconds = 0.0
         #: terminal outcome once finalized (finished/cancelled/...)
         self.outcome: Optional[str] = None
+        #: times this request's slot was preempted (residency up to
+        #: the eviction stays billed to this record — preemption never
+        #: un-bills the device time the victim already consumed)
+        self.preemptions = 0
         # open residency intervals (row-bytes charged at close)
         self._staging_since: Optional[float] = None
         self._slot_since: Optional[float] = None
+        # set while preempted-and-requeued: the next ``admitted`` adds
+        # the requeue→re-admission span to queue_wait_s instead of
+        # restarting the figure from submit
+        self._requeued_at: Optional[float] = None
 
     @property
     def device_s(self) -> float:
@@ -138,11 +146,13 @@ class UsageRecord:
             "device_decode_s": round(self.device_decode_s, 6),
             "device_s": round(self.device_s, 6),
             "kv_byte_seconds": round(self.kv_byte_seconds, 3),
+            "preemptions": self.preemptions,
         }
 
 
 def _zero_aggregate() -> dict:
-    return {"requests": 0, "finished": 0, "queue_wait_s": 0.0,
+    return {"requests": 0, "finished": 0, "preemptions": 0,
+            "queue_wait_s": 0.0,
             "prefill_tokens": 0, "prefix_reused_tokens": 0,
             "prefix_bytes_saved": 0, "decode_tokens": 0,
             "device_s": 0.0, "kv_byte_seconds": 0.0}
@@ -259,8 +269,17 @@ class UsageLedger:
                  reused_tokens: int = 0) -> None:
         """Prefill starts: close the queue wait, credit the prefix
         reuse (tokens and the KV bytes not recomputed), and open the
-        staging-row residency."""
-        rec.queue_wait_s = max(0.0, now - rec.submitted_at)
+        staging-row residency. A RE-admission after preemption adds
+        the requeue→now span to the accumulated queue wait instead of
+        restarting the figure from submit (the first wait was already
+        closed — double-billing it would inflate the tenant's queue
+        seconds)."""
+        if rec._requeued_at is not None:
+            rec.queue_wait_s = ((rec.queue_wait_s or 0.0)
+                                + max(0.0, now - rec._requeued_at))
+            rec._requeued_at = None
+        else:
+            rec.queue_wait_s = max(0.0, now - rec.submitted_at)
         if reused_tokens:
             rec.prefix_reused_tokens += int(reused_tokens)
             rec.prefix_bytes_saved += int(reused_tokens
@@ -284,6 +303,25 @@ class UsageLedger:
         rec.decode_tokens += int(tokens)
         with self._lock:
             self._tokens_delivered += int(tokens)
+
+    def preempted(self, rec: UsageRecord, now: float) -> None:
+        """The request's slot was preempted (NOT terminal — the
+        request requeues and resumes): close the open slot/staging
+        residency into ``kv_byte_seconds`` — the HBM it held up to the
+        eviction stays billed to this record — and stamp the requeue
+        time so the next ``admitted`` accumulates the second queue
+        wait. Device-seconds already attributed are untouched:
+        preemption never un-bills consumed device time."""
+        if rec._staging_since is not None:
+            rec.kv_byte_seconds += (self.staging_row_bytes
+                                    * max(0.0, now - rec._staging_since))
+            rec._staging_since = None
+        if rec._slot_since is not None:
+            rec.kv_byte_seconds += (self.slot_row_bytes
+                                    * max(0.0, now - rec._slot_since))
+            rec._slot_since = None
+        rec.preemptions += 1
+        rec._requeued_at = now
 
     # --------------------------------------------------------- dispatch
     def charge_dispatch(self, kind: str, wall_s: float,
@@ -364,6 +402,7 @@ class UsageLedger:
             agg["requests"] += 1
             if outcome == "finished":
                 agg["finished"] += 1
+            agg["preemptions"] += rec.preemptions
             if rec.queue_wait_s is not None:
                 agg["queue_wait_s"] += rec.queue_wait_s
             agg["prefill_tokens"] += rec.prefill_tokens

@@ -475,6 +475,35 @@ def tenant_usage_instruments(registry: Optional[MetricRegistry] = None
     )
 
 
+def qos_instruments(registry: Optional[MetricRegistry] = None
+                    ) -> SimpleNamespace:
+    """QoS flow counters fed by the engine's overload machinery.
+    Returned UNBOUND (families, not children): the engine binds
+    ``(service, class, tenant)`` per event — ``class`` is the
+    affected request's priority class (the preemption VICTIM's class,
+    the shed request's class), ``tenant`` the cardinality-capped
+    tenant label the usage ledger resolved."""
+    r = registry or default_registry()
+    lbl = ("service", "class", "tenant")
+    return SimpleNamespace(
+        preempted_total=r.counter(
+            "bigdl_serving_preempted_total",
+            "Slot preemptions: the victim's KV was donated to the "
+            "prefix pool and the request automatically requeued "
+            "(resumes token-identical, re-prefilling only the "
+            "uncached tail)", labelnames=lbl),
+        shed_total=r.counter(
+            "bigdl_serving_shed_total",
+            "Requests shed at admission by burn-rate load shedding "
+            "(TTFT SLO burning; lowest class first)", labelnames=lbl),
+        rate_limited_total=r.counter(
+            "bigdl_serving_rate_limited_total",
+            "Requests refused by the tenant's device-second token "
+            "bucket (Retry-After = exact refill time)",
+            labelnames=lbl),
+    )
+
+
 class OccupancyStats:
     """The serving ``stats()`` façade, shared by both services: served /
     dispatches / mean occupancy as the DELTA of a bound batch-occupancy
@@ -705,6 +734,30 @@ def serving_bench_instruments(registry: Optional[MetricRegistry] = None
             "Physical KV row bytes (int8 rows + scale sidecar) over "
             "the fp-equivalent row bytes (~0.5: capacity per HBM "
             "byte doubles)"),
+        qos_high_ttft_p50_ratio=lambda: r.gauge(
+            "bigdl_bench_serving_qos_high_ttft_p50_ratio",
+            "Storm-vs-uncontended high-class TTFT p50 ratio on the "
+            "mixed-priority QoS storm (~1.0: shedding + preemption "
+            "keep the top class's median at its uncontended self; "
+            "the bar is <= 1.25x)"),
+        qos_high_ttft_p99_ratio=lambda: r.gauge(
+            "bigdl_bench_serving_qos_high_ttft_p99_ratio",
+            "Storm-vs-uncontended high-class TTFT p99 ratio on the "
+            "mixed-priority QoS storm (small-sample tail: reported "
+            "for the trend, gated at the median)"),
+        qos_preempted=lambda: r.gauge(
+            "bigdl_bench_serving_qos_preempted",
+            "Slots preempted (KV donated, victim resumed) during the "
+            "QoS storm leg — 0 means the storm never exercised "
+            "preemption"),
+        qos_shed=lambda: r.gauge(
+            "bigdl_bench_serving_qos_shed",
+            "Submissions shed by the burn-rate policy during the QoS "
+            "storm leg"),
+        qos_rate_limited=lambda: r.gauge(
+            "bigdl_bench_serving_qos_rate_limited",
+            "Submissions refused by per-tenant token buckets during "
+            "the QoS storm leg"),
     )
 
 

@@ -318,3 +318,25 @@ class SloWatchdog:
         return [{"alert": f"slo:{st.obj.name}", "service": self.service,
                  "severity": "warning", "since": st.since, **st.detail}
                 for st in self._states if st.active]
+
+    def state(self) -> List[dict]:
+        """Every bound objective's CURRENT evaluation — active or not
+        — keyed for decisions, not display: the engine's load-shedding
+        policy reads the ``metric == "ttft"`` rows to decide whether
+        (and how hard) admission is burning its TTFT budget.
+        ``burn_rate`` is the last ``sample()``'s figure (0.0 before
+        traffic clears ``min_count``); ``severe`` marks a burn at or
+        past twice the alert threshold — the escalation point where
+        shedding widens from low-class to low+normal."""
+        return [{
+            "objective": st.obj.name,
+            "metric": st.obj.metric,
+            "active": st.active,
+            "burn_rate": round(st.burn, 3),
+            "burn_threshold": st.obj.burn_threshold,
+            "severe": st.active
+            and st.burn >= 2.0 * st.obj.burn_threshold,
+            "threshold_s": st.obj.threshold_s,
+            "target": st.obj.target,
+            "window_s": st.obj.window_s,
+        } for st in self._states]

@@ -26,10 +26,16 @@ dispatch with token-granular continuous batching —
   shared head (O(novel-suffix) TTFT); finished slots donate their KV
   back under an LRU/ref-count policy within a configurable byte
   budget.
-- ``AdmissionQueue`` / ``PrefillPolicy`` (``scheduler``): bounded FCFS
+- ``AdmissionQueue`` / ``PrefillPolicy`` (``scheduler``): bounded
   admission with backpressure, deadline/cancellation sweeps,
-  prefix-aware pop ordering (bounded bypass window), and the
-  prefill-vs-decode token budget.
+  QoS-ordered pop — (priority class, deadline slack, prefix-affinity
+  score) under a per-class bounded bypass window — plus the
+  prefill-vs-decode token budget and the per-tenant ``TokenBucket``
+  rate limiter. Under overload the engine PREEMPTS lower-class slots
+  (KV donated to the prefix pool, automatic token-identical resume),
+  SHEDS lowest-class admissions on SLO burn (``RequestShed``), and
+  throttles over-budget tenants (``RequestRateLimited``) — see
+  ``stats()["qos"]`` and ``engine(chaos=ChaosInjector())`` for drills.
 - ``RequestHandle`` (``streams``): per-request streaming token
   iterator + blocking ``result()``; greedy output is token-identical
   to a lone ``model.generate`` call (tested).
@@ -63,31 +69,37 @@ each tenant (``handle.usage()``, ``stats()["usage"]``,
 ``GET /debug/usage``, ``bigdl_serving_tenant_*`` counters).
 """
 
+from bigdl_tpu.serving.chaos import ChaosFault, ChaosInjector
 from bigdl_tpu.serving.engine import ContinuousBatchingEngine
 from bigdl_tpu.serving.prefix_cache import PrefixCache, PrefixEntry
 from bigdl_tpu.serving.scheduler import (
-    AdmissionQueue, PrefillPolicy, SpeculationPolicy,
+    AdmissionQueue, PrefillPolicy, SpeculationPolicy, TokenBucket,
 )
 from bigdl_tpu.serving.streams import (
-    EngineDraining, EngineStopped, QueueFull, RequestCancelled,
-    RequestError, RequestHandle, RequestTimedOut,
+    PRIORITIES, EngineDraining, EngineStopped, QueueFull,
+    RequestCancelled, RequestError, RequestHandle,
+    RequestRateLimited, RequestShed, RequestTimedOut,
 )
 from bigdl_tpu.serving.benchmark import (
     poisson_workload, quantized_quality_report, repeated_text_workload,
-    run_poisson_comparison, run_quantized_comparison,
+    run_poisson_comparison, run_qos_storm, run_quantized_comparison,
     run_shared_prefix_comparison, run_speculative_comparison,
     run_tp_comparison, run_working_set_sweep, shared_prefix_workload,
 )
 
 __all__ = [
     "ContinuousBatchingEngine",
+    "ChaosInjector", "ChaosFault",
     "PrefixCache", "PrefixEntry",
     "AdmissionQueue", "PrefillPolicy", "SpeculationPolicy",
+    "TokenBucket",
     "RequestHandle", "RequestError", "RequestCancelled",
-    "RequestTimedOut", "QueueFull", "EngineStopped", "EngineDraining",
+    "RequestTimedOut", "RequestShed", "RequestRateLimited",
+    "QueueFull", "EngineStopped", "EngineDraining", "PRIORITIES",
     "poisson_workload", "run_poisson_comparison",
     "shared_prefix_workload", "run_shared_prefix_comparison",
     "repeated_text_workload", "run_speculative_comparison",
     "run_tp_comparison", "run_working_set_sweep",
     "quantized_quality_report", "run_quantized_comparison",
+    "run_qos_storm",
 ]

@@ -49,6 +49,21 @@ and greedy token parity (a mesh changes where the math runs, never
 the tokens). Hermetic on a CPU host-device mesh; the same call
 measures real ICI scaling on hardware.
 
+``--serving --qos`` runs the MIXED-PRIORITY STORM
+(:func:`run_qos_storm`): one Poisson storm of interactive high-class
+requests, normal-class traffic, long-decode low-class batch jobs, and
+an over-budget ``greedy`` tenant, replayed into a deliberately
+undersized engine with a hair-trigger TTFT SLO objective — so the
+burn-rate shedder, the KV-donating preemption path, and the
+per-tenant token bucket all fire on REAL machinery, not mocks — vs an
+uncontended replay of only the high-class requests through the same
+engine config. The headline is the high-class p99 TTFT ratio
+storm/uncontended (the acceptance bar is <= 1.25x: the class buys
+isolation), alongside the shed / preempted / rate-limited counts, the
+outcome-conservation verdict (every submit ends in exactly one
+terminal outcome — no silent drops), and the per-tenant ledger
+breakdown.
+
 ``scripts/perf_gate.py`` turns consecutive rows of any variant into a
 CI regression gate.
 """
@@ -958,3 +973,280 @@ def run_poisson_comparison(model, n_requests: int = 16,
             "workload": {"requests": n_requests, "rate_hz": rate_hz,
                          "seed": seed, "max_slots": max_slots,
                          "max_batch": max_batch}}
+
+
+# --------------------------------------------------------------- QoS storm
+
+#: priority assignment cycle for the storm mix: half the traffic is
+#: low-class batch work (long decodes that hold slots), a quarter
+#: latency-sensitive high-class interactive traffic (long prompts,
+#: short decodes), a quarter normal. The cycle leads with TWO lows so
+#: the storm opens with every slot held by batch work — the first
+#: high-class arrival then exercises the preemption path, not a free
+#: slot
+_QOS_MIX = ("low", "low", "high", "normal")
+
+#: tenant names by class — the ledger's fair-share breakdown needs the
+#: classes billed apart; the over-budget tenant is added on top
+_QOS_TENANTS = {"high": "interactive", "normal": "standard",
+                "low": "batch"}
+
+
+def qos_storm_workload(n_requests: int, rate_hz: float, vocab: int,
+                       n_greedy: int = 3, seed: int = 0) -> List[dict]:
+    """Sample the MIXED-PRIORITY storm: Poisson arrivals cycling
+    through ``_QOS_MIX`` — high-class requests get LONG prompts and
+    short decodes (interactive: TTFT is the product), low/normal get
+    short prompts and LONG decodes (batch: they hold slots, which is
+    what makes them preemption victims) — plus ``n_greedy`` extra
+    high-class requests under the ``greedy`` tenant spread across the
+    storm span (the token-bucket's prey: even the top class cannot buy
+    unmetered device time). Each request carries ``priority`` and
+    ``tenant`` next to the usual arrival/prompt/n fields."""
+    r = np.random.RandomState(seed)
+    at = np.cumsum(r.exponential(1.0 / rate_hz, n_requests))
+    out = []
+    for i in range(n_requests):
+        cls = _QOS_MIX[i % len(_QOS_MIX)]
+        if cls == "high":
+            # interactive prompts are LONG (12-14 prefill chunks):
+            # TTFT is then dominated by real prefill work, so the
+            # fixed few-ms cost of a preemption reads as the small
+            # fraction it is, not as a 2x on a trivial baseline
+            t0 = int(r.randint(96, 113))
+            n = int(r.randint(4, 9))
+        else:
+            t0 = int(r.randint(8, 17))
+            n = int(r.randint(56, 81))
+        out.append({
+            "arrival_s": float(at[i]),
+            "prompt": r.randint(0, vocab, (t0,)).astype(np.int32),
+            "n": n,
+            "priority": cls,
+            "tenant": _QOS_TENANTS[cls],
+        })
+    # pin the storm's opening: the second batch job lands 10ms behind
+    # the first and the first interactive request 20ms behind that —
+    # DETERMINISTICALLY, both slots are held by mid-decode batch work
+    # when the first high-class request arrives, so the preemption
+    # path runs on every seed, not just unlucky ones
+    if n_requests > 2:
+        out[1]["arrival_s"] = out[0]["arrival_s"] + 0.01
+        out[2]["arrival_s"] = out[1]["arrival_s"] + 0.02
+    span = float(at[-1])
+    for k in range(n_greedy):
+        out.append({
+            # the first greedy request lands early enough to ADMIT and
+            # drain the bucket (16 decode tokens bill well past the
+            # bucket's burst); the rest arrive after it has finished
+            # and been billed, so they meet an exhausted bucket
+            "arrival_s": span * (0.2 + 0.65 * k / max(1, n_greedy - 1)),
+            "prompt": r.randint(0, vocab, (24,)).astype(np.int32),
+            "n": 16,
+            "priority": "high",
+            "tenant": "greedy",
+        })
+    out.sort(key=lambda q: q["arrival_s"])
+    return out
+
+
+def _qos_replay(engine, workload, timeout_s: float = 300.0) -> dict:
+    """Open-loop replay with OUTCOME accounting: structured QoS
+    rejections (shed / rate-limited) are expected results here, not
+    errors — every submit is tallied into exactly one terminal outcome
+    and the TTFT samples are kept PER CLASS (the storm's headline is
+    the high class's tail, measured apart from the traffic being
+    sacrificed for it)."""
+    from bigdl_tpu.serving.streams import (
+        RequestCancelled, RequestRateLimited, RequestShed,
+        RequestTimedOut,
+    )
+
+    outcomes = {"finished": 0, "shed": 0, "rate_limited": 0,
+                "cancelled": 0, "timed_out": 0}
+    # the greedy tenant is high-CLASS but not the headline: its TTFTs
+    # land in their own bucket so the interactive tail stays clean
+    ttft_by_class = {"high": [], "normal": [], "low": [], "greedy": []}
+    itl_high: List[float] = []
+    lat: List[float] = []
+    toks: List[int] = []
+    retry_hints: List[float] = []
+    errs: List[BaseException] = []
+    lock = threading.Lock()
+    t_start = time.monotonic()
+
+    def one(req):
+        try:
+            t_sub = time.monotonic()
+            try:
+                h = engine.submit(req["prompt"], req["n"],
+                                  tenant=req["tenant"],
+                                  priority=req["priority"])
+            except (RequestShed, RequestRateLimited) as e:
+                kind = ("shed" if isinstance(e, RequestShed)
+                        else "rate_limited")
+                with lock:
+                    outcomes[kind] += 1
+                    retry_hints.append(float(e.retry_after_s))
+                return
+            try:
+                row = h.result(timeout=timeout_s)
+            except RequestTimedOut:
+                with lock:
+                    outcomes["timed_out"] += 1
+                return
+            except RequestCancelled:
+                with lock:
+                    outcomes["cancelled"] += 1
+                return
+            dt = time.monotonic() - t_sub
+            cls = ("greedy" if req["tenant"] == "greedy"
+                   else req["priority"])
+            with lock:
+                outcomes["finished"] += 1
+                lat.append(dt)
+                toks.append(row.shape[0] - req["prompt"].shape[0])
+                if h.first_token_at is not None:
+                    ttft_by_class[cls].append(
+                        h.first_token_at - h.submitted_at)
+                if cls == "high":
+                    _append_itl(itl_high, h)
+        except BaseException as e:
+            with lock:
+                errs.append(e)
+
+    threads = []
+    for req in workload:
+        delay = t_start + req["arrival_s"] - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        t = threading.Thread(target=one, args=(req,), daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t_start
+    if errs:
+        raise errs[0]
+    return {"latency": _percentiles(lat),
+            "ttft_by_class": {c: _percentiles(v)
+                              for c, v in ttft_by_class.items()},
+            # the leg's headline percentile blocks are the HIGH class's
+            # — the class the SLO is written for, and what perf_gate
+            # reads as detail.qos.{ttft,inter_token}
+            "ttft": _percentiles(ttft_by_class["high"]),
+            "inter_token": _percentiles(itl_high),
+            "tokens_per_sec": round(sum(toks) / max(wall, 1e-9), 2),
+            "wall_s": round(wall, 3),
+            "submitted": len(workload),
+            "outcomes": outcomes,
+            "retry_after_s_max": (round(max(retry_hints), 3)
+                                  if retry_hints else None)}
+
+
+def run_qos_storm(model, n_requests: int = 24, rate_hz: float = 20.0,
+                  max_slots: int = 2, prefill_chunk: int = 8,
+                  prefill_rows: int = 2, n_greedy: int = 3,
+                  eos_id: Optional[int] = None, seed: int = 0,
+                  registry=None, log=None) -> dict:
+    """Replay ONE mixed-priority Poisson storm into a deliberately
+    undersized engine (``max_slots`` far below the offered load) wired
+    with the full QoS stack — a hair-trigger TTFT SLO objective so the
+    burn-rate shedder fires on the real watchdog, zero preemption
+    slack so waiting high-class requests evict batch slots through the
+    KV-donation path, ``shed_classes=("low", "normal")`` so a severe
+    burn widens the shed set, and a starved token bucket for the
+    ``greedy`` tenant — then replay ONLY the high-class interactive
+    requests through the SAME engine config as the uncontended
+    baseline.
+
+    The headline is ``high_ttft_p99_ratio`` (storm / uncontended high-
+    class p99 TTFT; the acceptance bar is <= 1.25x — under a storm
+    that sheds and preempts everything else, the top class's tail must
+    stay within a quarter of its uncontended self). The row also
+    carries the shed / preempted / rate-limited counts (all must be
+    > 0: a storm that never fired the machinery measured nothing), the
+    outcome-conservation verdict (client-side outcome tally == submits
+    AND == the engine's own finished+shed+rate_limited accounting — no
+    silent drops), and the per-tenant ledger breakdown."""
+    from bigdl_tpu.serving import ContinuousBatchingEngine
+
+    log = log or (lambda *a, **k: None)
+    vocab = model.vocab_size
+    wl = qos_storm_workload(n_requests, rate_hz, vocab,
+                            n_greedy=n_greedy, seed=seed)
+    # the uncontended baseline is the HIGH-PRIORITY traffic alone —
+    # interactive AND greedy, at the same arrival offsets, under the
+    # same rate limits — so any high-vs-high collision lands in both
+    # legs identically and the ratio isolates what the STORM adds
+    high_only = [q for q in wl if q["priority"] == "high"]
+    warm_prompt = np.asarray(
+        np.random.RandomState(seed + 1).randint(0, vocab, (12,)),
+        np.int32)
+    engine_kw = dict(
+        max_slots=max_slots, prefill_chunk=prefill_chunk,
+        prefill_rows=prefill_rows, eos_id=eos_id, registry=registry,
+        # the burn objective is a tripwire, not a target: every real
+        # TTFT lands over 0.1ms, so the storm's traffic itself drives
+        # the watchdog into a SEVERE burn (burn 10 >= 2x threshold)
+        # within min_count observations — shedding activates on the
+        # same machinery production would use, just tuned to fire
+        # min_count 3 = warm + the two leading lows: the slot-holding
+        # batch work ADMITS before the burn trips, so the first high
+        # arrival preempts a live victim; everything low/normal after
+        # the trip sheds at submit
+        slo_objectives=[{"name": "ttft_burn", "metric": "ttft",
+                         "threshold_s": 1e-4, "target": 0.9,
+                         "window_s": 30.0, "min_count": 3,
+                         "burn_threshold": 2.0}],
+        shed_classes=("low", "normal"),
+        preempt_slack_s=0.0,
+        tenant_rate_limits={"greedy": (0.01, 0.001)})
+
+    def leg(name: str, work) -> dict:
+        log(f"[serving-bench] qos {name} replay...")
+        with ContinuousBatchingEngine(model, service_name=name,
+                                      **engine_kw) as eng:
+            eng.submit(warm_prompt, 4).result(timeout=300)
+            res = _qos_replay(eng, work)
+            stats = eng.stats()
+        res["qos_state"] = stats["qos"]
+        res.update(_usage_blocks(stats))
+        res["cost"] = stats.get("cost")
+        res["loop"] = stats.get("loop")
+        res["alerts"] = stats["alerts"]
+        # conservation against the ENGINE's own books, not just the
+        # client's: every submit the engine saw must have landed in
+        # exactly one terminal outcome counter
+        qc = stats["qos"]
+        eng_terminal = (stats["finished"] + qc["shed"]
+                        + qc["rate_limited"] + stats["cancelled"]
+                        + stats["timed_out"])
+        client_terminal = sum(res["outcomes"].values())
+        res["conservation_ok"] = bool(
+            client_terminal == res["submitted"]
+            # +1: the warm request finished outside the tally
+            and eng_terminal == res["submitted"] + 1)
+        return res
+
+    storm = leg("bench_qos_storm", wl)
+    uncont = leg("bench_qos_uncontended", high_only)
+
+    def ratio(key):
+        a = storm["ttft"][key]
+        b = uncont["ttft"][key]
+        return round(a / b, 4) if a and b else None
+
+    qc = storm["qos_state"]
+    return {
+        "qos": storm, "uncontended": uncont,
+        "high_ttft_p50_ratio": ratio("p50"),
+        "high_ttft_p99_ratio": ratio("p99"),
+        "shed": qc["shed"], "preempted": qc["preempted"],
+        "rate_limited": qc["rate_limited"],
+        "conservation_ok": bool(storm["conservation_ok"]
+                                and uncont["conservation_ok"]),
+        "workload": {"kind": "qos_storm", "requests": n_requests,
+                     "n_greedy": n_greedy, "rate_hz": rate_hz,
+                     "seed": seed, "max_slots": max_slots,
+                     "prefill_rows": prefill_rows}}

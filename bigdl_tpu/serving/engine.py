@@ -71,7 +71,7 @@ import collections
 import sys
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -85,11 +85,11 @@ from bigdl_tpu.observability.timeseries import (
 )
 from bigdl_tpu.serving.prefix_cache import PrefixCache
 from bigdl_tpu.serving.scheduler import (
-    AdmissionQueue, PrefillPolicy, SpeculationPolicy,
+    AdmissionQueue, PrefillPolicy, SpeculationPolicy, TokenBucket,
 )
 from bigdl_tpu.serving.streams import (
-    EngineDraining, EngineStopped, RequestCancelled, RequestHandle,
-    RequestTimedOut,
+    PRIORITY_RANK, EngineDraining, EngineStopped, RequestCancelled,
+    RequestHandle, RequestRateLimited, RequestShed, RequestTimedOut,
 )
 
 
@@ -307,12 +307,17 @@ class ContinuousBatchingEngine:
                  timeseries_interval_s: float = 1.0,
                  timeseries_capacity: int = 600,
                  kv_dtype: Optional[str] = None,
-                 weights_dtype: Optional[str] = None):
+                 weights_dtype: Optional[str] = None,
+                 preempt_slack_s: Optional[float] = 0.25,
+                 shed_classes=("low",),
+                 tenant_rate_limits=None,
+                 chaos=None):
         from bigdl_tpu.models.transformer import _validate_sampling
         from bigdl_tpu.observability import serving_engine_instruments
         from bigdl_tpu.observability import memory as obs_memory
         from bigdl_tpu.observability.accounting import UsageLedger
         from bigdl_tpu.observability.events import default_recorder
+        from bigdl_tpu.observability.instruments import qos_instruments
         from bigdl_tpu.observability.watchdog import (
             RecompileWatchdog, SloObjective, SloWatchdog,
         )
@@ -722,6 +727,46 @@ class ContinuousBatchingEngine:
         self._stats_base = {k: self._counter(k).get()
                             for k in ("admitted", "finished", "evicted",
                                       "timed_out", "cancelled")}
+
+        # ---- QoS: preemption, burn-rate shedding, token buckets --------
+        # preemption: a HIGH-class request queued past this slack with
+        # no free slot evicts the lowest-class longest-remaining slot,
+        # donating its KV to the prefix pool so the automatic resume
+        # re-prefills only the uncached tail (None disables)
+        if preempt_slack_s is not None and preempt_slack_s < 0:
+            raise ValueError(f"preempt_slack_s must be >= 0 or None, "
+                             f"got {preempt_slack_s}")
+        self.preempt_slack_s = preempt_slack_s
+        # shed set under an active TTFT burn: "low" sheds the moment
+        # the alert raises; "normal" (opt-in) sheds only once the burn
+        # passes TWICE its alert threshold (severe). "high" is never
+        # sheddable — that is what the class buys.
+        self.shed_classes = tuple(shed_classes or ())
+        for p in self.shed_classes:
+            if p not in PRIORITY_RANK or p == "high":
+                raise ValueError(
+                    f"shed_classes may contain 'low'/'normal', "
+                    f"got {p!r}")
+        # per-tenant device-second token buckets (post-paid): keys are
+        # resolved tenant names, "*" sets the default for every tenant
+        # without an explicit entry; values are (rate_per_s, burst)
+        # tuples or {"rate": ..., "burst": ...} dicts. None = unlimited.
+        self._rate_limits = dict(tenant_rate_limits or {})
+        self._buckets: Dict[str, TokenBucket] = {}
+        self._buckets_lock = threading.Lock()
+        for tenant in self._rate_limits:
+            if tenant != "*":
+                self._tenant_bucket(self._usage.resolve_tenant(tenant))
+        #: scripted fault injector (serving.chaos.ChaosInjector): the
+        #: shed decision honors its synthetic burn, the loop honors
+        #: its dispatch faults and slot freezes. None = no injection.
+        self._chaos = chaos
+        self._qos_ins = qos_instruments(registry)
+        # host-side QoS tallies (per-instance exact — the registry
+        # counters are shared per label and carry dynamic class/tenant
+        # labels, so stats() keeps its own figures)
+        self._qos_counts = {"preempted": 0, "shed": 0,
+                            "rate_limited": 0}
 
         self._wake = threading.Condition()
         self._stop_evt = threading.Event()
@@ -1246,7 +1291,8 @@ class ContinuousBatchingEngine:
     def submit(self, prompt_ids, max_new_tokens: int,
                timeout_s: Optional[float] = None, block: bool = True,
                queue_timeout_s: Optional[float] = None,
-               tenant: Optional[str] = None) -> RequestHandle:
+               tenant: Optional[str] = None,
+               priority: str = "normal") -> RequestHandle:
         """Queue one request (1-D prompt). Returns its handle
         immediately; stream with ``handle.tokens()`` or block on
         ``handle.result()``. ``timeout_s`` is a wall deadline covering
@@ -1262,7 +1308,16 @@ class ContinuousBatchingEngine:
         their own series; later new names fold into ``"other"`` — the
         cardinality cap that keeps the label space bounded no matter
         what clients send. ``handle.usage()`` returns the request's
-        metered consumption."""
+        metered consumption.
+
+        ``priority`` (``"high"``/``"normal"``/``"low"``) is the QoS
+        class: admission orders by (class, deadline slack, prefix
+        score) with per-class starvation bounds; a waiting high-class
+        request may PREEMPT a lower-class slot (the victim resumes
+        token-identical); under an active TTFT burn the shed set
+        (``shed_classes``) is refused with ``RequestShed``, and a
+        tenant past its token bucket with ``RequestRateLimited`` —
+        both carry ``retry_after_s``."""
         if self._crashed is not None:
             raise EngineStopped("engine loop crashed") from self._crashed
         if self._draining:
@@ -1281,13 +1336,40 @@ class ContinuousBatchingEngine:
                 f"prompt ({t0}) + max_new_tokens ({n}) exceeds the "
                 f"engine's serving window {self.max_len}")
         self.start()
-        h = RequestHandle(prompt, n, timeout_s)
+        h = RequestHandle(prompt, n, timeout_s, priority=priority)
         h._usage = self._usage.begin(h.request_id, tenant, t0, n,
                                      submitted_at=h.submitted_at)
         h.tenant = h._usage.tenant
         self._rec.record("request/submitted", h.request_id,
                          service=self.service_name, prompt_tokens=t0,
-                         max_new_tokens=n, tenant=h.tenant)
+                         max_new_tokens=n, tenant=h.tenant,
+                         priority=priority)
+        # ---- QoS gates, cheapest-first: burn-rate shedding, then the
+        # tenant's token bucket. Both are structured rejections (the
+        # handle finishes through the _finish_handle funnel with its
+        # outcome, the ledger bills the queue-side life, the front
+        # door maps them to 429 + Retry-After) — never silent drops.
+        shed = self._shed_state()
+        if shed["active"] and priority in shed["classes"]:
+            retry = self._shed_retry_after_s(shed)
+            err = RequestShed(
+                f"shed at admission: TTFT SLO burning at "
+                f"{shed['burn_rate']:.1f}x budget "
+                f"({shed['source']}), class {priority!r} is in the "
+                f"shed set — retry in {retry:.2f}s",
+                retry_after_s=retry)
+            self._reject_qos(h, err, "shed")
+            raise err
+        bucket = self._tenant_bucket(h.tenant)
+        if bucket is not None and not bucket.try_admit():
+            retry = bucket.retry_after()
+            err = RequestRateLimited(
+                f"tenant {h.tenant!r} exhausted its device-second "
+                f"budget (bucket {bucket.level():.3f}s, refill "
+                f"{bucket.rate:.3f}/s) — retry in {retry:.2f}s",
+                retry_after_s=retry)
+            self._reject_qos(h, err, "rate_limited")
+            raise err
         try:
             self._queue.put(h, block=block, timeout=queue_timeout_s)
         except Exception as e:
@@ -1325,6 +1407,81 @@ class ContinuousBatchingEngine:
             raise err
         return h
 
+    # ------------------------------------------------------ QoS plumbing
+    def _shed_state(self) -> dict:
+        """The load-shedding decision input: is the TTFT SLO burning
+        (really — an active SloWatchdog alert on a ``metric="ttft"``
+        objective — or synthetically via the chaos injector), how
+        hard, and which priority classes shed as a result. ``low``
+        sheds on any active burn; ``normal`` (when opted into
+        ``shed_classes``) only once the burn is SEVERE (>= 2x its
+        alert threshold); ``high`` never sheds."""
+        active = severe = False
+        burn = 0.0
+        source = None
+        if self._chaos is not None and self._chaos.burn_active():
+            active = True
+            severe = self._chaos.burn_severe()
+            burn = 4.0 if severe else 2.0
+            source = "chaos"
+        else:
+            for row in self._slo_wd.state():
+                if row["metric"] != "ttft" or not row["active"]:
+                    continue
+                active = True
+                burn = max(burn, row["burn_rate"])
+                severe = severe or row["severe"]
+                source = "slo:" + row["objective"]
+        classes = ()
+        if active:
+            classes = (self.shed_classes if severe else
+                       tuple(p for p in self.shed_classes
+                             if p == "low"))
+        return {"active": active and bool(classes), "severe": severe,
+                "burn_rate": burn, "source": source,
+                "classes": classes}
+
+    def _shed_retry_after_s(self, shed: dict) -> float:
+        """Back-off hint for a shed rejection: long enough for the
+        trailing burn window to move, doubled under a severe burn."""
+        return 2.0 if shed["severe"] else 1.0
+
+    def _tenant_bucket(self, tenant: str):
+        """The tenant's device-second token bucket, created lazily
+        from ``tenant_rate_limits`` (exact name first, then the
+        ``"*"`` default); None = unlimited."""
+        if not self._rate_limits:
+            return None
+        with self._buckets_lock:
+            b = self._buckets.get(tenant)
+            if b is not None:
+                return b
+            cfg = self._rate_limits.get(tenant,
+                                        self._rate_limits.get("*"))
+            if cfg is None:
+                return None
+            if isinstance(cfg, dict):
+                b = TokenBucket(cfg["rate"], cfg["burst"])
+            else:
+                rate, burst = cfg
+                b = TokenBucket(rate, burst)
+            self._buckets[tenant] = b
+            return b
+
+    def _reject_qos(self, h: RequestHandle, err: Exception,
+                    outcome: str) -> None:
+        """Terminal bookkeeping for a structured QoS rejection
+        (shed / rate_limited): through the ``_finish_handle`` funnel —
+        the ledger bills the queue-side life under the real outcome,
+        the ``request/shed`` / ``request/rate_limited`` event stays
+        the last of the request's recorded arc, and the
+        ``(class, tenant)``-labelled QoS counter increments. The
+        caller raises ``err`` to the submitter."""
+        self._qos_counts[outcome] += 1
+        getattr(self._qos_ins, outcome + "_total").labels(
+            self.service_name, h.priority, h.tenant).inc()
+        self._finish_handle(h, err, outcome)
+
     def _finish_handle(self, h: RequestHandle,
                        err: Optional[BaseException],
                        outcome: str) -> None:
@@ -1345,6 +1502,18 @@ class ContinuousBatchingEngine:
             # BEFORE the outcome event, which stays the last event of
             # every request's recorded timeline (tested contract)
             self._usage.finalize(rec, outcome, h.finished_at)
+            # post-paid rate limiting: the bucket consumes the
+            # request's MEASURED device-seconds at the same terminal
+            # point the ledger bills them
+            bucket = self._tenant_bucket(rec.tenant)
+            if bucket is not None and rec.device_s > 0:
+                bucket.debit(rec.device_s)
+        # a preemption pin that never reached re-admission (the victim
+        # finished/cancelled/timed out while requeued) must not leak a
+        # pinned prefix entry
+        pin = h.__dict__.pop("_preempt_pin", None)
+        if pin is not None and self._prefix is not None:
+            self._prefix.release(pin)
         self._rec.record("request/" + outcome, h.request_id,
                          service=self.service_name,
                          tokens=len(h._tokens),
@@ -1391,7 +1560,33 @@ class ContinuousBatchingEngine:
         out["usage"] = self._usage.summary()
         out["cost"] = self._cost.summary()
         out["loop"] = self._loop_obs.summary()
+        out["qos"] = self._qos_summary()
         out["alerts"] = self.alerts()
+        return out
+
+    def _qos_summary(self) -> dict:
+        """The ``stats()["qos"]`` block: shedding state (is the TTFT
+        SLO burning, which classes shed), the preempted / shed /
+        rate-limited tallies, queue composition by class, and each
+        provisioned tenant bucket's balance."""
+        shed = self._shed_state()
+        with self._buckets_lock:
+            buckets = {t: b.snapshot()
+                       for t, b in sorted(self._buckets.items())}
+        out = {
+            "shedding": {"active": shed["active"],
+                         "severe": shed["severe"],
+                         "burn_rate": round(shed["burn_rate"], 3),
+                         "source": shed["source"],
+                         "classes": list(shed["classes"])},
+            "shed_classes_configured": list(self.shed_classes),
+            "preempt_slack_s": self.preempt_slack_s,
+            "queue_by_class": self._queue.depth_by_class(),
+            "rate_limits": buckets,
+            **self._qos_counts,
+        }
+        if self._chaos is not None:
+            out["chaos"] = self._chaos.snapshot()
         return out
 
     def alerts(self) -> List[dict]:
@@ -1494,6 +1689,11 @@ class ContinuousBatchingEngine:
             "draining": self._draining,
             "in_flight": (len(self._queue) + len(self._adms)
                           + sum(s is not None for s in self._slots)),
+            # compact QoS posture: is load shedding live right now,
+            # and how much traffic has been preempted/shed/throttled
+            # so far — the full picture lives in stats()["qos"]
+            "qos": {"shedding": self._shed_state()["active"],
+                    **self._qos_counts},
             "alerts": alerts,
         }
 
@@ -1514,6 +1714,7 @@ class ContinuousBatchingEngine:
                 "prompt_tokens": int(h.prompt.shape[0]),
                 "max_new_tokens": h.max_new_tokens,
                 "tenant": getattr(h, "tenant", None),
+                "priority": h.priority, "preempted": h.preempted,
             })
         for adm in list(self._adms):
             h = adm.handle
@@ -1523,6 +1724,7 @@ class ContinuousBatchingEngine:
                 "prompt_tokens": int(h.prompt.shape[0]),
                 "max_new_tokens": h.max_new_tokens,
                 "tenant": getattr(h, "tenant", None),
+                "priority": h.priority, "preempted": h.preempted,
                 "chunks_done": adm.next_chunk,
                 "chunks_total": adm.n_chunks,
                 "staging_row": adm.row,
@@ -1542,6 +1744,7 @@ class ContinuousBatchingEngine:
                 "prompt_tokens": int(h.prompt.shape[0]),
                 "max_new_tokens": h.max_new_tokens,
                 "tenant": getattr(h, "tenant", None),
+                "priority": h.priority, "preempted": h.preempted,
                 "tokens_delivered": st.delivered,
             })
         with self._timelines_lock:
@@ -1681,6 +1884,8 @@ class ContinuousBatchingEngine:
         # — phase seconds then sum to the iteration wall by
         # construction
         self._iter_disp = {"prefill": 0.0, "decode": 0.0}
+        if self._chaos is not None:
+            self._chaos.begin_iteration()
 
         # 1. running slots: cancellation + deadline eviction
         for sid, st in enumerate(self._slots):
@@ -1735,6 +1940,12 @@ class ContinuousBatchingEngine:
         # 4. one fused decode step over every occupied slot
         active = [sid for sid, st in enumerate(self._slots)
                   if st is not None]
+        if self._chaos is not None:
+            # frozen slots sit out this round's fused step (their KV
+            # and handle are untouched — they resume when the freeze
+            # expires), simulating a straggler row
+            active = [sid for sid in active
+                      if not self._chaos.slot_frozen(sid)]
         if active:
             self._decode_all(active)
             worked = True
@@ -1790,6 +2001,84 @@ class ContinuousBatchingEngine:
                 return r
         return None
 
+    # ------------------------------------------------------ preemption
+    def _maybe_preempt(self, now: float) -> bool:
+        """With the slot pool exhausted and a high-class request
+        waiting past ``preempt_slack_s``, evict one lower-class slot:
+        lowest class first, longest-remaining-work tie-break (the
+        victim with the most decode left ahead of it loses the least
+        sunk progress per unit of freed time). The victim's KV is
+        donated to the prefix pool and PINNED, the request requeued
+        at the queue head — its automatic re-admission re-prefills
+        only the tail the donated entry doesn't cover and resumes
+        token-identical. High-class slots are never preempted; a pool
+        full of high is simply full. Returns True when a slot was
+        freed."""
+        if self.preempt_slack_s is None:
+            return False
+        wait = self._queue.oldest_waiting("high", now)
+        if wait is None or wait <= self.preempt_slack_s:
+            return False
+        victim_sid, victim_key = None, None
+        for sid, st in enumerate(self._slots):
+            if st is None:
+                continue
+            rank = PRIORITY_RANK.get(st.handle.priority, 1)
+            if rank <= 0:
+                continue  # never preempt a high-class slot
+            remaining = st.handle.max_new_tokens - st.delivered
+            key = (rank, remaining)
+            if victim_key is None or key > victim_key:
+                victim_sid, victim_key = sid, key
+        if victim_sid is None:
+            return False
+        self._preempt_slot(victim_sid, now)
+        return True
+
+    def _preempt_slot(self, sid: int, now: float) -> None:
+        st = self._slots[sid]
+        h = st.handle
+        # the slot's KV covers [0, pos): prompt + generated[:-1] —
+        # exactly the donation key a finishing slot would use
+        tokens = np.concatenate(
+            [h.prompt, np.asarray(h._tokens[:-1], np.int32)])
+        self._maybe_donate(sid, tokens, h.request_id)
+        if self._prefix is not None:
+            # pin the covering entry so the LRU cannot evict the
+            # donated KV while the victim waits in the queue — the
+            # pin is released at re-admission (or by _finish_handle
+            # if the victim times out / is cancelled first). The
+            # donation may have been declined (covered / all-pinned):
+            # pin whatever entry covers the tokens, if any — a None
+            # pin just means the resume re-prefills from scratch,
+            # which is still token-identical.
+            pin = self._prefix.pin_covering(tokens)
+            if pin is not None:
+                stale = h.__dict__.pop("_preempt_pin", None)
+                if stale is not None:
+                    self._prefix.release(stale)
+                h._preempt_pin = pin
+        self._slots[sid] = None
+        self._ins.evicted_total.inc()
+        h.preempted += 1
+        rec = getattr(h, "_usage", None)
+        if rec is not None:
+            # slot residency closes into kv_byte_seconds and the
+            # requeue stamp opens a second queue-wait segment;
+            # device-seconds already charged stay charged (the work
+            # happened) — NOT a terminal transition
+            self._usage.preempted(rec, now)
+        self._qos_counts["preempted"] += 1
+        self._qos_ins.preempted_total.labels(
+            self.service_name, h.priority,
+            getattr(h, "tenant", None) or "unknown").inc()
+        self._rec.record("request/preempted", h.request_id,
+                         service=self.service_name, slot=sid,
+                         priority=h.priority, preempted=h.preempted,
+                         tokens_so_far=len(h._tokens),
+                         donated_tokens=int(tokens.shape[0]))
+        self._queue.requeue(h)
+
     def _fill_admissions(self, now: float) -> None:
         """Start new admissions until the staging cache is full, the
         slot pool is exhausted, or the queue runs dry. With a prefix
@@ -1808,8 +2097,12 @@ class ContinuousBatchingEngine:
                 # that alignment reduces to zero never bypasses the
                 # FCFS head for nothing. The raw lookup is stamped on
                 # the handle (generation-guarded) so the winner's
-                # admission doesn't re-walk the trie.
-                e, m = self._prefix.lookup(h.prompt)
+                # admission doesn't re-walk the trie. Preempted
+                # requests score by their EFFECTIVE prompt (prompt +
+                # already-generated tokens) — the donated KV makes
+                # them near-perfect hits.
+                p = self._effective_prompt(h)
+                e, m = self._prefix.lookup(p)
                 h._prefix_probe = (e, m, self._prefix.generation)
                 if e is not None and e.tier == "host":
                     # host-tier match: start the async device_put NOW,
@@ -1817,11 +2110,18 @@ class ContinuousBatchingEngine:
                     # — by its admission the transfer has (usually)
                     # already landed
                     self._begin_promotion(e)
-                return (min(m, h.prompt.shape[0] - 1) // c) * c
+                return (min(m, p.shape[0] - 1) // c) * c
         while len(self._adms) < self._policy.prefill_rows:
             slot = self._free_slot()
             if slot is None:
-                return
+                # slot pool exhausted: a high-class request waiting
+                # past its slack may preempt a lower-class victim
+                # (KV donated, victim requeued — see _maybe_preempt)
+                if not self._maybe_preempt(now):
+                    return
+                slot = self._free_slot()
+                if slot is None:
+                    return
             row = self._free_staging_row()
             if row is None:
                 return
@@ -1833,10 +2133,26 @@ class ContinuousBatchingEngine:
                 return
             self._start_admission(h, slot, row)
 
+    @staticmethod
+    def _effective_prompt(h: RequestHandle) -> np.ndarray:
+        """What a (re)admission must have in the KV cache before
+        decode can continue: the prompt plus every already-generated
+        token. Fresh requests: just the prompt. Preempted requests:
+        the tail token's KV was never written (variable-advance
+        invariant), but its position must still be COMPUTED — its
+        logits seed the next token — so the full generated list is
+        part of the effective prompt and the re-prefill covers
+        exactly the suffix the donated entry doesn't."""
+        if h._tokens:
+            return np.concatenate(
+                [h.prompt, np.asarray(h._tokens, np.int32)])
+        return h.prompt
+
     def _start_admission(self, h: RequestHandle, slot: int,
                          row: int) -> None:
         c = self._policy.chunk
-        t0 = h.prompt.shape[0]
+        prompt = self._effective_prompt(h)
+        t0 = prompt.shape[0]
         base, entry = 0, None
         if self._prefix is not None:
             # reuse the pop_ready scorer's lookup when it is still
@@ -1847,7 +2163,7 @@ class ContinuousBatchingEngine:
             if probe is not None and probe[2] == self._prefix.generation:
                 e, matched = probe[0], probe[1]
             else:
-                e, matched = self._prefix.lookup(h.prompt)
+                e, matched = self._prefix.lookup(prompt)
             if e is not None:
                 # cap at t0-1 (the last prompt position must be
                 # COMPUTED — its logits seed the first token), then
@@ -1884,10 +2200,17 @@ class ContinuousBatchingEngine:
             else:
                 self._prefix.record_miss()
                 self._ins.prefix_misses_total.inc()
+            # the preemption-time pin held the donated entry alive
+            # across the queue wait; the admission has now taken its
+            # own reference (or cleanly missed) — the insurance ref
+            # can go
+            pin = h.__dict__.pop("_preempt_pin", None)
+            if pin is not None:
+                self._prefix.release(pin)
         tail = t0 - base
         n_chunks = self._policy.n_chunks(tail)
         ids = np.zeros((n_chunks * c,), np.int32)  # right-pad final chunk
-        ids[:tail] = h.prompt[base:]
+        ids[:tail] = prompt[base:]
         d_ids, d_n_chunks = None, 0
         if self.draft is not None:
             # the draft prefills the FULL prompt into its own staging
@@ -1897,18 +2220,23 @@ class ContinuousBatchingEngine:
             # caches hold the prompt)
             d_n_chunks = self._policy.n_chunks(t0)
             d_ids = np.zeros((d_n_chunks * c,), np.int32)
-            d_ids[:t0] = h.prompt
+            d_ids[:t0] = prompt
         self._adms.append(_Admission(h, slot, row, ids, t0, base,
                                      n_chunks, entry, d_ids,
                                      d_n_chunks))
         h.prefix_tokens = base
-        h.admitted_at = time.monotonic()
+        t_adm = time.monotonic()
+        if h.admitted_at is None:
+            # set-once: a preempted request keeps its ORIGINAL
+            # admission stamp — first_token_at is set-once too, so a
+            # re-stamp would turn the timeline's prefill_s negative
+            h.admitted_at = t_adm
         rec = getattr(h, "_usage", None)
         if rec is not None:
-            # queue wait closes, staging-row residency opens, and the
+            # queue wait closes (re-admissions ACCUMULATE from the
+            # requeue stamp), staging-row residency opens, and the
             # chunk-aligned reuse is credited as tokens + bytes saved
-            self._usage.admitted(rec, h.admitted_at,
-                                 reused_tokens=base)
+            self._usage.admitted(rec, t_adm, reused_tokens=base)
         self._rec.record("request/admitted", h.request_id,
                          service=self.service_name, slot=slot,
                          staging_row=row, n_chunks=n_chunks,
@@ -1958,6 +2286,8 @@ class ContinuousBatchingEngine:
         was_warm = "chunk" in self._warm and (
             not spec or "d_chunk" in self._warm) and (
             not finals or "sample0" in self._warm)
+        if self._chaos is not None:
+            self._chaos.on_dispatch()
         t_disp = time.monotonic()
         logits, self._staging = self._chunk_jit(
             self._params, self._buffers, self._h2d(ids), self._staging,
@@ -2052,6 +2382,7 @@ class ContinuousBatchingEngine:
         self._adms.remove(a)
         now = time.monotonic()
         h = a.handle
+        first = h.first_token_at is None
         h._deliver(tok, now)
         rec = getattr(h, "_usage", None)
         if rec is not None:
@@ -2059,19 +2390,39 @@ class ContinuousBatchingEngine:
             # row's opens; the first token counts as delivered
             self._usage.slot_acquired(rec, now)
             self._usage.delivered(rec, 1)
-        self._ins.ttft_seconds.observe(now - h.submitted_at)
-        self._rec.record("request/first_token", h.request_id,
-                         service=self.service_name, token=tok,
-                         ttft_s=now - h.submitted_at)
+        if first:
+            # re-admissions of a preempted request deliver here too,
+            # but their first token shipped long ago — observing a
+            # second TTFT would double-count the request
+            self._ins.ttft_seconds.observe(now - h.submitted_at)
+            self._rec.record("request/first_token", h.request_id,
+                             service=self.service_name, token=tok,
+                             ttft_s=now - h.submitted_at)
+        else:
+            self._rec.record("request/resumed", h.request_id,
+                             service=self.service_name, slot=a.slot,
+                             tokens_so_far=len(h._tokens),
+                             prefix_tokens=a.base,
+                             reprefilled_tokens=a.t0 - a.base)
         if (self.eos_id is not None and tok == self.eos_id) \
-                or h.max_new_tokens == 1:
-            # instant finisher: the slot row still holds the full
-            # prompt's KV — donate it before the slot identity is lost
-            self._maybe_donate(a.slot, h.prompt, h.request_id)
+                or len(h._tokens) >= h.max_new_tokens:
+            # instant finisher: the slot row still holds the staged
+            # effective prompt's KV — donate it before the slot
+            # identity is lost (prompt + generated[:-1] is exactly
+            # what the row covers)
+            self._maybe_donate(a.slot, np.concatenate(
+                [h.prompt, np.asarray(h._tokens[:-1], np.int32)]),
+                h.request_id)
             self._finish_handle(h, None, "finished")
             self._ins.finished_total.inc()
             return
-        self._slots[a.slot] = _SlotState(h, a.t0, tok, now)
+        st = _SlotState(h, a.t0, tok, now)
+        # a resumed request's slot picks up where the preempted one
+        # left off: pos == effective-prompt length keeps the
+        # variable-advance invariant (KV covers [0, pos), the just-
+        # delivered token's KV unwritten) for fresh and resumed alike
+        st.delivered = len(h._tokens)
+        self._slots[a.slot] = st
 
     def _abort_admission(self, a: _Admission, err: Exception,
                          kind: str) -> None:
@@ -2255,6 +2606,8 @@ class ContinuousBatchingEngine:
             tok[sid] = st.last_token
             pos[sid] = st.pos
         was_warm = "step" in self._warm   # cold = compile in the wall
+        if self._chaos is not None:
+            self._chaos.on_dispatch()
         t_disp = time.monotonic()
         nxt, self._caches = self._step_jit(
             self._params, self._buffers, self._h2d(tok),
@@ -2307,6 +2660,8 @@ class ContinuousBatchingEngine:
             r_draft, r_acc = self._next_key(), self._next_key()
         else:
             r_draft = r_acc = self._zero_key
+        if self._chaos is not None:
+            self._chaos.on_dispatch()
         t_disp = time.monotonic()
         tok_d, pos_d = self._h2d(tok), self._h2d(pos)
         props, qlogits, self._d_caches = self._propose_jit(

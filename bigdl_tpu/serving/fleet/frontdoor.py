@@ -19,9 +19,14 @@ framework, no dependencies) in front of a ``ReplicaSupervisor``:
   A client that disappears mid-stream is detected by the failed
   socket write and the request is CANCELLED into the engine — the
   slot frees immediately instead of decoding tokens nobody will read
-  (``bigdl_fleet_client_disconnects_total``). ``"stream": false``
-  returns one JSON body after completion. Backpressure maps to HTTP:
-  ``QueueFull`` -> 429, fleet down -> 503, bad request -> 400.
+  (``bigdl_fleet_client_disconnects_total``) — including while the
+  request is still QUEUED (the socket is probed until the first
+  token, so a vanished client frees its queue slot too).
+  ``"stream": false`` returns one JSON body after completion.
+  Backpressure maps to HTTP: ``QueueFull`` -> 429,
+  ``RequestShed``/``RequestRateLimited`` -> 429 with a
+  ``Retry-After`` header derived from the engine's token-bucket
+  refill time, fleet down -> 503, bad request -> 400.
 - ``GET /v1/stats`` — the supervisor's fleet-wide aggregate: per-
   replica ``stats()``, the fleet prefix hit rate, the routing table.
 - ``GET /v1/replicas`` — just the routing table (the ``serve.py
@@ -35,7 +40,11 @@ framework, no dependencies) in front of a ``ReplicaSupervisor``:
 from __future__ import annotations
 
 import json
+import math
+import select
+import socket
 import threading
+import time
 from typing import Optional
 
 from bigdl_tpu.observability.exporters import (
@@ -45,7 +54,7 @@ from bigdl_tpu.observability.metrics import default_registry
 from bigdl_tpu.serving.fleet.router import NoLiveReplicas
 from bigdl_tpu.serving.streams import (
     EngineDraining, EngineStopped, QueueFull, RequestCancelled,
-    RequestTimedOut,
+    RequestRateLimited, RequestShed, RequestTimedOut,
 )
 
 __all__ = ["FleetFrontDoor", "start_front_door"]
@@ -73,13 +82,44 @@ class FleetFrontDoor:
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
-            def _send_json(self, payload, status: int = 200):
+            def _send_json(self, payload, status: int = 200,
+                           headers: Optional[dict] = None):
                 body = json.dumps(payload).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _send_429(self, e) -> None:
+                # structured shed / rate-limit rejection: Retry-After
+                # comes from the engine's token-bucket refill math (or
+                # the shed backoff), rounded UP to the header's whole
+                # seconds — never 0, which clients read as "retry now"
+                retry = max(1, math.ceil(
+                    getattr(e, "retry_after_s", 1.0)))
+                self._send_json(
+                    {"error": str(e),
+                     "kind": type(e).__name__,
+                     "retry_after_s": getattr(e, "retry_after_s",
+                                              1.0)},
+                    429, headers={"Retry-After": str(retry)})
+
+            def _client_gone(self) -> bool:
+                # a disconnected client shows up as a readable socket
+                # whose peek returns EOF — the only portable way to
+                # see a hangup while we are WAITING (not writing)
+                try:
+                    r, _, _ = select.select([self.connection], [], [],
+                                            0)
+                    if not r:
+                        return False
+                    return self.connection.recv(
+                        1, socket.MSG_PEEK) == b""
+                except OSError:
+                    return True
 
             # ------------------------------------------------ streaming
             def _sse(self, event: Optional[str], payload: dict) -> None:
@@ -109,6 +149,8 @@ class FleetFrontDoor:
                         prompt, n, tenant=req.get("tenant"),
                         priority=req.get("priority", "normal"),
                         timeout_s=req.get("timeout_s"))
+                except (RequestShed, RequestRateLimited) as e:
+                    return self._send_429(e)
                 except QueueFull as e:
                     return self._send_json(
                         {"error": f"fleet saturated: {e}"}, 429)
@@ -134,6 +176,19 @@ class FleetFrontDoor:
                 delivered = 0
                 try:
                     self._sse("meta", meta)
+                    # queued-phase disconnect watch: until the first
+                    # token, no socket write happens — a vanished
+                    # client would hold its queue slot until admission.
+                    # Probe the connection while the request waits and
+                    # cancel into the engine the moment the peer hangs
+                    # up, freeing the slot for live traffic.
+                    while (getattr(h, "first_token_at", None) is None
+                           and not h.done()):
+                        if self._client_gone():
+                            h.cancel()
+                            ins.disconnects_total.inc()
+                            return
+                        time.sleep(0.02)
                     for tok in h.tokens():
                         self._sse(None, {"token": int(tok),
                                          "index": delivered})
@@ -154,11 +209,19 @@ class FleetFrontDoor:
                                             "tokens": delivered})
                     except OSError:
                         pass
-                except (RequestTimedOut, EngineStopped) as e:
+                except (RequestTimedOut, EngineStopped, RequestShed,
+                        RequestRateLimited) as e:
+                    # shed/rate-limit can surface HERE (not at submit)
+                    # on worker replicas — their submit is async, so
+                    # the rejection arrives as the stream's terminal
+                    # event, retry advice included
+                    payload = {**meta, "error": type(e).__name__,
+                               "detail": str(e), "tokens": delivered}
+                    if isinstance(e, (RequestShed,
+                                      RequestRateLimited)):
+                        payload["retry_after_s"] = e.retry_after_s
                     try:
-                        self._sse("error", {
-                            **meta, "error": type(e).__name__,
-                            "detail": str(e), "tokens": delivered})
+                        self._sse("error", payload)
                     except OSError:
                         pass
 
@@ -167,6 +230,8 @@ class FleetFrontDoor:
                     toks = h.result(timeout=None) \
                         if hasattr(h, "result") else list(h.tokens())
                     toks = [int(t) for t in toks]
+                except (RequestShed, RequestRateLimited) as e:
+                    return self._send_429(e)
                 except RequestCancelled:
                     return self._send_json(
                         {**meta, "error": "cancelled"}, 499)

@@ -68,10 +68,11 @@ class InProcessReplica:
 
     def submit(self, prompt_ids, max_new_tokens: int,
                tenant: Optional[str] = None,
-               timeout_s: Optional[float] = None, block: bool = True):
+               timeout_s: Optional[float] = None, block: bool = True,
+               priority: str = "normal"):
         return self.engine.submit(prompt_ids, max_new_tokens,
                                   timeout_s=timeout_s, block=block,
-                                  tenant=tenant)
+                                  tenant=tenant, priority=priority)
 
     def stats(self) -> dict:
         return self.engine.stats()
@@ -257,13 +258,17 @@ class ReplicaSupervisor:
                tenant: Optional[str] = None,
                priority: str = "normal",
                timeout_s: Optional[float] = None) -> Routed:
-        """Route one request and submit it. ``priority`` maps to the
-        admission queue's backpressure stance: ``"low"`` never blocks
-        on a full replica queue (``QueueFull`` propagates to the
-        caller — the front door turns it into 429), everything else
-        waits. The chosen replica refusing (drain/stop race with the
-        poll thread) re-routes once per remaining live replica before
-        giving up."""
+        """Route one request and submit it. ``priority`` reaches the
+        replica engine's admission queue (class-ordered pop,
+        preemption eligibility, shed order — see the engine's QoS
+        docs) and also maps to the backpressure stance here:
+        ``"low"`` never blocks on a full replica queue (``QueueFull``
+        propagates to the caller — the front door turns it into 429),
+        everything else waits. An engine-side ``RequestShed`` /
+        ``RequestRateLimited`` rejection propagates unchanged (the
+        front door's 429 + Retry-After). The chosen replica refusing
+        (drain/stop race with the poll thread) re-routes once per
+        remaining live replica before giving up."""
         block = priority != "low"
         tried: set = set()
         while True:
@@ -271,7 +276,8 @@ class ReplicaSupervisor:
             try:
                 h = self._replicas[rid].submit(
                     prompt_ids, max_new_tokens, tenant=tenant,
-                    timeout_s=timeout_s, block=block)
+                    timeout_s=timeout_s, block=block,
+                    priority=priority)
             except (EngineDraining, EngineStopped):
                 tried.add(rid)
                 self._ins.rerouted_total.inc()

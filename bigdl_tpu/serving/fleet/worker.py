@@ -38,7 +38,7 @@ import numpy as np
 
 from bigdl_tpu.serving.streams import (
     EngineDraining, EngineStopped, QueueFull, RequestCancelled,
-    RequestError, RequestTimedOut,
+    RequestError, RequestRateLimited, RequestShed, RequestTimedOut,
 )
 
 __all__ = ["WorkerHandle", "WorkerReplica", "spawn_worker_fleet"]
@@ -47,6 +47,8 @@ _ERRORS = {
     "RequestCancelled": RequestCancelled,
     "RequestTimedOut": RequestTimedOut,
     "RequestError": RequestError,
+    "RequestShed": RequestShed,
+    "RequestRateLimited": RequestRateLimited,
     "QueueFull": QueueFull,
     "EngineStopped": EngineStopped,
     "EngineDraining": EngineDraining,
@@ -104,10 +106,12 @@ def _worker_main(conn, cfg: dict) -> None:
                 np.asarray(msg["prompt"], np.int32),
                 msg["max_new"], tenant=msg.get("tenant"),
                 timeout_s=msg.get("timeout_s"),
-                block=msg.get("block", True))
+                block=msg.get("block", True),
+                priority=msg.get("priority", "normal"))
         except Exception as e:
             send({"ev": "error", "rid": rid,
                   "kind": type(e).__name__, "msg": str(e),
+                  "retry_after": getattr(e, "retry_after_s", None),
                   "tokens": []})
             return
         handles[rid] = h
@@ -193,14 +197,21 @@ class WorkerHandle:
             self._done_evt.set()
         elif ev == "error":
             self._error = (msg.get("kind", "RequestError"),
-                           msg.get("msg", ""))
+                           msg.get("msg", ""),
+                           msg.get("retry_after"))
             self.finished_at = time.monotonic()
             self._done_evt.set()
         self._q.put(msg)
 
     def _raise_error(self):
-        kind, text = self._error
-        raise _ERRORS.get(kind, RequestError)(text)
+        kind, text, retry = self._error
+        cls = _ERRORS.get(kind, RequestError)
+        if retry is not None and cls in (RequestShed,
+                                         RequestRateLimited):
+            # re-raise with the worker engine's bucket-derived backoff
+            # intact — the front door turns it into Retry-After
+            raise cls(text, retry_after_s=retry)
+        raise cls(text)
 
     def tokens(self):
         """Stream generated token ids as the worker delivers them
@@ -222,7 +233,7 @@ class WorkerHandle:
             except queue_mod.Empty:
                 if not self._replica.alive():
                     self._error = self._error or (
-                        "EngineStopped", "worker process died")
+                        "EngineStopped", "worker process died", None)
                     self._done_evt.set()
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
@@ -398,7 +409,8 @@ class WorkerReplica:
     def submit(self, prompt_ids, max_new_tokens: int,
                tenant: Optional[str] = None,
                timeout_s: Optional[float] = None,
-               block: bool = True) -> WorkerHandle:
+               block: bool = True,
+               priority: str = "normal") -> WorkerHandle:
         if not self.alive():
             raise EngineStopped(f"worker {self.id} process died")
         self._next_rid += 1
@@ -410,7 +422,8 @@ class WorkerReplica:
         self._send({"op": "submit", "rid": rid,
                     "prompt": [int(t) for t in prompt],
                     "max_new": int(max_new_tokens), "tenant": tenant,
-                    "timeout_s": timeout_s, "block": block})
+                    "timeout_s": timeout_s, "block": block,
+                    "priority": priority})
         return h
 
     def healthz(self) -> dict:

@@ -326,6 +326,20 @@ class PrefixCache:
                     f"release() without matching acquire(): {entry!r}")
             entry.refs -= 1
 
+    def pin_covering(self, tokens: np.ndarray
+                     ) -> Optional[PrefixEntry]:
+        """Find an entry of which ``tokens`` is a (non-strict) prefix
+        and PIN it (caller must ``release``); None when no such entry
+        exists. The preemption path pins the entry it just donated so
+        LRU pressure cannot evict — and the demote sweep cannot spill
+        — the victim's KV before its automatic resume consumes it."""
+        with self._lock:
+            entry = self._covering_entry(
+                np.asarray(tokens, np.int32))
+            if entry is not None:
+                entry.refs += 1
+            return entry
+
     # --------------------------------------------------------- donation
     def donate(self, tokens: np.ndarray) -> Optional[int]:
         """Offer a finished request's cached tokens to the pool.

@@ -7,9 +7,15 @@ Two policies live here, deliberately separate from the device loop:
   overloaded engine pushes back instead of buffering unboundedly) plus
   deadline/cancellation sweeps: expired or cancelled requests are
   dropped from the queue without ever costing a prefill. ``pop_ready``
-  optionally reorders within a bounded window by a caller-supplied
-  scorer (the engine scores by cached-prefix length — prefix-aware
-  admission ordering with a hard starvation bound).
+  reorders within a bounded window by ``(priority class, deadline
+  slack, -prefix score)`` — high-class and deadline-tight requests
+  admit first, the caller-supplied scorer (the engine scores by
+  cached-prefix length) breaks ties, and a per-class forced-FCFS
+  starvation bound keeps even best-effort traffic finite-wait.
+  ``requeue`` re-heads a preempted handle past the capacity bound.
+- ``TokenBucket`` — per-tenant post-paid device-second rate limiting:
+  admit while positive, debit the UsageLedger's measured cost at
+  finalize, refuse with an exact ``retry_after()`` once negative.
 - ``PrefillPolicy`` — the prefill-vs-decode interleave: how many
   prompt tokens each loop iteration may spend on admission before the
   shared decode step runs (``budget_tokens``), and how many admissions
@@ -37,8 +43,13 @@ from collections import deque
 from typing import List, Optional, Tuple
 
 from bigdl_tpu.serving.streams import (
-    QueueFull, RequestCancelled, RequestHandle, RequestTimedOut,
+    PRIORITIES, PRIORITY_RANK, QueueFull, RequestCancelled,
+    RequestHandle, RequestTimedOut,
 )
+
+
+def _rank(h: RequestHandle) -> int:
+    return PRIORITY_RANK.get(getattr(h, "priority", "normal"), 1)
 
 
 class AdmissionQueue:
@@ -148,15 +159,21 @@ class AdmissionQueue:
         any cancelled/expired ones encountered on the way. Returns
         ``(handle_or_None, dropped)``.
 
-        PREFIX-AWARE ordering: with ``scorer`` (handle → number, e.g.
-        the cached-prefix length of the handle's prompt) and
-        ``window > 1``, the pop considers the first ``window`` live
-        handles and takes the highest-scoring one (ties and
-        all-zero scores fall back to FCFS — the scorer only ever
-        REORDERS within the window, admission stays work-conserving).
-        Starvation is bounded: after ``window`` consecutive pops bypass
-        the queue head, the next pop is forced FCFS, so the head waits
-        at most ``window`` extra admissions.
+        QoS ordering: with ``window > 1`` the pop considers the first
+        ``window`` live handles and takes the best by the composite
+        key ``(priority class, deadline slack, -score)`` — high class
+        beats tight deadline beats cached-prefix length (``scorer``:
+        handle → number, e.g. the cached-prefix length of the handle's
+        prompt). Ties keep strict FCFS — the key only ever REORDERS
+        within the window on a strict improvement, so all-default
+        traffic (same class, no deadlines, no scorer) stays exactly
+        FCFS and admission stays work-conserving.
+
+        Starvation is bounded PER CLASS: after ``window`` consecutive
+        pops bypass a high/normal queue head — or ``2 * window`` for a
+        low-class head — the next pop is forced FCFS, so even a
+        best-effort request under a priority storm waits at most a
+        bounded number of extra admissions, never forever.
 
         The scorer MAY carry side effects: the engine's prefix scorer
         starts the async host→device promotion the moment a candidate's
@@ -170,7 +187,7 @@ class AdmissionQueue:
         now = time.monotonic() if now is None else now
         dropped: List[Tuple[RequestHandle, Exception]] = []
         with self._lock:
-            if scorer is None or window <= 1:
+            if window <= 1:
                 # plain FCFS fast path: O(1) popleft per live pop —
                 # a deep queue must not pay a full rebuild per
                 # admission when nothing reorders
@@ -199,11 +216,20 @@ class AdmissionQueue:
                 self._lock.notify_all()
                 return None, dropped
             pick = live[0]
-            if len(live) > 1 and self._head_bypasses < window:
+            # the head's class sets its own starvation tolerance: a
+            # low-class head may be bypassed twice as long before the
+            # forced-FCFS pop, but the bound stays finite — low never
+            # starves completely, it just yields longer under load
+            budget = window * (2 if _rank(live[0]) >= 2 else 1)
+            if len(live) > 1 and self._head_bypasses < budget:
                 # one scorer call per candidate (each is a trie walk)
-                scores = [scorer(h) for h in live]
-                best = max(range(len(live)), key=scores.__getitem__)
-                if scores[best] > scores[0]:
+                keys = [(_rank(h),
+                         (h.deadline - now) if h.deadline is not None
+                         else float("inf"),
+                         -(scorer(h) if scorer is not None else 0))
+                        for h in live]
+                best = min(range(len(live)), key=keys.__getitem__)
+                if keys[best] < keys[0]:
                     pick = live[best]
             self._head_bypasses = (self._head_bypasses + 1
                                    if pick is not live[0] else 0)
@@ -215,6 +241,46 @@ class AdmissionQueue:
                     max(0.0, now - pick.submitted_at))
             self._lock.notify_all()
             return pick, dropped
+
+    def requeue(self, handle: RequestHandle) -> None:
+        """Put a PREEMPTED handle back at the queue head, bypassing
+        the capacity bound — the handle already held a slot, and
+        re-admission must not deadlock behind the very backlog that
+        caused the preemption. Bounded in practice by the engine's
+        slot count (at most one preemption per occupied slot).
+        Priority ordering still applies on the next pop: a requeued
+        best-effort victim yields to the high-class request whose
+        wait triggered the preemption."""
+        with self._lock:
+            self._q.appendleft(handle)
+            self._rec.record("request/requeued", handle.request_id,
+                             depth=len(self._q),
+                             preempted=getattr(handle, "preempted", 0),
+                             tenant=getattr(handle, "tenant", None))
+            self._lock.notify_all()
+
+    def oldest_waiting(self, priority: str,
+                       now: Optional[float] = None) -> Optional[float]:
+        """Longest current submit→now wait (seconds) among live queued
+        handles of the given priority class, or None when none are
+        queued — the engine's preemption trigger reads the high-class
+        figure every iteration."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            waits = [now - h.submitted_at for h in self._q
+                     if getattr(h, "priority", "normal") == priority
+                     and not h.cancelled]
+        return max(waits) if waits else None
+
+    def depth_by_class(self) -> dict:
+        """Queued handle count per priority class (``stats()["qos"]``
+        composition figure)."""
+        with self._lock:
+            out = {p: 0 for p in PRIORITIES}
+            for h in self._q:
+                p = getattr(h, "priority", "normal")
+                out[p] = out.get(p, 0) + 1
+            return out
 
     def sweep(self, now: Optional[float] = None
               ) -> List[Tuple[RequestHandle, Exception]]:
@@ -257,6 +323,84 @@ class AdmissionQueue:
                              reason=type(err).__name__,
                              tenant=getattr(h, "tenant", None))
         return err
+
+
+class TokenBucket:
+    """Per-tenant device-second token bucket (POST-PAID): a request is
+    admitted while the balance is positive and its measured
+    device-seconds are debited at finalize — the balance may go
+    negative (the in-flight request could not know its cost up
+    front), at which point further admissions are refused until the
+    refill brings it back above zero. ``retry_after()`` is therefore
+    the exact refill time to a positive balance — the honest
+    ``Retry-After`` figure the front door forwards.
+
+    Post-paid was chosen over pre-paid reservation because a
+    generation request's device cost is unknowable at submit (early
+    eos, speculative acceptance, preemption all change it) and the
+    UsageLedger already meters the true figure — the bucket just
+    consumes ``UsageRecord.device_s`` at the same finalize point.
+
+    Thread-safe; monotonic-clock based; rate and burst are in
+    device-seconds (per wall second / absolute)."""
+
+    def __init__(self, rate_per_s: float, burst: float):
+        if rate_per_s <= 0:
+            raise ValueError(
+                f"rate_per_s must be > 0, got {rate_per_s}")
+        if burst <= 0:
+            raise ValueError(f"burst must be > 0, got {burst}")
+        self.rate = float(rate_per_s)
+        self.burst = float(burst)
+        self._level = float(burst)
+        self._last: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def _refill(self, now: float) -> None:
+        if self._last is not None and now > self._last:
+            self._level = min(self.burst,
+                              self._level + (now - self._last)
+                              * self.rate)
+        self._last = now
+
+    def try_admit(self, now: Optional[float] = None) -> bool:
+        """True while the balance is positive (admit); no tokens are
+        taken here — the debit lands at finalize with the measured
+        cost."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._refill(now)
+            return self._level > 0.0
+
+    def retry_after(self, now: Optional[float] = None) -> float:
+        """Seconds until the balance refills back above zero (0.0
+        when already positive)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._refill(now)
+            if self._level > 0.0:
+                return 0.0
+            return (-self._level) / self.rate + 1e-9
+
+    def debit(self, amount: float,
+              now: Optional[float] = None) -> None:
+        """Consume ``amount`` device-seconds (finalize-time, measured
+        — may push the balance negative)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._refill(now)
+            self._level -= float(amount)
+
+    def level(self, now: Optional[float] = None) -> float:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._refill(now)
+            return self._level
+
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        return {"rate_device_s_per_s": self.rate,
+                "burst_device_s": self.burst,
+                "level_device_s": round(self.level(now), 9)}
 
 
 class PrefillPolicy:

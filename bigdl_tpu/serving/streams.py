@@ -23,6 +23,13 @@ import numpy as np
 
 from bigdl_tpu.observability.events import next_request_id
 
+#: admission priority classes, best-first. Rank (the tuple index) is
+#: the primary ordering key in ``AdmissionQueue.pop_ready`` and the
+#: shed/preemption order under overload: ``low`` is shed first and
+#: preempted first, ``high`` is never shed.
+PRIORITIES = ("high", "normal", "low")
+PRIORITY_RANK = {p: i for i, p in enumerate(PRIORITIES)}
+
 
 class RequestError(RuntimeError):
     """Base class for per-request terminal failures."""
@@ -34,6 +41,28 @@ class RequestCancelled(RequestError):
 
 class RequestTimedOut(RequestError):
     """The request's deadline passed while queued or mid-decode."""
+
+
+class RequestShed(RequestError):
+    """The request was shed at admission by burn-rate load shedding:
+    the engine's TTFT SLO is burning error budget and this request's
+    priority class is in the shed set. Carries ``retry_after_s`` — the
+    client should back off at least that long (the front door maps it
+    to HTTP 429 with a ``Retry-After`` header)."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
+class RequestRateLimited(RequestError):
+    """The request's tenant exhausted its device-second token bucket.
+    ``retry_after_s`` is the bucket's refill time back to a positive
+    balance — the honest ``Retry-After`` figure, not a guess."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
 
 
 class QueueFull(RuntimeError):
@@ -76,9 +105,21 @@ class RequestHandle:
 
     def __init__(self, prompt, max_new_tokens: int,
                  timeout_s: Optional[float] = None,
-                 request_id: Optional[str] = None):
+                 request_id: Optional[str] = None,
+                 priority: str = "normal"):
+        if priority not in PRIORITY_RANK:
+            raise ValueError(
+                f"priority must be one of {PRIORITIES}, got {priority!r}")
         self.prompt = np.asarray(prompt, np.int32)
         self.max_new_tokens = int(max_new_tokens)
+        #: admission priority class (``high``/``normal``/``low``) —
+        #: the queue's primary ordering key and the shed/preempt order
+        self.priority = priority
+        #: times this request was PREEMPTED (slot evicted with its KV
+        #: donated to the prefix pool, then automatically requeued) —
+        #: each resume re-prefills only the uncached tail, and the
+        #: final output stays token-identical to an unpreempted run
+        self.preempted: int = 0
         #: the request's correlation id (flight recorder events, the
         #: /debug endpoints, and Chrome traces all key on it)
         self.request_id = request_id or next_request_id()
@@ -181,6 +222,10 @@ class RequestHandle:
           accepted extensions arrive as multi-token bursts, so
           ``decode_s / (tokens - 1)`` remains the honest mean
           inter-token gap either way
+        - ``priority`` / ``preempted`` — the request's admission
+          class and how many times it was preempted (slot evicted,
+          KV donated, automatically resumed) — preemption cost is
+          attributable per request in ``/debug/requests``
 
         Final once the request is ``done()`` (the engine stamps each
         boundary as the lifecycle advances), partial before that."""
@@ -197,6 +242,8 @@ class RequestHandle:
             "prefix_tokens": self.prefix_tokens,
             "spec_proposed": self.spec_proposed,
             "spec_accepted": self.spec_accepted,
+            "priority": self.priority,
+            "preempted": self.preempted,
         }
 
     def usage(self) -> Optional[dict]:

@@ -46,8 +46,20 @@ must stay under ``_QUANT_LOGIT_DIV_CEILING`` and the speculative
 acceptance-rate delta between the int8-KV and fp-KV engines — signed,
 one-sided: only an acceptance LOSS gates — must stay under
 ``_QUANT_ACCEPT_DELTA_CEILING``; a numerics regression fails CI, not
-prod); all seven shapes are understood. Stdlib only — runnable from
-any CI step without the package installed.
+prod), and ``bench.py --serving --qos`` (``detail.qos.*`` — the QoS
+storm's high-class TTFT bands run-to-run like any other leg, and the
+row additionally gates three within-run verdicts: the storm-vs-
+uncontended high-class TTFT p50 ratio as an absolute ceiling
+(``_QOS_TTFT_P50_RATIO_CEILING`` — the ratio is already a within-run
+A/B, so like the fleet speedup it gates against its own meaningful
+scale, not as a band around the previous row's equally-noisy ratio;
+the p99 ratio rides along ungated, a max over a handful of samples),
+every QoS mechanism having actually fired (shed / preempted /
+rate-limited counts > 0 — a storm that exercised nothing measured
+nothing), and outcome conservation (every submission ended in exactly
+one terminal state — a silent drop is a correctness failure, not a
+perf number)); all eight shapes are understood. Stdlib only —
+runnable from any CI step without the package installed.
 
 Usage::
 
@@ -66,10 +78,12 @@ import sys
 #: block, in precedence order (--serving vs --serving --shared-prefix
 #: vs --serving --speculative vs --serving --tp vs --serving
 #: --shared-prefix --working-set vs --serving --fleet vs --serving
-#: --quantized — each row shape carries exactly one; the quantized
-#: row's fp leg is named ``fp_baseline`` so it stays out of this scan)
+#: --quantized vs --serving --qos — each row shape carries exactly
+#: one; the quantized row's fp leg is named ``fp_baseline`` and the
+#: qos row's contention-free leg ``uncontended`` so they stay out of
+#: this scan)
 _TTFT_PATHS = ("engine", "cached", "spec", "sharded", "tiered",
-               "affinity", "quantized")
+               "affinity", "quantized", "qos")
 
 #: absolute quality ceilings for --serving --quantized rows: int8
 #: numerics must stay this close to fp on the same seeds. Ceilings,
@@ -77,6 +91,13 @@ _TTFT_PATHS = ("engine", "cached", "spec", "sharded", "tiered",
 #: scale, unlike a latency that shifts with the host.
 _QUANT_LOGIT_DIV_CEILING = 0.25
 _QUANT_ACCEPT_DELTA_CEILING = 0.05
+
+#: absolute ceiling for --serving --qos rows: under the mixed-priority
+#: storm the high class's MEDIAN TTFT may cost at most this multiple
+#: of its uncontended self (the issue's acceptance bar). The p50 is
+#: the gated statistic — the p99 over a handful of high-class samples
+#: is a max, and host jitter swings it ±50% run to run.
+_QOS_TTFT_P50_RATIO_CEILING = 1.25
 
 
 def _p99(row: dict, measure: str):
@@ -186,6 +207,44 @@ def quantized_acceptance_delta(row: dict):
         return None
     dv = (detail.get("quality") or {}).get("acceptance_delta")
     return float(dv) if dv is not None else None
+
+
+def qos_ttft_p50_ratio(row: dict):
+    """The QoS storm row's storm-vs-uncontended high-class TTFT p50
+    ratio (~1.0: shedding + preemption held the top class at its
+    uncontended self), or None for every other row shape. Keyed off
+    the ``qos`` leg block — gated as an absolute ceiling
+    (``_QOS_TTFT_P50_RATIO_CEILING``), not run-to-run: the value is
+    already a within-run A/B ratio with a meaningful scale."""
+    detail = row.get("detail") or {}
+    if not detail.get("qos"):
+        return None
+    ratio = detail.get("high_ttft_p50_ratio")
+    return float(ratio) if ratio is not None else None
+
+
+def qos_mechanism_counts(row: dict):
+    """The QoS storm row's {shed, preempted, rate_limited} counts, or
+    None for every other row shape. Each must be > 0: the storm is
+    BUILT to trip all three mechanisms, so a zero means the workload
+    drifted and the headline ratio no longer measures the QoS stack
+    at work."""
+    detail = row.get("detail") or {}
+    if not detail.get("qos"):
+        return None
+    return {k: detail.get(k) for k in
+            ("shed", "preempted", "rate_limited")}
+
+
+def qos_conservation_ok(row: dict):
+    """The QoS storm row's outcome-conservation verdict (every
+    submission ended in exactly one of finished / shed / rate-limited
+    / cancelled / timed-out, client-side AND engine-side), or None for
+    every other row shape / rows predating the field."""
+    detail = row.get("detail") or {}
+    if not detail.get("qos"):
+        return None
+    return detail.get("conservation_ok")
 
 
 def signature(row: dict):
@@ -323,6 +382,44 @@ def main(argv=None) -> int:
             failed = True
         else:
             print(f"[perf-gate] ok: {verdict} clears the 1.0x floor")
+    # QoS storm rows: the p50 ratio is a within-run A/B with its own
+    # meaningful scale, so it gates as an absolute ceiling; the
+    # mechanism counts and conservation verdict are deterministic
+    # pass/fail facts about the run, not trends
+    qr = qos_ttft_p50_ratio(newest)
+    if qr is not None:
+        verdict = (f"qos high-class TTFT p50 ratio {qr:.3f}x for "
+                   f"{newest.get('metric')} {span}")
+        if qr > _QOS_TTFT_P50_RATIO_CEILING:
+            print(f"[perf-gate] FAIL: {verdict} exceeds the absolute "
+                  f"{_QOS_TTFT_P50_RATIO_CEILING}x ceiling — the storm "
+                  "is pricing the high class above its uncontended "
+                  "self")
+            failed = True
+        else:
+            print(f"[perf-gate] ok: {verdict} under the absolute "
+                  f"{_QOS_TTFT_P50_RATIO_CEILING}x ceiling")
+    counts = qos_mechanism_counts(newest)
+    if counts is not None:
+        for name, n in counts.items():
+            if not n:
+                print(f"[perf-gate] FAIL: qos storm fired 0 "
+                      f"{name} for {newest.get('metric')} {span} — the "
+                      "workload no longer exercises that mechanism, so "
+                      "the headline ratio measures nothing")
+                failed = True
+            else:
+                print(f"[perf-gate] ok: qos storm fired {n} {name}")
+    cons = qos_conservation_ok(newest)
+    if cons is not None:
+        if cons is not True:
+            print(f"[perf-gate] FAIL: qos outcome conservation broke "
+                  f"for {newest.get('metric')} {span} — a submission "
+                  "ended in zero or two terminal states")
+            failed = True
+        else:
+            print("[perf-gate] ok: qos outcomes conserve (every "
+                  "submission reached exactly one terminal state)")
     # quantized A/B rows: numerics quality gates as absolute ceilings
     # (a quality number has a meaningful scale of its own; gating it
     # against the previous row would let a slow drift walk the

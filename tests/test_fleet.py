@@ -106,7 +106,7 @@ class FakeReplica:
                 "queue_depth": 0, "active_slots": len(self.submitted)}
 
     def submit(self, prompt_ids, max_new_tokens, tenant=None,
-               timeout_s=None, block=True):
+               timeout_s=None, block=True, priority="normal"):
         if self.refuse is not None:
             raise self.refuse
         self.submitted.append(list(np.asarray(prompt_ids)))

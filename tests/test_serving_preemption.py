@@ -1,0 +1,171 @@
+"""Preemption with KV donation (bigdl_tpu/serving/engine.py).
+
+The acceptance contract under test: a high-class request waiting past
+``preempt_slack_s`` with no free slot evicts the lowest-class,
+longest-remaining victim; the victim's prompt + generated KV is
+donated to the prefix pool (pinned against LRU recycling), the
+request requeues at the head, and its automatic resume re-prefills
+only the uncached tail — so the preempted request's final output is
+TOKEN-IDENTICAL to an unpreempted ``model.generate`` run. That
+identity must hold through every engine variant (plain, tiered host
+cache, speculative draft, tensor-parallel mesh) with the jit-compile
+gauge FLAT across the preemption (no shape depends on it). Billing
+stays conserved: the victim's device-seconds are never un-billed,
+its slot residency closes at eviction, the second queue wait
+accumulates, and ``preemptions`` lands in the usage record, the
+timeline, ``/debug``-shaped surfaces and the flight recorder."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import observability as obs
+from bigdl_tpu.observability.events import FlightRecorder
+from bigdl_tpu.serving import ContinuousBatchingEngine
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(21)
+    m = TransformerLM(32, embed_dim=16, num_heads=4, num_kv_heads=2,
+                      num_layers=2, max_len=48, use_rope=True)
+    m.evaluate()
+    return m
+
+
+@pytest.fixture()
+def reg():
+    r = obs.MetricRegistry()
+    prev = obs.set_default_registry(r)
+    try:
+        yield r
+    finally:
+        obs.set_default_registry(prev)
+
+
+@pytest.fixture()
+def rec():
+    r = FlightRecorder()
+    prev = obs.set_default_recorder(r)
+    try:
+        yield r
+    finally:
+        obs.set_default_recorder(prev)
+
+
+def _direct(lm, prompt, n):
+    return np.asarray(lm.generate(jnp.asarray(prompt)[None], n))[0]
+
+
+_VICTIM = np.asarray([7, 3, 1, 4, 1, 5], np.int32)
+_URGENT = np.asarray([2, 6, 2, 6], np.int32)
+
+
+def _preempt_round(lm, rec, **engine_kw):
+    """The shared drill: one slot, a low-class long decode provably IN
+    the slot (first token streamed), then a high-class arrival whose
+    slack expires immediately — the engine must preempt, serve the
+    high request, resume the victim, and both outputs must match the
+    lone-generate oracle. Returns (engine stats, victim handle)."""
+    with ContinuousBatchingEngine(lm, max_slots=1, prefill_chunk=4,
+                                  preempt_slack_s=0.002,
+                                  **engine_kw) as eng:
+        # warm both request shapes so the jit gauge is steady before
+        # the preemption round
+        eng.submit(_VICTIM, 2, priority="low").result(timeout=60)
+        eng.submit(_URGENT, 2, priority="high").result(timeout=60)
+        jit_warm = eng.stats()["jit_compiles"]
+
+        h_low = eng.submit(_VICTIM, 40, priority="low", tenant="batch")
+        next(h_low.tokens())               # provably decoding in-slot
+        h_high = eng.submit(_URGENT, 4, priority="high",
+                            tenant="interactive")
+        np.testing.assert_array_equal(h_high.result(timeout=120),
+                                      _direct(lm, _URGENT, 4))
+        np.testing.assert_array_equal(h_low.result(timeout=120),
+                                      _direct(lm, _VICTIM, 40))
+        st = eng.stats()
+        assert h_low.preempted >= 1, "the drill never preempted"
+        assert h_high.preempted == 0
+        assert st["jit_compiles"] == jit_warm, \
+            "preemption must not mint new programs"
+        assert st["qos"]["preempted"] == h_low.preempted
+        assert st["finished"] == 4
+    events = [e for e in rec.tail() if e.kind == "request/preempted"]
+    assert events, "no request/preempted event recorded"
+    assert events[0].attrs["priority"] == "low"
+    assert events[0].attrs["donated_tokens"] >= len(_VICTIM)
+    return st, h_low
+
+
+def test_preempted_resume_token_identical_plain(lm, reg, rec):
+    st, h_low = _preempt_round(lm, rec)
+    tl = h_low.timeline()
+    assert tl["priority"] == "low" and tl["preempted"] >= 1
+    # the victim was billed BOTH prefill legs and both queue waits
+    u = h_low.usage()
+    assert u["preemptions"] == h_low.preempted
+    assert u["device_s"] > 0 and u["kv_byte_seconds"] > 0
+    assert u["queue_wait_s"] is not None
+
+
+def test_preempted_resume_token_identical_tiered(lm, reg, rec):
+    _preempt_round(lm, rec, prefix_host_rows=4)
+
+
+def test_preempted_resume_token_identical_speculative(lm, reg, rec):
+    from bigdl_tpu.nn.quantized import Quantizer
+
+    _preempt_round(lm, rec, draft=Quantizer.quantize(lm), spec_gamma=3)
+
+
+def test_preempted_resume_token_identical_tensor_parallel(lm, reg, rec):
+    from bigdl_tpu.parallel import Engine
+
+    mesh = Engine.create_mesh([("model", 2)],
+                              devices=jax.devices()[:2])
+    _preempt_round(lm, rec, mesh=mesh)
+
+
+def test_preemption_disabled_and_high_never_victim(lm, reg, rec):
+    """``preempt_slack_s=None`` turns the mechanism off — the high
+    request simply waits for the slot; and a slot held by HIGH work is
+    never preempted even with the mechanism on."""
+    with ContinuousBatchingEngine(lm, max_slots=1, prefill_chunk=4,
+                                  preempt_slack_s=None) as eng:
+        h_low = eng.submit(_VICTIM, 24, priority="low")
+        next(h_low.tokens())
+        h_high = eng.submit(_URGENT, 4, priority="high")
+        np.testing.assert_array_equal(h_high.result(timeout=120),
+                                      _direct(lm, _URGENT, 4))
+        assert h_low.preempted == 0
+        assert eng.stats()["qos"]["preempted"] == 0
+    with ContinuousBatchingEngine(lm, max_slots=1, prefill_chunk=4,
+                                  preempt_slack_s=0.002) as eng:
+        h_first = eng.submit(_VICTIM, 24, priority="high")
+        next(h_first.tokens())
+        h_second = eng.submit(_URGENT, 4, priority="high")
+        time.sleep(0.05)   # slack long expired; still no victim
+        np.testing.assert_array_equal(h_second.result(timeout=120),
+                                      _direct(lm, _URGENT, 4))
+        assert h_first.preempted == 0
+        np.testing.assert_array_equal(h_first.result(timeout=120),
+                                      _direct(lm, _VICTIM, 24))
+
+
+def test_preemption_ledger_conservation(lm, reg, rec):
+    """Engine-level conservation across a preemption: the per-tenant
+    device-second sums equal the measured dispatch busy time, and the
+    victim's preemption count survives into the aggregate."""
+    st, h_low = _preempt_round(lm, rec)
+    usage = st["usage"]
+    attributed = sum(a["device_s"] for a in usage["tenants"].values())
+    busy = usage["goodput"]["device_seconds"]["total"]
+    assert abs(attributed - busy) <= 1e-6 + 1e-3 * busy
+    assert usage["totals"]["preemptions"] >= h_low.preempted
