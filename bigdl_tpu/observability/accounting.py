@@ -294,6 +294,7 @@ class UsageLedger:
         residency closes into ``kv_byte_seconds`` and the slot-row
         residency opens."""
         if rec._staging_since is not None:
+            # graftlint: ok[lock-discipline] — staging_row_bytes is immutable after __init__
             rec.kv_byte_seconds += (self.staging_row_bytes
                                     * max(0.0, now - rec._staging_since))
             rec._staging_since = None
@@ -313,10 +314,12 @@ class UsageLedger:
         wait. Device-seconds already attributed are untouched:
         preemption never un-bills consumed device time."""
         if rec._staging_since is not None:
+            # graftlint: ok[lock-discipline] — staging_row_bytes is immutable after __init__
             rec.kv_byte_seconds += (self.staging_row_bytes
                                     * max(0.0, now - rec._staging_since))
             rec._staging_since = None
         if rec._slot_since is not None:
+            # graftlint: ok[lock-discipline] — slot_row_bytes is immutable after __init__
             rec.kv_byte_seconds += (self.slot_row_bytes
                                     * max(0.0, now - rec._slot_since))
             rec._slot_since = None
@@ -332,6 +335,7 @@ class UsageLedger:
         pro-rata across the rows it advanced (``shares`` weights sum
         to 1 — conservation), and fold the padded-idle fraction into
         the goodput accumulators + instruments. Loop thread only."""
+        # graftlint: ok[lock-discipline] — key-membership only; _busy's keys are fixed at __init__
         if kind not in self._busy:
             raise ValueError(f"unknown dispatch kind {kind!r}; "
                              f"expected one of {KINDS}")
@@ -515,6 +519,7 @@ class UsageLedger:
             "tenants": self.tenants(),
             "totals": self.totals(),
             "goodput": self.goodput(),
+            # graftlint: ok[lock-discipline] — max_tenants is immutable after __init__
             "max_tenants": self.max_tenants,
             "devices": self.devices,
         }

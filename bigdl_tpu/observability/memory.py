@@ -359,7 +359,7 @@ class DeviceMemoryMonitor:
             try:
                 self.sample()
             except Exception:
-                # a transient backend error must not kill the sampler
+                # graftlint: ok[resource-hygiene] — a transient backend error must not kill the sampler; the next tick retries
                 pass
 
     def stop(self) -> None:

@@ -849,6 +849,7 @@ class ContinuousBatchingEngine:
 
         def _jit(fn, donate, out=None):
             if self.mesh is None:
+                # graftlint: ok[jit-hazard] — meshless (single-device) branch has no shardings to pin
                 return jax.jit(fn, donate_argnums=donate)
             return jax.jit(fn, donate_argnums=donate, out_shardings=out)
 
@@ -1816,7 +1817,8 @@ class ContinuousBatchingEngine:
             self._crash(e)
 
     def _crash(self, e: BaseException) -> None:
-        self._crashed = e
+        with self._lifecycle:
+            self._crashed = e
         self._rec.record("engine/crash", service=self.service_name,
                          error=repr(e))
         # capture the in-flight picture BEFORE failing the handles —

@@ -157,6 +157,7 @@ class ReplicaSupervisor:
             try:
                 r.stop()
             except Exception:
+                # graftlint: ok[resource-hygiene] — best-effort fan-out stop; one dead replica must not block the rest
                 pass
         self._started = False
 
@@ -172,8 +173,7 @@ class ReplicaSupervisor:
             try:
                 self.poll_once()
             except Exception:
-                # a poll crash must not kill supervision; the next
-                # tick retries
+                # graftlint: ok[resource-hygiene] — a poll crash must not kill supervision; the next tick retries
                 pass
 
     def poll_once(self) -> Dict[str, dict]:
@@ -229,7 +229,7 @@ class ReplicaSupervisor:
         try:
             self._replicas[rid].drain()
         except Exception:
-            pass  # a crashed replica can't ack the drain — fine
+            pass  # graftlint: ok[resource-hygiene] — a crashed replica can't ack the drain; it's marked draining either way
         if not already:
             self._ins.drains_total.labels(
                 self.fleet_name, reason).inc()
@@ -246,6 +246,7 @@ class ReplicaSupervisor:
         try:
             self._replicas[rid].resume()
         except Exception:
+            # graftlint: ok[resource-hygiene] — a dead replica can't ack the resume; health polling re-drains it
             pass
         self.router.mark_live(rid)
         if was is not None:

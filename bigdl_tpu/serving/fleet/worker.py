@@ -330,6 +330,7 @@ class WorkerReplica:
             self._send({"op": "stop", "drain": True,
                         "timeout": max(0.0, timeout - 5.0)})
         except Exception:
+            # graftlint: ok[resource-hygiene] — best-effort goodbye on a possibly-dead pipe; join below is the real stop
             pass
         self._proc.join(timeout=timeout)
         if self._proc.is_alive():
@@ -392,6 +393,7 @@ class WorkerReplica:
                         f"worker {self.id}: no {op} reply in "
                         f"{timeout}s")
                 try:
+                    # graftlint: ok[lock-discipline] — _reply_lock IS the one-outstanding-call serializer; replies arrive from _read_loop, which never takes it
                     msg = self._replies.get(timeout=min(remaining, 0.5))
                 except queue_mod.Empty:
                     if not self.alive():

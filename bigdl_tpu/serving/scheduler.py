@@ -398,8 +398,10 @@ class TokenBucket:
             return self._level
 
     def snapshot(self, now: Optional[float] = None) -> dict:
-        return {"rate_device_s_per_s": self.rate,
-                "burst_device_s": self.burst,
+        # graftlint: ok[lock-discipline] — rate/burst are immutable after __init__
+        rate, burst = self.rate, self.burst
+        return {"rate_device_s_per_s": rate,
+                "burst_device_s": burst,
                 "level_device_s": round(self.level(now), 9)}
 
 

@@ -1,0 +1,47 @@
+"""graftlint — AST-based static analysis for the bigdl_tpu tree.
+
+A pluggable checker framework that makes this repo's two costliest
+invisible bug classes mechanical instead of tribal (the
+"RPC Considered Harmful" argument, PAPERS.md 1805.08430): silent jit
+recompilation on the serving hot path, and data races between the
+scheduler / engine-loop / ledger threads. Four checkers ship in
+:mod:`.checkers`:
+
+- ``jit-hazard`` (JIT0xx) — recompile / abstract-value hazards inside
+  functions reachable from ``jax.jit`` / ``pjit`` call sites.
+- ``lock-discipline`` (LCK0xx) — per-class guarded-by inference over
+  ``with self._lock:`` blocks; unguarded access to guarded attributes
+  and blocking calls made while a lock is held.
+- ``observability-drift`` (OBS0xx) — the former
+  ``scripts/metrics_lint.py`` as a checker: ``bigdl_*`` instruments
+  minted in one module, documented both directions.
+- ``resource-hygiene`` (RES0xx) — non-daemon threads without join
+  ownership, files/sockets opened outside a context manager,
+  ``except: pass`` on the serving hot path.
+
+Everything here is **stdlib-only** and import-light on purpose:
+``scripts/graftlint.py`` loads this package standalone (without
+executing ``bigdl_tpu/__init__``), so the CLI runs from any CI step in
+milliseconds, with no jax in sight. Keep imports relative and keep
+heavyweight dependencies out.
+
+Public surface: :func:`run` (scan → findings split against the
+baseline), the checker registry in :mod:`.core`, and
+:func:`.cli.main` behind ``scripts/graftlint.py``.
+"""
+
+from .core import (  # noqa: F401
+    Checker, Finding, SCHEMA_VERSION, all_checkers, in_scope,
+    iter_target_files, register, run_checkers, suppressions_for_text,
+)
+from .baseline import load_baseline, split_findings, write_baseline  # noqa: F401
+from .cache import FileCache  # noqa: F401
+from . import checkers  # noqa: F401  (registers the shipped checkers)
+from .cli import main, run  # noqa: F401
+
+__all__ = [
+    "Checker", "Finding", "FileCache", "SCHEMA_VERSION",
+    "all_checkers", "in_scope", "iter_target_files", "load_baseline",
+    "main", "register", "run", "run_checkers", "split_findings",
+    "suppressions_for_text", "write_baseline",
+]

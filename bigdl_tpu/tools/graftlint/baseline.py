@@ -1,0 +1,82 @@
+"""The committed-findings baseline: ratchet semantics.
+
+``graftlint_baseline.json`` records every pre-existing finding as
+``{file, code, line}`` — the line is the FIRST-SEEN line, kept so a
+baseline diff stays reviewable (you can open the site), but matching
+is **count-based per (file, code)**: a finding survives line drift
+from unrelated edits above it, while an *additional* hazard of the
+same code in the same file is always new. The ratchet only tightens —
+``--write-baseline`` regenerates from the current tree, and review
+should only ever see entries disappear.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+from typing import Dict, List, Tuple
+
+from .core import Finding, SCHEMA_VERSION
+
+DEFAULT_BASELINE = "graftlint_baseline.json"
+
+
+def load_baseline(path: str) -> Dict[Tuple[str, str], List[dict]]:
+    """(file, code) -> baseline entries (empty when absent/corrupt —
+    a missing baseline means everything is new, which is exactly the
+    bootstrap behavior ``--write-baseline`` expects)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    out: Dict[Tuple[str, str], List[dict]] = collections.defaultdict(list)
+    for e in data.get("entries", []):
+        try:
+            out[(e["file"], e["code"])].append(e)
+        except (TypeError, KeyError):
+            continue
+    return dict(out)
+
+
+def split_findings(findings: List[Finding],
+                   baseline: Dict[Tuple[str, str], List[dict]]
+                   ) -> Tuple[List[Finding], List[Finding]]:
+    """Partition into ``(new, baselined)``: per (file, code) bucket the
+    first N findings (by line) are absorbed by N baseline entries, the
+    rest are new. Line-drift tolerant, count-exact."""
+    budget = {k: len(v) for k, v in baseline.items()}
+    new: List[Finding] = []
+    baselined: List[Finding] = []
+    for f in sorted(findings, key=Finding.sort_key):
+        left = budget.get(f.key(), 0)
+        if left > 0:
+            budget[f.key()] = left - 1
+            baselined.append(f)
+        else:
+            new.append(f)
+    return new, baselined
+
+
+def write_baseline(findings: List[Finding], path: str) -> dict:
+    """Serialize the current findings as the new baseline (sorted,
+    one entry per finding, first-seen line recorded). Returns the
+    written document."""
+    doc = {
+        "version": SCHEMA_VERSION,
+        "note": ("pre-existing graftlint findings; matching is "
+                 "count-based per (file, code) — lines are first-seen, "
+                 "for review. Regenerate: scripts/graftlint.py --all "
+                 "--write-baseline. The ratchet only tightens."),
+        "entries": [
+            {"file": f.file, "code": f.code, "line": f.line}
+            for f in sorted(findings, key=Finding.sort_key)
+        ],
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fp:
+        json.dump(doc, fp, indent=1, sort_keys=False)
+        fp.write("\n")
+    os.replace(tmp, path)
+    return doc

@@ -1,0 +1,79 @@
+"""Per-file findings cache keyed on content hash + checker versions.
+
+A full scan parses ~150 modules through four AST checkers; the cache
+makes the steady-state ``--all`` run touch only edited files. Entries
+key on the file's sha1 (not mtime — checkouts and CI restores scramble
+mtimes) plus the combined checker signature, so bumping any checker's
+``version`` invalidates exactly everything. The cache file lives at
+the repo root as ``.graftlint_cache.json`` (gitignored) and is written
+atomically — a torn write at worst costs one cold scan.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import List, Optional, Tuple
+
+from .core import Finding, checkers_signature
+
+DEFAULT_CACHE = ".graftlint_cache.json"
+
+
+def _sha1(path: str) -> Optional[str]:
+    try:
+        with open(path, "rb") as f:
+            return hashlib.sha1(f.read()).hexdigest()
+    except OSError:
+        return None
+
+
+class FileCache:
+    def __init__(self, path: str):
+        self.path = path
+        self._sig = checkers_signature()
+        self._data: dict = {}
+        self._dirty = False
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+            if doc.get("signature") == self._sig:
+                self._data = doc.get("files", {})
+        except (OSError, ValueError):
+            pass
+
+    def get(self, root: str, relpath: str
+            ) -> Optional[Tuple[List[Finding], int]]:
+        ent = self._data.get(relpath)
+        if not ent:
+            return None
+        if ent.get("sha1") != _sha1(os.path.join(root, relpath)):
+            return None
+        fs = [Finding.from_dict(d) for d in ent.get("findings", [])]
+        return fs, int(ent.get("suppressed", 0))
+
+    def put(self, root: str, relpath: str, findings: List[Finding],
+            n_suppressed: int) -> None:
+        sha = _sha1(os.path.join(root, relpath))
+        if sha is None:
+            return
+        self._data[relpath] = {
+            "sha1": sha,
+            "suppressed": int(n_suppressed),
+            "findings": [f.to_dict() for f in findings],
+        }
+        self._dirty = True
+
+    def save(self) -> None:
+        if not self._dirty:
+            return
+        doc = {"signature": self._sig, "files": self._data}
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(doc, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # a read-only checkout just runs cold every time
+        self._dirty = False

@@ -1,0 +1,12 @@
+"""The shipped checkers. Importing this package registers all four
+into :mod:`..core`'s registry (the ``@register`` decorator runs at
+import time). To add a checker: write a module here subclassing
+``core.Checker``, decorate it with ``@register``, import it below,
+and give it a dirty+clean fixture pair under
+``tests/graftlint_fixtures/`` — see
+docs/programming-guide/static-analysis.md."""
+
+from . import jit_hazard  # noqa: F401
+from . import lock_discipline  # noqa: F401
+from . import observability_drift  # noqa: F401
+from . import resource_hygiene  # noqa: F401

@@ -1,0 +1,480 @@
+"""jit-hazard: recompile / abstract-value hazards under ``jax.jit``.
+
+The serving stack's whole performance story rests on a FLAT jit gauge
+(pinned shapes + pinned shardings, ROADMAP PRs 2/8): one leaked
+trace-time concretization or one unpinned output sharding turns every
+request into a fresh compile. This checker finds the classic hazards
+*statically*, inside any function reachable from a jit call site in
+the same module:
+
+- JIT001 — ``bool()/int()/float()/len()`` or ``.item()/.tolist()`` on
+  a likely-traced value (forces concretization → TracerError or a
+  silent host sync).
+- JIT002 — ``np.*`` call on a likely-traced value (host math on a
+  tracer: concretization or a per-call device→host transfer).
+- JIT003 — f-string / ``str()`` / ``.format()`` / ``%`` formatting of
+  a likely-traced value (stringifies the tracer, not the number).
+- JIT004 — a ``static_argnames``/``static_argnums`` parameter whose
+  default is mutable/unhashable (list/dict/set): static args are
+  hashed per call — an unhashable default is a TypeError, a mutable
+  one a cache-poisoning recompile per mutation.
+- JIT005 — a raw ``jax.jit``/``pjit`` call without ``out_shardings=``
+  (scoped to serving modules: left to GSPMD, a donated cache tree's
+  layout drifts and every request adds a compile — the PR 8 lesson).
+
+Reachability and tracedness are MODULE-LOCAL and deliberately
+heuristic: jit entries are functions decorated with ``jit``/``pjit``
+(bare or via ``partial``) or passed by name into a call whose callee
+ends in ``jit``; their non-static params seed the traced set, which
+propagates through assignments, arithmetic, ``jnp/lax/jax.*`` calls,
+and same-module call argument binding. Heuristics miss cross-module
+flows by design — a lint that needs whole-program inference stops
+being a pre-commit tool.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Checker, Finding, register
+
+_CONCRETIZERS = {"bool", "int", "float", "len"}
+_ITEM_METHODS = {"item", "tolist"}
+#: jnp/lax-ish dotted heads whose call results are traced values
+_TRACED_HEADS = ("jnp.", "lax.", "jax.numpy.", "jax.lax.", "jax.nn.",
+                 "jax.random.", "jax.scipy.")
+#: jax entry points that are NOT value-producing (don't mark traced)
+_JAX_META = {"jax.jit", "jax.pjit", "jax.grad", "jax.vmap", "jax.pmap",
+             "jax.tree.map", "jax.tree_util.tree_map"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _numpy_aliases(tree: ast.AST) -> Set[str]:
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    out.add(a.asname or "numpy")
+        elif isinstance(node, ast.ImportFrom):
+            # `from numpy import linalg as la` etc. — treat the bound
+            # name as a numpy head too
+            if node.module == "numpy":
+                for a in node.names:
+                    out.add(a.asname or a.name)
+    return out
+
+
+def _jnp_aliases(tree: ast.AST) -> Set[str]:
+    """Names bound to jax.numpy / jax.lax / jax itself."""
+    out = {"jax"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in ("jax.numpy", "jax.lax", "jax.nn",
+                              "jax.random") and a.asname:
+                    out.add(a.asname)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name in ("numpy", "lax", "nn", "random"):
+                        out.add(a.asname or a.name)
+    return out
+
+
+class _FnInfo:
+    __slots__ = ("node", "qual", "traced_params", "reachable",
+                 "statics")
+
+    def __init__(self, node, qual):
+        self.node = node
+        self.qual = qual
+        self.traced_params: Set[str] = set()
+        self.reachable = False
+        #: static param names (from the jit site) — never traced
+        self.statics: Set[str] = set()
+
+
+def _param_names(fn) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _static_names_from_call(call: ast.Call, fn) -> Set[str]:
+    """Resolve static_argnames/static_argnums kwargs of a jit call
+    against the target function's positional parameter order."""
+    out: Set[str] = set()
+    pos = [p.arg for p in fn.args.posonlyargs + fn.args.args]
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for el in ast.walk(kw.value):
+                if isinstance(el, ast.Constant) \
+                        and isinstance(el.value, str):
+                    out.add(el.value)
+        elif kw.arg == "static_argnums":
+            for el in ast.walk(kw.value):
+                if isinstance(el, ast.Constant) \
+                        and isinstance(el.value, int) \
+                        and not isinstance(el.value, bool):
+                    if 0 <= el.value < len(pos):
+                        out.add(pos[el.value])
+    return out
+
+
+def _mutable_default(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        head = _dotted(node.func)
+        return head in ("list", "dict", "set", "bytearray",
+                        "collections.defaultdict")
+    return False
+
+
+@register
+class JitHazardChecker(Checker):
+    name = "jit-hazard"
+    version = 1
+    codes = {
+        "JIT001": "concretization (bool/int/float/len/.item) of a "
+                  "traced value under jit",
+        "JIT002": "numpy host math on a traced value under jit",
+        "JIT003": "string formatting of a traced value under jit",
+        "JIT004": "mutable/unhashable default on a static jit arg",
+        "JIT005": "raw jax.jit/pjit without pinned out_shardings "
+                  "(serving modules)",
+    }
+
+    # ------------------------------------------------------- analysis
+    def check_file(self, relpath: str, tree: ast.AST,
+                   text: str) -> List[Finding]:
+        if "jit" not in text:
+            return []  # cheap pre-filter: no jit, no hazard surface
+        self._np = _numpy_aliases(tree)
+        self._jnp = _jnp_aliases(tree)
+
+        fns: Dict[str, _FnInfo] = {}
+        order: List[_FnInfo] = []
+
+        def collect(node, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    qual = (prefix + "." + child.name) if prefix \
+                        else child.name
+                    info = _FnInfo(child, qual)
+                    # bare name resolution (first definition wins)
+                    fns.setdefault(child.name, info)
+                    order.append(info)
+                    collect(child, qual)
+                elif isinstance(child, ast.ClassDef):
+                    collect(child, (prefix + "." if prefix else "")
+                            + child.name)
+                else:
+                    collect(child, prefix)
+
+        collect(tree, "")
+
+        findings: List[Finding] = []
+        entries = self._find_jit_entries(tree, fns, relpath, findings)
+
+        # seed: every non-static param of a jit entry is traced
+        work: List[_FnInfo] = []
+        for info, statics in entries:
+            info.statics |= statics
+            new = {p for p in _param_names(info.node)
+                   if p not in info.statics}
+            if not info.reachable or not new <= info.traced_params:
+                info.reachable = True
+                info.traced_params |= new
+                work.append(info)
+
+        # propagate through same-module call argument binding until
+        # fixpoint (bounded: traced sets only grow)
+        for _ in range(20):
+            if not work:
+                break
+            batch, work = work, []
+            for info in batch:
+                for callee, params in self._called_with_traced(
+                        info, fns):
+                    added = params - callee.traced_params
+                    if added or not callee.reachable:
+                        callee.reachable = True
+                        callee.traced_params |= added
+                        work.append(callee)
+
+        for info in order:
+            if info.reachable:
+                self._scan_body(relpath, info, findings)
+        return findings
+
+    # ------------------------------------------------- entry discovery
+    def _find_jit_entries(self, tree, fns, relpath, findings
+                          ) -> List[Tuple[_FnInfo, Set[str]]]:
+        entries: List[Tuple[_FnInfo, Set[str]]] = []
+
+        def is_jit_callee(func) -> bool:
+            head = _dotted(func)
+            if head is None:
+                return False
+            last = head.rsplit(".", 1)[-1]
+            return last in ("jit", "pjit") or last.endswith("_jit") \
+                or last == "_jit"
+
+        for node in ast.walk(tree):
+            # decorators: @jax.jit / @jit / @partial(jax.jit, ...)
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                info = fns.get(node.name)
+                if info is None or info.node is not node:
+                    info = next((i for i in fns.values()
+                                 if i.node is node), info)
+                for dec in node.decorator_list:
+                    call = dec if isinstance(dec, ast.Call) else None
+                    target = call.func if call else dec
+                    head = _dotted(target) or ""
+                    last = head.rsplit(".", 1)[-1]
+                    if last == "partial" and call and call.args:
+                        inner = _dotted(call.args[0]) or ""
+                        if inner.rsplit(".", 1)[-1] in ("jit", "pjit"):
+                            statics = (_static_names_from_call(
+                                call, node) if call else set())
+                            if info:
+                                entries.append((info, statics))
+                                self._check_static_defaults(
+                                    relpath, call, node, findings)
+                    elif last in ("jit", "pjit"):
+                        statics = (_static_names_from_call(call, node)
+                                   if call else set())
+                        if info:
+                            entries.append((info, statics))
+                        if call:
+                            self._check_static_defaults(
+                                relpath, call, node, findings)
+            # calls: jax.jit(fn, ...) / _jit(step, ...) — any function
+            # NAME handed to a jit-ish callee becomes an entry
+            elif isinstance(node, ast.Call) \
+                    and is_jit_callee(node.func):
+                head = _dotted(node.func) or ""
+                raw = head.rsplit(".", 1)[-1] in ("jit", "pjit")
+                for arg in node.args:
+                    if isinstance(arg, ast.Name) and arg.id in fns:
+                        statics = _static_names_from_call(
+                            node, fns[arg.id].node)
+                        entries.append((fns[arg.id], statics))
+                        if raw:
+                            self._check_static_defaults(
+                                relpath, node, fns[arg.id].node,
+                                findings)
+                if raw and not any(kw.arg == "out_shardings"
+                                   for kw in node.keywords):
+                    findings.append(self.finding(
+                        relpath, node, "JIT005",
+                        "jax.jit without out_shardings= — unpinned "
+                        "output layout lets GSPMD drift a donated "
+                        "tree and mint a compile per request"))
+        return entries
+
+    def _check_static_defaults(self, relpath, call, fn, findings):
+        statics = _static_names_from_call(call, fn)
+        if not statics:
+            return
+        args = fn.args
+        pos = args.posonlyargs + args.args
+        defaults = args.defaults
+        for p, d in zip(pos[len(pos) - len(defaults):], defaults):
+            if p.arg in statics and _mutable_default(d):
+                findings.append(self.finding(
+                    relpath, d, "JIT004",
+                    f"static arg {p.arg!r} of {fn.name!r} has a "
+                    "mutable/unhashable default — static args are "
+                    "hashed per jit call"))
+        for p, d in zip(args.kwonlyargs, args.kw_defaults):
+            if d is not None and p.arg in statics \
+                    and _mutable_default(d):
+                findings.append(self.finding(
+                    relpath, d, "JIT004",
+                    f"static arg {p.arg!r} of {fn.name!r} has a "
+                    "mutable/unhashable default — static args are "
+                    "hashed per jit call"))
+
+    # --------------------------------------------------- traced values
+    def _is_traced(self, node, traced: Set[str]) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in traced
+        if isinstance(node, ast.Attribute):
+            # x.T / x.dtype-ish chains: traced if the root is
+            return self._is_traced(node.value, traced)
+        if isinstance(node, ast.Subscript):
+            return self._is_traced(node.value, traced)
+        if isinstance(node, ast.BinOp):
+            return (self._is_traced(node.left, traced)
+                    or self._is_traced(node.right, traced))
+        if isinstance(node, ast.UnaryOp):
+            return self._is_traced(node.operand, traced)
+        if isinstance(node, ast.Compare):
+            return (self._is_traced(node.left, traced)
+                    or any(self._is_traced(c, traced)
+                           for c in node.comparators))
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self._is_traced(e, traced) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return (self._is_traced(node.body, traced)
+                    or self._is_traced(node.orelse, traced))
+        if isinstance(node, ast.Call):
+            head = _dotted(node.func)
+            if head:
+                root = head.split(".", 1)[0]
+                if head in _JAX_META:
+                    return False
+                if any(head.startswith(h) for h in _TRACED_HEADS) \
+                        or root in self._jnp:
+                    return True
+                # method on a traced value (x.sum(), x.astype())
+            if isinstance(node.func, ast.Attribute) \
+                    and self._is_traced(node.func.value, traced):
+                return True
+        return False
+
+    def _called_with_traced(self, info: _FnInfo, fns
+                            ) -> List[Tuple[_FnInfo, Set[str]]]:
+        """Same-module callees of ``info`` with the params that
+        receive traced arguments."""
+        out = []
+        traced = info.traced_params
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Name):
+                continue
+            callee = fns.get(node.func.id)
+            if callee is None or callee.node is info.node:
+                continue
+            pos = [p.arg for p in (callee.node.args.posonlyargs
+                                   + callee.node.args.args)]
+            hit: Set[str] = set()
+            for i, arg in enumerate(node.args):
+                if i < len(pos) and self._is_traced(arg, traced):
+                    hit.add(pos[i])
+            for kw in node.keywords:
+                if kw.arg and self._is_traced(kw.value, traced):
+                    hit.add(kw.arg)
+            if hit:
+                out.append((callee, hit))
+        return out
+
+    # ------------------------------------------------------- emission
+    def _scan_body(self, relpath: str, info: _FnInfo,
+                   findings: List[Finding]) -> None:
+        traced = set(info.traced_params)
+        own_defs = {n for n in ast.walk(info.node)
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+                    and n is not info.node}
+
+        def in_nested(node):
+            return any(node in ast.walk(d) for d in own_defs)
+
+        # forward pass: grow the traced set through assignments
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Assign) \
+                    and self._is_traced(node.value, traced):
+                for t in node.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            traced.add(n.id)
+            elif isinstance(node, ast.AugAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and self._is_traced(node.value, traced):
+                traced.add(node.target.id)
+
+        for node in ast.walk(info.node):
+            if in_nested(node):
+                continue  # nested defs analyzed via their own info
+            if isinstance(node, ast.Call):
+                head = _dotted(node.func)
+                # bool(x) / len(x) / int(x) / float(x)
+                if head in _CONCRETIZERS and node.args \
+                        and self._is_traced(node.args[0], traced):
+                    findings.append(self.finding(
+                        relpath, node, "JIT001",
+                        f"{head}() on traced value inside "
+                        f"jit-reachable {info.qual!r} — forces "
+                        "concretization at trace time"))
+                # str(x) formats the tracer
+                elif head == "str" and node.args \
+                        and self._is_traced(node.args[0], traced):
+                    findings.append(self.finding(
+                        relpath, node, "JIT003",
+                        f"str() of traced value inside jit-reachable "
+                        f"{info.qual!r} — stringifies the tracer"))
+                elif isinstance(node.func, ast.Attribute):
+                    # x.item() / x.tolist()
+                    if node.func.attr in _ITEM_METHODS \
+                            and self._is_traced(node.func.value,
+                                                traced):
+                        findings.append(self.finding(
+                            relpath, node, "JIT001",
+                            f".{node.func.attr}() on traced value "
+                            f"inside jit-reachable {info.qual!r} — "
+                            "forces a device sync / concretization"))
+                    # "...".format(traced)
+                    elif node.func.attr == "format" \
+                            and isinstance(node.func.value,
+                                           ast.Constant) \
+                            and any(self._is_traced(a, traced)
+                                    for a in list(node.args)
+                                    + [k.value for k in
+                                       node.keywords]):
+                        findings.append(self.finding(
+                            relpath, node, "JIT003",
+                            f".format() of traced value inside "
+                            f"jit-reachable {info.qual!r}"))
+                    # np.<anything>(traced)
+                    if head:
+                        root = head.split(".", 1)[0]
+                        if root in self._np \
+                                and any(self._is_traced(a, traced)
+                                        for a in node.args):
+                            findings.append(self.finding(
+                                relpath, node, "JIT002",
+                                f"{head}() on traced value inside "
+                                f"jit-reachable {info.qual!r} — host "
+                                "numpy concretizes the tracer"))
+            elif isinstance(node, ast.JoinedStr):
+                for v in node.values:
+                    if isinstance(v, ast.FormattedValue) \
+                            and self._is_traced(v.value, traced):
+                        findings.append(self.finding(
+                            relpath, node, "JIT003",
+                            f"f-string interpolates traced value "
+                            f"inside jit-reachable {info.qual!r}"))
+                        break
+            elif isinstance(node, ast.BinOp) \
+                    and isinstance(node.op, ast.Mod) \
+                    and isinstance(node.left, ast.Constant) \
+                    and isinstance(node.left.value, str) \
+                    and self._is_traced(node.right, traced):
+                findings.append(self.finding(
+                    relpath, node, "JIT003",
+                    f"%-format of traced value inside jit-reachable "
+                    f"{info.qual!r}"))

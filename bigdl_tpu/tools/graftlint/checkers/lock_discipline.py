@@ -1,0 +1,310 @@
+"""lock-discipline: guarded-by inference + held-lock blocking calls.
+
+A lightweight race detector for the threaded serving stack (scoped by
+core.SCOPES to ``bigdl_tpu/serving/**`` and
+``observability/accounting.py`` — the modules the scheduler, engine
+loop, HTTP front door, and ledger threads all write through).
+
+Per class that owns a lock (an attribute assigned
+``threading.Lock/RLock/Condition`` or used as ``with self._lock:``):
+
+- the **guarded-by set** is inferred as every ``self.X`` attribute
+  touched (read or write) while the lock is held. "Held" is lexical
+  (inside the ``with``) plus one interprocedural step: a private
+  method whose every intra-class call site is lock-held is analyzed
+  as lock-held itself (the ``_refill``/``_terminal`` pattern), to a
+  fixpoint.
+- LCK001 — an access to a guarded attribute at a site where the lock
+  is NOT held. ``__init__``/``__new__``/``__del__`` are exempt
+  (construction/teardown are single-threaded by contract). Immutable
+  config reads that trip this are exactly the "unguarded stat read"
+  class — suppress each with ``# graftlint: ok[lock-discipline] — <why>``
+  rather than widening the checker.
+- LCK002 — a blocking call made while the lock is held:
+  ``time.sleep``, zero-arg ``.join()`` (thread join; ``str.join``
+  always takes an iterable), zero-arg ``.get()`` (queue get; ``dict
+  .get`` always takes a key), socket ops, ``subprocess``/``urlopen``,
+  ``jax device_put`` / ``.block_until_ready()`` — a device sync under
+  a lock serializes every other thread behind the transfer.
+  ``Condition.wait/notify`` are deliberately NOT flagged: holding the
+  lock there is the API contract.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Checker, Finding, register
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_LOCK_NAME_HINTS = ("lock", "cond", "mutex")
+_EXEMPT_METHODS = {"__init__", "__new__", "__del__"}
+#: attribute-method calls that MUTATE their receiver (count as writes
+#: for guarded-by inference — ``self._q.append`` guards ``_q``)
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "remove", "discard", "pop", "popleft", "popitem", "clear",
+    "update", "setdefault", "sort", "reverse", "rotate",
+}
+#: dotted names that block (module-level calls)
+_BLOCKING_DOTTED = {
+    "time.sleep", "select.select", "subprocess.run",
+    "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "urllib.request.urlopen", "urlopen",
+}
+#: attribute calls that block regardless of receiver
+_BLOCKING_ATTRS = {"block_until_ready", "accept", "recv", "recvfrom",
+                   "sendall", "connect"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'X' for a ``self.X`` attribute node, else None."""
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _Access:
+    __slots__ = ("attr", "write", "locked", "node")
+
+    def __init__(self, attr, write, locked, node):
+        self.attr = attr
+        self.write = write
+        self.locked = locked
+        self.node = node
+
+
+class _MethodScan:
+    __slots__ = ("name", "accesses", "calls", "blocking")
+
+    def __init__(self, name):
+        self.name = name
+        self.accesses: List[_Access] = []
+        #: (callee_method_name, locked_at_call_site)
+        self.calls: List[Tuple[str, bool]] = []
+        #: blocking call sites seen while lexically locked:
+        #: (node, rendered_callee)
+        self.blocking: List[Tuple[ast.AST, str]] = []
+
+
+@register
+class LockDisciplineChecker(Checker):
+    name = "lock-discipline"
+    version = 1
+    codes = {
+        "LCK001": "access to a lock-guarded attribute without the "
+                  "lock held",
+        "LCK002": "blocking call while holding a lock",
+    }
+
+    def check_file(self, relpath: str, tree: ast.AST,
+                   text: str) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                self._check_class(relpath, node, findings)
+        return findings
+
+    # ---------------------------------------------------------- class
+    def _lock_attrs(self, cls: ast.ClassDef) -> Set[str]:
+        locks: Set[str] = set()
+        for node in ast.walk(cls):
+            # self.X = threading.Lock() / Condition() / ...
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                head = _dotted(node.value.func) or ""
+                if head.rsplit(".", 1)[-1] in _LOCK_CTORS:
+                    for t in node.targets:
+                        a = _self_attr(t)
+                        if a:
+                            locks.add(a)
+            # with self.X: where X smells like a lock
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    a = _self_attr(item.context_expr)
+                    if a and any(h in a.lower()
+                                 for h in _LOCK_NAME_HINTS):
+                        locks.add(a)
+        return locks
+
+    def _scan_method(self, fn, locks: Set[str]) -> _MethodScan:
+        scan = _MethodScan(fn.name)
+
+        def is_lock_item(withnode) -> bool:
+            return any(_self_attr(i.context_expr) in locks
+                       for i in withnode.items)
+
+        def visit(node, locked):
+            if isinstance(node, ast.With) and is_lock_item(node):
+                for item in node.items:
+                    visit(item.context_expr, locked)
+                for st in node.body:
+                    visit(st, True)
+                return
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                return  # nested defs: different execution time
+            if isinstance(node, ast.Call):
+                head = _dotted(node.func)
+                if locked:
+                    label = self._blocking_label(node, head)
+                    if label:
+                        scan.blocking.append((node, label))
+                if isinstance(node.func, ast.Attribute):
+                    recv = node.func.value
+                    recv_attr = _self_attr(recv)
+                    if isinstance(recv, ast.Name) \
+                            and recv.id == "self":
+                        # self.method(...): a call edge, not a data
+                        # access — visit only the arguments
+                        scan.calls.append((node.func.attr, locked))
+                        for a in node.args:
+                            visit(a, locked)
+                        for kw in node.keywords:
+                            visit(kw.value, locked)
+                        return
+                    if recv_attr is not None \
+                            and recv_attr not in locks \
+                            and node.func.attr in _MUTATORS:
+                        # self._q.append(...): a WRITE to _q (skip the
+                        # receiver subtree so it isn't double-counted
+                        # as a read)
+                        scan.accesses.append(_Access(
+                            recv_attr, True, locked, recv))
+                        for a in node.args:
+                            visit(a, locked)
+                        for kw in node.keywords:
+                            visit(kw.value, locked)
+                        return
+            # subscript store: self.X[k] = v is a write to X (the
+            # inner Attribute has Load ctx — record the write here
+            # and skip the inner read)
+            if isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, (ast.Store, ast.Del)):
+                attr = _self_attr(node.value)
+                if attr is not None and attr not in locks:
+                    scan.accesses.append(
+                        _Access(attr, True, locked, node.value))
+                    visit(node.slice, locked)
+                    return
+            attr = _self_attr(node)
+            if attr is not None and attr not in locks:
+                write = isinstance(node.ctx, (ast.Store, ast.Del))
+                scan.accesses.append(
+                    _Access(attr, write, locked, node))
+            for child in ast.iter_child_nodes(node):
+                visit(child, locked)
+
+        for st in fn.body:
+            visit(st, False)
+        # dedupe per (attr, line, locked): an augmented store or a
+        # mutator call can record a read+write pair at one site — keep
+        # the write (the stronger fact)
+        best = {}
+        for a in scan.accesses:
+            k = (a.attr, a.node.lineno, a.locked)
+            if k not in best or (a.write and not best[k].write):
+                best[k] = a
+        scan.accesses = list(best.values())
+        return scan
+
+    def _blocking_label(self, node: ast.Call,
+                        head: Optional[str]) -> Optional[str]:
+        if head:
+            last = head.rsplit(".", 1)[-1]
+            if head in _BLOCKING_DOTTED or last == "sleep":
+                return head
+            if last == "device_put" or head == "jax.device_put":
+                return head
+        if isinstance(node.func, ast.Attribute):
+            a = node.func.attr
+            if a in _BLOCKING_ATTRS:
+                return f".{a}()"
+            if a == "join" and not node.args:
+                # zero-arg join: a thread join (str.join and
+                # os.path.join always take positional args)
+                return ".join()"
+            if a == "get" and not node.args:
+                # zero-positional-arg get: Queue.get-style blocking
+                # (dict.get always takes the key positionally)
+                return ".get()"
+        return None
+
+    def _check_class(self, relpath: str, cls: ast.ClassDef,
+                     findings: List[Finding]) -> None:
+        locks = self._lock_attrs(cls)
+        if not locks:
+            return
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        scans = {m.name: self._scan_method(m, locks) for m in methods}
+
+        # fixpoint: a method whose every intra-class call site is
+        # lock-held is itself analyzed as lock-held (``_refill``
+        # pattern). Methods with no intra-class call sites stay
+        # unlocked-context (they are the public API surface).
+        locked_ctx: Set[str] = set()
+        for _ in range(10):
+            changed = False
+            sites: Dict[str, List[bool]] = {}
+            for s in scans.values():
+                eff = s.name in locked_ctx
+                for callee, locked in s.calls:
+                    if callee in scans:
+                        sites.setdefault(callee, []).append(
+                            locked or eff)
+            for name, states in sites.items():
+                if name not in locked_ctx and states \
+                        and all(states):
+                    locked_ctx.add(name)
+                    changed = True
+            if not changed:
+                break
+
+        def effective(scan: _MethodScan, locked: bool) -> bool:
+            return locked or scan.name in locked_ctx
+
+        # guarded-by inference: attrs touched with the lock held,
+        # outside the exempt methods
+        guarded: Set[str] = set()
+        for s in scans.values():
+            if s.name in _EXEMPT_METHODS:
+                continue
+            for a in s.accesses:
+                if effective(s, a.locked):
+                    guarded.add(a.attr)
+
+        for s in scans.values():
+            if s.name in _EXEMPT_METHODS:
+                continue
+            for a in s.accesses:
+                if a.attr in guarded and not effective(s, a.locked):
+                    kind = "write to" if a.write else "read of"
+                    findings.append(self.finding(
+                        relpath, a.node, "LCK001",
+                        f"{kind} {cls.name}.{a.attr} outside the "
+                        f"lock that guards it elsewhere "
+                        f"(in {s.name!r})"))
+            for node, label in s.blocking:
+                findings.append(self.finding(
+                    relpath, node, "LCK002",
+                    f"blocking call {label} while holding "
+                    f"{cls.name}'s lock (in {s.name!r}) — every "
+                    "other thread serializes behind it"))

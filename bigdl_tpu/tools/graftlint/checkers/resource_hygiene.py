@@ -1,0 +1,203 @@
+"""resource-hygiene: thread/file/socket ownership and swallowed errors.
+
+Three habits that are harmless in a script and lethal in a serving
+process that restarts workers, drains replicas, and runs for weeks:
+
+- RES001 — ``threading.Thread(...)`` constructed without ``daemon=``
+  and with no visible ``.join()`` ownership. A non-daemon thread with
+  no joiner keeps the interpreter alive through shutdown (the fleet
+  drain path hangs on exactly this). Pass ``daemon=`` explicitly —
+  either value — or join the thread somewhere in the module.
+- RES002 — ``open()`` / ``socket.socket()`` / ``socket.create_
+  connection()`` / ``os.fdopen()`` used outside a ``with`` and without
+  visible close ownership (assigned to ``self.X``, returned to the
+  caller, registered with an ExitStack, or ``.close()``d on the bound
+  name somewhere in the module). A bare/chained/argument use leaks
+  the descriptor on any exception between acquire and release.
+- RES003 — ``except:`` / ``except Exception:`` / ``except
+  BaseException:`` whose body is exactly ``pass``. On the serving hot
+  path (core.SCOPES confines RES003 to serving/observability/optim) a
+  swallowed error is a request that vanishes with no metric, no log
+  line, and no flight-recorder event. Narrow the exception or record
+  it; a deliberate swallow takes
+  ``# graftlint: ok[resource-hygiene] — <why>``.
+
+Ownership evidence is module-wide, not flow-sensitive: a ``.join()``
+or ``.close()`` on the bound name anywhere in the module clears the
+construction site. That trades soundness for a reviewable signal —
+the goal is catching the *no owner anywhere* case, which is the one
+that bites in production.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from ..core import Checker, Finding, register
+
+_OPENERS_DOTTED = {"open", "io.open", "os.fdopen", "socket.socket",
+                   "socket.create_connection"}
+#: ExitStack-style sinks that take ownership of a resource argument
+_OWNERSHIP_SINKS = {"enter_context", "push", "callback", "register"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_opener(call: ast.Call) -> bool:
+    return _dotted(call.func) in _OPENERS_DOTTED
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    head = _dotted(call.func) or ""
+    return head.rsplit(".", 1)[-1] == "Thread"
+
+
+@register
+class ResourceHygieneChecker(Checker):
+    name = "resource-hygiene"
+    version = 1
+    codes = {
+        "RES001": "thread created without daemon= or join ownership",
+        "RES002": "file/socket opened outside a context manager "
+                  "without close ownership",
+        "RES003": "broad except clause that silently passes",
+    }
+
+    def check_file(self, relpath: str, tree: ast.AST,
+                   text: str) -> List[Finding]:
+        findings: List[Finding] = []
+        owned_names = self._owned_names(tree)
+        owned_calls = self._owned_call_sites(tree, owned_names)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                self._check_call(relpath, node, owned_calls, findings)
+            elif isinstance(node, ast.ExceptHandler):
+                self._check_except(relpath, node, findings)
+        return findings
+
+    # ----------------------------------------------------- ownership
+    def _owned_names(self, tree: ast.AST) -> Set[str]:
+        """Dotted names with visible lifecycle ownership anywhere in
+        the module: ``.join()``ed or ``.close()``d, or an explicit
+        ``X.daemon = ...`` assignment."""
+        owned: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("join", "close"):
+                base = _dotted(node.func.value)
+                if base:
+                    owned.add(base)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and t.attr == "daemon":
+                        base = _dotted(t.value)
+                        if base:
+                            owned.add(base)
+        # loop-alias ownership: ``for t in threads: t.join()`` makes
+        # the iterated collection owned too (the common fan-out idiom
+        # ``threads = [Thread(...) for ...]`` then join-all)
+        for _ in range(3):
+            grew = False
+            for node in ast.walk(tree):
+                if isinstance(node, ast.For) \
+                        and isinstance(node.target, ast.Name) \
+                        and node.target.id in owned \
+                        and isinstance(node.iter, ast.Name) \
+                        and node.iter.id not in owned:
+                    owned.add(node.iter.id)
+                    grew = True
+            if not grew:
+                break
+        return owned
+
+    def _owned_call_sites(self, tree: ast.AST,
+                          owned_names: Set[str]) -> Set[int]:
+        """id()s of Call nodes appearing in an ownership position:
+        a with-item, a return value, an assignment to ``self.X`` or to
+        a name the module later joins/closes, or an argument to an
+        ExitStack-style sink."""
+        owned: Set[int] = set()
+
+        def mark(node):
+            if isinstance(node, ast.Call):
+                owned.add(id(node))
+
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    mark(item.context_expr)
+            elif isinstance(node, ast.Return) and node.value:
+                mark(node.value)
+            elif isinstance(node, ast.Assign):
+                val = node.value
+                # comprehension building a collection of resources:
+                # ownership of the collection name covers the element
+                # constructor (``files = [open(p) for p in ps]``)
+                elt = val.elt if isinstance(
+                    val, (ast.ListComp, ast.SetComp,
+                          ast.GeneratorExp)) else None
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute):
+                        # self.X = open(...): the object owns it (its
+                        # close()/__exit__ is a different method)
+                        mark(val)
+                        mark(elt)
+                    elif isinstance(t, ast.Name) \
+                            and t.id in owned_names:
+                        mark(val)
+                        mark(elt)
+            elif isinstance(node, ast.Call):
+                head = _dotted(node.func) or ""
+                if head.rsplit(".", 1)[-1] in _OWNERSHIP_SINKS:
+                    for a in node.args:
+                        mark(a)
+        return owned
+
+    # -------------------------------------------------------- checks
+    def _check_call(self, relpath: str, node: ast.Call,
+                    owned_calls: Set[int],
+                    findings: List[Finding]) -> None:
+        if id(node) in owned_calls:
+            return
+        if _is_thread_ctor(node):
+            if not any(kw.arg == "daemon" for kw in node.keywords):
+                findings.append(self.finding(
+                    relpath, node, "RES001",
+                    "Thread() without daemon= and no visible .join() "
+                    "owner — it will outlive shutdown; pass daemon= "
+                    "explicitly or join it"))
+        elif _is_opener(node):
+            findings.append(self.finding(
+                relpath, node, "RES002",
+                f"{_dotted(node.func)}(...) outside a context manager "
+                "with no close ownership — the handle leaks on any "
+                "exception before close; use 'with' or an ExitStack"))
+
+    def _check_except(self, relpath: str, node: ast.ExceptHandler,
+                      findings: List[Finding]) -> None:
+        broad = node.type is None or (
+            isinstance(node.type, ast.Name)
+            and node.type.id in ("Exception", "BaseException"))
+        silent = (len(node.body) == 1
+                  and isinstance(node.body[0], ast.Pass))
+        if broad and silent:
+            what = ("bare except" if node.type is None
+                    else f"except {node.type.id}")
+            # anchor at the pass, not the except: the pass is the
+            # defect, and a suppression reads naturally next to it
+            findings.append(self.finding(
+                relpath, node.body[0], "RES003",
+                f"{what}: pass swallows every error with no metric "
+                "or log — narrow it or record the failure"))

@@ -1,0 +1,194 @@
+"""graftlint CLI: scan, diff against the baseline, report.
+
+Exit status is the contract: 0 means *no finding that is not in the
+committed baseline* — new code is held to zero findings while the
+pre-existing debt recorded in ``graftlint_baseline.json`` neither
+fails the build nor silently grows (the baseline is count-exact per
+(file, code): fixing a finding without refreshing the baseline is
+fine; adding one is not).
+
+Modes:
+
+- ``scripts/graftlint.py FILE...`` — scan just those files, all rules
+  (no scope filter: explicit paths mean "tell me everything here").
+- ``--all`` — full repo scan, code scoping applied, per-file cache on.
+- ``--changed`` — scan files touched vs HEAD (staged + unstaged +
+  untracked); falls back to ``--all`` when git is unavailable.
+  Repo-level checkers (observability-drift) always run in full.
+- ``--write-baseline`` — accept the current findings as debt.
+- ``--json`` / ``--report PATH`` — machine-readable findings document
+  (the CI artifact ``graftlint_report.json``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from typing import List, Optional
+
+from . import baseline as baseline_mod
+from .cache import DEFAULT_CACHE, FileCache
+from .core import (SCHEMA_VERSION, all_checkers, iter_target_files,
+                   run_checkers)
+
+
+def _find_root(start: str) -> str:
+    """Nearest ancestor holding a .git dir or the bigdl_tpu package."""
+    cur = os.path.abspath(start)
+    while True:
+        if os.path.isdir(os.path.join(cur, ".git")) \
+                or os.path.isdir(os.path.join(cur, "bigdl_tpu")):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return os.path.abspath(start)
+        cur = parent
+
+
+def _changed_files(root: str) -> Optional[List[str]]:
+    """Tracked files touched vs HEAD plus untracked files, as
+    repo-relative paths; None when git can't answer (not a checkout,
+    no git binary) so the caller can fall back to a full scan."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+        extra = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if diff.returncode != 0 or extra.returncode != 0:
+        return None
+    seen = []
+    for line in (diff.stdout + extra.stdout).splitlines():
+        line = line.strip()
+        if line and line not in seen:
+            seen.append(line)
+    return seen
+
+
+def run(root: str, paths: Optional[List[str]] = None,
+        scoped: bool = True, use_cache: bool = True):
+    """Scan and return (findings, n_suppressed). ``paths`` of None
+    means the whole tree; explicit paths skip code scoping."""
+    cache = FileCache(os.path.join(root, DEFAULT_CACHE)) \
+        if use_cache else None
+    findings, n_sup = run_checkers(root, relpaths=paths, scoped=scoped,
+                                   cache=cache)
+    if cache is not None:
+        cache.save()
+    return findings, n_sup
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="graftlint",
+        description="AST-based static analysis for jit hazards, lock "
+                    "discipline, observability drift, and resource "
+                    "hygiene. Exit 0 iff no non-baselined findings.")
+    p.add_argument("paths", nargs="*",
+                   help="files to scan (all rules, no scope filter); "
+                        "default: --changed behavior")
+    p.add_argument("--all", action="store_true",
+                   help="scan the whole repository")
+    p.add_argument("--changed", action="store_true",
+                   help="scan files changed vs HEAD (falls back to "
+                        "--all without git)")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: auto-detect)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file (default: "
+                        "<root>/graftlint_baseline.json)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="accept current findings as the new baseline")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="print the findings document as JSON")
+    p.add_argument("--report", default=None, metavar="PATH",
+                   help="also write the JSON findings document here")
+    p.add_argument("--no-cache", action="store_true",
+                   help="ignore and do not write the per-file cache")
+    p.add_argument("--list-checkers", action="store_true",
+                   help="print registered checkers and codes, exit 0")
+    args = p.parse_args(argv)
+
+    root = os.path.abspath(args.root) if args.root \
+        else _find_root(os.getcwd())
+
+    if args.list_checkers:
+        for c in all_checkers():
+            print(f"{c.name} (v{c.version})")
+            for code, desc in sorted(c.codes.items()):
+                print(f"  {code}: {desc}")
+        return 0
+
+    explicit = bool(args.paths)
+    if explicit:
+        paths = []
+        for raw in args.paths:
+            ap = os.path.abspath(raw)
+            rel = os.path.relpath(ap, root).replace(os.sep, "/")
+            paths.append(rel)
+        scoped = False
+    elif args.all:
+        paths, scoped = None, True
+    else:
+        # --changed (also the default mode)
+        changed = _changed_files(root)
+        if changed is None:
+            paths, scoped = None, True
+        else:
+            known = set(iter_target_files(root))
+            paths = [c for c in changed if c in known]
+            scoped = True
+
+    findings, n_sup = run(root, paths=paths, scoped=scoped,
+                          use_cache=not args.no_cache)
+
+    baseline_path = args.baseline or os.path.join(
+        root, baseline_mod.DEFAULT_BASELINE)
+    if args.write_baseline:
+        baseline_mod.write_baseline(findings, baseline_path)
+        print(f"[graftlint] baseline written: {len(findings)} "
+              f"finding(s) -> {os.path.relpath(baseline_path, root)}")
+        return 0
+
+    bl = baseline_mod.load_baseline(baseline_path)
+    new, baselined = baseline_mod.split_findings(findings, bl)
+
+    doc = {
+        "schema": SCHEMA_VERSION,
+        "root": root,
+        "mode": ("paths" if explicit
+                 else "all" if paths is None else "changed"),
+        "checked": (len(paths) if paths is not None else "all"),
+        "suppressed": n_sup,
+        "baselined": len(baselined),
+        "new": [f.to_dict() for f in new],
+    }
+    if args.report:
+        tmp = args.report + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, args.report)
+    if args.as_json:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        for f in sorted(new, key=lambda x: x.sort_key()):
+            print(f"[graftlint] {f.render()}")
+        tail = (f"{len(baselined)} baselined, {n_sup} suppressed"
+                if (baselined or n_sup) else "clean")
+        if new:
+            print(f"[graftlint] FAIL: {len(new)} new finding(s) "
+                  f"({tail})")
+        else:
+            print(f"[graftlint] ok: no new findings ({tail})")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

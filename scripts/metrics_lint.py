@@ -1,193 +1,69 @@
 #!/usr/bin/env python3
-"""CI lint: every ``bigdl_*`` metric name is minted in ONE place —
-and documented.
+"""DEPRECATED shim — metrics-lint is now graftlint's
+``observability-drift`` checker.
 
-``bigdl_tpu/observability/instruments.py`` is the canonical schema —
-one module defines every ``bigdl_*`` metric name, type, help string,
-and bucket layout, so live scrapes, bench snapshots, and dashboards
-can never drift apart. Two checks hold that line (both fail the build,
-exit 1):
-
-1. REGISTRATION: grep the tree for registration calls
-   (``.counter("bigdl_...")`` / ``.gauge(...)`` / ``.histogram(...)``)
-   OUTSIDE that module — the fix is always to add an
-   ``*_instruments`` entry and call it.
-2. DOC DRIFT (both directions): every name registered IN that module
-   must appear in the instrument table of
-   ``docs/programming-guide/observability.md`` — an operator reading
-   the docs sees every series a scrape can emit — and every name the
-   table documents must still be registered there, so a renamed or
-   deleted instrument cannot leave a ghost row promising a series no
-   scrape will ever emit. The table may spell names exactly, expand
-   one ``{a,b,c}`` alternation, or end in ``*`` for a family prefix
-   (``bigdl_bench_*``); a wildcard row is satisfied by any registered
-   name under its prefix.
-
-Scopes deliberately skipped by the registration check: ``tests/``
-(tests mint throwaway names against throwaway registries), ``docs/``
-(examples use ``myapp_*``), and build/VCS droppings. Stdlib only —
-runnable from any CI step without the package installed;
-``tests/test_resource_observability.py`` wires it as a tier-1 test.
-
-Usage::
+The logic (and the contract it enforces: every ``bigdl_*`` metric
+minted in ``bigdl_tpu/observability/instruments.py`` and documented —
+both directions — in the instrument table of
+``docs/programming-guide/observability.md``) lives in
+``bigdl_tpu/tools/graftlint/checkers/observability_drift.py``.
+This file remains so every documented command keeps working::
 
     python scripts/metrics_lint.py [--root REPO_ROOT]
+
+with byte-identical output and exit semantics (exit 1 on any
+out-of-place registration, undocumented instrument, or ghost doc
+row). Prefer the full suite::
+
+    python scripts/graftlint.py --all
+
+which runs the same checks as codes OBS001/OBS002/OBS003 alongside
+the jit-hazard, lock-discipline, and resource-hygiene checkers. The
+historical helper API (``lint``, ``registered_names``,
+``documented_patterns``, ``doc_drift``, ``reverse_drift``,
+``ALLOWED``, ``DOCS_GUIDE``, ``SKIP_DIRS``) is re-exported below
+unchanged — ``tests/test_resource_observability.py`` and
+``tests/test_usage_accounting.py`` hold it stable.
 """
 
 from __future__ import annotations
 
-import argparse
+import importlib.util
 import os
-import re
 import sys
 
-#: the one module allowed to register bigdl_* instruments
-ALLOWED = ("bigdl_tpu", "observability", "instruments.py")
-
-#: the guide whose instrument table must cover every registered name
-DOCS_GUIDE = ("docs", "programming-guide", "observability.md")
-
-SKIP_DIRS = {".git", "__pycache__", "build", "dist", "docs", "tests",
-             ".eggs", "bigdl_tpu.egg-info", "native", "docker"}
-
-# a registration call with a bigdl_* name literal as its first
-# argument; assembled from pieces so this file never matches itself
-_PATTERN = re.compile(
-    r"\.\s*(counter|gauge|histogram)\s*\(\s*"   # .counter( / .gauge( /...
-    r"[\"']" + "(bigdl" + r"_[A-Za-z0-9_:]*)[\"']",
-    re.S)
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+_PKG = os.path.join(_REPO, "bigdl_tpu", "tools", "graftlint")
 
 
-def lint(root: str):
-    """Yield (path, lineno, method, metric_name) violations."""
-    allowed = os.path.join(root, *ALLOWED)
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
-        for fname in filenames:
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            if os.path.abspath(path) == os.path.abspath(allowed):
-                continue
-            try:
-                with open(path, encoding="utf-8") as f:
-                    text = f.read()
-            except (OSError, UnicodeDecodeError):
-                continue
-            for m in _PATTERN.finditer(text):
-                lineno = text.count("\n", 0, m.start()) + 1
-                yield (os.path.relpath(path, root), lineno,
-                       m.group(1), m.group(2))
+def _load_graftlint():
+    """Load the graftlint package standalone (same trick as
+    scripts/graftlint.py: no ``import bigdl_tpu``, hence no jax)."""
+    if "graftlint" not in sys.modules:
+        spec = importlib.util.spec_from_file_location(
+            "graftlint", os.path.join(_PKG, "__init__.py"),
+            submodule_search_locations=[_PKG])
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["graftlint"] = mod
+        spec.loader.exec_module(mod)
+    return sys.modules["graftlint.checkers.observability_drift"]
 
 
-# a documented-name token in the guide: a bigdl_ head, at most one
-# {a,b,c} alternation (a {label=} brace contains '=' and is NOT an
-# alternation, so it terminates the token), an optional tail, and an
-# optional trailing * marking a family prefix; assembled from pieces
-# so this file never matches itself
-_DOC_TOKEN = re.compile(
-    "(" + "bigdl" + r"_[A-Za-z0-9_]*)"
-    r"(?:\{([A-Za-z0-9_,]+)\})?"
-    r"([A-Za-z0-9_]*)"
-    r"(\*)?")
+_obs = _load_graftlint()
 
-
-def registered_names(root: str):
-    """Every metric name literal registered in the canonical module."""
-    path = os.path.join(root, *ALLOWED)
-    try:
-        with open(path, encoding="utf-8") as f:
-            text = f.read()
-    except OSError:
-        return []
-    return sorted({m.group(2) for m in _PATTERN.finditer(text)})
-
-
-def documented_patterns(root: str):
-    """The doc guide's instrument-TABLE vocabulary: exact names,
-    expanded ``{a,b,c}`` alternations, and ``prefix*`` family
-    wildcards. Only markdown table rows (lines starting with ``|``)
-    count — prose mentioning ``bigdl_*`` generically must not satisfy
-    the per-instrument documentation requirement."""
-    path = os.path.join(root, *DOCS_GUIDE)
-    try:
-        with open(path, encoding="utf-8") as f:
-            lines = f.readlines()
-    except OSError:
-        return set()
-    pats = set()
-    for line in lines:
-        if not line.lstrip().startswith("|"):
-            continue
-        for m in _DOC_TOKEN.finditer(line):
-            head, alts, tail, star = m.groups()
-            for alt in (alts.split(",") if alts else ("",)):
-                pats.add(head + alt + (tail or "")
-                         + ("*" if star else ""))
-    return pats
-
-
-def doc_drift(root: str):
-    """Yield registered instrument names the docs table never
-    mentions."""
-    pats = documented_patterns(root)
-
-    def covered(name):
-        return any((p.endswith("*") and name.startswith(p[:-1]))
-                   or name == p for p in pats)
-
-    return [n for n in registered_names(root) if not covered(n)]
-
-
-def reverse_drift(root: str):
-    """Yield documented table names/patterns with no registered
-    counterpart: an exact (or ``{a,b,c}``-expanded) name must be
-    registered verbatim; a ``prefix*`` wildcard row needs at least one
-    registered name under its prefix."""
-    names = set(registered_names(root))
-
-    def alive(pat):
-        if pat.endswith("*"):
-            return any(n.startswith(pat[:-1]) for n in names)
-        return pat in names
-
-    return sorted(p for p in documented_patterns(root) if not alive(p))
+ALLOWED = _obs.ALLOWED
+DOCS_GUIDE = _obs.DOCS_GUIDE
+SKIP_DIRS = _obs.SKIP_DIRS
+lint = _obs.lint
+registered_names = _obs.registered_names
+documented_patterns = _obs.documented_patterns
+doc_drift = _obs.doc_drift
+reverse_drift = _obs.reverse_drift
 
 
 def main(argv=None) -> int:
-    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    p = argparse.ArgumentParser(
-        description="Fail when a bigdl_* metric is registered outside "
-                    "observability/instruments.py, or registered there "
-                    "but missing from the docs instrument table.")
-    p.add_argument("--root", default=here)
-    args = p.parse_args(argv)
-
-    violations = list(lint(args.root))
-    for path, lineno, method, name in violations:
-        print(f"[metrics-lint] {path}:{lineno}: .{method}({name!r}) — "
-              f"bigdl_* metrics must be defined in "
-              f"{'/'.join(ALLOWED)} (add an *_instruments entry)")
-    undocumented = doc_drift(args.root)
-    for name in undocumented:
-        print(f"[metrics-lint] {'/'.join(ALLOWED)}: {name!r} is "
-              f"registered but missing from the instrument table in "
-              f"{'/'.join(DOCS_GUIDE)} (add a table row)")
-    ghosts = reverse_drift(args.root)
-    for name in ghosts:
-        print(f"[metrics-lint] {'/'.join(DOCS_GUIDE)}: {name!r} is "
-              f"documented in the instrument table but no longer "
-              f"registered in {'/'.join(ALLOWED)} (drop the row or "
-              f"restore the instrument)")
-    if violations or undocumented or ghosts:
-        print(f"[metrics-lint] FAIL: {len(violations)} out-of-place "
-              f"registration(s), {len(undocumented)} undocumented "
-              f"instrument(s), {len(ghosts)} ghost doc row(s)")
-        return 1
-    print("[metrics-lint] ok: all bigdl_* metrics registered in "
-          + "/".join(ALLOWED) + " and documented in "
-          + "/".join(DOCS_GUIDE) + " (both directions)")
-    return 0
+    return _obs.legacy_main(argv, default_root=_REPO)
 
 
 if __name__ == "__main__":

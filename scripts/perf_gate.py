@@ -72,6 +72,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 #: detail keys that hold a serving result with a ``ttft`` percentile
@@ -288,7 +289,38 @@ def main(argv=None) -> int:
     p.add_argument("--metric", default=None,
                    help="only gate rows with this metric name "
                         "(default: any serving row carrying a TTFT)")
+    p.add_argument("--lint", dest="lint", action="store_true",
+                   default=None,
+                   help="run the graftlint --changed preflight before "
+                        "gating (default: only for the repo's own "
+                        "history file)")
+    p.add_argument("--no-lint", dest="lint", action="store_false",
+                   help="skip the graftlint preflight")
     args = p.parse_args(argv)
+
+    # static-analysis preflight: a perf row must not buy its numbers
+    # with a new jit hazard or race. Runs by default only for the
+    # repo's own history (tests/tools gating ad-hoc histories pass
+    # --history and keep their exact exit-code contracts); emits the
+    # graftlint_report.json CI artifact next to the history file.
+    default_history = os.path.join(here, "bench_history.jsonl")
+    want_lint = (args.lint if args.lint is not None
+                 else os.path.abspath(args.history)
+                 == os.path.abspath(default_history))
+    if want_lint:
+        report = os.path.join(here, "graftlint_report.json")
+        r = subprocess.run(
+            [sys.executable, os.path.join(here, "scripts",
+                                          "graftlint.py"),
+             "--changed", "--report", report],
+            cwd=here, capture_output=True, text=True)
+        sys.stdout.write(r.stdout)
+        if r.returncode != 0:
+            print("[perf-gate] FAIL: graftlint preflight found new "
+                  f"non-baselined findings (report: {report})")
+            return 1
+        print(f"[perf-gate] graftlint preflight clean "
+              f"(report: {report})")
 
     try:
         rows = load_rows(args.history)

@@ -1,0 +1,6 @@
+"""Fixture modules for tests/test_graftlint.py — one dirty + one clean
+module per checker. These are DATA, not code under test: they are
+parsed by graftlint, never imported or executed (``tests/`` is outside
+graftlint's repo-scan scope, so nothing here pollutes the baseline).
+Line numbers are asserted exactly — edit with care and update
+test_graftlint.py's expectation tables in the same commit."""

@@ -1,0 +1,26 @@
+"""Clean jit-hazard fixture: jit-heavy code with zero hazards."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def entry(x, y):
+    z = jnp.sum(x) + y
+    return jnp.maximum(z, 0.0)
+
+
+def shaped(x):
+    n = x.shape[0]  # static shape read is trace-safe
+    return jnp.zeros((n,), dtype=x.dtype)
+
+
+@partial(jax.jit, static_argnames=("width",))
+def entry2(x, width=8):  # hashable static default: fine
+    return jnp.pad(x, (0, width))
+
+
+def build(step, sharding):
+    # out_shardings pinned: no JIT005
+    return jax.jit(step, donate_argnums=(0,), out_shardings=sharding)

@@ -1,0 +1,32 @@
+"""Dirty jit-hazard fixture: every JIT code fires at a known line."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def entry(x, y):
+    if bool(x):  # JIT001: concretizes the tracer
+        pass
+    n = len(y)  # JIT001
+    z = np.sum(x)  # JIT002: host numpy on a traced value
+    msg = f"x is {x}"  # JIT003: f-string of a tracer
+    s = str(y)  # JIT003
+    t = "v={}".format(x)  # JIT003
+    return helper(x) + n + z, msg, s, t
+
+
+def helper(a):
+    # reached from entry() with a traced argument
+    return a.item()  # JIT001: device sync
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def entry2(x, cfg=[]):  # JIT004: mutable default on a static arg
+    return jnp.sum(x)
+
+
+def build(step):
+    return jax.jit(step, donate_argnums=(0,))  # JIT005: no out_shardings

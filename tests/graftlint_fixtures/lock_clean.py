@@ -1,0 +1,30 @@
+"""Clean lock-discipline fixture: consistent locking, the
+locked-context helper pattern, and one suppressed config read."""
+import threading
+
+
+class Clean:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self.limit = 8
+
+    def add(self, x):
+        with self._lock:
+            if len(self._items) < self.limit:
+                self._items.append(x)
+            else:
+                self._evict()
+
+    def _evict(self):
+        # every intra-class call site holds the lock, so this body is
+        # analyzed as lock-held (no false positive)
+        self._items.pop(0)
+
+    def size(self):
+        with self._lock:
+            return len(self._items)
+
+    def snapshot(self):
+        # graftlint: ok[lock-discipline] — limit is immutable after construction
+        return {"limit": self.limit}

@@ -1,0 +1,27 @@
+"""Dirty lock-discipline fixture: guarded attrs touched unlocked, and
+a blocking call under the lock."""
+import threading
+import time
+
+
+class Dirty:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self._count = 0
+
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+            self._count += 1
+
+    def peek(self):
+        return self._count  # LCK001: guarded read without the lock
+
+    def reset(self):
+        self._items = []  # LCK001: guarded write without the lock
+
+    def slow_flush(self):
+        with self._lock:
+            time.sleep(0.1)  # LCK002: blocking while locked
+            self._items.clear()

@@ -1,0 +1,11 @@
+"""Fixture schema module: one documented name, one undocumented."""
+
+
+class _Reg:
+    def counter(self, name):
+        return name
+
+
+reg = _Reg()
+reg.counter("bigdl_good_total")
+reg.counter("bigdl_undocumented_total")  # OBS002: no doc-table row

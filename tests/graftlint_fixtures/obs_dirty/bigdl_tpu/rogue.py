@@ -1,0 +1,5 @@
+"""Fixture rogue module: registers a bigdl_* name out of place."""
+
+
+def setup(reg):
+    return reg.counter("bigdl_rogue_total")  # OBS001

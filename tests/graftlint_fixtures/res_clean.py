@@ -1,0 +1,38 @@
+"""Clean resource-hygiene fixture: owned threads (including the
+join-loop idiom), context-managed handles, narrow excepts."""
+import threading
+
+
+def fan_out(fns):
+    # no daemon=, but the join loop below owns every thread
+    threads = [threading.Thread(target=f) for f in fns]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def background(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    return t
+
+
+def read_file(path):
+    with open(path) as f:
+        return f.read()
+
+
+class Owner:
+    def __init__(self, path):
+        self.f = open(path, "rb")  # object owns the handle
+
+    def close(self):
+        self.f.close()
+
+
+def careful(op):
+    try:
+        op()
+    except ValueError:
+        pass  # narrow except: deliberate and visible

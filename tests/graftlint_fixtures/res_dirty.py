@@ -1,0 +1,33 @@
+"""Dirty resource-hygiene fixture: leaked thread, leaked handles,
+swallowed errors."""
+import socket
+import threading
+
+
+def leak_thread(fn):
+    t = threading.Thread(target=fn)  # RES001: no daemon=, never joined
+    t.start()
+
+
+def leak_handle(path):
+    return open(path).read()  # RES002: chained use, nothing to close
+
+
+def leak_socket(host):
+    s = socket.socket()  # RES002: never closed, no context manager
+    s.connect((host, 80))
+    s.sendall(b"ping")
+
+
+def swallow_broad(op):
+    try:
+        op()
+    except Exception:
+        pass  # RES003: silent broad swallow
+
+
+def swallow_bare(op):
+    try:
+        op()
+    except:  # noqa: E722
+        pass  # RES003

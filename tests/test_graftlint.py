@@ -1,0 +1,319 @@
+"""Tier-1 tests for graftlint, the AST-based static-analysis suite.
+
+Three layers:
+
+1. THE RATCHET — a full repo scan must produce zero findings outside
+   the committed ``graftlint_baseline.json``. This is the test that
+   makes every checker a merge gate: new serving code with an
+   unguarded write, a jit concretization, a leaked thread, or an
+   undocumented metric fails tier-1.
+2. FIXTURES — each checker fires on its dirty fixture with exact
+   (code, line) pairs and stays silent on its clean twin. The clean
+   fixtures also pin the deliberate non-findings (join-loop thread
+   ownership, locked-context helper methods, pinned out_shardings).
+3. MECHANICS — baseline count-matching, the suppression grammar, the
+   per-file cache, and the CLI's exit-code / JSON / report contracts.
+
+The package is loaded standalone (same as ``scripts/graftlint.py``):
+no ``import bigdl_tpu``, no jax — these tests run in milliseconds.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "bigdl_tpu", "tools", "graftlint")
+FIXTURES = "tests/graftlint_fixtures"
+
+
+def _load():
+    if "graftlint" not in sys.modules:
+        spec = importlib.util.spec_from_file_location(
+            "graftlint", os.path.join(PKG, "__init__.py"),
+            submodule_search_locations=[PKG])
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["graftlint"] = mod
+        spec.loader.exec_module(mod)
+    return sys.modules["graftlint"]
+
+
+gl = _load()
+core = sys.modules["graftlint.core"]
+baseline_mod = sys.modules["graftlint.baseline"]
+cache_mod = sys.modules["graftlint.cache"]
+cli = sys.modules["graftlint.cli"]
+obs = sys.modules["graftlint.checkers.observability_drift"]
+
+
+def _fixture_findings(name):
+    rel = f"{FIXTURES}/{name}.py"
+    findings, n_sup = core.check_one_file(REPO, rel)
+    return [(f.code, f.line) for f in findings], n_sup
+
+
+# ----------------------------------------------------------- the ratchet
+def test_repo_has_no_findings_outside_baseline():
+    findings, _ = core.run_checkers(REPO, scoped=True, cache=None)
+    bl = baseline_mod.load_baseline(
+        os.path.join(REPO, baseline_mod.DEFAULT_BASELINE))
+    new, _old = baseline_mod.split_findings(findings, bl)
+    assert new == [], (
+        "new graftlint findings — fix them or suppress with a "
+        "reasoned '# graftlint: ok[...]':\n"
+        + "\n".join(f.render() for f in new))
+
+
+def test_baseline_is_committed_and_well_formed():
+    path = os.path.join(REPO, baseline_mod.DEFAULT_BASELINE)
+    assert os.path.exists(path)
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    assert doc["version"] == core.SCHEMA_VERSION
+    for e in doc["entries"]:
+        assert set(e) == {"file", "code", "line"}
+        # baseline must only reference scan-scope repo files
+        assert os.path.exists(os.path.join(REPO, e["file"]))
+
+
+# -------------------------------------------------------------- fixtures
+def test_jit_hazard_dirty_fixture():
+    got, n_sup = _fixture_findings("jit_dirty")
+    assert got == [
+        ("JIT001", 11),   # bool(x)
+        ("JIT001", 13),   # len(y)
+        ("JIT002", 14),   # np.sum(x)
+        ("JIT003", 15),   # f-string
+        ("JIT003", 16),   # str(y)
+        ("JIT003", 17),   # "".format(x)
+        ("JIT001", 23),   # .item() in a jit-reachable helper
+        ("JIT004", 27),   # mutable default on a static arg
+        ("JIT005", 32),   # jax.jit without out_shardings
+    ]
+    assert n_sup == 0
+
+
+def test_jit_hazard_clean_fixture():
+    assert _fixture_findings("jit_clean") == ([], 0)
+
+
+def test_lock_discipline_dirty_fixture():
+    got, n_sup = _fixture_findings("lock_dirty")
+    assert got == [
+        ("LCK001", 19),   # unlocked read of _count
+        ("LCK001", 22),   # unlocked write of _items
+        ("LCK002", 26),   # time.sleep while locked
+    ]
+    assert n_sup == 0
+
+
+def test_lock_discipline_clean_fixture():
+    # zero findings AND exactly one counted suppression (the
+    # immutable-config read in snapshot())
+    assert _fixture_findings("lock_clean") == ([], 1)
+
+
+def test_resource_hygiene_dirty_fixture():
+    got, n_sup = _fixture_findings("res_dirty")
+    assert got == [
+        ("RES001", 8),    # unowned non-daemon thread
+        ("RES002", 13),   # chained open().read()
+        ("RES002", 17),   # socket never closed
+        ("RES003", 26),   # except Exception: pass
+        ("RES003", 33),   # bare except: pass
+    ]
+    assert n_sup == 0
+
+
+def test_resource_hygiene_clean_fixture():
+    # pins the join-loop ownership idiom as a non-finding
+    assert _fixture_findings("res_clean") == ([], 0)
+
+
+def test_observability_drift_dirty_tree():
+    root = os.path.join(REPO, FIXTURES, "obs_dirty")
+    got = sorted((f.code, f.file) for f in
+                 obs.ObservabilityDriftChecker().check_repo(root))
+    assert got == [
+        ("OBS001", "bigdl_tpu/rogue.py"),
+        ("OBS002", "bigdl_tpu/observability/instruments.py"),
+        ("OBS003", "docs/programming-guide/observability.md"),
+    ]
+
+
+def test_observability_drift_clean_tree():
+    root = os.path.join(REPO, FIXTURES, "obs_clean")
+    assert obs.ObservabilityDriftChecker().check_repo(root) == []
+    # the wildcard row satisfies the family name, both directions
+    assert obs.doc_drift(root) == []
+    assert obs.reverse_drift(root) == []
+
+
+# ------------------------------------------------------------- mechanics
+def _mk(file, code, line):
+    return core.Finding(file, line, 0, code, "t", "m")
+
+
+def test_baseline_matching_is_count_based_and_line_tolerant():
+    bl = {("a.py", "LCK001"): [{"file": "a.py", "code": "LCK001",
+                                "line": 10},
+                               {"file": "a.py", "code": "LCK001",
+                                "line": 30}]}
+    # same counts at drifted lines: all absorbed
+    new, old = baseline_mod.split_findings(
+        [_mk("a.py", "LCK001", 12), _mk("a.py", "LCK001", 33)], bl)
+    assert new == [] and len(old) == 2
+    # one extra finding of the same code: exactly one is new
+    new, old = baseline_mod.split_findings(
+        [_mk("a.py", "LCK001", 12), _mk("a.py", "LCK001", 33),
+         _mk("a.py", "LCK001", 50)], bl)
+    assert len(new) == 1 and len(old) == 2
+    # fixing one without refreshing the baseline stays green
+    new, old = baseline_mod.split_findings(
+        [_mk("a.py", "LCK001", 12)], bl)
+    assert new == [] and len(old) == 1
+    # a different code in the same file is never absorbed
+    new, _ = baseline_mod.split_findings([_mk("a.py", "RES003", 10)],
+                                         bl)
+    assert len(new) == 1
+
+
+def test_baseline_round_trip(tmp_path):
+    path = str(tmp_path / "bl.json")
+    fs = [_mk("b.py", "JIT001", 7), _mk("a.py", "RES002", 3)]
+    baseline_mod.write_baseline(fs, path)
+    bl = baseline_mod.load_baseline(path)
+    assert bl[("a.py", "RES002")][0]["line"] == 3
+    new, old = baseline_mod.split_findings(fs, bl)
+    assert new == [] and len(old) == 2
+
+
+def test_suppression_grammar():
+    text = (
+        "x = 1  # graftlint: ok[LCK001]\n"
+        "y = 2\n"
+        "# graftlint: ok[jit-hazard, RES003] — reasoned\n"
+        "z = 3\n")
+    supp = core.suppressions_for_text(text)
+    assert supp[1] == {"LCK001"}
+    assert supp[2] == {"LCK001"}          # carries one line down
+    assert supp[3] == {"jit-hazard", "RES003"}
+    assert supp[4] == {"jit-hazard", "RES003"}
+    # matching: code, checker name, or all
+    f = _mk("x.py", "LCK001", 1)
+    assert core.is_suppressed(f, supp)
+    assert not core.is_suppressed(_mk("x.py", "LCK002", 4), supp)
+    assert core.is_suppressed(
+        core.Finding("x.py", 4, 0, "JIT001", "jit-hazard", "m"), supp)
+    assert core.is_suppressed(
+        _mk("x.py", "Z", 9), {9: {"all"}})
+
+
+def test_syntax_error_is_a_finding_not_a_crash(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    findings, _ = core.check_one_file(str(tmp_path), "bad.py")
+    assert [f.code for f in findings] == ["GL000"]
+
+
+def test_scoping_applies_only_to_scoped_runs():
+    # LCK findings outside serving/** are dropped by a scoped run
+    assert core.in_scope("LCK001", "bigdl_tpu/serving/engine.py")
+    assert core.in_scope("LCK001",
+                         "bigdl_tpu/observability/accounting.py")
+    assert not core.in_scope("LCK001", "bigdl_tpu/optim/adamw.py")
+    assert core.in_scope("JIT001", "bigdl_tpu/optim/adamw.py")
+    assert not core.in_scope("JIT005", "bigdl_tpu/models/resnet.py")
+    assert not core.in_scope("RES003", "bigdl_tpu/dataset/records.py")
+
+
+def test_file_cache_round_trip(tmp_path):
+    src = tmp_path / "m.py"
+    src.write_text("import threading\n"
+                   "t = threading.Thread(target=print)\n")
+    cache = cache_mod.FileCache(str(tmp_path / "c.json"))
+    assert cache.get(str(tmp_path), "m.py") is None
+    fs, ns = core.check_one_file(str(tmp_path), "m.py")
+    assert [f.code for f in fs] == ["RES001"]
+    cache.put(str(tmp_path), "m.py", fs, ns)
+    cache.save()
+    # a fresh cache object serves the hit...
+    c2 = cache_mod.FileCache(str(tmp_path / "c.json"))
+    hit = c2.get(str(tmp_path), "m.py")
+    assert hit is not None and [f.code for f in hit[0]] == ["RES001"]
+    # ...until the content changes
+    src.write_text("t = None\n")
+    assert c2.get(str(tmp_path), "m.py") is None
+
+
+# ------------------------------------------------------------------- CLI
+def test_cli_all_is_green_against_committed_baseline(capsys):
+    rc = cli.main(["--all", "--root", REPO, "--no-cache"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "ok: no new findings" in out
+
+
+def test_cli_explicit_path_on_dirty_fixture_fails(capsys):
+    rc = cli.main([f"{FIXTURES}/lock_dirty.py", "--root", REPO,
+                   "--no-cache"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "LCK001" in out and "LCK002" in out
+    assert "FAIL: 3 new finding(s)" in out
+
+
+def test_cli_json_and_report_artifact(tmp_path, capsys):
+    report = str(tmp_path / "graftlint_report.json")
+    rc = cli.main([f"{FIXTURES}/res_dirty.py", "--root", REPO,
+                   "--no-cache", "--json", "--report", report])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["mode"] == "paths" and doc["checked"] == 1
+    codes = sorted(e["code"] for e in doc["new"])
+    assert codes == ["RES001", "RES002", "RES002", "RES003", "RES003"]
+    with open(report, encoding="utf-8") as f:
+        assert json.load(f) == doc
+
+
+def test_cli_write_baseline_then_green(tmp_path, capsys):
+    bl = str(tmp_path / "bl.json")
+    rc = cli.main([f"{FIXTURES}/res_dirty.py", "--root", REPO,
+                   "--no-cache", "--baseline", bl,
+                   "--write-baseline"])
+    assert rc == 0
+    rc = cli.main([f"{FIXTURES}/res_dirty.py", "--root", REPO,
+                   "--no-cache", "--baseline", bl])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "5 baselined" in out
+
+
+@pytest.mark.slow
+def test_cli_subprocess_entrypoint():
+    # the documented command, end to end, in a clean interpreter
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "graftlint.py"),
+         "--all", "--no-cache"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ok: no new findings" in r.stdout
+
+
+def test_legacy_metrics_lint_shim_still_works(capsys):
+    spec = importlib.util.spec_from_file_location(
+        "_metrics_lint_shim",
+        os.path.join(REPO, "scripts", "metrics_lint.py"))
+    shim = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(shim)
+    assert shim.main([]) == 0
+    assert "[metrics-lint] ok" in capsys.readouterr().out
+    # historical helper API intact
+    assert shim.doc_drift(REPO) == []
+    assert shim.reverse_drift(REPO) == []
+    assert shim.ALLOWED == ("bigdl_tpu", "observability",
+                            "instruments.py")
