@@ -635,6 +635,10 @@ def _serving_bench(args, dev):
                 "device": str(getattr(dev, "device_kind", dev.platform)),
                 **_row_stamps(dev),
                 **res,
+                # headline hop decomposition: the affinity leg's mean
+                # seconds per fleet hop (route/rpc_submit/queue/
+                # prefill/first_token/decode/stream)
+                "hops": (res.get("affinity") or {}).get("hops"),
             },
         }
         _record_fleet_metrics(res)

@@ -88,12 +88,18 @@ from bigdl_tpu.observability.events import (
 from bigdl_tpu.observability.chrometrace import (
     chrome_trace_events, render_chrome_trace, write_chrome_trace,
 )
+from bigdl_tpu.observability.fleettrace import (
+    FLEET_HOPS, estimate_clock_offset, hop_breakdown,
+    merge_fleet_trace, merge_request_timelines, mint_trace_id,
+    parse_traceparent, render_fleet_trace, write_fleet_trace,
+)
 from bigdl_tpu.observability.postmortem import (
     build_postmortem, registry_snapshot, write_postmortem,
 )
 from bigdl_tpu.observability.exporters import (
     MetricsHTTPServer, PROMETHEUS_CONTENT_TYPE, TensorBoardBridge,
-    render_prometheus, start_http_server, write_prometheus,
+    render_prometheus, render_snapshot_prometheus, start_http_server,
+    write_prometheus,
 )
 from bigdl_tpu.observability.instruments import (
     FRACTION_BUCKETS, OCCUPANCY_BUCKETS, OccupancyStats, TIME_BUCKETS,
@@ -131,9 +137,13 @@ __all__ = [
     "set_default_recorder", "record", "next_request_id",
     "percentile_summary",
     "chrome_trace_events", "render_chrome_trace", "write_chrome_trace",
+    "FLEET_HOPS", "estimate_clock_offset", "hop_breakdown",
+    "merge_fleet_trace", "merge_request_timelines", "mint_trace_id",
+    "parse_traceparent", "render_fleet_trace", "write_fleet_trace",
     "build_postmortem", "registry_snapshot", "write_postmortem",
     "MetricsHTTPServer", "PROMETHEUS_CONTENT_TYPE", "TensorBoardBridge",
-    "render_prometheus", "start_http_server", "write_prometheus",
+    "render_prometheus", "render_snapshot_prometheus",
+    "start_http_server", "write_prometheus",
     "FRACTION_BUCKETS", "OCCUPANCY_BUCKETS", "OccupancyStats",
     "TIME_BUCKETS",
     "bench_instruments", "engine_instruments", "fleet_instruments",

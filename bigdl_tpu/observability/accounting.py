@@ -76,7 +76,7 @@ class UsageRecord:
     per-field (float/int) picture — final once the request is done.
     """
 
-    __slots__ = ("request_id", "tenant", "prompt_tokens",
+    __slots__ = ("request_id", "tenant", "trace_id", "prompt_tokens",
                  "max_new_tokens", "submitted_at", "queue_wait_s",
                  "prefill_tokens", "prefix_reused_tokens",
                  "prefix_bytes_saved", "decode_tokens",
@@ -89,6 +89,9 @@ class UsageRecord:
                  submitted_at: float = 0.0):
         self.request_id = request_id
         self.tenant = tenant
+        #: distributed-trace correlation id (engine-stamped from
+        #: ``submit(trace_id=...)``; None outside a traced fleet)
+        self.trace_id: Optional[str] = None
         self.prompt_tokens = int(prompt_tokens)
         self.max_new_tokens = int(max_new_tokens)
         self.submitted_at = submitted_at
@@ -134,6 +137,7 @@ class UsageRecord:
         return {
             "request_id": self.request_id,
             "tenant": self.tenant,
+            "trace_id": self.trace_id,
             "outcome": self.outcome,
             "prompt_tokens": self.prompt_tokens,
             "queue_wait_s": (round(self.queue_wait_s, 6)

@@ -79,7 +79,8 @@ def _span_events(sp: Span, tids: _Tids, pid: int, now_wall: float,
 
 def chrome_trace_events(tracer: Optional[Tracer] = None,
                         recorder: Optional[FlightRecorder] = None,
-                        last_events: Optional[int] = None) -> List[dict]:
+                        last_events: Optional[int] = None,
+                        process_name: str = "bigdl_tpu") -> List[dict]:
     """The combined trace-event list (no enclosing JSON object):
     metadata rows naming the process and each thread track, one "X"
     event per span (completed roots, then open stacks), one "i" event
@@ -112,7 +113,7 @@ def chrome_trace_events(tracer: Optional[Tracer] = None,
         })
 
     meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
-             "args": {"name": "bigdl_tpu"}}]
+             "args": {"name": process_name}}]
     for thread_name, tid in tids.items():
         meta.append({"name": "thread_name", "ph": "M", "pid": pid,
                      "tid": tid, "args": {"name": thread_name}})

@@ -105,6 +105,15 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._enabled = enabled
         self._total = 0
+        # process-wide attrs stamped on every event (a fleet worker
+        # sets ``replica=<id>`` here so its whole export is
+        # attributable after a cross-process merge)
+        self._context: Dict[str, Any] = {}
+        # request_id -> attrs stamped on that request's events (the
+        # engine binds ``trace=<trace_id>`` at submit); bounded like
+        # the ring so long-lived recorders never grow without limit
+        self._bound: "collections.OrderedDict[str, Dict[str, Any]]" = \
+            collections.OrderedDict()
         # anchor: maps monotonic event timestamps onto the wall clock
         # for exports (Chrome trace, JSONL) without ever ordering by
         # the jumpable wall clock internally
@@ -124,18 +133,54 @@ class FlightRecorder:
     def enabled(self) -> bool:
         return self._enabled
 
+    # ------------------------------------------------------------ context
+    def set_context(self, **attrs) -> None:
+        """Merge process-wide attrs into every subsequently recorded
+        event (explicit per-call attrs win). A fleet worker stamps
+        ``replica=<id>`` once here instead of threading it through
+        every integration."""
+        with self._lock:
+            self._context.update(attrs)
+
+    @property
+    def context(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._context)
+
+    def bind_request(self, request_id: str, **attrs) -> None:
+        """Attach attrs to one request id: every event recorded with
+        that id carries them (the trace-context channel — the engine
+        binds ``trace=<trace_id>`` at submit so the whole per-request
+        arc is joinable across processes). Bindings are bounded by
+        the ring capacity; the oldest falls off first."""
+        with self._lock:
+            self._bound[request_id] = dict(attrs)
+            self._bound.move_to_end(request_id)
+            while len(self._bound) > self.capacity:
+                self._bound.popitem(last=False)
+
+    def request_context(self, request_id: str) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._bound.get(request_id) or {})
+
     # ------------------------------------------------------------- writer
     def record(self, kind: str, request_id: Optional[str] = None,
                **attrs) -> Optional[Event]:
         """Append one event; returns it (or None while disabled)."""
         if not self._enabled:
             return None
-        ev = Event(0, time.monotonic(),
-                   threading.current_thread().name, request_id, kind,
-                   attrs or None)
+        ts = time.monotonic()
+        thread = threading.current_thread().name
         with self._lock:
+            if self._context:
+                attrs = {**self._context, **attrs}
+            if request_id is not None and self._bound:
+                bound = self._bound.get(request_id)
+                if bound:
+                    attrs = {**bound, **attrs}
             self._total += 1
-            ev.seq = self._total
+            ev = Event(self._total, ts, thread, request_id, kind,
+                       attrs or None)
             self._events.append(ev)
         return ev
 

@@ -818,6 +818,26 @@ def fleet_instruments(fleet: str = "fleet",
             "bigdl_fleet_replica_active_slots",
             "One replica's occupied decode slots as last polled",
             labelnames=("fleet", "replica")),
+        hop_seconds=r.histogram(
+            "bigdl_fleet_hop_seconds",
+            "Per-request wall seconds by fleet hop (route / "
+            "rpc_submit / queue / prefill / first_token / decode / "
+            "stream) — the components sum to the client-observed "
+            "total, so any hop's histogram is its share of end-to-end "
+            "latency", buckets=TIME_BUCKETS,
+            labelnames=("fleet", "hop")),
+        rpc_timeouts_total=r.counter(
+            "bigdl_fleet_rpc_timeouts_total",
+            "Worker pipe-RPC control calls (healthz/stats/ping) that "
+            "hit their deadline — the wedged-child signal that "
+            "degrades the replica to auto-drain",
+            labelnames=("fleet", "replica")),
+        clock_offset_seconds=r.gauge(
+            "bigdl_fleet_clock_offset_seconds",
+            "Estimated monotonic-clock offset of one replica vs the "
+            "supervisor (min-RTT ping estimate; added to replica "
+            "timestamps when merging fleet traces)",
+            labelnames=("fleet", "replica")),
     )
 
 

@@ -1293,7 +1293,8 @@ class ContinuousBatchingEngine:
                timeout_s: Optional[float] = None, block: bool = True,
                queue_timeout_s: Optional[float] = None,
                tenant: Optional[str] = None,
-               priority: str = "normal") -> RequestHandle:
+               priority: str = "normal",
+               trace_id: Optional[str] = None) -> RequestHandle:
         """Queue one request (1-D prompt). Returns its handle
         immediately; stream with ``handle.tokens()`` or block on
         ``handle.result()``. ``timeout_s`` is a wall deadline covering
@@ -1318,7 +1319,14 @@ class ContinuousBatchingEngine:
         token-identical); under an active TTFT burn the shed set
         (``shed_classes``) is refused with ``RequestShed``, and a
         tenant past its token bucket with ``RequestRateLimited`` —
-        both carry ``retry_after_s``."""
+        both carry ``retry_after_s``.
+
+        ``trace_id`` is the distributed-trace correlation id (the
+        fleet front door mints one per request, honoring an inbound
+        ``traceparent``): the handle and the usage record carry it,
+        and the recorder binds it so EVERY flight-recorder event of
+        this request — queue, prefill, per-token decode, terminal —
+        is joinable across processes in the merged fleet trace."""
         if self._crashed is not None:
             raise EngineStopped("engine loop crashed") from self._crashed
         if self._draining:
@@ -1338,8 +1346,15 @@ class ContinuousBatchingEngine:
                 f"engine's serving window {self.max_len}")
         self.start()
         h = RequestHandle(prompt, n, timeout_s, priority=priority)
+        if trace_id is not None:
+            h.trace_id = trace_id
+            # one binding covers the request's whole recorded arc —
+            # every layer that records with this request_id (queue,
+            # loop, usage ledger) inherits the trace attr for free
+            self._rec.bind_request(h.request_id, trace=trace_id)
         h._usage = self._usage.begin(h.request_id, tenant, t0, n,
                                      submitted_at=h.submitted_at)
+        h._usage.trace_id = trace_id
         h.tenant = h._usage.tenant
         self._rec.record("request/submitted", h.request_id,
                          service=self.service_name, prompt_tokens=t0,
@@ -1523,6 +1538,7 @@ class ContinuousBatchingEngine:
         tl["request_id"] = h.request_id
         tl["outcome"] = outcome
         tl["tenant"] = getattr(h, "tenant", None)
+        tl["trace_id"] = getattr(h, "trace_id", None)
         with self._timelines_lock:
             self._timelines.append(tl)
 

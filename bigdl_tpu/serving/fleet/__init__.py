@@ -55,13 +55,14 @@ from bigdl_tpu.serving.fleet.supervisor import (
     InProcessReplica, ReplicaSupervisor, Routed,
 )
 from bigdl_tpu.serving.fleet.worker import (
-    WorkerHandle, WorkerReplica, spawn_worker_fleet,
+    WorkerHandle, WorkerRPCTimeout, WorkerReplica, spawn_worker_fleet,
 )
 
 __all__ = [
     "PrefixAffinityRouter", "RouteDecision", "NoLiveReplicas",
     "ReplicaSupervisor", "InProcessReplica", "Routed",
-    "WorkerReplica", "WorkerHandle", "spawn_worker_fleet",
+    "WorkerReplica", "WorkerHandle", "WorkerRPCTimeout",
+    "spawn_worker_fleet",
     "FleetFrontDoor", "start_front_door",
     "run_fleet_comparison",
 ]

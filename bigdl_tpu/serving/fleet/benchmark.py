@@ -31,11 +31,15 @@ token parity is the acceptance bar.
 from __future__ import annotations
 
 import threading
+import time
 from collections import Counter
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from bigdl_tpu.observability.fleettrace import (
+    FLEET_HOPS, hop_breakdown,
+)
 from bigdl_tpu.serving.benchmark import (
     _append_itl, _engine_replay, _percentiles, _replay,
     shared_prefix_workload,
@@ -59,17 +63,26 @@ def _fleet_replay(sup: ReplicaSupervisor, workload,
     shared ``_replay`` pacer). TTFT is CLIENT-side — routing + IPC +
     queue + prefill, stamped at first-token receipt in this process.
     ``on_submitted(i)`` fires after the i-th request is handed to a
-    replica (the drain drill's trigger point)."""
+    replica (the drain drill's trigger point). Each finished request
+    is decomposed into the seven fleet hops (``hop_breakdown`` on the
+    supervisor-measured route/rpc_submit timings plus the replica
+    timeline); the leg block reports the per-hop MEANS under
+    ``hops``."""
     ttft: List[float] = []
     itl: List[float] = []
     rows: Dict[int, list] = {}
     count = {"n": 0}
+    t0s: Dict[int, float] = {}
+    hop_sums = dict.fromkeys(FLEET_HOPS, 0.0)
+    hop_n = [0]
     lock = threading.Lock()
 
     def submit(req):
+        t0 = time.monotonic()
         routed = sup.submit(req["prompt"], req["n"],
                             tenant=req.get("tenant"))
         with lock:
+            t0s[id(req)] = t0
             count["n"] += 1
             i = count["n"]
         if on_submitted is not None:
@@ -78,18 +91,29 @@ def _fleet_replay(sup: ReplicaSupervisor, workload,
 
     def collect(routed, req):
         toks = routed.handle.result(timeout=300)
+        done = time.monotonic()
         h = routed.handle
         with lock:
             rows[id(req)] = [int(t) for t in toks]
             if h.first_token_at is not None:
                 ttft.append(h.first_token_at - h.submitted_at)
             _append_itl(itl, h)
+            t0 = t0s.pop(id(req), None)
+            if t0 is not None:
+                tl = h.timeline() if hasattr(h, "timeline") else {}
+                hops = hop_breakdown(tl or {}, routed.route_s,
+                                     routed.rpc_submit_s, done - t0)
+                for k, v in hops.items():
+                    hop_sums[k] += v
+                hop_n[0] += 1
         return len(toks)
 
     res = _replay(workload, submit, collect)
     res["ttft"] = _percentiles(ttft)
     res["inter_token"] = _percentiles(itl)
     res["rows"] = rows
+    res["hops"] = {k: (hop_sums[k] / hop_n[0]) for k in FLEET_HOPS} \
+        if hop_n[0] else None
     return res
 
 

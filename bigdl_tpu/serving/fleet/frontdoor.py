@@ -11,8 +11,8 @@ framework, no dependencies) in front of a ``ReplicaSupervisor``:
   (PAPERS.md, "RPC Considered Harmful" — never a per-token
   request/response):
 
-  ``event: meta``  — ``{request_id, replica, route}`` (where the
-  router placed it, first thing on the wire);
+  ``event: meta``  — ``{request_id, replica, route, trace_id}``
+  (where the router placed it, first thing on the wire);
   ``data:`` lines — ``{"token": t, "index": i}`` per decoded token;
   ``event: done`` — the terminal summary (token count, timeline).
 
@@ -34,7 +34,27 @@ framework, no dependencies) in front of a ``ReplicaSupervisor``:
 - ``GET /healthz`` — 200 with the fleet health dict; 503 once no
   replica can take traffic (same crashed-loop convention as the
   engine endpoint).
-- ``GET /metrics`` — Prometheus text, ``bigdl_fleet_*`` included.
+- ``GET /metrics`` — Prometheus text, ``bigdl_fleet_*`` included,
+  PLUS every worker child's registry fetched over pipe RPC and
+  rendered with a ``replica="<rid>"`` label — one scrape, whole
+  fleet.
+
+Fleet tracing: every request gets a ``trace_id`` — an inbound W3C
+``traceparent`` header is honored, otherwise one is minted — which
+rides the pipe RPC into the replica so every recorder event and
+usage record fleet-wide carries it. Responses echo ``X-Trace-Id`` /
+``X-Request-Id``; the ``meta`` SSE event and the JSON body carry
+``trace_id`` too. Finished requests are decomposed into
+``bigdl_fleet_hop_seconds`` histogram observations
+(route / rpc_submit / queue / prefill / first_token / decode /
+stream) whose per-request sum reconciles with the client-observed
+total. Two debug endpoints expose the merged view:
+
+- ``GET /debug/fleet/trace`` — ONE Chrome/Perfetto trace merging the
+  front door's and every worker process's recorder events onto a
+  clock-aligned common timeline (per-process tracks).
+- ``GET /debug/fleet/requests`` — the recent-request ring (hop
+  breakdowns) plus per-request cross-process timelines.
 """
 
 from __future__ import annotations
@@ -49,6 +69,11 @@ from typing import Optional
 
 from bigdl_tpu.observability.exporters import (
     PROMETHEUS_CONTENT_TYPE, render_prometheus,
+    render_snapshot_prometheus,
+)
+from bigdl_tpu.observability.fleettrace import (
+    hop_breakdown, mint_trace_id, parse_traceparent,
+    render_fleet_trace,
 )
 from bigdl_tpu.observability.metrics import default_registry
 from bigdl_tpu.serving.fleet.router import NoLiveReplicas
@@ -144,11 +169,20 @@ class FleetFrontDoor:
                     return self._send_json(
                         {"error": "max_new_tokens must be an int"}, 400)
                 stream = bool(req.get("stream", True))
+                # trace context: honor an inbound W3C ``traceparent``
+                # (or bare 32-hex id) so the fleet joins the caller's
+                # distributed trace; mint fresh otherwise. The id rides
+                # the pipe RPC into the replica and back out in the
+                # merged fleet trace.
+                trace_id = parse_traceparent(
+                    self.headers.get("traceparent")) or mint_trace_id()
+                t_start = time.monotonic()
                 try:
                     routed = sup.submit(
                         prompt, n, tenant=req.get("tenant"),
                         priority=req.get("priority", "normal"),
-                        timeout_s=req.get("timeout_s"))
+                        timeout_s=req.get("timeout_s"),
+                        trace_id=trace_id)
                 except (RequestShed, RequestRateLimited) as e:
                     return self._send_429(e)
                 except QueueFull as e:
@@ -163,12 +197,17 @@ class FleetFrontDoor:
                 h = routed.handle
                 meta = {"request_id": getattr(h, "request_id", None),
                         "replica": routed.replica,
-                        "route": routed.route}
+                        "route": routed.route,
+                        "trace_id": trace_id}
                 if not stream:
-                    return self._collect(h, meta)
+                    return self._collect(routed, meta, t_start)
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
+                self.send_header("X-Trace-Id", trace_id)
+                if meta["request_id"] is not None:
+                    self.send_header("X-Request-Id",
+                                     str(meta["request_id"]))
                 # SSE is an unbounded stream: no Content-Length; close
                 # delimits the body
                 self.send_header("Connection", "close")
@@ -193,8 +232,12 @@ class FleetFrontDoor:
                         self._sse(None, {"token": int(tok),
                                          "index": delivered})
                         delivered += 1
+                    total_s = time.monotonic() - t_start
+                    hops = self._note_hops(routed, total_s)
                     self._sse("done", {**meta, "tokens": delivered,
-                                       "timeline": h.timeline()})
+                                       "timeline": h.timeline(),
+                                       "hops": hops,
+                                       "total_s": total_s})
                 except (BrokenPipeError, ConnectionResetError,
                         OSError):
                     # the client vanished mid-stream: cancel into the
@@ -225,7 +268,27 @@ class FleetFrontDoor:
                     except OSError:
                         pass
 
-            def _collect(self, h, meta: dict) -> None:
+            def _note_hops(self, routed, total_s: float):
+                """Decompose the client-observed total into fleet hops
+                and feed the supervisor's ``bigdl_fleet_hop_seconds``
+                histograms + request ring. Best-effort: a hop record
+                must never fail a request that already finished."""
+                try:
+                    h = routed.handle
+                    tl = h.timeline() if hasattr(h, "timeline") else {}
+                    hops = hop_breakdown(tl or {}, routed.route_s,
+                                         routed.rpc_submit_s, total_s)
+                    sup.note_request(routed, hops, total_s)
+                    return hops
+                except Exception:
+                    return None
+
+            def _collect(self, routed, meta: dict,
+                         t_start: float) -> None:
+                h = routed.handle
+                hdrs = {"X-Trace-Id": meta["trace_id"]}
+                if meta["request_id"] is not None:
+                    hdrs["X-Request-Id"] = str(meta["request_id"])
                 try:
                     toks = h.result(timeout=None) \
                         if hasattr(h, "result") else list(h.tokens())
@@ -234,15 +297,18 @@ class FleetFrontDoor:
                     return self._send_429(e)
                 except RequestCancelled:
                     return self._send_json(
-                        {**meta, "error": "cancelled"}, 499)
+                        {**meta, "error": "cancelled"}, 499,
+                        headers=hdrs)
                 except RequestTimedOut as e:
                     return self._send_json(
                         {**meta, "error": "timeout",
-                         "detail": str(e)}, 504)
+                         "detail": str(e)}, 504, headers=hdrs)
                 except EngineStopped as e:
                     return self._send_json(
                         {**meta, "error": "engine stopped",
-                         "detail": str(e)}, 503)
+                         "detail": str(e)}, 503, headers=hdrs)
+                total_s = time.monotonic() - t_start
+                hops = self._note_hops(routed, total_s)
                 # in-process handles' result() includes the prompt —
                 # normalize to generated-only via the timeline count
                 tl = h.timeline() if hasattr(h, "timeline") else {}
@@ -250,7 +316,8 @@ class FleetFrontDoor:
                 if gen is not None and len(toks) > gen:
                     toks = toks[-gen:]
                 self._send_json({**meta, "tokens": toks,
-                                 "timeline": tl})
+                                 "timeline": tl, "hops": hops,
+                                 "total_s": total_s}, headers=hdrs)
 
             # ------------------------------------------------- requests
             def do_POST(self):  # noqa: N802 (stdlib handler contract)
@@ -288,8 +355,43 @@ class FleetFrontDoor:
                         self._send_json(
                             {"status": "unhealthy", "error": str(e)},
                             503)
+                elif path == "/debug/fleet/trace":
+                    # ONE merged Chrome/Perfetto trace for the whole
+                    # fleet: front-door events plus every worker
+                    # replica's recorder export, timestamps aligned by
+                    # the supervisor's clock-offset estimates
+                    try:
+                        body = render_fleet_trace(
+                            sup.trace_exports(),
+                            wall_offset=sup.wall_offset).encode()
+                    except Exception as e:
+                        return self._send_json({"error": str(e)}, 500)
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == "/debug/fleet/requests":
+                    try:
+                        self._send_json(sup.fleet_requests())
+                    except Exception as e:
+                        self._send_json({"error": str(e)}, 500)
                 elif path == "/metrics":
-                    body = render_prometheus(get_registry()).encode()
+                    text = render_prometheus(get_registry())
+                    try:
+                        # replica-labeled aggregation: each worker
+                        # child's registry, fetched over pipe RPC and
+                        # rendered with a replica="<rid>" label so one
+                        # scrape sees the whole fleet
+                        snaps = sup.metrics_snapshots()
+                        if snaps:
+                            text += "\n" + render_snapshot_prometheus(
+                                snaps, label="replica")
+                    except Exception:
+                        # graftlint: ok[resource-hygiene] — child metrics are best-effort; the parent text still serves
+                        pass
+                    body = text.encode()
                     self.send_response(200)
                     self.send_header("Content-Type",
                                      PROMETHEUS_CONTENT_TYPE)

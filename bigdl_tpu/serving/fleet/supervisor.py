@@ -29,31 +29,46 @@ max_new_tokens, tenant=, timeout_s=, block=) -> handle``, ``stats()``,
 
 from __future__ import annotations
 
+import collections
+import json
+import os
 import threading
 import time
 from typing import Dict, List, NamedTuple, Optional
 
 from bigdl_tpu.observability import fleet_instruments
 from bigdl_tpu.observability.events import default_recorder
+from bigdl_tpu.observability.fleettrace import (
+    merge_request_timelines,
+)
 from bigdl_tpu.serving.fleet.router import (
     NoLiveReplicas, PrefixAffinityRouter,
 )
+from bigdl_tpu.serving.fleet.worker import WorkerRPCTimeout
 from bigdl_tpu.serving.streams import EngineDraining, EngineStopped
 
 __all__ = ["InProcessReplica", "ReplicaSupervisor", "Routed"]
 
 #: drain reasons the poll loop may lift again once the probe is clean
-_AUTO_REASONS = ("degraded", "crashed")
+#: (rpc_timeout: the wedged child answered again)
+_AUTO_REASONS = ("degraded", "crashed", "rpc_timeout")
 
 
 class Routed(NamedTuple):
     """One accepted fleet submission: the replica's request handle plus
     where it landed and why (``route`` is ``affinity`` / ``spilled`` /
-    ``round_robin``)."""
+    ``round_robin``). ``trace_id`` is the request's distributed-trace
+    id; ``route_s`` / ``rpc_submit_s`` are the supervisor-measured
+    first two fleet hops (routing decision wall, replica ``submit()``
+    call wall — summed across any re-route retries), which the front
+    door folds into the ``bigdl_fleet_hop_seconds`` breakdown."""
 
     handle: object
     replica: str
     route: str
+    trace_id: Optional[str] = None
+    route_s: float = 0.0
+    rpc_submit_s: float = 0.0
 
 
 class InProcessReplica:
@@ -69,10 +84,12 @@ class InProcessReplica:
     def submit(self, prompt_ids, max_new_tokens: int,
                tenant: Optional[str] = None,
                timeout_s: Optional[float] = None, block: bool = True,
-               priority: str = "normal"):
+               priority: str = "normal",
+               trace_id: Optional[str] = None):
         return self.engine.submit(prompt_ids, max_new_tokens,
                                   timeout_s=timeout_s, block=block,
-                                  tenant=tenant, priority=priority)
+                                  tenant=tenant, priority=priority,
+                                  trace_id=trace_id)
 
     def stats(self) -> dict:
         return self.engine.stats()
@@ -108,6 +125,7 @@ class ReplicaSupervisor:
                  chunk: int = 16, vnodes: int = 64,
                  saturation: float = 8.0, spill_window: int = 8,
                  poll_interval: float = 0.25,
+                 clock_resync_s: float = 30.0,
                  fleet_name: str = "fleet", registry=None,
                  recorder=None):
         if policy not in ("affinity", "round_robin"):
@@ -129,6 +147,21 @@ class ReplicaSupervisor:
         self._health: Dict[str, dict] = {}
         self._drained: Dict[str, str] = {}   # rid -> reason
         self._rr_next = 0
+        #: how stale a worker's ping-estimated clock offset may get
+        #: before the poll loop re-syncs it (drift guard for the
+        #: merged fleet trace)
+        self.clock_resync_s = float(clock_resync_s)
+        # finished-request hop breakdowns, newest last (the
+        # /debug/fleet/requests ring)
+        self._requests: "collections.deque" = collections.deque(
+            maxlen=256)
+        # rid -> collected crash-postmortem summary (path + error)
+        self._postmortems: Dict[str, dict] = {}
+        # rid -> monotonic deadline before which a wedged replica is
+        # NOT re-probed (each probe of a wedged child costs a full
+        # rpc_timeout — without backoff the poll loop would spend all
+        # its wall blocked on the one stuck pipe)
+        self._wedged_until: Dict[str, float] = {}
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._started = False
@@ -183,9 +216,29 @@ class ReplicaSupervisor:
         per-replica probe results (exception reprs for crashed ones)."""
         results: Dict[str, dict] = {}
         for rid, rep in list(self._replicas.items()):
+            until = self._wedged_until.get(rid)
+            if until is not None and time.monotonic() < until:
+                results[rid] = {"status": "wedged", "backoff": True}
+                continue
             try:
                 hz = rep.healthz()
                 results[rid] = hz
+                self._wedged_until.pop(rid, None)
+            except WorkerRPCTimeout as e:
+                # alive but not answering: the wedged-child path —
+                # count it and degrade to auto-drain instead of
+                # letting the next poll block on it again
+                self._ins.rpc_timeouts_total.labels(
+                    self.fleet_name, rid).inc()
+                self._wedged_until[rid] = time.monotonic() \
+                    + 2 * getattr(rep, "rpc_timeout", 10.0)
+                results[rid] = {"status": "wedged", "error": repr(e)}
+                with self._lock:
+                    self._health[rid] = results[rid]
+                    self._loads.pop(rid, None)
+                if self._drained.get(rid) is None:
+                    self.drain(rid, reason="rpc_timeout")
+                continue
             except Exception as e:
                 results[rid] = {"status": "crashed", "error": repr(e)}
                 with self._lock:
@@ -199,6 +252,15 @@ class ReplicaSupervisor:
             with self._lock:
                 self._health[rid] = hz
                 self._loads[rid] = load
+            if hasattr(rep, "maybe_sync_clock"):
+                try:
+                    off = rep.maybe_sync_clock(self.clock_resync_s)
+                    if off is not None:
+                        self._ins.clock_offset_seconds.labels(
+                            self.fleet_name, rid).set(off)
+                except Exception:
+                    # graftlint: ok[resource-hygiene] — a failed resync keeps the last estimate; the next poll retries
+                    pass
             self._ins.replica_queue_depth.labels(
                 self.fleet_name, rid).set(hz.get("queue_depth", 0))
             self._ins.replica_active_slots.labels(
@@ -233,8 +295,45 @@ class ReplicaSupervisor:
         if not already:
             self._ins.drains_total.labels(
                 self.fleet_name, reason).inc()
+            pm = (self._collect_postmortem(rid)
+                  if reason in ("crashed", "rpc_timeout") else None)
+            extra = {"postmortem": pm["path"],
+                     "postmortem_error": (pm.get("error") or {}
+                                          ).get("type")} \
+                if pm else {}
             self._rec.record("fleet/drain", rid, fleet=self.fleet_name,
-                             replica=rid, reason=reason)
+                             replica=rid, reason=reason, **extra)
+
+    def _collect_postmortem(self, rid: str) -> Optional[dict]:
+        """Read the crashed worker's postmortem artifact (if its
+        engine wrote one) into a parent-side summary — path, error
+        type/message, event count — so the child's crash is
+        diagnosable from the fleet ``stats()`` without shelling into
+        the worker's filesystem view. Best-effort: a missing or torn
+        file just means no summary."""
+        with self._lock:
+            rep = self._replicas.get(rid)
+        path = getattr(rep, "postmortem_path", None)
+        if not path or not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                pm = json.load(f)
+        except (OSError, ValueError):
+            return None
+        err = pm.get("error") or {}
+        summary = {
+            "path": path,
+            "schema": pm.get("schema"),
+            "created_at": pm.get("created_at"),
+            "error": {"type": err.get("type"),
+                      "message": err.get("message")},
+            "events": len(pm.get("events") or []),
+            "requests": len(pm.get("requests") or []),
+        }
+        with self._lock:
+            self._postmortems[rid] = summary
+        return summary
 
     def rejoin(self, rid: str) -> None:
         """Return a drained replica to rotation (``resume()`` + back
@@ -258,7 +357,8 @@ class ReplicaSupervisor:
     def submit(self, prompt_ids, max_new_tokens: int,
                tenant: Optional[str] = None,
                priority: str = "normal",
-               timeout_s: Optional[float] = None) -> Routed:
+               timeout_s: Optional[float] = None,
+               trace_id: Optional[str] = None) -> Routed:
         """Route one request and submit it. ``priority`` reaches the
         replica engine's admission queue (class-ordered pop,
         preemption eligibility, shed order — see the engine's QoS
@@ -269,25 +369,49 @@ class ReplicaSupervisor:
         ``RequestRateLimited`` rejection propagates unchanged (the
         front door's 429 + Retry-After). The chosen replica refusing
         (drain/stop race with the poll thread) re-routes once per
-        remaining live replica before giving up."""
+        remaining live replica before giving up.
+
+        ``trace_id`` (the front door's minted/forwarded id) is passed
+        through to the replica — worker replicas carry it over the
+        pipe into the child ``engine.submit`` so the whole
+        cross-process arc shares one id. The returned ``Routed``
+        carries the measured ``route_s`` / ``rpc_submit_s`` hops."""
         block = priority != "low"
         tried: set = set()
+        kwargs = {} if trace_id is None else {"trace_id": trace_id}
+        route_s = rpc_submit_s = 0.0
         while True:
+            t0 = time.monotonic()
             rid, route = self._pick(prompt_ids, tried)
+            t1 = time.monotonic()
+            route_s += t1 - t0
             try:
                 h = self._replicas[rid].submit(
                     prompt_ids, max_new_tokens, tenant=tenant,
                     timeout_s=timeout_s, block=block,
-                    priority=priority)
+                    priority=priority, **kwargs)
             except (EngineDraining, EngineStopped):
+                rpc_submit_s += time.monotonic() - t1
                 tried.add(rid)
                 self._ins.rerouted_total.inc()
                 if len(tried) >= len(self._replicas):
                     raise
                 continue
+            rpc_submit_s += time.monotonic() - t1
             self._ins.requests_total.inc()
             self._ins.routed_total.labels(self.fleet_name, route).inc()
-            return Routed(h, rid, route)
+            req_id = getattr(h, "request_id", None)
+            if trace_id is not None and req_id is not None:
+                # the front-door process's side of the request carries
+                # the trace too — its fleet/* events join the child's
+                # arc in the merged trace
+                self._rec.bind_request(req_id, trace=trace_id,
+                                       replica=rid)
+            self._rec.record("fleet/submitted", req_id,
+                             fleet=self.fleet_name, replica=rid,
+                             route=route)
+            return Routed(h, rid, route, trace_id, route_s,
+                          rpc_submit_s)
 
     def _pick(self, prompt_ids, tried) -> tuple:
         with self._lock:
@@ -309,6 +433,111 @@ class ReplicaSupervisor:
             return rid, "spilled"
         d = self.router.route(prompt_ids, loads)
         return d.replica, d.route
+
+    # --------------------------------------------------- fleet tracing
+    def note_request(self, routed: Routed, hops: Dict[str, float],
+                     total_s: float, outcome: str = "finished"
+                     ) -> dict:
+        """Record one completed request's hop decomposition: observe
+        every ``bigdl_fleet_hop_seconds`` component, append the entry
+        to the ``/debug/fleet/requests`` ring, and close the front-
+        door process's side of the trace with a ``fleet/request_done``
+        event. Called by the front door once the stream is fully
+        written — ``total_s`` is the client-observed wall."""
+        for hop, s in hops.items():
+            self._ins.hop_seconds.labels(self.fleet_name,
+                                         hop).observe(s)
+        entry = {
+            "request_id": getattr(routed.handle, "request_id", None),
+            "trace_id": routed.trace_id,
+            "replica": routed.replica,
+            "route": routed.route,
+            "outcome": outcome,
+            "hops": {k: round(v, 6) for k, v in hops.items()},
+            "hop_sum_s": round(sum(hops.values()), 6),
+            "total_s": round(float(total_s), 6),
+            "ts_s": time.monotonic(),
+        }
+        with self._lock:
+            self._requests.append(entry)
+        self._rec.record("fleet/request_done", entry["request_id"],
+                         fleet=self.fleet_name,
+                         replica=routed.replica, outcome=outcome,
+                         total_s=round(float(total_s), 6))
+        return entry
+
+    def trace_exports(self, last: Optional[int] = None) -> List[dict]:
+        """Per-process event exports for the fleet trace merge: the
+        front-door process's own recorder (offset 0 — it IS the
+        reference clock; in-process replicas share it) plus every
+        worker replica's ``trace_export`` RPC, each tagged with its
+        ping-estimated ``clock_offset_s``. Feed to
+        ``merge_fleet_trace`` with ``wall_offset=self.wall_offset``."""
+        exports: List[dict] = [{
+            "process": "front-door",
+            "pid": os.getpid(),
+            "clock_offset_s": 0.0,
+            "events": self._rec.snapshot(last),
+        }]
+        with self._lock:
+            replicas = list(self._replicas.items())
+        for rid, rep in replicas:
+            export_fn = getattr(rep, "trace_export", None)
+            if export_fn is None:
+                continue
+            try:
+                payload = export_fn(last)
+            except Exception as e:
+                exports.append({"process": rid, "error": repr(e),
+                                "events": [], "clock_offset_s": 0.0})
+                continue
+            exports.append({
+                "process": rid,
+                "clock_offset_s": getattr(rep, "clock_offset_s",
+                                          None) or 0.0,
+                "clock_rtt_s": getattr(rep, "clock_rtt_s", None),
+                "events": payload.get("events") or [],
+            })
+        return exports
+
+    @property
+    def wall_offset(self) -> float:
+        """The reference (front-door) monotonic→wall anchor the
+        merged trace's microsecond axis uses."""
+        return self._rec.wall_offset
+
+    def fleet_requests(self, last: Optional[int] = None) -> dict:
+        """The ``/debug/fleet/requests`` aggregate: the finished-
+        request hop ring plus every request's per-process timeline
+        joined across the fleet's trace exports (aligned first/last
+        timestamps, event-kind sequences, trace ids)."""
+        with self._lock:
+            ring = list(self._requests)
+        return {
+            "fleet": self.fleet_name,
+            "requests": ring,
+            "timelines": merge_request_timelines(
+                self.trace_exports(last)),
+        }
+
+    def metrics_snapshots(self) -> Dict[str, list]:
+        """Every worker replica's registry as plain data (the
+        ``metrics_export`` RPC) — the front door renders them under a
+        ``replica=`` label on ``/metrics``. In-process replicas share
+        the parent registry and are skipped."""
+        out: Dict[str, list] = {}
+        with self._lock:
+            replicas = list(self._replicas.items())
+        for rid, rep in replicas:
+            metrics_fn = getattr(rep, "metrics_export", None)
+            if metrics_fn is None:
+                continue
+            try:
+                out[rid] = metrics_fn()
+            except Exception:
+                # graftlint: ok[resource-hygiene] — a dead/wedged replica just drops out of this scrape
+                continue
+        return out
 
     # ------------------------------------------------------ aggregates
     def loads(self) -> Dict[str, float]:
@@ -347,9 +576,16 @@ class ReplicaSupervisor:
         per: Dict[str, dict] = {}
         hits = lookups = reused = prefilled = 0
         finished = 0
-        for rid, rep in self._replicas.items():
+        with self._lock:
+            replicas = list(self._replicas.items())
+        for rid, rep in replicas:
             try:
                 s = rep.stats()
+            except WorkerRPCTimeout as e:
+                self._ins.rpc_timeouts_total.labels(
+                    self.fleet_name, rid).inc()
+                per[rid] = {"error": repr(e), "wedged": True}
+                continue
             except Exception as e:
                 per[rid] = {"error": repr(e)}
                 continue
@@ -361,6 +597,17 @@ class ReplicaSupervisor:
                 reused += pc.get("reused_tokens", 0)
                 prefilled += pc.get("prefilled_tokens", 0)
             finished += int(s.get("finished", 0) or 0)
+        # a crash postmortem may land on disk AFTER the drain (the
+        # child's crash handler races the parent's poll) — re-check
+        # any crashed replica we have no summary for yet
+        with self._lock:
+            missing = [rid for rid, why in self._drained.items()
+                       if why in ("crashed", "rpc_timeout")
+                       and rid not in self._postmortems]
+        for rid in missing:
+            self._collect_postmortem(rid)
+        with self._lock:
+            postmortems = dict(self._postmortems)
         denom = reused + prefilled
         return {
             "fleet": self.fleet_name,
@@ -378,6 +625,20 @@ class ReplicaSupervisor:
             },
             "routing": self.router.snapshot(),
             "loads": self.loads(),
+            # parent-side views of the workers: wedged-RPC tallies,
+            # clock-offset estimates, and any collected crash
+            # postmortems (path + error summary — satellite of the
+            # fleet-tracing work; a child crash is diagnosable here)
+            "rpc_timeouts": {
+                rid: rep.rpc_timeouts
+                for rid, rep in replicas
+                if getattr(rep, "rpc_timeouts", 0)},
+            "clock": {
+                rid: {"offset_s": rep.clock_offset_s,
+                      "rtt_s": rep.clock_rtt_s}
+                for rid, rep in replicas
+                if getattr(rep, "clock_offset_s", None) is not None},
+            "postmortems": postmortems,
         }
 
     def routing_table(self) -> dict:

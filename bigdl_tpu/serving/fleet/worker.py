@@ -10,8 +10,21 @@ to it over one duplex ``Pipe`` with a tiny message protocol, streaming
 tokens one-way as they decode — never per-token request/response
 (PAPERS.md, "RPC Considered Harmful"):
 
-parent -> worker   ``{op: submit|cancel|healthz|stats|drain|resume|stop}``
+parent -> worker   ``{op: submit|cancel|healthz|stats|ping|``
+                   ``trace_export|metrics_export|drain|resume|stop}``
 worker -> parent   ``{ev: ready|token|done|error|reply|bye}``
+
+Fleet tracing rides this protocol: ``submit`` carries the front
+door's ``trace`` id into ``engine.submit(trace_id=...)`` (every child
+recorder event then carries it, plus the ``replica=`` context stamped
+at startup); ``ping`` answers with the child's monotonic clock for
+the supervisor's min-RTT offset estimate (``sync_clock``);
+``trace_export`` / ``metrics_export`` ship the child's flight-recorder
+events and registry snapshot back for the merged fleet trace and the
+replica-labelled ``/metrics`` aggregation. Control calls that miss
+their deadline raise :class:`WorkerRPCTimeout` (counted in
+``bigdl_fleet_rpc_timeouts_total``) so a wedged child degrades to
+auto-drain instead of blocking the supervisor's poll loop.
 
 ``WorkerReplica`` implements the supervisor's replica protocol;
 ``WorkerHandle`` mirrors the ``RequestHandle`` streaming surface
@@ -41,7 +54,14 @@ from bigdl_tpu.serving.streams import (
     RequestError, RequestRateLimited, RequestShed, RequestTimedOut,
 )
 
-__all__ = ["WorkerHandle", "WorkerReplica", "spawn_worker_fleet"]
+__all__ = ["WorkerHandle", "WorkerRPCTimeout", "WorkerReplica",
+           "spawn_worker_fleet"]
+
+
+class WorkerRPCTimeout(EngineStopped):
+    """A control round-trip (healthz/stats/ping/...) missed its
+    deadline: the child process is alive but not answering — wedged.
+    The supervisor counts it and auto-drains the replica."""
 
 _ERRORS = {
     "RequestCancelled": RequestCancelled,
@@ -68,6 +88,9 @@ def _worker_main(conn, cfg: dict) -> None:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
     from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.observability.events import default_recorder
+    from bigdl_tpu.observability.metrics import default_registry
+    from bigdl_tpu.observability.postmortem import registry_snapshot
     from bigdl_tpu.serving import ContinuousBatchingEngine
     from bigdl_tpu.utils import random as rnd
 
@@ -81,6 +104,10 @@ def _worker_main(conn, cfg: dict) -> None:
                 pass
 
     try:
+        # every event this process records carries its replica id —
+        # the merged fleet trace's per-process attribution key
+        default_recorder().set_context(
+            replica=cfg.get("service", "worker"))
         rnd.set_seed(cfg.get("seed", 7))
         model = TransformerLM(**cfg["model"])
         model.evaluate()
@@ -107,7 +134,8 @@ def _worker_main(conn, cfg: dict) -> None:
                 msg["max_new"], tenant=msg.get("tenant"),
                 timeout_s=msg.get("timeout_s"),
                 block=msg.get("block", True),
-                priority=msg.get("priority", "normal"))
+                priority=msg.get("priority", "normal"),
+                trace_id=msg.get("trace"))
         except Exception as e:
             send({"ev": "error", "rid": rid,
                   "kind": type(e).__name__, "msg": str(e),
@@ -147,10 +175,30 @@ def _worker_main(conn, cfg: dict) -> None:
                 h.cancel()
             else:
                 cancelled.add(msg["rid"])
-        elif op in ("healthz", "stats"):
+        elif op == "ping":
+            # the clock-sync fast path: answer with this process's
+            # monotonic reading immediately (no engine call) so the
+            # parent's min-RTT offset estimate stays tight
+            send({"ev": "reply", "seq": msg["seq"],
+                  "payload": {"mono": time.monotonic(),
+                              "wall": time.time()}})
+        elif op in ("healthz", "stats", "trace_export",
+                    "metrics_export"):
             try:
-                payload = (eng.healthz() if op == "healthz"
-                           else eng.stats())
+                if op == "healthz":
+                    payload = eng.healthz()
+                elif op == "stats":
+                    payload = eng.stats()
+                elif op == "trace_export":
+                    # raw monotonic ts_s — the PARENT aligns them
+                    # with its ping-estimated clock offset
+                    payload = {
+                        "service": cfg.get("service", "worker"),
+                        "events": default_recorder().snapshot(
+                            msg.get("last")),
+                    }
+                else:
+                    payload = registry_snapshot(default_registry())
                 send({"ev": "reply", "seq": msg["seq"],
                       "payload": payload})
             except Exception as e:
@@ -272,11 +320,27 @@ class WorkerReplica:
     """Supervisor replica protocol over one spawn worker process."""
 
     def __init__(self, rid: str, cfg: dict,
-                 start_timeout: float = 120.0):
+                 start_timeout: float = 120.0,
+                 rpc_timeout: float = 10.0):
         self.id = rid
         self._cfg = dict(cfg)
         self._cfg.setdefault("service", rid)
         self._start_timeout = start_timeout
+        #: control-call deadline (healthz/ping/drain/resume; stats
+        #: gets 3x — it renders percentiles). A miss raises
+        #: ``WorkerRPCTimeout`` instead of blocking the caller.
+        self.rpc_timeout = float(rpc_timeout)
+        #: control calls that hit their deadline (the supervisor
+        #: mirrors this into ``bigdl_fleet_rpc_timeouts_total``)
+        self.rpc_timeouts = 0
+        #: ping-estimated monotonic-clock offset: add to a child
+        #: timestamp to land on THIS process's monotonic timeline
+        #: (None until the post-ready handshake syncs it)
+        self.clock_offset_s: Optional[float] = None
+        #: round trip of the winning ping sample — the offset's
+        #: error bound is rtt/2
+        self.clock_rtt_s: Optional[float] = None
+        self._clock_synced_at: Optional[float] = None
         self._proc: Optional[mp.process.BaseProcess] = None
         self._conn = None
         self._reader: Optional[threading.Thread] = None
@@ -319,6 +383,14 @@ class WorkerReplica:
             raise EngineStopped(
                 f"worker {self.id} failed to start: "
                 f"{self._ready_error}")
+        try:
+            # clock-sync handshake: part of coming up, but a failed
+            # estimate must not kill an otherwise-healthy worker —
+            # the supervisor's poll loop retries it
+            self.sync_clock()
+        except Exception:
+            # graftlint: ok[resource-hygiene] — best-effort first sync; maybe_sync_clock refreshes on the poll loop
+            pass
 
     def alive(self) -> bool:
         return self._proc is not None and self._proc.is_alive()
@@ -379,19 +451,20 @@ class WorkerReplica:
             h._push({"ev": "error", "kind": "EngineStopped",
                      "msg": why})
 
-    def _call(self, op: str, timeout: float = 30.0):
+    def _call(self, op: str, timeout: float = 30.0, **extra):
         """One control round-trip (serialized: one outstanding call)."""
         with self._reply_lock:
             self._seq += 1
             seq = self._seq
-            self._send({"op": op, "seq": seq})
+            self._send({"op": op, "seq": seq, **extra})
             deadline = time.monotonic() + timeout
             while True:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    raise EngineStopped(
+                    self.rpc_timeouts += 1
+                    raise WorkerRPCTimeout(
                         f"worker {self.id}: no {op} reply in "
-                        f"{timeout}s")
+                        f"{timeout}s (process alive but wedged)")
                 try:
                     # graftlint: ok[lock-discipline] — _reply_lock IS the one-outstanding-call serializer; replies arrive from _read_loop, which never takes it
                     msg = self._replies.get(timeout=min(remaining, 0.5))
@@ -412,7 +485,8 @@ class WorkerReplica:
                tenant: Optional[str] = None,
                timeout_s: Optional[float] = None,
                block: bool = True,
-               priority: str = "normal") -> WorkerHandle:
+               priority: str = "normal",
+               trace_id: Optional[str] = None) -> WorkerHandle:
         if not self.alive():
             raise EngineStopped(f"worker {self.id} process died")
         self._next_rid += 1
@@ -425,32 +499,109 @@ class WorkerReplica:
                     "prompt": [int(t) for t in prompt],
                     "max_new": int(max_new_tokens), "tenant": tenant,
                     "timeout_s": timeout_s, "block": block,
-                    "priority": priority})
+                    "priority": priority, "trace": trace_id})
         return h
 
     def healthz(self) -> dict:
-        return self._call("healthz", timeout=10.0)
+        return self._call("healthz", timeout=self.rpc_timeout)
 
     def stats(self) -> dict:
-        return self._call("stats", timeout=30.0)
+        return self._call("stats", timeout=3 * self.rpc_timeout)
 
     def drain(self) -> None:
-        self._call("drain", timeout=10.0)
+        self._call("drain", timeout=self.rpc_timeout)
 
     def resume(self) -> None:
-        self._call("resume", timeout=10.0)
+        self._call("resume", timeout=self.rpc_timeout)
+
+    # -------------------------------------------------- fleet tracing
+    def sync_clock(self, samples: int = 8) -> float:
+        """Ping the worker ``samples`` times and keep the min-RTT
+        estimate of its monotonic-clock offset (``clock_offset_s``:
+        add to a child timestamp to land on this process's timeline).
+        Called once after ready and refreshed from the supervisor's
+        poll loop (``maybe_sync_clock``) so drift never accumulates
+        into the merged trace."""
+        from bigdl_tpu.observability.fleettrace import (
+            estimate_clock_offset,
+        )
+
+        def ping() -> float:
+            return self._call("ping",
+                              timeout=self.rpc_timeout)["mono"]
+
+        off, rtt = estimate_clock_offset(ping, samples=samples)
+        self.clock_offset_s, self.clock_rtt_s = off, rtt
+        self._clock_synced_at = time.monotonic()
+        return off
+
+    def maybe_sync_clock(self, max_age_s: float = 30.0,
+                         samples: int = 4) -> Optional[float]:
+        """Refresh the offset estimate when the last sync is older
+        than ``max_age_s`` (the poll loop's periodic refresh); returns
+        the current offset (None before any successful sync)."""
+        age_ok = (self._clock_synced_at is not None
+                  and time.monotonic() - self._clock_synced_at
+                  < max_age_s)
+        if not age_ok:
+            self.sync_clock(samples=samples)
+        return self.clock_offset_s
+
+    def trace_export(self, last: Optional[int] = None) -> dict:
+        """The worker's flight-recorder snapshot (raw monotonic
+        ``ts_s`` — ``merge_fleet_trace`` aligns them with
+        ``clock_offset_s``)."""
+        return self._call("trace_export",
+                          timeout=3 * self.rpc_timeout, last=last)
+
+    def metrics_export(self) -> list:
+        """The worker's metric registry as plain data
+        (``registry_snapshot`` shape) — the front door renders it
+        under a ``replica=`` label on ``/metrics``."""
+        return self._call("metrics_export",
+                          timeout=3 * self.rpc_timeout)
+
+    @property
+    def postmortem_path(self) -> Optional[str]:
+        """Where this worker's engine writes its crash postmortem
+        (``spawn_worker_fleet`` assigns one per worker) — the
+        supervisor collects it on a crash drain."""
+        return (self._cfg.get("engine") or {}).get("postmortem_path")
 
 
 def spawn_worker_fleet(n: int, model: dict, engine: Optional[dict]
                        = None, seed: int = 7,
                        env: Optional[dict] = None,
-                       prefix: str = "r") -> List[WorkerReplica]:
+                       prefix: str = "r",
+                       rpc_timeout: float = 10.0,
+                       postmortem_dir: Optional[str] = None
+                       ) -> List[WorkerReplica]:
     """Build (NOT start) ``n`` same-seed worker replicas — the
     supervisor's ``start()`` brings them up. Same ``model``/``seed``
     in every worker means bit-identical params, so any replica's
     greedy output is every replica's greedy output (the fleet bench's
-    token-parity invariant)."""
-    cfg = {"model": dict(model), "engine": dict(engine or {}),
-           "seed": seed, "env": dict(env or {})}
-    return [WorkerReplica(f"{prefix}{i}", dict(cfg, service=f"{prefix}{i}"))
-            for i in range(n)]
+    token-parity invariant).
+
+    Unless the engine config pins ``postmortem_path``, each worker
+    gets its own under ``postmortem_dir`` (a fresh temp dir by
+    default) so a child crash leaves an artifact the supervisor can
+    collect from the parent."""
+    import os
+    import tempfile
+
+    base_engine = dict(engine or {})
+    if "postmortem_path" not in base_engine:
+        postmortem_dir = postmortem_dir or tempfile.mkdtemp(
+            prefix="bigdl_fleet_pm_")
+    cfg = {"model": dict(model), "seed": seed, "env": dict(env or {})}
+    fleet = []
+    for i in range(n):
+        rid = f"{prefix}{i}"
+        eng = dict(base_engine)
+        if "postmortem_path" not in eng:
+            eng["postmortem_path"] = os.path.join(
+                postmortem_dir, f"{rid}_postmortem.json")
+        fleet.append(WorkerReplica(
+            rid, dict(cfg, engine=eng, service=rid),
+            rpc_timeout=rpc_timeout))
+    return fleet

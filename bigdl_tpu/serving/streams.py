@@ -123,6 +123,13 @@ class RequestHandle:
         #: the request's correlation id (flight recorder events, the
         #: /debug endpoints, and Chrome traces all key on it)
         self.request_id = request_id or next_request_id()
+        #: distributed-trace id (engine-stamped from
+        #: ``submit(trace_id=...)``): the CROSS-process correlation
+        #: key — the fleet front door mints it, every replica-side
+        #: recorder event and usage record carries it, and the merged
+        #: fleet trace joins the per-process arcs on it. None when
+        #: the request never crossed a traced front door.
+        self.trace_id: Optional[str] = None
         self.submitted_at = time.monotonic()
         self.deadline = (self.submitted_at + timeout_s
                          if timeout_s is not None else None)

@@ -31,7 +31,9 @@ against the previous sharded run of the same mesh width), and
 tiered prefix-cache sweep additionally gates the headline hit rate,
 higher-is-better, and the tiered leg's p50 TTFT), and ``bench.py
 --serving --fleet N`` (``detail.affinity.*`` — the multi-replica A/B
-additionally gates the fleet-wide prefix hit rate run-to-run and the
+additionally gates the fleet-wide prefix hit rate run-to-run, the
+mean per-request ``rpc_submit`` hop from the fleet-tracing
+decomposition — the pipe-RPC overhead must not creep — and the
 affinity-vs-round-robin TTFT p50 speedup as an absolute floor: the
 speedup is itself a within-run A/B ratio, so it must stay >= 1.0
 rather than within a band of the previous row's value), and
@@ -179,6 +181,18 @@ def fleet_hit_rate(row: dict):
         or {}
     hr = fl.get("hit_rate")
     return float(hr) if hr is not None else None
+
+
+def fleet_rpc_submit_mean(row: dict):
+    """The fleet A/B row's mean per-request ``rpc_submit`` hop (the
+    parent->worker pipe submit cost from the hop decomposition,
+    affinity leg) — the fleet-tracing overhead signal banded
+    run-to-run. None for every other row shape and for rows predating
+    the ``hops`` stamp."""
+    hops = ((row.get("detail") or {}).get("affinity") or {}
+            ).get("hops") or {}
+    v = hops.get("rpc_submit")
+    return float(v) if v is not None else None
 
 
 def quantized_logit_div_rel(row: dict):
@@ -375,6 +389,9 @@ def main(argv=None) -> int:
         # buying its affinity hit rate (deterministic per workload, so
         # run-to-run ratio gating is stable)
         ("fleet hit rate", fleet_hit_rate, 100.0, "%", True),
+        # the per-hop stamp: the pipe-RPC submit cost must not creep
+        ("fleet rpc_submit mean", fleet_rpc_submit_mean, 1e3, "ms",
+         False),
     )
     for label, reader, scale, unit, higher_better in measures:
         new_v, old_v = reader(newest), reader(prev)

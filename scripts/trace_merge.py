@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Merge per-replica flight-recorder exports into ONE Perfetto trace.
+
+Offline counterpart of the front door's ``GET /debug/fleet/trace``:
+each fleet process can dump its recorder tail (``FlightRecorder
+.snapshot()`` as JSONL or JSON), and this CLI merges the dumps onto a
+clock-aligned common timeline — per-process tracks, derived
+per-request envelope + phase spans — using the same
+``bigdl_tpu.observability.fleettrace`` core the live endpoint serves.
+
+Usage:
+    python scripts/trace_merge.py out.json r0=r0_events.jsonl \\
+        r1=r1_events.jsonl front=door_events.jsonl \\
+        --offset r0=0.0123 --offset r1=-0.0041 \\
+        --wall-offset 1722470000.0
+
+Each positional is ``NAME=PATH``; ``--offset NAME=SECONDS`` is that
+process's monotonic-clock offset vs the reference process (the
+supervisor's ``stats()["clock"]`` values, or 0 for the reference
+itself). ``--wall-offset`` maps the reference monotonic timeline onto
+wall-clock (a recorder's ``wall_offset``); omit it for a
+zero-anchored trace. Input files hold recorder snapshot dicts — JSON
+lines, one JSON array, or a full ``{"process": ..., "events": [...]}``
+export object (extra keys like ``clock_offset_s``/``pid`` are
+honored; CLI flags win).
+
+Stdlib-only: when ``bigdl_tpu`` (and its jax dependency) is not
+importable, the fleettrace module is loaded straight from this
+script's sibling source tree.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _load_fleettrace():
+    """Import the merge core — via the package when available, else
+    straight from source files so the CLI runs without jax."""
+    try:
+        from bigdl_tpu.observability import fleettrace
+        return fleettrace
+    except ImportError:
+        import importlib.util
+        import pathlib
+        import types
+
+        root = pathlib.Path(__file__).resolve().parent.parent
+        for pkg in ("bigdl_tpu", "bigdl_tpu.observability"):
+            if pkg not in sys.modules:
+                sys.modules[pkg] = types.ModuleType(pkg)
+        mods = {}
+        for name in ("events", "fleettrace"):
+            full = f"bigdl_tpu.observability.{name}"
+            spec = importlib.util.spec_from_file_location(
+                full, root / "bigdl_tpu" / "observability"
+                / f"{name}.py")
+            mod = importlib.util.module_from_spec(spec)
+            sys.modules[full] = mod
+            spec.loader.exec_module(mod)
+            mods[name] = mod
+        return mods["fleettrace"]
+
+
+def load_events(path: str) -> dict:
+    """Read one process's recorder dump: JSONL, a JSON array, or a
+    full export object. Returns a partial export dict (``events``
+    plus whatever metadata the file carried)."""
+    with open(path) as f:
+        text = f.read()
+    head = text.lstrip()[:1]
+    if head == "[":
+        return {"events": json.loads(text)}
+    if head == "{":
+        try:
+            obj = json.loads(text)
+        except json.JSONDecodeError:
+            obj = None  # more than one JSON value: JSONL
+        if isinstance(obj, dict):
+            return dict(obj) if "events" in obj \
+                else {"events": [obj]}
+        return {"events": [json.loads(line)
+                           for line in text.splitlines()
+                           if line.strip()]}
+    if not head:
+        return {"events": []}
+    raise SystemExit(f"{path}: not JSON or JSONL")
+
+
+def _kv(pairs, cast, what):
+    out = {}
+    for item in pairs or []:
+        name, sep, val = item.partition("=")
+        if not sep:
+            raise SystemExit(f"--{what} wants NAME=VALUE, got {item!r}")
+        try:
+            out[name] = cast(val)
+        except ValueError:
+            raise SystemExit(f"--{what} {name}: bad value {val!r}")
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Merge per-replica recorder exports into one "
+                    "Perfetto trace.")
+    ap.add_argument("out", help="output trace JSON path")
+    ap.add_argument("exports", nargs="+", metavar="NAME=PATH",
+                    help="one recorder dump per fleet process")
+    ap.add_argument("--offset", action="append", metavar="NAME=SECS",
+                    help="clock offset of NAME vs the reference "
+                         "process (repeatable)")
+    ap.add_argument("--pid", action="append", metavar="NAME=PID",
+                    help="pin NAME's pid in the trace (repeatable)")
+    ap.add_argument("--wall-offset", type=float, default=0.0,
+                    help="reference monotonic->wall anchor seconds "
+                         "(a recorder's wall_offset)")
+    args = ap.parse_args(argv)
+
+    ft = _load_fleettrace()
+    offsets = _kv(args.offset, float, "offset")
+    pids = _kv(args.pid, int, "pid")
+    exports = []
+    for item in args.exports:
+        name, sep, path = item.partition("=")
+        if not sep:
+            raise SystemExit(f"expected NAME=PATH, got {item!r}")
+        ex = load_events(path)
+        ex["process"] = name
+        if name in offsets:
+            ex["clock_offset_s"] = offsets[name]
+        if name in pids:
+            ex["pid"] = pids[name]
+        exports.append(ex)
+        print(f"  {name}: {len(ex['events'])} events "
+              f"(offset {ex.get('clock_offset_s', 0.0):+.6f}s)")
+
+    ft.write_fleet_trace(args.out, exports,
+                         wall_offset=args.wall_offset)
+    n = sum(len(e["events"]) for e in exports)
+    print(f"wrote {args.out}: {len(exports)} processes, {n} events "
+          f"-- open in https://ui.perfetto.dev")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
