@@ -24,11 +24,15 @@ POINT is the serving machinery, not the prose):
      model (per-kind MFU + roofline class from stats()["cost"], loop-
      phase bubble breakdown from stats()["loop"]), and the live
      /debug/dashboard sparkline page (URL printed on startup)
-  7. --tp N: the SAME engine tensor-parallel over an N-way model-axis
+  7. --paged: the SAME engine on the paged KV cache — one refcounted
+     block pool per model, per-request block tables, zero-copy
+     prefix sharing — with the pool's occupancy, fragmentation, and
+     alloc/share/COW/free flow printed from stats()["paging"]
+  8. --tp N: the SAME engine tensor-parallel over an N-way model-axis
      device mesh (Megatron-sharded params, heads-sharded KV pools,
      SPMD dispatches; N virtual host devices on CPU) — topology and
      per-device pool bytes printed from stats()["mesh"]
-  8. --fleet N: the multi-replica fleet instead — N in-process engine
+  9. --fleet N: the multi-replica fleet instead — N in-process engine
      replicas behind a ReplicaSupervisor and the HTTP front door;
      POST /v1/generate streams tokens as SSE (the meta event says
      which replica the prefix-affinity router picked and why), the
@@ -76,6 +80,13 @@ def main(argv=None):
                         "dequantize fused into the attention read) "
                         "and int8 weights, and print membw_util + "
                         "pool bytes next to the fp engine's figures")
+    p.add_argument("--paged", action="store_true",
+                   help="run the continuous-batching engine on the "
+                        "PAGED KV cache (one refcounted block pool "
+                        "per model, per-request block tables, prefix "
+                        "hits share pages copy-on-write) and print "
+                        "the pool's occupancy, fragmentation, and "
+                        "alloc/share/COW/free flow from stats()")
     p.add_argument("--fleet", type=int, default=0, metavar="N",
                    help="run the MULTI-REPLICA demo instead: N in-"
                         "process engine replicas behind the "
@@ -225,6 +236,12 @@ def main(argv=None):
                 "overridden before startup?)")
         engine_kw["mesh"] = MeshEngine.create_mesh(
             [("model", args.tp)], devices=devs[:args.tp])
+    if args.paged:
+        # paged KV: requests hold page_size-token pages from ONE
+        # refcounted pool instead of a dense full-length slot row, so
+        # a short chat never bills a document's worth of HBM and a
+        # prefix hit is a refcount bump, not a row copy
+        engine_kw["page_size"] = 4
     # tiered prefix cache: a tiny device pool forces LRU eviction to
     # DEMOTE rows into pinned host RAM instead of dropping them; a
     # revisit of a demoted prefix promotes it back asynchronously
@@ -334,6 +351,23 @@ def main(argv=None):
               f"({pc['host_entries']} rows); hits "
               f"{pc['hits']} ({pc['host_hits']} from host), "
               f"demoted {pc['demotions']}, promoted {pc['promotions']}")
+        if args.paged:
+            # the block pool's health: live occupancy (prefix entries
+            # still hold their pages), internal fragmentation (wasted
+            # tail of each trailing partial page), and the cumulative
+            # alloc/share/COW/free flow — shares and frees are pure
+            # refcount moves, so cow stays 0 on the aligned hit leg
+            pg = engine.stats()["paging"]
+            pool = pg["pool"]
+            print(f"[paged]     page_size {pg['page_size']}: "
+                  f"{pool['pages_in_use']}/{pool['max_pages']} pages "
+                  f"held ({pool['bytes_in_use'] // 1024} KB of "
+                  f"{pool['capacity_bytes'] // 1024} KB), "
+                  f"fragmentation {pg['fragmentation']:.0%}; flow: "
+                  f"{pool['allocated_total']} allocated, "
+                  f"{pool['shared_total']} shared, "
+                  f"{pool['cow_forks_total']} cow, "
+                  f"{pool['freed_total']} freed")
 
         # who consumed the device: the per-tenant usage table, the
         # goodput block, and the top requests by device-seconds —

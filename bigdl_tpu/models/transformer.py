@@ -440,6 +440,85 @@ class TransformerLM(Module):
             return logits, new_caches
         return logits[:, 0], new_caches
 
+    def init_page_pool(self, max_pages: int, page_size: int,
+                       dtype=jnp.float32, sharding=None, kv_dtype=None):
+        """Per-block PAGE-POOL buffers for paged serving
+        (bigdl_tpu/serving/paging.py): the ``init_cache`` tree forms
+        with the leading dim indexing pool pages instead of batch rows.
+        One block table indexes EVERY layer — page ``p`` names slice
+        ``p`` of each block's buffers — so a request's pages are one
+        id list, not one per layer."""
+        return [getattr(self, f"block{i}").attn.init_page_pool(
+                    max_pages, page_size, dtype, sharding=sharding,
+                    kv_dtype=kv_dtype)
+                for i in range(self.num_layers)]
+
+    def prefill_chunk_at_paged(self, ids, pools, tables, pos0, last_idx):
+        """Paged twin of :meth:`prefill_chunk_at`: each row's chunk
+        scatters its KV into the pool pages its block-table row names
+        and attends the gathered view (``pos0`` is always the (B,)
+        ragged form — the paged engine has no lockstep path). Same
+        caller contract per row: every written position must fall
+        inside the row's reserved pages."""
+        return self._prefill_impl_paged(ids, pools, tables, pos0,
+                                        gather_last=last_idx)
+
+    def verify_chunk_paged(self, ids, pools, tables, pos0):
+        """Paged twin of :meth:`verify_chunk` (ragged (B,) ``pos0``):
+        logits at every chunk position, KV written through the block
+        tables — the paged engine's speculative verifier."""
+        return self._prefill_impl_paged(ids, pools, tables, pos0,
+                                        all_logits=True)
+
+    def _prefill_impl_paged(self, ids, pools, tables, pos0,
+                            all_logits: bool = False, gather_last=None):
+        b, t = ids.shape
+        x = jnp.take(self.tok_embed, ids, axis=0)
+        if not self.use_rope:
+            x = x + jnp.take(self.pos_embed,
+                             pos0[:, None] + jnp.arange(t)[None],
+                             axis=0)
+        new_pools = []
+        for i in range(self.num_layers):
+            blk = getattr(self, f"block{i}")
+            x, c = blk.forward_chunk_paged(x, pools[i], tables, pos0)
+            new_pools.append(c)
+        if gather_last is not None:
+            x = jnp.take_along_axis(
+                x, gather_last[:, None, None].astype(jnp.int32), axis=1)
+        elif not all_logits:
+            x = x[:, -1:]
+        x = self.ln_f(x)
+        if self.tie_embeddings:
+            logits = jnp.einsum("btc,vc->btv", x, self.tok_embed)
+        else:
+            logits = self.head(x.reshape(-1, x.shape[-1])).reshape(
+                b, x.shape[1], -1)
+        if all_logits and gather_last is None:
+            return logits, new_pools
+        return logits[:, 0], new_pools
+
+    def decode_step_paged(self, ids_t, pos, pools, tables):
+        """Paged twin of :meth:`decode_step` (ragged (B,) ``pos``
+        only): one token per row, KV scattered into and gathered from
+        the page pool through ``tables`` inside the same dispatch —
+        compiled shape depends on the pool geometry and the table
+        length, never on any request's span."""
+        x = jnp.take(self.tok_embed, ids_t, axis=0)[:, None, :]  # (B,1,C)
+        if not self.use_rope:
+            x = x + jnp.take(self.pos_embed, pos, axis=0)[:, None]
+        new_pools = []
+        for i in range(self.num_layers):
+            x, c = getattr(self, f"block{i}").forward_step_paged(
+                x, pools[i], tables, pos)
+            new_pools.append(c)
+        x = self.ln_f(x)
+        if self.tie_embeddings:
+            logits = jnp.einsum("btc,vc->btv", x, self.tok_embed)
+        else:
+            logits = self.head(x.reshape(x.shape[0], -1))[:, None, :]
+        return logits[:, 0], new_pools
+
     def decode_step(self, ids_t, pos, caches):
         """One token in, next-token logits out. ids_t (B,) int, ``pos`` a
         traced scalar position — or a (B,) vector for RAGGED batches
@@ -858,6 +937,51 @@ class TransformerLM(Module):
                 (_, _, caches, _), (toks, qlogits) = jax.lax.scan(
                     body, carry, None, length=gamma)
                 return toks, qlogits, caches
+
+        kw = {}
+        if cache_sharding is not None:
+            kw["out_shardings"] = (repl_sharding, repl_sharding,
+                                   cache_sharding)
+        fn = jax.jit(propose, donate_argnums=(4,), **kw)
+        per_model[key] = fn
+        return fn
+
+    def _propose_fn_paged(self, b: int, gamma: int, table_len: int,
+                          sampled: bool = False, cache_sharding=None,
+                          repl_sharding=None):
+        """Paged twin of :meth:`_propose_fn`: the gamma-step proposal
+        scan over ``decode_step_paged`` — the draft's page pool cycles
+        through the scan carry while the block tables ride as a loop
+        constant (a request's pages are fixed for its whole flight, so
+        the tables never change inside one proposal). Signature gains
+        ``tables`` after the pool; donation moves with the pool."""
+        per_model = _SPEC_JIT.setdefault(self, {})
+        key = ("propose_paged", b, gamma, table_len, sampled,
+               cache_sharding)
+        fn = per_model.get(key)
+        if fn is not None:
+            return fn
+        from bigdl_tpu.nn.module import bind
+
+        def propose(p, bufs, tok, pos0, pools, tables, rng, temperature):
+            with bind(self, p, bufs, False, None):
+                def body(carry, _):
+                    tok, pos, pools, rng = carry
+                    logits, pools = self.decode_step_paged(
+                        tok, pos, pools, tables)
+                    if sampled:
+                        rng, sub = jax.random.split(rng)
+                        nxt = jax.random.categorical(
+                            sub, logits.astype(jnp.float32) / temperature,
+                            axis=-1).astype(jnp.int32)
+                    else:
+                        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    return (nxt, pos + 1, pools, rng), (nxt, logits)
+
+                carry = (tok, jnp.asarray(pos0, jnp.int32), pools, rng)
+                (_, _, pools, _), (toks, qlogits) = jax.lax.scan(
+                    body, carry, None, length=gamma)
+                return toks, qlogits, pools
 
         kw = {}
         if cache_sharding is not None:

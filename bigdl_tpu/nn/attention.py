@@ -128,6 +128,72 @@ def _write_kv(cache, k_t, v_t, write):
             dequantize_kv(v_q, v_s, v_t.dtype))
 
 
+def _gather_pages(leaf, tables):
+    """Assemble one logical KV row per batch entry from a page pool:
+    ``leaf`` is a pool buffer (max_pages, H, page_size, D) and
+    ``tables`` (B, table_len) the per-row page ids — position ``i`` of
+    row ``b`` lives at ``leaf[tables[b, i // page_size], :,
+    i % page_size]``. Returns the dense view (B, H, table_len *
+    page_size, D) the existing attention math consumes unchanged; XLA
+    lowers the take to one gather, so compiled shape depends only on
+    the POOL geometry, never on any request's length. Table slots past
+    a request's reservation point at the scratch page — garbage the
+    caller's causal mask must (and does) discard."""
+    b, tlen = tables.shape
+    g = jnp.take(leaf, tables, axis=0)          # (B, table_len, H, ps, D)
+    _, _, h, ps, d = g.shape
+    return g.transpose(0, 2, 1, 3, 4).reshape(b, h, tlen * ps, d)
+
+
+def _write_kv_paged(pool, k_t, v_t, tables, positions):
+    """Paged twin of :func:`_write_kv`: scatter one K/V block into the
+    page-pool buffers through per-row block tables, then gather the
+    dense per-row views attention attends over. ``positions`` is (B,)
+    (one decode token per row) or (B, T) (a ragged chunk); token ``t``
+    of row ``b`` scatters to page ``tables[b, positions[b,t] //
+    page_size]`` at offset ``positions[b, t] % page_size``. The
+    quantized 4-tuple form mirrors the dense path exactly — codes and
+    scale sidecars share the scatter index math, and what is attended
+    is the dequantized STORED view, so a paged cold pass is bitwise the
+    pass a dense engine runs.
+
+    Rows whose table slots are the scratch page (idle dispatch lanes)
+    scatter junk there — multiple lanes may collide on it, which is
+    fine precisely because nothing gathered from the scratch page ever
+    survives the position mask."""
+    if jnp.ndim(positions) == 1:
+        positions = positions[:, None]          # decode step: T == 1
+    ps = pool[0].shape[2]
+    pg = jnp.take_along_axis(tables, positions // ps, axis=1)  # (B, T)
+    off = positions % ps
+
+    def write(buf, blk):
+        # blk (B, H, T, D'): advanced indices at dims 0 and 2 put the
+        # scattered axes in front — value layout (B, T, H, D')
+        return buf.at[pg, :, off, :].set(
+            blk.transpose(0, 2, 1, 3).astype(buf.dtype))
+
+    if len(pool) == 2:
+        k_buf, v_buf = pool
+        k_buf = write(k_buf, k_t)
+        v_buf = write(v_buf, v_t)
+        return ((k_buf, v_buf),
+                _gather_pages(k_buf, tables),
+                _gather_pages(v_buf, tables))
+    k_q, v_q, k_s, v_s = pool
+    kq, ks = quantize_kv(k_t)
+    vq, vs = quantize_kv(v_t)
+    k_q = write(k_q, kq)
+    v_q = write(v_q, vq)
+    k_s = write(k_s, ks)
+    v_s = write(v_s, vs)
+    return ((k_q, v_q, k_s, v_s),
+            dequantize_kv(_gather_pages(k_q, tables),
+                          _gather_pages(k_s, tables), k_t.dtype),
+            dequantize_kv(_gather_pages(v_q, tables),
+                          _gather_pages(v_s, tables), v_t.dtype))
+
+
 def rotary_embedding(x, positions, base: float = 10000.0):
     """RoPE: rotate interleaved feature pairs of x (..., T, D) by
     per-position angles (RoFormer). ``positions`` is (T,) absolute
@@ -418,6 +484,85 @@ class MultiHeadAttention(Module):
         o = self.out_proj(o.reshape(b * t, self.embed_dim).astype(x.dtype))
         return o.reshape(b, t, -1), cache
 
+    def init_page_pool(self, max_pages: int, page_size: int,
+                       dtype=jnp.float32, sharding=None, kv_dtype=None):
+        """Zero PAGE-POOL buffers for paged serving: the same tree
+        forms as :meth:`init_cache` with the leading dim indexing pages
+        instead of batch rows — (max_pages, H_kv, page_size, D) (+ the
+        int8 scale sidecars). Heads stay at dim 1, so the heads-sharded
+        pool layout (parallel/tp.py ``kv_pool_spec``) applies to a page
+        pool exactly as to a dense pool."""
+        return self.init_cache(max_pages, page_size, dtype,
+                               sharding=sharding, kv_dtype=kv_dtype)
+
+    def forward_step_paged(self, x_t, pool, tables, pos):
+        """One RAGGED decode step against a page pool: identical math
+        to the ragged form of :meth:`forward_step`, but each row's KV
+        row is the concatenation of the pool pages its block table
+        names — the write scatters through ``tables`` and the read
+        gathers through ``tables`` inside the same dispatch, so
+        compiled shapes depend only on ``(max_pages, table_len,
+        page_size)``. ``pos`` is the (B,) per-row position vector;
+        rows parked on the scratch page (all-zero tables) are idle
+        lanes whose output the caller ignores."""
+        b = x_t.shape[0]
+        qkv = self.qkv(x_t.reshape(b, self.embed_dim)).reshape(b, 1, -1)
+        q, k_t, v_t = self._split_kv_step(qkv)      # q (B,H,1,D)
+        if self.rotary:
+            q = rotary_embedding_rowwise(q, pos, self.rotary_base)
+            k_t = rotary_embedding_rowwise(k_t, pos, self.rotary_base)
+        pool, k_read, v_read = _write_kv_paged(pool, k_t, v_t,
+                                               tables, pos)
+        h_kv = self.num_kv_heads
+        rep = self.num_heads // h_kv
+        qg = q.reshape(b, h_kv, rep, self.head_dim)
+        scale = 1.0 / math.sqrt(self.head_dim)
+        s = jnp.einsum("bgrd,bgtd->bgrt", qg, k_read,
+                       preferred_element_type=jnp.float32) * scale
+        live = jnp.arange(k_read.shape[2])[None, :] <= pos[:, None]
+        s = jnp.where(live[:, None, None, :], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1).astype(v_read.dtype)
+        o = jnp.einsum("bgrt,bgtd->bgrd", p, v_read)
+        o = o.reshape(b, self.embed_dim).astype(x_t.dtype)
+        o = self.out_proj(o).reshape(b, 1, -1)
+        return o, pool
+
+    def forward_chunk_paged(self, x, pool, tables, pos0):
+        """RAGGED chunked prefill against a page pool (the paged twin
+        of :meth:`forward_chunk` with a (B,) ``pos0``): each row's
+        chunk scatters into its own pages and attends the gathered
+        view under its own position mask.
+
+        CALLER CONTRACT (the paged form of forward_chunk's): every
+        written position ``pos0 + i`` must fall inside the row's
+        reserved pages — ``(pos0 + T) <= len(pages) * page_size`` per
+        row. The engine reserves a request's full span at admission,
+        and page-aligned reuse (``prefill_chunk % page_size == 0``)
+        guarantees no chunk ever straddles into a SHARED page."""
+        b, t, _ = x.shape
+        qkv = self.qkv(x.reshape(b * t, self.embed_dim)).reshape(b, t, -1)
+        q, k, v = self._split_kv_step(qkv)
+        positions = pos0[:, None] + jnp.arange(t)[None]  # (B, T)
+        if self.rotary:
+            q = rotary_embedding_rowwise(q, positions, self.rotary_base)
+            k = rotary_embedding_rowwise(k, positions, self.rotary_base)
+        pool, k_read, v_read = _write_kv_paged(pool, k, v,
+                                               tables, positions)
+        h_kv = self.num_kv_heads
+        rep = self.num_heads // h_kv
+        qg = q.reshape(b, h_kv, rep, t, self.head_dim)
+        scale = 1.0 / math.sqrt(self.head_dim)
+        s = jnp.einsum("bgrtd,bgTd->bgrtT", qg, k_read,
+                       preferred_element_type=jnp.float32) * scale
+        ln = k_read.shape[2]
+        live = jnp.arange(ln)[None, None, :] <= positions[:, :, None]
+        s = jnp.where(live[:, None, None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1).astype(v_read.dtype)
+        o = jnp.einsum("bgrtT,bgTd->bgrtd", p, v_read)
+        o = o.transpose(0, 3, 1, 2, 4).reshape(b, t, self.embed_dim)
+        o = self.out_proj(o.reshape(b * t, self.embed_dim).astype(x.dtype))
+        return o.reshape(b, t, -1), pool
+
     def _rope(self, x, positions):
         return rotary_embedding(x, positions, self.rotary_base) \
             if self.rotary else x
@@ -536,6 +681,20 @@ class TransformerBlock(Module):
         MultiHeadAttention.forward_chunk)."""
         h, cache = self.attn.forward_chunk(self.ln1(x), cache, pos0)
         return self._mlp_residual(x + h), cache
+
+    def forward_step_paged(self, x_t, pool, tables, pos):
+        """Paged decode step (see
+        MultiHeadAttention.forward_step_paged)."""
+        h, pool = self.attn.forward_step_paged(self.ln1(x_t), pool,
+                                               tables, pos)
+        return self._mlp_residual(x_t + h), pool
+
+    def forward_chunk_paged(self, x, pool, tables, pos0):
+        """Paged ragged chunk pass (see
+        MultiHeadAttention.forward_chunk_paged)."""
+        h, pool = self.attn.forward_chunk_paged(self.ln1(x), pool,
+                                                tables, pos0)
+        return self._mlp_residual(x + h), pool
 
     def _mlp_residual(self, x):
         b, t, c = x.shape

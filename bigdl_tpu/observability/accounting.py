@@ -330,6 +330,18 @@ class UsageLedger:
         rec.preemptions += 1
         rec._requeued_at = now
 
+    def accrue_kv(self, rec: UsageRecord, byte_seconds: float) -> None:
+        """Paged-KV billing: add ``byte_seconds`` of device KV
+        residency measured externally. A paged engine integrates each
+        holder's pro-rata page footprint (``PagePool.holder_bytes`` —
+        a page shared by r requests bills 1/r to each, so the sum over
+        holders equals the pool's live bytes) over every loop
+        iteration and feeds it here; its ledger is constructed with
+        ``slot_row_bytes=staging_row_bytes=0`` so the dense
+        row-residency bookkeeping above contributes nothing and the
+        two billing models never double-count. Loop thread only."""
+        rec.kv_byte_seconds += max(0.0, float(byte_seconds))
+
     # --------------------------------------------------------- dispatch
     def charge_dispatch(self, kind: str, wall_s: float,
                         shares: Iterable[Tuple[Optional[UsageRecord],

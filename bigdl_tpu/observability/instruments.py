@@ -289,6 +289,47 @@ def serving_engine_instruments(service: str = "engine",
             "bigdl_serving_prefix_host_cache_entries",
             "Prefix-cache entries currently resident in the host tier",
             labelnames=lbl).labels(service),
+        page_allocated_total=r.counter(
+            "bigdl_serving_page_allocated_total",
+            "KV pages claimed from the paged block pool (refcount "
+            "0 -> 1; 0 for a dense engine)", labelnames=lbl
+        ).labels(service),
+        page_shared_total=r.counter(
+            "bigdl_serving_page_shared_total",
+            "KV page reference bumps (prefix-hit shares, donations, "
+            "copy-on-write forks taking a reference) — each one is a "
+            "row copy the dense engine would have dispatched",
+            labelnames=lbl).labels(service),
+        page_cow_forks_total=r.counter(
+            "bigdl_serving_page_cow_forks_total",
+            "Shared KV pages privatized by a copy-on-write single-page "
+            "device copy before a write (0 on the engine's own paths — "
+            "chunk/page alignment keeps shared pages read-only)",
+            labelnames=lbl).labels(service),
+        page_freed_total=r.counter(
+            "bigdl_serving_page_freed_total",
+            "KV pages returned to the free list (last reference "
+            "dropped) — allocated minus freed is the live page count",
+            labelnames=lbl).labels(service),
+        page_pool_bytes=r.gauge(
+            "bigdl_serving_page_pool_bytes",
+            "Device bytes of paged-KV pool pages currently referenced "
+            "(pages_in_use x per-page footprint, scale sidecars "
+            "included; target + draft pools summed)", labelnames=lbl
+        ).labels(service),
+        page_pool_pages_in_use=r.gauge(
+            "bigdl_serving_page_pool_pages_in_use",
+            "Paged-KV pool pages with at least one live reference "
+            "(slot tables, in-flight admissions, prefix entries; "
+            "target + draft pools summed)", labelnames=lbl
+        ).labels(service),
+        page_pool_fragmentation=r.gauge(
+            "bigdl_serving_page_pool_fragmentation",
+            "Internal fragmentation of live request reservations: 1 - "
+            "covered token positions / reserved page capacity — the "
+            "over-allocation a dense full-length row pays on every "
+            "request, bounded here by the eager page reservation",
+            labelnames=lbl).labels(service),
         quantized_kv=r.gauge(
             "bigdl_serving_quantized_kv",
             "1 when every persistent KV pool (slots, staging, prefix "
@@ -758,6 +799,22 @@ def serving_bench_instruments(registry: Optional[MetricRegistry] = None
             "bigdl_bench_serving_qos_rate_limited",
             "Submissions refused by per-tenant token buckets during "
             "the QoS storm leg"),
+        paged_admitted_concurrency_ratio=lambda: r.gauge(
+            "bigdl_bench_serving_paged_admitted_concurrency_ratio",
+            "Paged-vs-dense peak admitted concurrency ratio at an "
+            "equal device KV byte budget on the mixed short/long "
+            "storm (the bar is >= 3x: page-granular reservation "
+            "admits more requests from the same bytes)"),
+        paged_ttft_p99_speedup=lambda: r.gauge(
+            "bigdl_bench_serving_paged_ttft_p99_speedup",
+            "Dense-vs-paged engine TTFT p99 speedup on the paged A/B "
+            "storm (>1.0: less queueing behind full-window "
+            "reservations)"),
+        paged_fragmentation=lambda: r.gauge(
+            "bigdl_bench_serving_paged_fragmentation",
+            "Paged leg's end-of-run internal fragmentation (wasted "
+            "fraction of held page capacity; trailing partial pages "
+            "are the only waste paging permits)"),
     )
 
 

@@ -71,9 +71,13 @@ each tenant (``handle.usage()``, ``stats()["usage"]``,
 
 from bigdl_tpu.serving.chaos import ChaosFault, ChaosInjector
 from bigdl_tpu.serving.engine import ContinuousBatchingEngine
+from bigdl_tpu.serving.paging import (
+    SCRATCH_PAGE, BlockTable, PagedPrefixIndex, PagePool,
+)
 from bigdl_tpu.serving.prefix_cache import PrefixCache, PrefixEntry
 from bigdl_tpu.serving.scheduler import (
     AdmissionQueue, PrefillPolicy, SpeculationPolicy, TokenBucket,
+    page_fit_score, pages_needed,
 )
 from bigdl_tpu.serving.streams import (
     PRIORITIES, EngineDraining, EngineStopped, QueueFull,
@@ -81,7 +85,8 @@ from bigdl_tpu.serving.streams import (
     RequestRateLimited, RequestShed, RequestTimedOut,
 )
 from bigdl_tpu.serving.benchmark import (
-    poisson_workload, quantized_quality_report, repeated_text_workload,
+    mixed_length_workload, poisson_workload, quantized_quality_report,
+    repeated_text_workload, run_paged_comparison,
     run_poisson_comparison, run_qos_storm, run_quantized_comparison,
     run_shared_prefix_comparison, run_speculative_comparison,
     run_tp_comparison, run_working_set_sweep, shared_prefix_workload,
@@ -91,8 +96,12 @@ __all__ = [
     "ContinuousBatchingEngine",
     "ChaosInjector", "ChaosFault",
     "PrefixCache", "PrefixEntry",
+    "PagePool", "BlockTable", "PagedPrefixIndex", "SCRATCH_PAGE",
     "AdmissionQueue", "PrefillPolicy", "SpeculationPolicy",
-    "TokenBucket",
+    "TokenBucket", "pages_needed", "page_fit_score",
+]
+
+__all__ += [
     "RequestHandle", "RequestError", "RequestCancelled",
     "RequestTimedOut", "RequestShed", "RequestRateLimited",
     "QueueFull", "EngineStopped", "EngineDraining", "PRIORITIES",
@@ -102,4 +111,5 @@ __all__ = [
     "run_tp_comparison", "run_working_set_sweep",
     "quantized_quality_report", "run_quantized_comparison",
     "run_qos_storm",
+    "mixed_length_workload", "run_paged_comparison",
 ]

@@ -83,9 +83,13 @@ from bigdl_tpu.observability.costmodel import (
 from bigdl_tpu.observability.timeseries import (
     TimeSeriesSampler, render_dashboard,
 )
+from bigdl_tpu.serving.paging import (
+    BlockTable, PagedPrefixIndex, PagePool,
+)
 from bigdl_tpu.serving.prefix_cache import PrefixCache
 from bigdl_tpu.serving.scheduler import (
     AdmissionQueue, PrefillPolicy, SpeculationPolicy, TokenBucket,
+    page_fit_score, pages_needed,
 )
 from bigdl_tpu.serving.streams import (
     PRIORITY_RANK, EngineDraining, EngineStopped, RequestCancelled,
@@ -101,7 +105,7 @@ class _Admission:
 
     __slots__ = ("handle", "slot", "row", "ids", "t0", "base", "tail",
                  "n_chunks", "next_chunk", "entry", "d_ids",
-                 "d_n_chunks", "d_next_chunk")
+                 "d_n_chunks", "d_next_chunk", "table", "d_table")
 
     def __init__(self, handle: RequestHandle, slot: int, row: int,
                  ids: np.ndarray, t0: int, base: int, n_chunks: int,
@@ -124,6 +128,11 @@ class _Admission:
         self.d_ids = d_ids        # (d_n_chunks * chunk,) full prompt
         self.d_n_chunks = d_n_chunks
         self.d_next_chunk = 0
+        #: paged mode: the BlockTables this admission writes through
+        #: (full span reserved at admission; handed to the slot on
+        #: completion, freed on abort). None on a dense engine.
+        self.table: Optional[BlockTable] = None
+        self.d_table: Optional[BlockTable] = None
 
 
 class _SlotState:
@@ -311,7 +320,9 @@ class ContinuousBatchingEngine:
                  preempt_slack_s: Optional[float] = 0.25,
                  shed_classes=("low",),
                  tenant_rate_limits=None,
-                 chaos=None):
+                 chaos=None,
+                 page_size: Optional[int] = None,
+                 max_pages: Optional[int] = None):
         from bigdl_tpu.models.transformer import _validate_sampling
         from bigdl_tpu.observability import serving_engine_instruments
         from bigdl_tpu.observability import memory as obs_memory
@@ -423,6 +434,53 @@ class ContinuousBatchingEngine:
                 f"engine's serving window ({cap}); shrink max_len or "
                 "bring a longer-context draft")
 
+        # ---- paged KV mode ---------------------------------------------
+        # page_size switches EVERY KV surface (slot rows, prefill
+        # staging, prefix pool, host tier, draft mirrors) from
+        # full-length rows to ONE refcounted block pool per model
+        # (serving.paging): requests hold fixed page_size-token pages
+        # through BlockTables, prefix hits SHARE the aligned pages
+        # copy-on-write instead of copying rows, and eviction /
+        # host-tier demotion / preemption-donation become refcount
+        # moves. Compiled shapes depend only on (max_pages, page_size)
+        # — the jit gauge stays flat exactly as in dense mode.
+        self.paged = page_size is not None
+        if max_pages is not None and not self.paged:
+            raise ValueError("max_pages requires page_size (paged mode)")
+        self.page_size: Optional[int] = None
+        self._pages = self._d_pages = None
+        self._kv_pool = self._d_kv_pool = None
+        self._tables = self._d_tables = None
+        self._table_len = 0
+        if self.paged:
+            page_size = int(page_size)
+            if page_size < 1:
+                raise ValueError(
+                    f"page_size must be >= 1, got {page_size}")
+            if c % page_size != 0:
+                raise ValueError(
+                    f"prefill_chunk ({c}) must be a multiple of "
+                    f"page_size ({page_size}): the chunk-aligned reuse "
+                    "boundary must land on a page boundary, or a hit's "
+                    "shared pages would be written under a live share "
+                    "(the copy-on-write invariant paging.py documents)")
+            self.page_size = page_size
+            #: fixed device block-table width: every request's table is
+            #: padded to the worst-case page count, so compiled shapes
+            #: never depend on any one request's length
+            self._table_len = -(-phys_len // page_size)
+            if max_pages is None:
+                # room for every slot at full length plus an equal
+                # retained-prefix share — roughly the dense engine's
+                # slot-pool + prefix-pool byte budget in page currency
+                max_pages = 1 + 2 * max_slots * self._table_len
+            max_pages = int(max_pages)
+            if max_pages < 1 + self._table_len:
+                raise ValueError(
+                    f"max_pages ({max_pages}) cannot hold one "
+                    f"full-length request ({self._table_len} pages) "
+                    "plus the reserved scratch page")
+
         # ---- tensor-parallel mesh (SPMD serving) -----------------------
         # With a mesh, EVERY compiled program below runs as one SPMD
         # dispatch: params are Megatron-sharded (transformer_tp_rules /
@@ -462,19 +520,32 @@ class ContinuousBatchingEngine:
             self._params = shard_params(self._params, mesh, tp_rules)
             self._buffers = replicate(self._buffers, mesh)
         dtype = model.tok_embed.dtype
-        # THE pooled cache: one persistent (max_slots, ...) buffer set,
-        # donated through every step — updates are in-place for the
-        # engine's whole life
-        self._caches = model.init_cache(max_slots, phys_len, dtype=dtype,
-                                        sharding=self._kv_shard,
-                                        kv_dtype=self.kv_dtype)
-        # prefill_rows-wide staging cache for chunked prefill; rows are
-        # reused across admissions (stale tail KV is position-masked,
-        # never attended)
-        self._staging = model.init_cache(self._policy.prefill_rows,
-                                         phys_len, dtype=dtype,
-                                         sharding=self._kv_shard,
-                                         kv_dtype=self.kv_dtype)
+        if self.paged:
+            # THE page pool: one persistent (max_pages, page_size, ...)
+            # buffer set per layer, donated through every dispatch.
+            # There is no separate staging cache — admissions prefill
+            # straight through their reserved tables — and no separate
+            # prefix pool: retained prefixes are refcounted shares of
+            # these same pages.
+            self._kv_pool = model.init_page_pool(
+                max_pages, page_size, dtype=dtype,
+                sharding=self._kv_shard, kv_dtype=self.kv_dtype)
+            self._pages = PagePool(self._kv_pool, page_size)
+            self._tables = [None] * max_slots
+            self._caches = self._staging = None
+        else:
+            # THE pooled cache: one persistent (max_slots, ...) buffer
+            # set, donated through every step — updates are in-place
+            # for the engine's whole life
+            self._caches = model.init_cache(
+                max_slots, phys_len, dtype=dtype,
+                sharding=self._kv_shard, kv_dtype=self.kv_dtype)
+            # prefill_rows-wide staging cache for chunked prefill; rows
+            # are reused across admissions (stale tail KV is
+            # position-masked, never attended)
+            self._staging = model.init_cache(
+                self._policy.prefill_rows, phys_len, dtype=dtype,
+                sharding=self._kv_shard, kv_dtype=self.kv_dtype)
         if draft is not None:
             # the draft's slot pool + staging mirror the target's
             # geometry row-for-row (same phys_len so lifecycle stays
@@ -495,12 +566,25 @@ class ContinuousBatchingEngine:
                                               tp_rules)
                 self._d_bufs = replicate(self._d_bufs, mesh)
             d_dtype = draft.tok_embed.dtype
-            self._d_caches = draft.init_cache(
-                max_slots, phys_len, dtype=d_dtype,
-                sharding=self._d_kv_shard, kv_dtype=self.kv_dtype)
-            self._d_staging = draft.init_cache(
-                self._policy.prefill_rows, phys_len, dtype=d_dtype,
-                sharding=self._d_kv_shard, kv_dtype=self.kv_dtype)
+            if self.paged:
+                # the draft's own page pool: it never shares pages (the
+                # prefix index retains target KV only), so at most
+                # max_slots concurrent tables — sized to always satisfy
+                # a reservation the target pool accepted
+                self._d_kv_pool = draft.init_page_pool(
+                    1 + max_slots * self._table_len, page_size,
+                    dtype=d_dtype, sharding=self._d_kv_shard,
+                    kv_dtype=self.kv_dtype)
+                self._d_pages = PagePool(self._d_kv_pool, page_size)
+                self._d_tables = [None] * max_slots
+                self._d_caches = self._d_staging = None
+            else:
+                self._d_caches = draft.init_cache(
+                    max_slots, phys_len, dtype=d_dtype,
+                    sharding=self._d_kv_shard, kv_dtype=self.kv_dtype)
+                self._d_staging = draft.init_cache(
+                    self._policy.prefill_rows, phys_len, dtype=d_dtype,
+                    sharding=self._d_kv_shard, kv_dtype=self.kv_dtype)
         else:
             self._d_caches = self._d_staging = None
         # prefix-cache KV pool: a third persistent buffer set holding
@@ -513,8 +597,15 @@ class ContinuousBatchingEngine:
         # (token_bytes, pool/host row budgets, PrefixCache accounting,
         # the ledger's KV byte-seconds and bytes_saved credits) stays
         # honest without a special case
-        row_bytes = sum(int(leaf.nbytes) // max_slots
-                        for leaf in jax.tree.leaves(self._caches))
+        if self.paged:
+            # the full-length-row EQUIVALENT (what one dense slot of
+            # this geometry would cost): the exchange rate for pool /
+            # host budgets and reuse credits stays comparable across
+            # modes, while actual paged billing is per held page
+            row_bytes = self._table_len * self._pages.page_bytes
+        else:
+            row_bytes = sum(int(leaf.nbytes) // max_slots
+                            for leaf in jax.tree.leaves(self._caches))
         self._row_bytes = row_bytes
         #: device KV bytes one cached token position costs — the
         #: exchange rate prefix-reuse savings are credited at
@@ -534,7 +625,20 @@ class ContinuousBatchingEngine:
             host_rows = 0
         else:
             host_rows = max(0, int(prefix_host_bytes) // row_bytes)
-        if pool_rows > 0:
+        if pool_rows > 0 and self.paged:
+            # pages as the retention currency: pool_rows bounds ENTRY
+            # count (cardinality), the shared page pool bounds bytes;
+            # the host budget converts to pages
+            self._pool = None
+            self._prefix = PagedPrefixIndex(
+                self._pages, max_entries=pool_rows,
+                min_tokens=(prefix_min_tokens
+                            if prefix_min_tokens is not None else c),
+                token_bytes=self._token_bytes,
+                devices=(int(mesh.shape[model_axis])
+                         if mesh is not None else 1),
+                host_pages=host_rows * self._table_len)
+        elif pool_rows > 0:
             self._pool = model.init_cache(pool_rows, phys_len,
                                           dtype=dtype,
                                           sharding=self._kv_shard,
@@ -574,6 +678,16 @@ class ContinuousBatchingEngine:
         #: programs that have run at least once — the jit_compiles
         #: fallback when jax's _cache_size probe is unavailable
         self._warm = set()
+        #: paged bookkeeping: last KV byte-second accrual stamp, the
+        #: page-flow counter baselines behind the delta-published
+        #: bigdl_serving_page_* instruments, and the blocked-admission
+        #: latch (set when the pool cannot satisfy the queue head's
+        #: reservation this iteration — re-probed next iteration
+        #: instead of thrashing pop/requeue within one)
+        self._last_kv_accrue: Optional[float] = None
+        self._page_seen = {"allocated": 0, "shared": 0,
+                           "cow_forks": 0, "freed": 0}
+        self._adm_blocked = False
         self._build_fns()
 
         self._ins = serving_engine_instruments(service_name, registry)
@@ -585,8 +699,14 @@ class ContinuousBatchingEngine:
         self._usage = UsageLedger(
             service=service_name, registry=registry, recorder=self._rec,
             instruments=self._ins, max_tenants=usage_tenants,
-            recent=usage_recent, slot_row_bytes=row_bytes,
-            staging_row_bytes=row_bytes, token_bytes=self._token_bytes,
+            recent=usage_recent,
+            # paged mode bills KV byte-seconds per actually-held page
+            # (accrue_kv from the loop, holder_bytes pro-rata over
+            # shares) — the dense row-residency terms must be zero or
+            # a request would be double-billed
+            slot_row_bytes=0 if self.paged else row_bytes,
+            staging_row_bytes=0 if self.paged else row_bytes,
+            token_bytes=self._token_bytes,
             devices=(int(mesh.size) if mesh is not None else 1))
         self._queue = AdmissionQueue(
             queue_capacity, recorder=self._rec,
@@ -637,6 +757,16 @@ class ContinuousBatchingEngine:
 
         pools = {f"serving/{service_name}/{key}": pool_reader(key)
                  for key in self._pool_bytes}
+        if self.paged:
+            # the page pool's LIVE footprint next to its capacity:
+            # bytes of pages something still references (slot tables,
+            # in-flight admissions, prefix entries) — /debug/memory
+            # then answers "how full is the pool" not just "how big"
+            pools[f"serving/{service_name}/kv_pages_in_use"] = (
+                lambda e: e._pages.bytes_in_use)
+            if self.draft is not None:
+                pools[f"serving/{service_name}/draft_pages_in_use"] = (
+                    lambda e: e._d_pages.bytes_in_use)
         self._memory_pools = obs_memory.register_owned_pools(self, pools)
         if self._prefix is not None:
             self._memory_pools.append(self._prefix.register_memory_pool(
@@ -777,11 +907,14 @@ class ContinuousBatchingEngine:
 
     # ------------------------------------------------- compiled programs
     def _build_fns(self):
+        if self.paged:
+            return self._build_fns_paged()
         from bigdl_tpu.models.transformer import (
             _filter_logits, _spec_accept,
         )
         from bigdl_tpu.nn.module import bind
 
+        self._copy_page_jit = None   # paged-only program
         model = self.model
         sampled = self.temperature > 0.0
         top_k, top_p = self.top_k, self.top_p
@@ -1023,6 +1156,207 @@ class ContinuousBatchingEngine:
             self._warm.update(("spec:propose", "spec:verify",
                                "spec:sync"))
 
+    def _build_fns_paged(self):
+        """Paged twins of the compiled programs: every KV surface is
+        the page pool, gathered/scattered through per-request block
+        tables INSIDE the dispatch. Compiled shapes depend only on
+        ``(max_pages, page_size)`` and the fixed dispatch widths
+        (max_slots / prefill_rows / table_len / gamma) — none on load —
+        so the jit gauge stays flat while alloc/share/COW-fork/evict/
+        demote/preempt move nothing but host-side refcounts."""
+        from bigdl_tpu.models.transformer import (
+            _filter_logits, _spec_accept,
+        )
+        from bigdl_tpu.nn.module import bind
+
+        model = self.model
+        sampled = self.temperature > 0.0
+        top_k, top_p = self.top_k, self.top_p
+
+        def step(p, bufs, tok, pos, pool, tables, rng, temperature):
+            # one fused decode over ALL slots; idle lanes carry the
+            # all-scratch table (SCRATCH_PAGE padding) so their junk
+            # write lands on page 0, never on a live page
+            with bind(model, p, bufs, False, None):
+                logits, pool = model.decode_step_paged(tok, pos, pool,
+                                                       tables)
+            if sampled:
+                nxt = jax.random.categorical(
+                    rng, _filter_logits(logits, temperature, top_k,
+                                        top_p),
+                    axis=-1).astype(jnp.int32)
+            else:
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return nxt, pool
+
+        def chunk(p, bufs, ids, pool, tables, pos0, last_idx):
+            # the ragged admission prefill, writing through each row's
+            # reserved table: a prefix hit's row starts at pos0 = base
+            # (page-aligned — see the ctor's chunk/page check), so its
+            # writes land only in its FRESH pages while the shared head
+            # is read via the gather — zero row copies on the hit leg
+            with bind(model, p, bufs, False, None):
+                return model.prefill_chunk_at_paged(ids, pool, tables,
+                                                    pos0, last_idx)
+
+        def copy_page(pool, dst, src):
+            # single-page pool-internal copy — the COW privatization
+            # primitive (BlockTable.ensure_writable's copy_page
+            # callback) — one compiled signature, load-independent
+            return jax.tree.map(
+                lambda b: jax.lax.dynamic_update_slice(
+                    b,
+                    jax.lax.dynamic_slice(
+                        b, (src,) + (0,) * (b.ndim - 1),
+                        (1,) + b.shape[1:]),
+                    (dst,) + (jnp.int32(0),) * (b.ndim - 1)),
+                pool)
+
+        def copy_row(dst, src, dst_row, src_row):
+            # generic tree row copy, kept for the promote landing:
+            # (1, ...) host-transferred page tree -> pool page dst_row
+            return jax.tree.map(
+                lambda d, s: jax.lax.dynamic_update_slice(
+                    d,
+                    jax.lax.dynamic_slice(
+                        s, (src_row,) + (0,) * (s.ndim - 1),
+                        (1,) + s.shape[1:]).astype(d.dtype),
+                    (dst_row,) + (jnp.int32(0),) * (d.ndim - 1)),
+                dst, src)
+
+        def sample0(logits, rng, temperature):
+            if sampled:
+                return jax.random.categorical(
+                    rng, _filter_logits(logits, temperature, top_k,
+                                        top_p),
+                    axis=-1).astype(jnp.int32)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        kv, repl = self._kv_shard, self._repl
+
+        def _jit(fn, donate, out=None):
+            if self.mesh is None:
+                # graftlint: ok[jit-hazard] — meshless (single-device) branch has no shardings to pin
+                return jax.jit(fn, donate_argnums=donate)
+            return jax.jit(fn, donate_argnums=donate, out_shardings=out)
+
+        self._step_jit = _jit(step, (4,), (repl, kv))
+        self._chunk_jit = _jit(chunk, (3,), (repl, kv))
+        self._copy_page_jit = _jit(copy_page, (0,), kv)
+        self._copy_row_jit = _jit(copy_row, (0,), kv)
+        self._sample0_jit = _jit(sample0, (), repl)
+
+        self._take_row_jit = None
+        if self._prefix is not None and self._prefix.host_rows > 0:
+            def take_row(src, row):
+                # demotion source: one jitted slice lifting page `row`
+                # out as a (1, ...) tree the spill bulk-copies to host
+                return jax.tree.map(
+                    lambda s: jax.lax.dynamic_slice(
+                        s, (row,) + (0,) * (s.ndim - 1),
+                        (1,) + s.shape[1:]), src)
+
+            self._take_row_jit = _jit(take_row, (), kv)
+
+        # ---- speculative-decoding programs (paged) -------------------
+        self._propose_jit = self._spec_verify_jit = None
+        self._d_chunk_jit = self._d_sync_jit = None
+        if self.draft is not None:
+            draft = self.draft
+            g = self._spec.gamma
+
+            self._propose_jit = draft._propose_fn_paged(
+                self.max_slots, g, self._table_len, sampled=sampled,
+                cache_sharding=self._d_kv_shard,
+                repl_sharding=self._repl)
+
+            def d_chunk(p, bufs, ids, pool, tables, pos0, last_idx):
+                with bind(draft, p, bufs, False, None):
+                    return draft.prefill_chunk_at_paged(
+                        ids, pool, tables, pos0, last_idx)
+
+            def d_sync(p, bufs, tok, pos, pool, tables):
+                with bind(draft, p, bufs, False, None):
+                    _, pool = draft.decode_step_paged(tok, pos, pool,
+                                                      tables)
+                return pool
+
+            def spec_verify(p, bufs, tok, props, qlogits, pos, pool,
+                            tables, rng, temperature):
+                chunk_ids = jnp.concatenate(
+                    [tok[:, None], jnp.swapaxes(props, 0, 1)], axis=1)
+                with bind(model, p, bufs, False, None):
+                    logits, pool = model.verify_chunk_paged(
+                        chunk_ids, pool, tables, pos)
+                if sampled:
+                    accept, resid, bonus = _spec_accept(
+                        logits, jnp.swapaxes(qlogits, 0, 1),
+                        chunk_ids[:, 1:], temperature, rng)
+                    n_acc = jnp.sum(jnp.cumprod(
+                        accept.astype(jnp.int32), axis=1), axis=1)
+                    fix = jnp.take_along_axis(
+                        jnp.concatenate([resid, bonus[:, None]],
+                                        axis=1),
+                        n_acc[:, None], axis=1)
+                    cols = jnp.arange(g + 1)[None, :]
+                    padded = jnp.concatenate(
+                        [chunk_ids[:, 1:],
+                         jnp.zeros_like(tok)[:, None]], axis=1)
+                    emit = jnp.where(cols < n_acc[:, None], padded, fix)
+                else:
+                    v_tok = jnp.argmax(logits, axis=-1).astype(
+                        jnp.int32)
+                    match = (chunk_ids[:, 1:] == v_tok[:, :g]).astype(
+                        jnp.int32)
+                    n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+                    emit = v_tok
+                return emit, n_acc, pool
+
+            self._d_chunk_jit = _jit(d_chunk, (3,), (repl, kv))
+            self._d_sync_jit = _jit(d_sync, (4,), kv)
+            self._spec_verify_jit = _jit(spec_verify, (6,),
+                                         (repl, repl, kv))
+
+        # warm every copy/transfer signature NOW (page 0 onto page 0 —
+        # the scratch page, harmless): COW copies, demote slices, and
+        # promote scatters first fire deep into steady state, and a
+        # compile there would read as a post-warmup jit_compiles bump —
+        # the flatness contract the gauge polices
+        z = jnp.int32(0)
+        self._kv_pool = self._copy_page_jit(self._kv_pool, z, z)
+        self._warm.add("copy:page")
+        if self._take_row_jit is not None:
+            from bigdl_tpu.parallel.tp import put_from_host
+
+            _ = self._take_row_jit(self._kv_pool, z)
+            host_proto = jax.tree.map(
+                lambda s: np.zeros((1,) + s.shape[1:], s.dtype),
+                self._kv_pool)
+            one_page = put_from_host(host_proto, self._kv_shard)
+            self._kv_pool = self._copy_row_jit(self._kv_pool, one_page,
+                                               z, z)
+            self._warm.update(("copy:demote", "copy:promote"))
+        if self.draft is not None:
+            # warm the whole speculative round (all-scratch tables:
+            # every junk write lands on page 0) — the sync dispatch is
+            # conditional at runtime, exactly the dense argument
+            zt = self._h2d(jnp.zeros((self.max_slots,), jnp.int32))
+            zT = self._h2d(jnp.zeros(
+                (self.max_slots, self._table_len), jnp.int32))
+            zk = self._h2d(jax.random.PRNGKey(0))
+            t1 = self._h2d(jnp.float32(1.0))
+            props, qlogits, self._d_kv_pool = self._propose_jit(
+                self._d_params, self._d_bufs, zt, zt,
+                self._d_kv_pool, zT, zk, t1)
+            _, _, self._kv_pool = self._spec_verify_jit(
+                self._params, self._buffers, zt, props, qlogits, zt,
+                self._kv_pool, zT, zk, t1)
+            self._d_kv_pool = self._d_sync_jit(
+                self._d_params, self._d_bufs, zt, zt,
+                self._d_kv_pool, zT)
+            self._warm.update(("spec:propose", "spec:verify",
+                               "spec:sync"))
+
     def _h2d(self, x):
         """Host value → device array; on a mesh, committed REPLICATED.
         Every per-iteration host input (token/position vectors, chunk
@@ -1040,6 +1374,13 @@ class ContinuousBatchingEngine:
         pool this engine owns (the mesh-summary / per-device gauge
         enumeration; keys match the ``serving/<name>/<pool>`` registry
         suffixes)."""
+        if self.paged:
+            out = {"kv_page_pool": self._kv_pool,
+                   "params": self._params}
+            if self.draft is not None:
+                out["draft_page_pool"] = self._d_kv_pool
+                out["draft_params"] = self._d_params
+            return out
         out = {"kv_slots": self._caches,
                "prefill_staging": self._staging,
                "params": self._params}
@@ -1095,6 +1436,8 @@ class ContinuousBatchingEngine:
     def _compile_total(self) -> int:
         fns = [self._step_jit, self._chunk_jit, self._copy_row_jit,
                self._sample0_jit]
+        if self._copy_page_jit is not None:
+            fns.append(self._copy_page_jit)
         if self._take_row_jit is not None:
             fns.append(self._take_row_jit)
         if self.draft is not None:
@@ -1133,40 +1476,74 @@ class ContinuousBatchingEngine:
         t1 = self._temp_const
         ids = self._h2d(jnp.zeros((rows, c), jnp.int32))
         rpos = self._h2d(jnp.zeros((rows,), jnp.int32))
-        progs = {"prefill": [(self._chunk_jit,
-                              (self._params, self._buffers, ids,
-                               self._staging, rpos, rpos))]}
-        if self.draft is None:
-            progs["decode"] = [(self._step_jit,
-                                (self._params, self._buffers, zt, zt,
-                                 self._caches, zk, t1))]
+        if self.paged:
+            zT = self._h2d(jnp.zeros((S, self._table_len), jnp.int32))
+            zTr = self._h2d(jnp.zeros((rows, self._table_len),
+                                      jnp.int32))
+            progs = {"prefill": [(self._chunk_jit,
+                                  (self._params, self._buffers, ids,
+                                   self._kv_pool, zTr, rpos, rpos))]}
+            if self.draft is None:
+                progs["decode"] = [(self._step_jit,
+                                    (self._params, self._buffers, zt,
+                                     zt, self._kv_pool, zT, zk, t1))]
+            else:
+                progs["prefill"].append(
+                    (self._d_chunk_jit,
+                     (self._d_params, self._d_bufs, ids,
+                      self._d_kv_pool, zTr, rpos, rpos)))
+                try:
+                    props_sd, qlog_sd, _ = jax.eval_shape(
+                        self._propose_jit, self._d_params,
+                        self._d_bufs, zt, zt, self._d_kv_pool, zT,
+                        zk, t1)
+                except Exception:
+                    props_sd = qlog_sd = None
+                progs["decode"] = [
+                    (self._propose_jit,
+                     (self._d_params, self._d_bufs, zt, zt,
+                      self._d_kv_pool, zT, zk, t1))]
+                if props_sd is not None:
+                    progs["decode"].append(
+                        (self._spec_verify_jit,
+                         (self._params, self._buffers, zt, props_sd,
+                          qlog_sd, zt, self._kv_pool, zT, zk, t1)))
         else:
-            progs["prefill"].append(
-                (self._d_chunk_jit,
-                 (self._d_params, self._d_bufs, ids, self._d_staging,
-                  rpos, rpos)))
-            try:
-                props_sd, qlog_sd, _ = jax.eval_shape(
-                    self._propose_jit, self._d_params, self._d_bufs,
-                    zt, zt, self._d_caches, zk, t1)
-            except Exception:
-                props_sd = qlog_sd = None
-            progs["decode"] = [
-                (self._propose_jit,
-                 (self._d_params, self._d_bufs, zt, zt, self._d_caches,
-                  zk, t1))]
-            if props_sd is not None:
-                progs["decode"].append(
-                    (self._spec_verify_jit,
-                     (self._params, self._buffers, zt, props_sd,
-                      qlog_sd, zt, self._caches, zk, t1)))
+            progs = {"prefill": [(self._chunk_jit,
+                                  (self._params, self._buffers, ids,
+                                   self._staging, rpos, rpos))]}
+            if self.draft is None:
+                progs["decode"] = [(self._step_jit,
+                                    (self._params, self._buffers, zt,
+                                     zt, self._caches, zk, t1))]
+            else:
+                progs["prefill"].append(
+                    (self._d_chunk_jit,
+                     (self._d_params, self._d_bufs, ids,
+                      self._d_staging, rpos, rpos)))
+                try:
+                    props_sd, qlog_sd, _ = jax.eval_shape(
+                        self._propose_jit, self._d_params,
+                        self._d_bufs, zt, zt, self._d_caches, zk, t1)
+                except Exception:
+                    props_sd = qlog_sd = None
+                progs["decode"] = [
+                    (self._propose_jit,
+                     (self._d_params, self._d_bufs, zt, zt,
+                      self._d_caches, zk, t1))]
+                if props_sd is not None:
+                    progs["decode"].append(
+                        (self._spec_verify_jit,
+                         (self._params, self._buffers, zt, props_sd,
+                          qlog_sd, zt, self._caches, zk, t1)))
         ctx = self._phys_len // 2
         g = self._spec.gamma if self._spec is not None else 0
         analytic = {
             "prefill": (rows * c, ctx),
             "decode": (S * (g + 1) if g else S, ctx),
         }
-        cache_itemsize = int(jax.tree.leaves(self._caches)[0]
+        kv_tree = self._kv_pool if self.paged else self._caches
+        cache_itemsize = int(jax.tree.leaves(kv_tree)[0]
                              .dtype.itemsize)
         for kind, entries in progs.items():
             costs = [program_cost(fn, *args) for fn, args in entries]
@@ -1241,12 +1618,26 @@ class ContinuousBatchingEngine:
             if a.entry is not None:
                 self._prefix.release(a.entry)
                 a.entry = None
+            if a.table is not None:
+                a.table.free()
+                a.table = None
+            if a.d_table is not None:
+                a.d_table.free()
+                a.d_table = None
             self._finish_handle(a.handle, err, "stopped")
         self._adms = []
         for sid, st in enumerate(self._slots):
             if st is not None:
                 self._finish_handle(st.handle, err, "stopped")
                 self._slots[sid] = None
+            self._free_slot_table(sid)
+        if self.paged:
+            # leak invariant: after the tables above and the index's
+            # retained entries release their references, every page
+            # is back on the free list (pages_in_use == 0 — tested)
+            if self._prefix is not None:
+                self._prefix.drop_all()
+            self._sync_page_gauges()
 
     def drain(self) -> None:
         """Stop admitting NEW requests while everything already
@@ -1344,6 +1735,20 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"prompt ({t0}) + max_new_tokens ({n}) exceeds the "
                 f"engine's serving window {self.max_len}")
+        if self.paged:
+            # the request's FULL page reservation (admission reserves
+            # the whole span eagerly — the no-mid-flight-OOM contract)
+            # must fit the pool even with every other page free
+            g = self._spec.gamma if self._spec is not None else 0
+            need = pages_needed(min(t0 + n + g, self._phys_len),
+                                self.page_size)
+            usable = self._pages.max_pages - 1  # page 0 is scratch
+            if need > usable:
+                raise ValueError(
+                    f"request needs {need} KV pages but the pool only "
+                    f"has {usable} allocatable (max_pages="
+                    f"{self._pages.max_pages} minus the scratch page) "
+                    f"— raise max_pages or shorten the request")
         self.start()
         h = RequestHandle(prompt, n, timeout_s, priority=priority)
         if trace_id is not None:
@@ -1578,6 +1983,8 @@ class ContinuousBatchingEngine:
         out["cost"] = self._cost.summary()
         out["loop"] = self._loop_obs.summary()
         out["qos"] = self._qos_summary()
+        if self.paged:
+            out["paging"] = self._paging_summary()
         out["alerts"] = self.alerts()
         return out
 
@@ -1853,12 +2260,21 @@ class ContinuousBatchingEngine:
             if a.entry is not None:
                 self._prefix.release(a.entry)
                 a.entry = None
+            if a.table is not None:
+                a.table.free()
+                a.table = None
+            if a.d_table is not None:
+                a.d_table.free()
+                a.d_table = None
             self._finish_handle(a.handle, err, "crashed")
         self._adms = []
         for sid, st in enumerate(self._slots):
             if st is not None:
                 self._finish_handle(st.handle, err, "crashed")
                 self._slots[sid] = None
+            self._free_slot_table(sid)
+        if self.paged and self._prefix is not None:
+            self._prefix.drop_all()
         for h in self._queue.drain():
             self._finish_handle(h, err, "crashed")
 
@@ -1902,6 +2318,9 @@ class ContinuousBatchingEngine:
         # — phase seconds then sum to the iteration wall by
         # construction
         self._iter_disp = {"prefill": 0.0, "decode": 0.0}
+        # paged: a fresh iteration may admit again — pages freed by
+        # the releases/donations above can satisfy what blocked before
+        self._adm_blocked = False
         if self._chaos is not None:
             self._chaos.begin_iteration()
 
@@ -1979,6 +2398,9 @@ class ContinuousBatchingEngine:
         ins.active_slots.set(sum(s is not None for s in self._slots))
         ins.queue_depth.set(len(self._queue))
         ins.jit_compiles.set(self._compile_total())
+        if self.paged:
+            self._accrue_paged_kv()
+            self._sync_page_gauges()
         self._recompile_wd.sample()
         self._slo_wd.sample()
         mfu_d, bw_d = self._cost.rates("decode")
@@ -2076,6 +2498,7 @@ class ContinuousBatchingEngine:
                 if stale is not None:
                     self._prefix.release(stale)
                 h._preempt_pin = pin
+        self._free_slot_table(sid)
         self._slots[sid] = None
         self._ins.evicted_total.inc()
         h.preempted += 1
@@ -2103,8 +2526,37 @@ class ContinuousBatchingEngine:
         cache and ``admission_window > 1``, the pop prefers the queued
         candidate with the longest cached prefix (bounded bypass —
         see AdmissionQueue.pop_ready)."""
+        if self.paged and self._adm_blocked:
+            # the pool already refused this iteration's queue head —
+            # popping more candidates would just thrash requeues
+            return
         scorer = None
-        if self._prefix is not None and self.admission_window > 1:
+        if self.paged and self._prefix is not None \
+                and self.admission_window > 1:
+            c, ps = self._policy.chunk, self.page_size
+
+            def scorer(h):
+                # paged bounded-bypass score: reuse tokens, but a
+                # candidate whose FRESH page need exceeds what the
+                # pool could cover even after a full prefix reclaim
+                # scores negative by the shortfall — electing it
+                # would stall the fill loop for nothing
+                p = self._effective_prompt(h)
+                e, m = self._prefix.lookup(p)
+                h._prefix_probe = (e, m, self._prefix.generation)
+                base = (min(m, p.shape[0] - 1) // c) * c
+                if e is not None and e.tier != "device":
+                    base = 0  # promote may still land it, score cold
+                g = (self._spec.gamma if self._spec is not None
+                     else 0)
+                need = pages_needed(
+                    min(p.shape[0] + h.max_new_tokens + g,
+                        self._phys_len), ps)
+                fresh = need - base // ps
+                avail = (self._pages.free_pages
+                         + self._prefix.device_pages)
+                return page_fit_score(base, fresh, avail)
+        elif self._prefix is not None and self.admission_window > 1:
             c = self._policy.chunk
             if self._promotions:
                 self._prune_promotions(now)
@@ -2149,7 +2601,8 @@ class ContinuousBatchingEngine:
                 self._finish_dropped(hd, err)
             if h is None:
                 return
-            self._start_admission(h, slot, row)
+            if not self._start_admission(h, slot, row):
+                return
 
     @staticmethod
     def _effective_prompt(h: RequestHandle) -> np.ndarray:
@@ -2167,7 +2620,14 @@ class ContinuousBatchingEngine:
         return h.prompt
 
     def _start_admission(self, h: RequestHandle, slot: int,
-                         row: int) -> None:
+                         row: int) -> bool:
+        """Stage one popped request for chunked prefill. Returns True
+        when the admission started; False (paged mode only) when the
+        page pool could not cover the request's reservation — the
+        request is already requeued at the head and the caller stops
+        filling for this iteration."""
+        if self.paged:
+            return self._start_admission_paged(h, slot, row)
         c = self._policy.chunk
         prompt = self._effective_prompt(h)
         t0 = prompt.shape[0]
@@ -2260,6 +2720,134 @@ class ContinuousBatchingEngine:
                          staging_row=row, n_chunks=n_chunks,
                          prefix_tokens=base)
         self._ins.admitted_total.inc()
+        return True
+
+    def _start_admission_paged(self, h: RequestHandle, slot: int,
+                               row: int) -> bool:
+        """Paged admission: reserve the request's FULL page span up
+        front — shared prefix head by refcount bump, fresh tail from
+        the free list (with a reclaim sweep of unpinned prefix entries
+        under pressure) — and never copy a row. A hit's shared pages
+        are READ through the block table while the prefill writes land
+        only in the fresh tail (chunk alignment implies page
+        alignment, so a shared page is never written): the zero-copy
+        hit leg. Admission is the ONLY allocation point — the
+        reservation covers prompt + max_new_tokens (+ gamma verify
+        headroom), so decode can never run out of pages mid-flight
+        and ``ensure_writable`` never fires on an engine path.
+
+        Returns False when the pool cannot cover the reservation even
+        after reclaim: the request goes back to the queue HEAD (its
+        order is preserved) and the ``_adm_blocked`` latch stops the
+        fill loop for this iteration — pages free as slots finish, so
+        the next iteration retries instead of thrashing pop/requeue."""
+        c, ps = self._policy.chunk, self.page_size
+        prompt = self._effective_prompt(h)
+        t0 = prompt.shape[0]
+        base, entry, from_host = 0, None, False
+        if self._prefix is not None:
+            probe = h.__dict__.pop("_prefix_probe", None)
+            if probe is not None \
+                    and probe[2] == self._prefix.generation:
+                e, matched = probe[0], probe[1]
+            else:
+                e, matched = self._prefix.lookup(prompt)
+            if e is not None:
+                # cap at t0-1 (last position must be COMPUTED), then
+                # chunk-align DOWN — and c % page_size == 0 makes the
+                # reuse base page-aligned, the COW-free invariant
+                base = (min(matched, t0 - 1) // c) * c
+            from_host = base > 0 and e.tier == "host"
+            if from_host and not self._promote_entry(e):
+                base, e = 0, None
+                from_host = False
+            if base > 0:
+                entry = e
+        shared = (tuple(entry.pages[:base // ps])
+                  if entry is not None else ())
+        g = self._spec.gamma if self._spec is not None else 0
+        remaining = h.max_new_tokens - len(h._tokens)
+        need_tokens = min(t0 + remaining + g, self._phys_len)
+        n_fresh = pages_needed(need_tokens, ps) - len(shared)
+        table = BlockTable.build(self._pages, shared, n_fresh)
+        if table is None:
+            spill = (self._spill_pages
+                     if self._prefix is not None
+                     and self._prefix.host_rows > 0 else None)
+            if self._prefix is not None:
+                self._prefix.reclaim(n_fresh, spill)
+                table = BlockTable.build(self._pages, shared, n_fresh)
+        d_table = None
+        if table is not None and self.draft is not None:
+            # the draft pool is sized so a draft reservation can never
+            # fail once the target's succeeded (1 + max_slots *
+            # table_len, no prefix sharing) — the unwind is belt and
+            # braces for exotic subclassing
+            d_table = BlockTable.build(
+                self._d_pages, (),
+                pages_needed(need_tokens, ps))
+            if d_table is None:
+                table.free()
+                table = None
+        if table is None:
+            self._queue.requeue(h)
+            self._adm_blocked = True
+            self._rec.record("request/page_wait", h.request_id,
+                             service=self.service_name,
+                             needed_pages=n_fresh,
+                             free_pages=self._pages.free_pages)
+            return False
+        if self._prefix is not None:
+            if base > 0:
+                # no staging copy and no entry acquire: the shared
+                # refcounts keep the pages alive even if the entry is
+                # evicted while we prefill (single mutator thread)
+                self._prefix.record_hit(entry, base, host=from_host)
+                self._ins.prefix_hits_total.inc()
+                if from_host:
+                    self._ins.prefix_host_hits_total.inc()
+                    self._sync_prefix_gauges()
+                self._ins.prefix_reused_tokens_total.inc(base)
+                self._rec.record("request/prefix_hit", h.request_id,
+                                 service=self.service_name,
+                                 matched_tokens=base,
+                                 tail_tokens=t0 - base,
+                                 shared_pages=len(shared),
+                                 tier="host" if from_host
+                                 else "device")
+            else:
+                self._prefix.record_miss()
+                self._ins.prefix_misses_total.inc()
+            pin = h.__dict__.pop("_preempt_pin", None)
+            if pin is not None:
+                self._prefix.release(pin)
+        tail = t0 - base
+        n_chunks = self._policy.n_chunks(tail)
+        ids = np.zeros((n_chunks * c,), np.int32)
+        ids[:tail] = prompt[base:]
+        d_ids, d_n_chunks = None, 0
+        if self.draft is not None:
+            d_n_chunks = self._policy.n_chunks(t0)
+            d_ids = np.zeros((d_n_chunks * c,), np.int32)
+            d_ids[:t0] = prompt
+        a = _Admission(h, slot, row, ids, t0, base, n_chunks, None,
+                       d_ids, d_n_chunks)
+        a.table, a.d_table = table, d_table
+        self._adms.append(a)
+        h.prefix_tokens = base
+        t_adm = time.monotonic()
+        if h.admitted_at is None:
+            h.admitted_at = t_adm
+        rec = getattr(h, "_usage", None)
+        if rec is not None:
+            self._usage.admitted(rec, t_adm, reused_tokens=base)
+        self._rec.record("request/admitted", h.request_id,
+                         service=self.service_name, slot=slot,
+                         staging_row=row, n_chunks=n_chunks,
+                         prefix_tokens=base, pages=len(table),
+                         shared_pages=len(shared))
+        self._ins.admitted_total.inc()
+        return True
 
     def _prefill_round(self) -> None:
         """Advance EVERY in-flight admission by one chunk through one
@@ -2307,9 +2895,18 @@ class ContinuousBatchingEngine:
         if self._chaos is not None:
             self._chaos.on_dispatch()
         t_disp = time.monotonic()
-        logits, self._staging = self._chunk_jit(
-            self._params, self._buffers, self._h2d(ids), self._staging,
-            self._h2d(pos0), self._h2d(last))
+        if self.paged:
+            # same ragged dispatch, but each row writes through its
+            # admission's reserved block table (idle rows carry the
+            # all-scratch table — their padding writes hit page 0)
+            logits, self._kv_pool = self._chunk_jit(
+                self._params, self._buffers, self._h2d(ids),
+                self._kv_pool, self._adm_tables(), self._h2d(pos0),
+                self._h2d(last))
+        else:
+            logits, self._staging = self._chunk_jit(
+                self._params, self._buffers, self._h2d(ids),
+                self._staging, self._h2d(pos0), self._h2d(last))
         self._warm.add("chunk")
         if spec:
             d_ids = np.zeros((rows, c), np.int32)
@@ -2318,10 +2915,17 @@ class ContinuousBatchingEngine:
                 dk = a.d_next_chunk
                 d_ids[a.row] = a.d_ids[dk * c:(dk + 1) * c]
                 d_pos0[a.row] = dk * c
-            _, self._d_staging = self._d_chunk_jit(
-                self._d_params, self._d_bufs, self._h2d(d_ids),
-                self._d_staging, self._h2d(d_pos0),
-                self._h2d(np.zeros((rows,), np.int32)))
+            if self.paged:
+                _, self._d_kv_pool = self._d_chunk_jit(
+                    self._d_params, self._d_bufs, self._h2d(d_ids),
+                    self._d_kv_pool, self._adm_tables(draft=True),
+                    self._h2d(d_pos0),
+                    self._h2d(np.zeros((rows,), np.int32)))
+            else:
+                _, self._d_staging = self._d_chunk_jit(
+                    self._d_params, self._d_bufs, self._h2d(d_ids),
+                    self._d_staging, self._h2d(d_pos0),
+                    self._h2d(np.zeros((rows,), np.int32)))
             self._warm.add("d_chunk")
         toks = None
         if finals:
@@ -2380,20 +2984,32 @@ class ContinuousBatchingEngine:
             self._complete_admission(a, int(toks[a.row]))
 
     def _complete_admission(self, a: _Admission, tok: int) -> None:
-        # prompt fully staged: scatter the staging row into the
-        # reserved pool slot, release the prefix pin (the staged copy
-        # is now independent of the pool row), deliver the first token
-        self._caches = self._copy_row_jit(
-            self._caches, self._staging, jnp.int32(a.slot),
-            jnp.int32(a.row))
-        self._warm.add("copy:insert")
-        if self.draft is not None:
-            # draft slot state moves in lockstep: the draft's staged
-            # full-prompt KV lands in the SAME slot index
-            self._d_caches = self._copy_row_jit(
-                self._d_caches, self._d_staging, jnp.int32(a.slot),
+        if self.paged:
+            # zero-copy handoff: the admission's reserved tables
+            # BECOME the slot's — the pages already hold the prompt
+            # KV, there is no staging row to scatter
+            self._free_slot_table(a.slot)
+            self._tables[a.slot] = a.table
+            a.table = None
+            if self.draft is not None:
+                self._d_tables[a.slot] = a.d_table
+                a.d_table = None
+        else:
+            # prompt fully staged: scatter the staging row into the
+            # reserved pool slot, release the prefix pin (the staged
+            # copy is now independent of the pool row), deliver the
+            # first token
+            self._caches = self._copy_row_jit(
+                self._caches, self._staging, jnp.int32(a.slot),
                 jnp.int32(a.row))
-            self._warm.add("copy:d_insert")
+            self._warm.add("copy:insert")
+            if self.draft is not None:
+                # draft slot state moves in lockstep: the draft's
+                # staged full-prompt KV lands in the SAME slot index
+                self._d_caches = self._copy_row_jit(
+                    self._d_caches, self._d_staging, jnp.int32(a.slot),
+                    jnp.int32(a.row))
+                self._warm.add("copy:d_insert")
         if a.entry is not None:
             self._prefix.release(a.entry)
             a.entry = None
@@ -2431,6 +3047,7 @@ class ContinuousBatchingEngine:
             self._maybe_donate(a.slot, np.concatenate(
                 [h.prompt, np.asarray(h._tokens[:-1], np.int32)]),
                 h.request_id)
+            self._free_slot_table(a.slot)
             self._finish_handle(h, None, "finished")
             self._ins.finished_total.inc()
             return
@@ -2447,6 +3064,12 @@ class ContinuousBatchingEngine:
         if a.entry is not None:
             self._prefix.release(a.entry)
             a.entry = None
+        if a.table is not None:
+            a.table.free()
+            a.table = None
+        if a.d_table is not None:
+            a.d_table.free()
+            a.d_table = None
         self._adms.remove(a)
         self._count_drop(kind)
         self._finish_handle(a.handle, err, kind)
@@ -2459,6 +3082,21 @@ class ContinuousBatchingEngine:
         ``0..len-1``); the index decides (covered / LRU-evict /
         decline) and the accepted row is filled by one donated copy."""
         if self._prefix is None:
+            return
+        if self.paged:
+            # page donation is a refcount move, never a copy: the
+            # covering pages are SHARED into the new entry; the slot's
+            # own references are freed separately by the caller
+            tbl = self._tables[sid]
+            if tbl is not None and tokens.shape[0] > 0:
+                held = tbl.covering(int(tokens.shape[0]))
+                if self._prefix.donate_pages(tokens, held):
+                    self._rec.record(
+                        "request/prefix_donated", request_id,
+                        service=self.service_name,
+                        tokens=int(tokens.shape[0]),
+                        pages=len(held))
+            self._sync_prefix_gauges()
             return
         row = self._prefix.donate(tokens)
         if row is not None:
@@ -2530,6 +3168,8 @@ class ContinuousBatchingEngine:
         ``device_put`` returns immediately — the copy overlaps the
         request's remaining queue wait — and the record PINS the entry
         so its host buffer cannot be evicted mid-flight."""
+        if self.paged:
+            return  # paged promotion is synchronous at admission
         key = id(entry)
         now = time.monotonic()
         rec = self._promotions.get(key)
@@ -2576,6 +3216,8 @@ class ContinuousBatchingEngine:
         blocking one here (window=1 engines never score). False means
         the promotion fell through — the caller treats the probe as a
         clean miss."""
+        if self.paged:
+            return self._promote_entry_paged(entry)
         rec = self._promotions.pop(id(entry), None)
         if entry.tier != "host":
             # raced: another admission promoted it first — its pool
@@ -2613,6 +3255,209 @@ class ContinuousBatchingEngine:
         finally:
             self._prefix.release(entry)
 
+    def _promote_entry_paged(self, entry) -> bool:
+        """Synchronous host→device promotion of a paged host-tier
+        entry: allocate fresh pages (reclaim sweep of unpinned prefix
+        entries under pressure), land each host page buffer with the
+        warmed per-page transfer + scatter, flip the entry's tier.
+        False = clean miss (pool exhausted or the buffer raced away).
+        Per-page copies are small and bounded, so the dense tier's
+        async-overlap machinery buys nothing here."""
+        if entry.tier != "host":
+            return entry.tier == "device"
+        buf = entry.host_buf
+        if buf is None:
+            return False  # spill still pending or already evicted
+        n = len(buf)
+        pages = self._pages.alloc(n)
+        if pages is None:
+            spill = (self._spill_pages
+                     if self._prefix.host_rows > 0 else None)
+            self._prefix.reclaim(n, spill)
+            pages = self._pages.alloc(n)
+        if pages is None:
+            return False
+        from bigdl_tpu.parallel.tp import put_from_host
+
+        try:
+            for dst, host_page in zip(pages, buf):
+                one = put_from_host(host_page, self._kv_shard)
+                self._kv_pool = self._copy_row_jit(
+                    self._kv_pool, one, jnp.int32(dst), jnp.int32(0))
+            self._warm.add("copy:promote")
+        except Exception:
+            self._pages.free(pages)
+            return False
+        self._prefix.promote_pages(entry, pages)
+        self._ins.prefix_host_promoted_total.inc()
+        return True
+
+    def _spill_pages(self, pages):
+        """Demotion spill callback for ``PagedPrefixIndex.reclaim``:
+        lift each victim page out of the pool with the warmed slice
+        and bulk-copy it host-side. Returns the per-page host buffer
+        list the host tier retains, or None to degrade the demotion
+        to a plain drop (the index never keeps an entry pointing at
+        garbage)."""
+        if self._take_row_jit is None:
+            return None
+        from bigdl_tpu.parallel.tp import fetch_to_host
+
+        try:
+            out = []
+            for p in pages:
+                one = self._take_row_jit(self._kv_pool, jnp.int32(p))
+                out.append(fetch_to_host(one))
+            self._warm.add("copy:demote")
+            return out
+        except Exception:
+            return None
+
+    # --------------------------------------------------- paged plumbing
+    def _copy_page(self, dst: int, src: int) -> None:
+        """``BlockTable.ensure_writable``'s copy callback: one warmed
+        jitted single-page copy inside the target pool. Engine hot
+        paths never trigger COW (full-span reservation at admission);
+        this exists for API users forking tables (n>1 completions)."""
+        self._kv_pool = self._copy_page_jit(
+            self._kv_pool, jnp.int32(dst), jnp.int32(src))
+
+    def _adm_tables(self, draft: bool = False):
+        """The prefill dispatch's ``(prefill_rows, table_len)`` block
+        tables: each admission row's reserved table, idle rows padded
+        with the all-scratch table (their padding writes land on page
+        0 and are never attended)."""
+        rows = self._policy.prefill_rows
+        t = np.zeros((rows, self._table_len), np.int32)
+        for a in self._adms:
+            tbl = a.d_table if draft else a.table
+            if tbl is not None:
+                t[a.row] = tbl.as_array(self._table_len)
+        return self._h2d(t)
+
+    def _slot_tables(self, draft: bool = False):
+        """The decode dispatch's ``(max_slots, table_len)`` block
+        tables (idle slots all-scratch, same argument as above)."""
+        t = np.zeros((self.max_slots, self._table_len), np.int32)
+        tables = self._d_tables if draft else self._tables
+        for sid, tbl in enumerate(tables):
+            if tbl is not None:
+                t[sid] = tbl.as_array(self._table_len)
+        return self._h2d(t)
+
+    def _free_slot_table(self, sid: int) -> None:
+        """Drop slot ``sid``'s page references (target + draft) —
+        refcount moves only; pages shared into the prefix index
+        survive under the index's references."""
+        if not self.paged:
+            return
+        tbl = self._tables[sid]
+        if tbl is not None:
+            tbl.free()
+            self._tables[sid] = None
+        if self._d_tables is not None:
+            d = self._d_tables[sid]
+            if d is not None:
+                d.free()
+                self._d_tables[sid] = None
+
+    def _accrue_paged_kv(self) -> None:
+        """Per-iteration paged-KV billing: integrate each request's
+        ACTUALLY-HELD page bytes over the elapsed interval.
+        ``holder_bytes`` prices a shared page pro-rata across its
+        refcount, so a page shared by k holders is billed once in
+        total no matter how many requests read it — summing every
+        holder's accrual can never exceed the pool's physical
+        ``bytes_in_use`` integrated over the same window (the
+        conservation property the ledger test checks)."""
+        now = time.monotonic()
+        last, self._last_kv_accrue = self._last_kv_accrue, now
+        if last is None:
+            return
+        dt = now - last
+        if dt <= 0.0:
+            return
+
+        def bill(h, tbl, d_tbl):
+            rec = getattr(h, "_usage", None)
+            if rec is None:
+                return
+            b = (self._pages.holder_bytes(tbl.pages)
+                 if tbl is not None else 0.0)
+            if d_tbl is not None and self._d_pages is not None:
+                b += self._d_pages.holder_bytes(d_tbl.pages)
+            if b > 0.0:
+                self._usage.accrue_kv(rec, b * dt)
+
+        for sid, st in enumerate(self._slots):
+            if st is not None:
+                bill(st.handle, self._tables[sid],
+                     self._d_tables[sid]
+                     if self._d_tables is not None else None)
+        for a in self._adms:
+            bill(a.handle, a.table, a.d_table)
+
+    def _fragmentation(self) -> float:
+        """Internal fragmentation of the live reservations: the token
+        slack inside held pages — 1 − covered_tokens / (held_pages ×
+        page_size) over every slot table (coverage = the slot's KV
+        cursor) and admission table (coverage = reuse base + prefill
+        cursor). 0.0 when nothing is held."""
+        ps = self.page_size
+        c = self._policy.chunk
+        held = covered = 0
+        for sid, st in enumerate(self._slots):
+            tbl = self._tables[sid]
+            if st is None or tbl is None:
+                continue
+            held += len(tbl.pages)
+            covered += min(st.pos, len(tbl.pages) * ps)
+        for a in self._adms:
+            if a.table is None:
+                continue
+            held += len(a.table.pages)
+            covered += min(a.base + a.next_chunk * c, a.t0,
+                           len(a.table.pages) * ps)
+        if held == 0:
+            return 0.0
+        return 1.0 - covered / (held * ps)
+
+    def _sync_page_gauges(self) -> None:
+        """Publish page-flow counter deltas (target + draft pools
+        summed) and pool occupancy/fragmentation gauges."""
+        pools = [self._pages]
+        if self._d_pages is not None:
+            pools.append(self._d_pages)
+        stats = [p.stats() for p in pools]
+        ins = self._ins
+        flows = (("allocated", "allocated_total",
+                  ins.page_allocated_total),
+                 ("shared", "shared_total", ins.page_shared_total),
+                 ("cow_forks", "cow_forks_total",
+                  ins.page_cow_forks_total),
+                 ("freed", "freed_total", ins.page_freed_total))
+        for key, stat_key, counter in flows:
+            cur = sum(s[stat_key] for s in stats)
+            if cur > self._page_seen[key]:
+                counter.inc(cur - self._page_seen[key])
+                self._page_seen[key] = cur
+        ins.page_pool_bytes.set(
+            sum(s["bytes_in_use"] for s in stats))
+        ins.page_pool_pages_in_use.set(
+            sum(s["pages_in_use"] for s in stats))
+        ins.page_pool_fragmentation.set(self._fragmentation())
+
+    def _paging_summary(self) -> dict:
+        out = {"page_size": self.page_size,
+               "table_len": self._table_len,
+               "fragmentation": self._fragmentation(),
+               "pool": self._pages.stats()}
+        if self._d_pages is not None:
+            out["draft_pool"] = self._d_pages.stats()
+        if isinstance(self._prefix, PagedPrefixIndex):
+            out["prefix_device_pages"] = self._prefix.device_pages
+        return out
+
     # --------------------------------------------------------- decode
     def _decode_all(self, active: List[int]) -> None:
         if self.draft is not None:
@@ -2627,10 +3472,16 @@ class ContinuousBatchingEngine:
         if self._chaos is not None:
             self._chaos.on_dispatch()
         t_disp = time.monotonic()
-        nxt, self._caches = self._step_jit(
-            self._params, self._buffers, self._h2d(tok),
-            self._h2d(pos), self._caches, self._next_key(),
-            self._temp())
+        if self.paged:
+            nxt, self._kv_pool = self._step_jit(
+                self._params, self._buffers, self._h2d(tok),
+                self._h2d(pos), self._kv_pool, self._slot_tables(),
+                self._next_key(), self._temp())
+        else:
+            nxt, self._caches = self._step_jit(
+                self._params, self._buffers, self._h2d(tok),
+                self._h2d(pos), self._caches, self._next_key(),
+                self._temp())
         self._warm.add("step")
         nxt_np = np.asarray(nxt)   # blocks on the fused step
         now = time.monotonic()
@@ -2682,13 +3533,23 @@ class ContinuousBatchingEngine:
             self._chaos.on_dispatch()
         t_disp = time.monotonic()
         tok_d, pos_d = self._h2d(tok), self._h2d(pos)
-        props, qlogits, self._d_caches = self._propose_jit(
-            self._d_params, self._d_bufs, tok_d, pos_d,
-            self._d_caches, r_draft, self._temp())
-        emit, n_acc, self._caches = self._spec_verify_jit(
-            self._params, self._buffers, tok_d, props,
-            qlogits, pos_d, self._caches, r_acc,
-            self._temp())
+        if self.paged:
+            props, qlogits, self._d_kv_pool = self._propose_jit(
+                self._d_params, self._d_bufs, tok_d, pos_d,
+                self._d_kv_pool, self._slot_tables(draft=True),
+                r_draft, self._temp())
+            emit, n_acc, self._kv_pool = self._spec_verify_jit(
+                self._params, self._buffers, tok_d, props,
+                qlogits, pos_d, self._kv_pool, self._slot_tables(),
+                r_acc, self._temp())
+        else:
+            props, qlogits, self._d_caches = self._propose_jit(
+                self._d_params, self._d_bufs, tok_d, pos_d,
+                self._d_caches, r_draft, self._temp())
+            emit, n_acc, self._caches = self._spec_verify_jit(
+                self._params, self._buffers, tok_d, props,
+                qlogits, pos_d, self._caches, r_acc,
+                self._temp())
         emit_np = np.asarray(emit)    # blocks on both dispatches
         n_np = np.asarray(n_acc)
         wall = time.monotonic() - t_disp
@@ -2718,9 +3579,15 @@ class ContinuousBatchingEngine:
                 sync_tok[sid] = (tok[sid] if n_r == 0
                                  else int(emit_np[sid, n_r - 1]))
                 sync_pos[sid] = pos[sid] + n_r
-            self._d_caches = self._d_sync_jit(
-                self._d_params, self._d_bufs, self._h2d(sync_tok),
-                self._h2d(sync_pos), self._d_caches)
+            if self.paged:
+                self._d_kv_pool = self._d_sync_jit(
+                    self._d_params, self._d_bufs, self._h2d(sync_tok),
+                    self._h2d(sync_pos), self._d_kv_pool,
+                    self._slot_tables(draft=True))
+            else:
+                self._d_caches = self._d_sync_jit(
+                    self._d_params, self._d_bufs, self._h2d(sync_tok),
+                    self._h2d(sync_pos), self._d_caches)
         # burst lengths FIRST (pure), so the dispatch wall is
         # attributed before any handle can finalize — a late charge
         # against an already-finalized record would leak out of the
@@ -2820,6 +3687,7 @@ class ContinuousBatchingEngine:
             [st.handle.prompt,
              np.asarray(st.handle._tokens[:-1], np.int32)])
         self._maybe_donate(sid, tokens, st.handle.request_id)
+        self._free_slot_table(sid)
         self._slots[sid] = None
         self._ins.evicted_total.inc()
         if reason == "finished":

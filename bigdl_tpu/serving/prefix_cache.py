@@ -66,7 +66,7 @@ class PrefixEntry:
     ``row`` is ``-1`` while demoted so stale use fails loudly)."""
 
     __slots__ = ("tokens", "row", "refs", "last_used", "hits", "tier",
-                 "host_buf")
+                 "host_buf", "pages")
 
     def __init__(self, tokens: np.ndarray, row: int, stamp: int):
         self.tokens = tokens
@@ -76,6 +76,10 @@ class PrefixEntry:
         self.hits = 0
         self.tier = "device"
         self.host_buf = None
+        #: paged mode (``serving.paging.PagedPrefixIndex``): the page-pool
+        #: page ids holding this prefix's KV, in position order; ``row``
+        #: stays ``-1`` so any dense-path use of a paged entry fails loudly
+        self.pages: Tuple[int, ...] = ()
 
     @property
     def length(self) -> int:

@@ -461,6 +461,30 @@ class PrefillPolicy:
         return -(-prompt_len // self.chunk)
 
 
+def pages_needed(tokens: int, page_size: int) -> int:
+    """Pages a ``tokens``-position KV span occupies (ceil division) —
+    the paged engine's reservation unit for admission sizing, submit
+    validation, and the admission scorer's fit check."""
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    return -(-int(tokens) // int(page_size))
+
+
+def page_fit_score(reuse_tokens: int, fresh_pages: int,
+                   available_pages: int) -> int:
+    """Admission-window score for a paged engine: candidates are
+    ranked by the prefill work their cached prefix skips (exactly the
+    dense scorer's currency), but a candidate whose FRESH page need
+    cannot currently be met — free pages plus everything the prefix
+    LRU could reclaim — scores strictly below every admittable one
+    (negative, by its shortfall), so the bounded bypass never elects a
+    request the allocator would immediately bounce back to the queue
+    head while an admittable neighbor waits behind it."""
+    if fresh_pages > available_pages:
+        return available_pages - fresh_pages
+    return int(reuse_tokens)
+
+
 class SpeculationPolicy:
     """Speculative-decoding config for the engine's fused decode loop:
     per round the DRAFT model proposes ``gamma`` tokens for every live
