@@ -448,17 +448,36 @@ def _chaos_demo(args):
     """``--chaos``: the overload drill. Every QoS degradation path
     fires deterministically via the scripted injector — no real storm
     needed — and the drill prints what an operator would see on each
-    surface (structured rejections, ``stats()["qos"]``, healthz)."""
+    surface (structured rejections, ``stats()["qos"]``, healthz).
+    Each fault class additionally mints exactly one correctly-
+    classified incident bundle (slo / stall / crash) through the
+    anomaly→incident pipeline, round-tripped over
+    ``/debug/fleet/incidents`` in a closing fleet leg, and the tally
+    lands in ``bench_history.jsonl`` for ``scripts/perf_gate.py``."""
+    import tempfile
     import time
 
     import numpy as np
 
     from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.observability.anomaly import (
+        DetectorBank, StallDetector,
+    )
     from bigdl_tpu.serving import (
         ChaosInjector, ContinuousBatchingEngine, EngineStopped,
         RequestRateLimited, RequestShed,
     )
     from bigdl_tpu.utils import random as rnd
+
+    def _wait_incident(engine, kind, timeout=30.0):
+        """Poll ``debug_incidents`` until a ``kind`` bundle exists."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            d = engine.debug_incidents()
+            if d["by_kind"].get(kind):
+                return d
+            time.sleep(0.1)
+        return engine.debug_incidents()
 
     rnd.set_seed(0)
     model = TransformerLM(args.vocab, embed_dim=32, num_heads=4,
@@ -467,12 +486,19 @@ def _chaos_demo(args):
     model.evaluate()
     r = np.random.RandomState(3)
     chaos = ChaosInjector()
+    inc_dir = tempfile.mkdtemp(prefix="bigdl-incidents-")
     with ContinuousBatchingEngine(
             model, max_slots=1, prefill_chunk=8, prefix_cache_rows=4,
             admission_window=4, preempt_slack_s=0.002,
             shed_classes=("low",),
             tenant_rate_limits={"greedy": (1e-4, 1e-4)},
-            chaos=chaos, service_name="chaos-drill") as eng:
+            chaos=chaos, service_name="chaos-drill",
+            incident_dir=inc_dir,
+            # a 20-iteration scripted freeze must trip the stall
+            # detector (the default 200-iteration threshold is sized
+            # for production, not a drill)
+            anomaly_detectors=DetectorBank(
+                stall=StallDetector(threshold=8))) as eng:
         warm = eng.submit(r.randint(1, args.vocab, (6,)), 2)
         warm.result(timeout=120)
 
@@ -492,6 +518,11 @@ def _chaos_demo(args):
         chaos.force_burn(active=False)
         print(f"[shed]      synthetic TTFT burn: {shed}/4 low-class "
               f"shed (Retry-After {retry:.0f}s), high-class served")
+        d = _wait_incident(eng, "slo")
+        slo_inc = d["by_kind"].get("slo", 0)
+        print(f"[incident]  burn captured as kind=slo: "
+              f"{slo_inc} bundle(s), exemplars phase-attributed "
+              f"{[e['phase'] for b in d['incidents'] for e in b.get('exemplars', [])][:3]}")
 
         # 2. token bucket: "greedy" has a near-zero refill — its first
         #    request drains the bucket, the next bounces with the
@@ -534,6 +565,12 @@ def _chaos_demo(args):
               f"still finished; qos counters: "
               f"preempted={q['preempted']} shed={q['shed']} "
               f"rate_limited={q['rate_limited']}")
+        d = _wait_incident(eng, "stall")
+        drill_counts = dict(d["by_kind"])
+        print(f"[incident]  freeze captured as kind=stall: "
+              f"{d['by_kind'].get('stall', 0)} bundle(s); drill "
+              f"engine totals {drill_counts}; bundles on disk under "
+              f"{inc_dir} (scripts/show_incident.py renders one)")
 
     # 5. dispatch failure: a sacrificial engine takes a scripted fault
     #    on its next dispatch — the loop crashes into the postmortem
@@ -556,6 +593,126 @@ def _chaos_demo(args):
             print(f"[crash]     scripted dispatch fault: request "
                   f"failed structured, {status}, postmortem "
                   "written")
+    # the crashed engine's incident ring survives stop() — the crash
+    # handler captured a kind=crash bundle next to the postmortem
+    crash_d = eng2.debug_incidents()
+    print(f"[incident]  crash captured as kind=crash: "
+          f"{crash_d['by_kind'].get('crash', 0)} bundle(s), error="
+          f"{(crash_d['incidents'][0].get('error') or {}).get('type') if crash_d['incidents'] else None}")
+
+    # 6. fleet round trip: the same drill surfaces aggregate across a
+    #    fleet — one replica burns, the front door's
+    #    /debug/fleet/incidents stamps its bundles with replica= and
+    #    the exemplar trace ids resolve in the merged fleet trace
+    _chaos_fleet_leg(args, model, r)
+
+    totals = dict(drill_counts)
+    for k, v in crash_d["by_kind"].items():
+        totals[k] = totals.get(k, 0) + v
+    _append_chaos_history(totals)
+    print(f"[history]   serving_chaos_incidents row appended: "
+          f"{sum(totals.values())} incidents {totals}")
+
+
+def _chaos_fleet_leg(args, model, r):
+    """The ``--chaos`` closing leg: two in-process replicas behind the
+    HTTP front door; r0 takes a forced burn, and the drill verifies
+    the bundle round-trips over ``GET /debug/fleet/incidents`` with
+    its replica stamp and a trace id resolvable in the merged fleet
+    timelines (``/debug/fleet/requests``)."""
+    import json
+    import time
+    import urllib.request
+
+    from bigdl_tpu.serving import ChaosInjector, ContinuousBatchingEngine
+    from bigdl_tpu.serving.fleet import (
+        FleetFrontDoor, InProcessReplica, ReplicaSupervisor,
+    )
+
+    burn = ChaosInjector()
+    replicas = [
+        InProcessReplica("r0", ContinuousBatchingEngine(
+            model, max_slots=1, prefill_chunk=8, chaos=burn,
+            service_name="chaos-fleet-r0")),
+        InProcessReplica("r1", ContinuousBatchingEngine(
+            model, max_slots=1, prefill_chunk=8,
+            service_name="chaos-fleet-r1")),
+    ]
+    with ReplicaSupervisor(replicas, chunk=8,
+                           fleet_name="chaos-fleet") as sup, \
+            FleetFrontDoor(sup) as door:
+        base = f"http://127.0.0.1:{door.port}"
+
+        def post(prompt):
+            body = json.dumps({"prompt_ids": prompt,
+                               "max_new_tokens": 4,
+                               "stream": False}).encode()
+            req = urllib.request.Request(
+                f"{base}/v1/generate", data=body,
+                headers={"Content-Type": "application/json"})
+            return json.loads(urllib.request.urlopen(
+                req, timeout=60).read())
+
+        for i in range(4):
+            post(r.randint(1, args.vocab, (6 + i,)).tolist())
+        burn.force_burn(active=True, severe=True)
+        post(r.randint(1, args.vocab, (8,)).tolist())
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if replicas[0].engine.debug_incidents()["count"]:
+                break
+            time.sleep(0.1)
+        burn.force_burn(active=False)
+        fi = json.loads(urllib.request.urlopen(
+            f"{base}/debug/fleet/incidents?n=5", timeout=10).read())
+        fr = json.loads(urllib.request.urlopen(
+            f"{base}/debug/fleet/requests", timeout=10).read())
+        tls = fr.get("timelines")
+        known = (set(tls) if isinstance(tls, dict)
+                 else {t.get("trace_id") for t in tls or []})
+        resolved = [t for t in fi["trace_ids"] if t in known]
+        stamps = sorted({b.get("replica") for b in fi["incidents"]})
+        print(f"[fleet]     /debug/fleet/incidents: {fi['count']} "
+              f"incident(s) {fi['by_kind']} stamped replica="
+              f"{stamps}; {len(resolved)}/{len(fi['trace_ids'])} "
+              f"exemplar trace ids resolve in the merged fleet trace")
+
+
+def _append_chaos_history(by_kind):
+    """One ``serving_chaos_incidents`` row into bench_history.jsonl
+    (same append idiom as bench.py — UTC ts, ``BIGDL_BENCH_HISTORY``
+    override honored) so ``scripts/perf_gate.py`` can require every
+    drill fault class to have minted its incident."""
+    import datetime
+    import json
+    import os
+
+    import jax
+
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    path = (os.environ.get("BIGDL_BENCH_HISTORY")
+            or os.path.join(here, "bench_history.jsonl"))
+    dev = jax.devices()[0]
+    row = {
+        "metric": "serving_chaos_incidents",
+        "value": int(sum(by_kind.values())),
+        "unit": "incidents",
+        "vs_baseline": None,
+        "detail": {
+            "chaos_drill": True,
+            "device": str(getattr(dev, "device_kind", dev.platform)),
+            "incidents": {"count": int(sum(by_kind.values())),
+                          "by_kind": dict(by_kind)},
+        },
+        "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds"),
+    }
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(row) + "\n")
+    except OSError as e:
+        print(f"[history]   append failed: {e}")
 
 
 def _fleet_demo(args):

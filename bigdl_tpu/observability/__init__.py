@@ -38,6 +38,16 @@ equivalent for one-process-per-host JAX):
   (burn-rate evaluation of latency objectives over the TTFT /
   inter-token / queue-wait histograms) — alert gauges, flight-recorder
   events, and the engine's degraded-``/healthz`` state.
+- **Anomaly detection** (``anomaly``): online detectors (EWMA
+  z-score, sustained threshold, rate-of-change, iteration-fed stall)
+  over the timeseries rings, with warmup, hysteresis, and cooldown —
+  plus a ``DetectorBank`` converging watchdog alerts onto the same
+  trigger stream.
+- **Incidents** (``incidents``): an ``IncidentManager`` that turns a
+  trigger into a self-contained evidence bundle (windowed event
+  slice, phase-attributed slow-request exemplars, memory/stats
+  blocks, config digest), deduped under cooldown, ring-bounded in
+  memory and on disk, behind ``GET /debug/incidents``.
 - **Cost model** (``costmodel``): per-dispatch FLOPs/bytes extracted
   once from XLA's ``cost_analysis`` on the lowered (never compiled)
   programs, with analytic transformer fallbacks and a per-device-kind
@@ -128,6 +138,15 @@ from bigdl_tpu.observability.profiler import (
 from bigdl_tpu.observability.watchdog import (
     RecompileWatchdog, SloObjective, SloWatchdog,
 )
+from bigdl_tpu.observability.anomaly import (
+    AnomalyDetector, DetectorBank, EwmaZScoreDetector,
+    RateOfChangeDetector, StallDetector, ThresholdDetector,
+    default_detector_bank,
+)
+from bigdl_tpu.observability.incidents import (
+    INCIDENT_SCHEMA, IncidentManager, classify_timeline, load_incident,
+)
+from bigdl_tpu.observability.instruments import incident_instruments
 
 __all__ = [
     "DEFAULT_BUCKETS", "Metric", "MetricRegistry", "REGISTRY",
@@ -161,6 +180,11 @@ __all__ = [
     "tree_bytes", "tree_device_bytes", "unregister_pool",
     "ProfilerBusy", "ProfilerUnavailable", "capture",
     "RecompileWatchdog", "SloObjective", "SloWatchdog",
+    "AnomalyDetector", "DetectorBank", "EwmaZScoreDetector",
+    "RateOfChangeDetector", "StallDetector", "ThresholdDetector",
+    "default_detector_bank",
+    "INCIDENT_SCHEMA", "IncidentManager", "classify_timeline",
+    "load_incident", "incident_instruments",
     "enable", "disable", "enabled",
 ]
 

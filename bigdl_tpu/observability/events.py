@@ -213,6 +213,27 @@ class FlightRecorder:
         """Every retained event of one request, in recording order."""
         return [e for e in self.tail() if e.request_id == request_id]
 
+    def window(self, t0: float,
+               t1: Optional[float] = None) -> List[Event]:
+        """Events whose monotonic ``ts`` falls in ``[t0, t1]``
+        (``t1`` defaults to now), oldest first — the evidence slice
+        incidents and postmortems share."""
+        if t1 is None:
+            t1 = time.monotonic()
+        with self._lock:
+            out = list(self._events)
+        return [e for e in out if t0 <= e.ts <= t1]
+
+    def window_snapshot(self, t0: float, t1: Optional[float] = None,
+                        limit: Optional[int] = None) -> List[dict]:
+        """:meth:`window` as plain dicts (with ``wall_s``), capped to
+        the newest ``limit`` when given."""
+        off = self.wall_offset
+        evs = self.window(t0, t1)
+        if limit is not None and limit > 0:
+            evs = evs[-limit:]
+        return [e.to_dict(off) for e in evs]
+
     def snapshot(self, last: Optional[int] = None) -> List[dict]:
         """The newest ``last`` events as plain dicts (with ``wall_s``)
         — what the ``/debug/events`` endpoint and postmortems embed."""

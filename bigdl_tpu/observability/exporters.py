@@ -180,6 +180,10 @@ class MetricsHTTPServer:
       byte-seconds, prefix savings), the engine goodput block, and
       the top-``n`` requests by attributed device-seconds. The
       callable receives the top-N count.
+    - ``GET /debug/incidents[?n=10]`` — the newest captured incident
+      bundles (anomaly/watchdog/chaos triggers with their evidence);
+      wire ``ContinuousBatchingEngine.debug_incidents`` here. The
+      callable receives the bundle count.
     - ``GET/POST /debug/profile?seconds=N`` — one bounded on-demand
       ``jax.profiler`` capture; responds with the artifact directory
       (501 when the backend cannot capture, 409 while another capture
@@ -205,7 +209,8 @@ class MetricsHTTPServer:
                  debug_usage: Optional[Callable[[int], dict]] = None,
                  profiler: Optional[Callable[[float], str]] = None,
                  debug_timeseries=None,
-                 dashboard: Optional[Callable[[], str]] = None):
+                 dashboard: Optional[Callable[[], str]] = None,
+                 debug_incidents=None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         from bigdl_tpu.observability import events as _events
@@ -340,6 +345,19 @@ class MetricsHTTPServer:
                             self._send_json(debug_usage(n))
                     except Exception as e:
                         self._send_json({"error": str(e)}, status=500)
+                elif path == "/debug/incidents":
+                    try:
+                        if debug_incidents is None:
+                            self._send_json(
+                                {"incidents": [],
+                                 "note": "no incident source attached "
+                                         "(pass debug_incidents=)"})
+                        else:
+                            from urllib.parse import parse_qs
+                            n = int(parse_qs(query).get("n", ["10"])[0])
+                            self._send_json(debug_incidents(n))
+                    except Exception as e:
+                        self._send_json({"error": str(e)}, status=500)
                 elif path == "/debug/profile":
                     payload, status = run_profile(query)
                     self._send_json(payload, status=status)
@@ -440,7 +458,8 @@ def start_http_server(port: int = 0,
                       debug_usage: Optional[Callable[[int], dict]] = None,
                       profiler: Optional[Callable[[float], str]] = None,
                       debug_timeseries=None,
-                      dashboard: Optional[Callable[[], str]] = None
+                      dashboard: Optional[Callable[[], str]] = None,
+                      debug_incidents=None
                       ) -> MetricsHTTPServer:
     """Convenience wrapper: start and return a MetricsHTTPServer."""
     return MetricsHTTPServer(registry=registry, host=host, port=port,
@@ -451,7 +470,8 @@ def start_http_server(port: int = 0,
                              debug_usage=debug_usage,
                              profiler=profiler,
                              debug_timeseries=debug_timeseries,
-                             dashboard=dashboard)
+                             dashboard=dashboard,
+                             debug_incidents=debug_incidents)
 
 
 # -------------------------------------------------------- TensorBoard bridge

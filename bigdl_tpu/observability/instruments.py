@@ -630,6 +630,37 @@ def watchdog_instruments(registry: Optional[MetricRegistry] = None
     )
 
 
+def incident_instruments(registry: Optional[MetricRegistry] = None
+                         ) -> SimpleNamespace:
+    """Anomaly-detection and incident-capture instruments, fed by
+    ``observability.anomaly`` / ``observability.incidents``. Returned
+    UNBOUND (families, not children): the incident manager binds
+    ``(service, kind)`` per captured bundle and the engine binds
+    ``(service, detector)`` per detector it hosts — kinds and
+    detector names are dynamic."""
+    r = registry or default_registry()
+    return SimpleNamespace(
+        incidents_total=r.counter(
+            "bigdl_serving_incidents_total",
+            "Incident bundles captured, by classified kind (slo / "
+            "stall / crash / recompile / anomaly) — cooldown-deduped "
+            "rising edges, not per-sample breaches", labelnames=(
+                "service", "kind")),
+        detector_state=r.gauge(
+            "bigdl_anomaly_detector_state",
+            "One anomaly detector's state: 0 ok (or warming up), 1 "
+            "firing — hysteresis holds it at 1 until clear_after "
+            "consecutive calm samples", labelnames=(
+                "service", "detector")),
+        triggers_total=r.counter(
+            "bigdl_anomaly_triggers_total",
+            "Detector trigger firings (rising edges past warmup and "
+            "cooldown) per detector — each one hands a capture "
+            "request to the incident manager",
+            labelnames=("service", "detector")),
+    )
+
+
 def bench_instruments(registry: Optional[MetricRegistry] = None
                       ) -> SimpleNamespace:
     """Headline-bench gauges (``bench.py``) — defined here so bench

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import datetime
 import json
+import time
 import traceback as _tb
 from typing import List, Optional
 
@@ -84,10 +85,14 @@ def build_postmortem(error: Optional[BaseException] = None,
                      tracer: Optional[Tracer] = None,
                      registry: Optional[MetricRegistry] = None,
                      last_events: int = 512,
+                     window_s: Optional[float] = None,
                      context: Optional[dict] = None) -> dict:
     """Assemble the postmortem dict (see module docstring for the
     payload). Every section degrades independently — a reader always
-    gets whatever could be captured."""
+    gets whatever could be captured. The events slice goes through
+    the recorder's ``window_snapshot`` — the same evidence path the
+    incident manager uses — bounded to ``window_s`` seconds when
+    given, always capped at ``last_events``."""
     recorder = recorder if recorder is not None else default_recorder()
     tracer = tracer if tracer is not None else trace
     pm = {
@@ -99,7 +104,10 @@ def build_postmortem(error: Optional[BaseException] = None,
         "requests": requests or [],
     }
     try:
-        pm["events"] = recorder.snapshot(last_events)
+        now = time.monotonic()
+        t0 = now - window_s if window_s is not None else float("-inf")
+        pm["events"] = recorder.window_snapshot(
+            t0, now, limit=last_events)
         pm["events_dropped"] = max(
             0, recorder.total - len(recorder))
     except Exception as e:  # a torn recorder must not kill the artifact
@@ -127,6 +135,7 @@ def write_postmortem(path: str, error: Optional[BaseException] = None,
                      tracer: Optional[Tracer] = None,
                      registry: Optional[MetricRegistry] = None,
                      last_events: int = 512,
+                     window_s: Optional[float] = None,
                      context: Optional[dict] = None) -> dict:
     """Build and atomically write the postmortem JSON to ``path``;
     returns the dict. Pretty-print it later with
@@ -134,6 +143,6 @@ def write_postmortem(path: str, error: Optional[BaseException] = None,
     pm = build_postmortem(error=error, requests=requests,
                           recorder=recorder, tracer=tracer,
                           registry=registry, last_events=last_events,
-                          context=context)
+                          window_s=window_s, context=context)
     _atomic_write(path, json.dumps(pm, indent=1, default=repr))
     return pm

@@ -40,9 +40,10 @@ __all__ = ["TimeSeriesSampler", "render_dashboard"]
 class TimeSeriesSampler:
     """Background sampler: named sources -> bounded (ts, value) rings.
 
-    ``registry`` is optional; when given and disabled, sampling is a
-    no-op.  Sources must be cheap, thread-safe, and may return ``None``
-    to skip a point (e.g. MFU before the first warm dispatch).
+    ``registry`` is optional; ``None`` resolves to the process default
+    at sample time, and a disabled registry makes sampling a no-op.
+    Sources must be cheap, thread-safe, and may return ``None`` to
+    skip a point (e.g. MFU before the first warm dispatch).
     """
 
     def __init__(self, interval_s: float = 1.0, capacity: int = 600,
@@ -56,6 +57,20 @@ class TimeSeriesSampler:
         self._lock = threading.Lock()
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._observer: Optional[Callable] = None
+        #: samples an observer raised on — a torn detector must not
+        #: kill the sampler thread, but the failures stay countable
+        self.observer_errors = 0
+
+    def set_observer(self, fn: Optional[Callable]
+                     ) -> "TimeSeriesSampler":
+        """Register ``fn(name, ts, value)`` to see every appended
+        point (anomaly detectors hook in here).  Called OUTSIDE the
+        ring lock — an observer may call ``snapshot()`` — and on the
+        sampler thread, so it must stay cheap and must not raise
+        (exceptions are swallowed).  Returns self for chaining."""
+        self._observer = fn
+        return self
 
     # -- sources -------------------------------------------------------
     def add_source(self, name: str, fn: Callable[[], Optional[float]],
@@ -70,6 +85,9 @@ class TimeSeriesSampler:
     @property
     def enabled(self) -> bool:
         reg = self._registry
+        if reg is None:  # resolve the process default at use time
+            from .metrics import default_registry
+            reg = default_registry()
         return bool(getattr(reg, "enabled", True)) if reg is not None \
             else True
 
@@ -81,6 +99,7 @@ class TimeSeriesSampler:
         ts = time.monotonic() if now is None else float(now)
         with self._lock:
             items = list(self._sources.items())
+        appended = []
         for name, (fn, rate) in items:
             try:
                 raw = fn()
@@ -102,6 +121,16 @@ class TimeSeriesSampler:
                 value = raw
             with self._lock:
                 self._rings[name].append((ts, value))
+            appended.append((name, value))
+        obs = self._observer
+        if obs is not None:
+            # outside the ring lock on purpose: the observer may read
+            # snapshot(), and _lock is not reentrant
+            for name, value in appended:
+                try:
+                    obs(name, ts, value)
+                except Exception:
+                    self.observer_errors += 1
 
     # -- lifecycle -----------------------------------------------------
     @property
@@ -155,8 +184,16 @@ class TimeSeriesSampler:
                 "metrics": out}
 
 
-def _sparkline(points, width: int = 280, height: int = 48) -> str:
-    """One inline-SVG sparkline for a [[ts, value], ...] series."""
+#: marker stroke by event kind (unknown kinds fall back to "alert")
+_MARKER_COLORS = {"incident": "#c53030", "alert": "#dd6b20"}
+
+
+def _sparkline(points, width: int = 280, height: int = 48,
+               markers=None) -> str:
+    """One inline-SVG sparkline for a [[ts, value], ...] series.
+    ``markers`` is an optional list of ``{"ts_s": .., "kind": ..}``
+    dicts; each one whose timestamp lies inside the series' time span
+    draws a vertical rule (red for incidents, orange for alerts)."""
     vals = [p[1] for p in points if p[1] is not None]
     if len(vals) < 2:
         return ("<svg width='%d' height='%d'><text x='4' y='%d' "
@@ -170,9 +207,24 @@ def _sparkline(points, width: int = 280, height: int = 48) -> str:
         "%.1f,%.1f" % (pad + i * step,
                        height - pad - (v - lo) / span * (height - 2 * pad))
         for i, v in enumerate(vals))
-    return ("<svg width='%d' height='%d' viewBox='0 0 %d %d'>"
+    rules = []
+    t0, t1 = points[0][0], points[-1][0]
+    if markers and t1 > t0:
+        for mk in markers:
+            ts = mk.get("ts_s")
+            if ts is None or not (t0 <= ts <= t1):
+                continue
+            x = pad + (ts - t0) / (t1 - t0) * (width - 2 * pad)
+            color = _MARKER_COLORS.get(
+                mk.get("kind"), _MARKER_COLORS["alert"])
+            rules.append(
+                "<line x1='%.1f' y1='0' x2='%.1f' y2='%d' "
+                "stroke='%s' stroke-width='1' "
+                "stroke-dasharray='2,2'/>" % (x, x, height, color))
+    return ("<svg width='%d' height='%d' viewBox='0 0 %d %d'>%s"
             "<polyline fill='none' stroke='#2b6cb0' stroke-width='1.5' "
-            "points='%s'/></svg>" % (width, height, width, height, pts))
+            "points='%s'/></svg>" % (width, height, width, height,
+                                     "".join(rules), pts))
 
 
 def _fmt(v) -> str:
@@ -186,11 +238,14 @@ def _fmt(v) -> str:
 
 
 def render_dashboard(snapshot: dict, title: str = "engine",
-                     extra: Optional[dict] = None) -> str:
+                     extra: Optional[dict] = None,
+                     markers=None) -> str:
     """Render a sampler snapshot (plus optional ``extra`` blocks like
     alerts / cost / loop summaries) into ONE self-contained HTML page:
     stdlib string formatting, inline CSS, inline SVG sparklines, zero
-    external assets."""
+    external assets.  ``markers`` (``[{"ts_s", "kind", "label"}]`` —
+    captured incidents and fired alerts) draw vertical rules on every
+    sparkline at the moment each event happened."""
     extra = extra or {}
     cards = []
     for name in sorted(snapshot.get("metrics", {})):
@@ -199,7 +254,16 @@ def render_dashboard(snapshot: dict, title: str = "engine",
             "<div class='card'><div class='name'>%s</div>"
             "<div class='last'>%s</div>%s</div>"
             % (html.escape(name), _fmt(series.get("last")),
-               _sparkline(series.get("points", []))))
+               _sparkline(series.get("points", []), markers=markers)))
+    if markers:
+        legend = "; ".join(
+            "%s@%.1fs (%s)" % (html.escape(str(
+                mk.get("label") or mk.get("kind") or "event")),
+                mk.get("ts_s") or 0.0,
+                html.escape(str(mk.get("kind") or "alert")))
+            for mk in markers[-12:])
+        extra = dict(extra)
+        extra.setdefault("markers", legend)
     blocks = []
     for key in sorted(extra):
         val = extra[key]

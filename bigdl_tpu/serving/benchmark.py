@@ -966,6 +966,11 @@ def run_poisson_comparison(model, n_requests: int = 16,
         eng.update(_usage_blocks(stats))
         eng["cost"] = stats.get("cost")
         eng["loop"] = stats.get("loop")
+        # calm-storm incident gate: a healthy Poisson replay must
+        # record ZERO incidents (perf_gate fails the build otherwise)
+        inc = stats.get("incidents") or {}
+        incidents = {"count": inc.get("count", 0),
+                     "by_kind": inc.get("by_kind", {}), "calm": True}
     eng["ttft"] = _percentiles(ttft)
     eng["inter_token"] = _percentiles(itl)
 
@@ -983,6 +988,7 @@ def run_poisson_comparison(model, n_requests: int = 16,
                  if eng["latency"]["p99"] else None)
     return {"engine": eng, "generation_service": gen,
             "p99_speedup": p99_ratio,
+            "incidents": incidents,
             "workload": {"requests": n_requests, "rate_hz": rate_hz,
                          "seed": seed, "max_slots": max_slots,
                          "max_batch": max_batch}}

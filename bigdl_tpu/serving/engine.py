@@ -322,13 +322,22 @@ class ContinuousBatchingEngine:
                  tenant_rate_limits=None,
                  chaos=None,
                  page_size: Optional[int] = None,
-                 max_pages: Optional[int] = None):
+                 max_pages: Optional[int] = None,
+                 incident_dir: Optional[str] = None,
+                 anomaly_detectors=None,
+                 incident_cooldown_s: float = 30.0):
         from bigdl_tpu.models.transformer import _validate_sampling
         from bigdl_tpu.observability import serving_engine_instruments
         from bigdl_tpu.observability import memory as obs_memory
         from bigdl_tpu.observability.accounting import UsageLedger
+        from bigdl_tpu.observability.anomaly import (
+            DetectorBank, default_detector_bank,
+        )
         from bigdl_tpu.observability.events import default_recorder
-        from bigdl_tpu.observability.instruments import qos_instruments
+        from bigdl_tpu.observability.incidents import IncidentManager
+        from bigdl_tpu.observability.instruments import (
+            incident_instruments, qos_instruments,
+        )
         from bigdl_tpu.observability.watchdog import (
             RecompileWatchdog, SloObjective, SloWatchdog,
         )
@@ -826,7 +835,46 @@ class ContinuousBatchingEngine:
                 "acceptance_rate",
                 lambda: (self._spec_accepted / self._spec_proposed
                          if self._spec_proposed else None))
+        if self.paged:
+            # PR 17 pool gauges, charted: occupancy (live references
+            # over usable pages) and reservation fragmentation
+            self._ts.add_source(
+                "page_pool_occupancy",
+                lambda: (self._pages.pages_in_use
+                         / max(1, self._pages.max_pages - 1)))
+            self._ts.add_source("page_fragmentation",
+                                self._fragmentation)
         self._ts.add_source("alerts", lambda: float(len(self.alerts())))
+
+        # ---- anomaly detection + incident capture ----------------------
+        # detectors see every appended sampler point (observer runs on
+        # the sampler thread and only RECORDS triggers — the engine
+        # loop drains them once per iteration and does the capture
+        # work there); watchdog alerts and chaos drills converge on
+        # the same trigger stream in _process_triggers. Host-side
+        # Python only — the jit gauge stays flat with capture on.
+        if anomaly_detectors is None:
+            self._bank = default_detector_bank()
+        elif isinstance(anomaly_detectors, DetectorBank):
+            self._bank = anomaly_detectors
+        else:
+            self._bank = DetectorBank(anomaly_detectors)
+        self._ts.set_observer(self._bank.observe)
+        self._incidents = IncidentManager(
+            service_name, recorder=self._rec, registry=registry,
+            dirpath=incident_dir, cooldown_s=incident_cooldown_s,
+            config={"service_name": service_name,
+                    "max_slots": max_slots, "max_len": self.max_len,
+                    "prefill_chunk": self._policy.chunk,
+                    "admission_window": admission_window,
+                    "kv_dtype": self.kv_dtype,
+                    "weights_dtype": self.weights_dtype,
+                    "paged": self.paged,
+                    "shed_classes": list(shed_classes or ()),
+                    "preempt_slack_s": preempt_slack_s})
+        self._inc_ins = incident_instruments(registry)
+        self._det_gauges: Dict[str, object] = {}
+        self._trig_counters: Dict[str, object] = {}
 
         # watchdogs, sampled once per loop iteration: compiles that keep
         # growing after warmup break the engine's shape-stability
@@ -1944,6 +1992,7 @@ class ContinuousBatchingEngine:
         tl["outcome"] = outcome
         tl["tenant"] = getattr(h, "tenant", None)
         tl["trace_id"] = getattr(h, "trace_id", None)
+        tl["page_waited"] = bool(getattr(h, "_page_waited", False))
         with self._timelines_lock:
             self._timelines.append(tl)
 
@@ -1986,6 +2035,8 @@ class ContinuousBatchingEngine:
         if self.paged:
             out["paging"] = self._paging_summary()
         out["alerts"] = self.alerts()
+        out["incidents"] = {"count": self._incidents.total,
+                            "by_kind": self._incidents.counts_by_kind()}
         return out
 
     def _qos_summary(self) -> dict:
@@ -2203,16 +2254,41 @@ class ContinuousBatchingEngine:
                 "running": self._ts.running,
                 **self._ts.snapshot(metric=metric, n=n)}
 
+    def debug_incidents(self, n: Optional[int] = None) -> dict:
+        """The ``GET /debug/incidents[?n=]`` payload: the newest
+        ``n`` captured bundles plus the lifetime count and per-kind
+        tallies. Snapshot semantics — safe from HTTP threads while
+        the loop runs; the same shape ships over the fleet's
+        ``incident_export`` RPC."""
+        n = 10 if n is None else int(n)
+        return {"service": self.service_name,
+                "count": self._incidents.total,
+                "by_kind": self._incidents.counts_by_kind(),
+                "detectors": self._bank.states(),
+                "incidents": self._incidents.snapshot(n)}
+
     def dashboard(self) -> str:
         """The ``GET /debug/dashboard`` page: one self-contained HTML
         document (inline CSS + SVG sparklines, zero external assets)
         over the sampler rings, plus the live cost/roofline, loop
-        bubble, and alert blocks."""
+        bubble, and alert blocks. Captured incidents and fired
+        triggers draw vertical markers on every sparkline."""
+        markers = [{"ts_s": t.get("ts_s"), "kind": "alert",
+                    "label": t.get("detector")}
+                   for t in self._incidents.history()]
+        markers += [{"ts_s": b.get("ts_s"), "kind": "incident",
+                     "label": "%s (%s)" % (b.get("id"),
+                                           b.get("kind"))}
+                    for b in self._incidents.snapshot()]
+        markers.sort(key=lambda m: m.get("ts_s") or 0.0)
         return render_dashboard(
             self._ts.snapshot(), title=self.service_name,
             extra={"alerts": self.alerts() or None,
+                   "incidents": (self._incidents.counts_by_kind()
+                                 or None),
                    "cost": self._cost.summary(),
-                   "loop": self._loop_obs.summary()})
+                   "loop": self._loop_obs.summary()},
+            markers=markers)
 
     # ------------------------------------------------------- loop body
     def _loop(self):
@@ -2252,6 +2328,15 @@ class ContinuousBatchingEngine:
         except Exception:
             states = []
         self._write_postmortem(e, states)
+        # the crash is itself an incident: same evidence pipeline as
+        # the anomaly/watchdog triggers, kind "crash" — a fleet
+        # supervisor aggregating incident_export sees the dead
+        # replica's last picture without reading its postmortem file
+        self._capture_incident(
+            {"detector": "engine", "metric": "loop", "kind": "crash",
+             "reason": f"engine loop crashed: {e!r}",
+             "ts_s": time.monotonic(), "value": 1.0, "score": 1.0},
+            error=e)
         err = EngineStopped(f"engine loop crashed: {e!r}")
         err.__cause__ = e
         for key in list(self._promotions):
@@ -2307,6 +2392,72 @@ class ContinuousBatchingEngine:
         except Exception as pe:
             print(f"[bigdl_tpu.serving] postmortem write failed: "
                   f"{pe!r} (crash: {e!r})", file=sys.stderr)
+
+    def _process_triggers(self, occupied: List[int],
+                          advanced: List[int]) -> None:
+        """Once-per-iteration incident funnel: drain detector
+        triggers recorded on the sampler thread, feed the
+        iteration-scale stall detector (a live slot that stops
+        advancing — sampler cadence is far too coarse for that), and
+        map active watchdog alerts (plus a chaos-forced burn, which
+        mints no real watchdog alert) onto the same stream. Every
+        surviving trigger becomes one capture attempt, deduped by the
+        manager's per-kind cooldown. Host-side bookkeeping only."""
+        now = time.monotonic()
+        triggers = self._bank.drain()
+        triggers += self._bank.observe_iteration(now, occupied,
+                                                 advanced)
+        alerts = self.alerts()
+        if self._chaos is not None and self._chaos.burn_active():
+            alerts = alerts + [{"alert": "slo:forced_burn",
+                                "severity": "critical",
+                                "forced": True}]
+        triggers += self._bank.alert_triggers(alerts, now)
+        for t in triggers:
+            name = str(t.get("detector", "detector"))
+            c = self._trig_counters.get(name)
+            if c is None:
+                c = self._inc_ins.triggers_total.labels(
+                    self.service_name, name)
+                self._trig_counters[name] = c
+            c.inc()
+            self._capture_incident(t)
+        for name, state in self._bank.states().items():
+            g = self._det_gauges.get(name)
+            if g is None:
+                g = self._inc_ins.detector_state.labels(
+                    self.service_name, name)
+                self._det_gauges[name] = g
+            g.set(1.0 if state == "firing" else 0.0)
+
+    def _capture_incident(self, trigger: dict,
+                          error: Optional[BaseException] = None):
+        """Hand one trigger to the incident manager with the live
+        evidence: the finished-timeline ring (exemplar source), the
+        qos/latency/cost/loop stats blocks, and the memory/page-pool
+        picture. Best-effort — capture must never take down the loop
+        (or the crash path, which also funnels through here)."""
+        try:
+            with self._timelines_lock:
+                tls = list(self._timelines)
+            stats = {
+                "qos": self._qos_summary(),
+                "latency": self._latency_summary(),
+                "cost": self._cost.summary(),
+                "loop": self._loop_obs.summary(),
+                "queue_depth": len(self._queue),
+                "active_slots": sum(s is not None
+                                    for s in self._slots),
+                "jit_compiles": self._compile_total(),
+            }
+            memory = {"pools": self._pool_bytes}
+            if self.paged:
+                memory["paging"] = self._paging_summary()
+            return self._incidents.capture(
+                trigger, timelines=tls, stats=stats, memory=memory,
+                error=error)
+        except Exception:
+            return None
 
     def _iterate(self) -> bool:
         now = time.monotonic()
@@ -2375,8 +2526,9 @@ class ContinuousBatchingEngine:
                max(0.0, t_adm - t_sweep - self._iter_disp["prefill"]))
 
         # 4. one fused decode step over every occupied slot
-        active = [sid for sid, st in enumerate(self._slots)
-                  if st is not None]
+        occupied = [sid for sid, st in enumerate(self._slots)
+                    if st is not None]
+        active = list(occupied)
         if self._chaos is not None:
             # frozen slots sit out this round's fused step (their KV
             # and handle are untouched — they resume when the freeze
@@ -2403,6 +2555,7 @@ class ContinuousBatchingEngine:
             self._sync_page_gauges()
         self._recompile_wd.sample()
         self._slo_wd.sample()
+        self._process_triggers(occupied, active)
         mfu_d, bw_d = self._cost.rates("decode")
         if mfu_d is not None:
             ins.mfu_decode.set(mfu_d)
@@ -2792,6 +2945,10 @@ class ContinuousBatchingEngine:
         if table is None:
             self._queue.requeue(h)
             self._adm_blocked = True
+            # sticky per-request latch: the finished timeline reports
+            # page_waited and the incident exemplars classify the
+            # request page_wait-bound
+            h._page_waited = True
             self._rec.record("request/page_wait", h.request_id,
                              service=self.service_name,
                              needed_pages=n_fresh,

@@ -55,6 +55,11 @@ total. Two debug endpoints expose the merged view:
   clock-aligned common timeline (per-process tracks).
 - ``GET /debug/fleet/requests`` — the recent-request ring (hop
   breakdowns) plus per-request cross-process timelines.
+- ``GET /debug/fleet/incidents[?n=]`` — every replica's incident
+  bundles (``IncidentManager`` captures, fetched over the
+  ``incident_export`` RPC) stamped with ``replica=``, fleet-wide
+  counts by kind, per-replica detector states, and the trace ids the
+  exemplars reference — each resolvable in the merged fleet trace.
 """
 
 from __future__ import annotations
@@ -66,6 +71,7 @@ import socket
 import threading
 import time
 from typing import Optional
+from urllib.parse import parse_qs
 
 from bigdl_tpu.observability.exporters import (
     PROMETHEUS_CONTENT_TYPE, render_prometheus,
@@ -375,6 +381,18 @@ class FleetFrontDoor:
                 elif path == "/debug/fleet/requests":
                     try:
                         self._send_json(sup.fleet_requests())
+                    except Exception as e:
+                        self._send_json({"error": str(e)}, 500)
+                elif path == "/debug/fleet/incidents":
+                    # every replica's incident bundles stamped with
+                    # replica=, fleet counts by kind, and the trace
+                    # ids the exemplars reference (each resolvable in
+                    # /debug/fleet/requests' merged timelines)
+                    try:
+                        query = self.path.partition("?")[2]
+                        n_raw = parse_qs(query).get("n", ["10"])[0]
+                        self._send_json(
+                            sup.fleet_incidents(int(n_raw)))
                     except Exception as e:
                         self._send_json({"error": str(e)}, 500)
                 elif path == "/metrics":

@@ -109,6 +109,12 @@ class InProcessReplica:
     def stop(self) -> None:
         self.engine.stop()
 
+    def incident_export(self, n: Optional[int] = None) -> dict:
+        """The engine's ``debug_incidents`` payload — same shape as
+        the worker RPC, so the supervisor's fleet merge treats both
+        deployments identically."""
+        return self.engine.debug_incidents(n)
+
 
 class ReplicaSupervisor:
     """Own replicas, poll health, drain/rejoin, route submissions.
@@ -538,6 +544,64 @@ class ReplicaSupervisor:
                 # graftlint: ok[resource-hygiene] — a dead/wedged replica just drops out of this scrape
                 continue
         return out
+
+    def incident_exports(self, n: Optional[int] = None
+                         ) -> Dict[str, dict]:
+        """Every replica's ``incident_export`` payload keyed by
+        replica id (duck-typed, best-effort like
+        ``metrics_snapshots`` — a replica without the method or with
+        a dead pipe just drops out)."""
+        out: Dict[str, dict] = {}
+        with self._lock:
+            replicas = list(self._replicas.items())
+        for rid, rep in replicas:
+            export_fn = getattr(rep, "incident_export", None)
+            if export_fn is None:
+                continue
+            try:
+                out[rid] = export_fn(n)
+            except Exception as e:
+                out[rid] = {"error": repr(e), "incidents": []}
+        return out
+
+    def fleet_incidents(self, n: Optional[int] = None) -> dict:
+        """The ``/debug/fleet/incidents`` aggregate: every replica's
+        bundles stamped with their replica id, fleet-wide counts by
+        kind, detector states per replica, and the set of trace ids
+        the bundles' exemplars reference — each resolvable in the
+        merged fleet trace (``/debug/fleet/requests`` timelines)."""
+        per = self.incident_exports(n)
+        incidents: List[dict] = []
+        by_kind: Dict[str, int] = {}
+        detectors: Dict[str, dict] = {}
+        trace_ids: set = set()
+        for rid, payload in sorted(per.items()):
+            if payload.get("error"):
+                continue
+            detectors[rid] = payload.get("detectors") or {}
+            for kind, c in (payload.get("by_kind") or {}).items():
+                by_kind[kind] = by_kind.get(kind, 0) + int(c)
+            for bundle in payload.get("incidents") or []:
+                stamped = dict(bundle)
+                stamped["replica"] = rid
+                incidents.append(stamped)
+                for ex in bundle.get("exemplars") or []:
+                    tid = ex.get("trace_id")
+                    if tid:
+                        trace_ids.add(tid)
+        incidents.sort(key=lambda b: b.get("ts_s") or 0.0,
+                       reverse=True)
+        return {
+            "fleet": self.fleet_name,
+            "count": sum(by_kind.values()),
+            "by_kind": by_kind,
+            "detectors": detectors,
+            "trace_ids": sorted(trace_ids),
+            "incidents": incidents,
+            "replicas": {rid: {"count": p.get("count", 0),
+                               "error": p.get("error")}
+                         for rid, p in sorted(per.items())},
+        }
 
     # ------------------------------------------------------ aggregates
     def loads(self) -> Dict[str, float]:

@@ -183,7 +183,7 @@ def _worker_main(conn, cfg: dict) -> None:
                   "payload": {"mono": time.monotonic(),
                               "wall": time.time()}})
         elif op in ("healthz", "stats", "trace_export",
-                    "metrics_export"):
+                    "metrics_export", "incident_export"):
             try:
                 if op == "healthz":
                     payload = eng.healthz()
@@ -197,6 +197,8 @@ def _worker_main(conn, cfg: dict) -> None:
                         "events": default_recorder().snapshot(
                             msg.get("last")),
                     }
+                elif op == "incident_export":
+                    payload = eng.debug_incidents(msg.get("n"))
                 else:
                     payload = registry_snapshot(default_registry())
                 send({"ev": "reply", "seq": msg["seq"],
@@ -560,6 +562,13 @@ class WorkerReplica:
         under a ``replica=`` label on ``/metrics``."""
         return self._call("metrics_export",
                           timeout=3 * self.rpc_timeout)
+
+    def incident_export(self, n: Optional[int] = None) -> dict:
+        """The worker engine's ``debug_incidents`` payload (newest-n
+        bundles, counts by kind, detector states) — the supervisor
+        merges these into ``/debug/fleet/incidents``."""
+        return self._call("incident_export",
+                          timeout=3 * self.rpc_timeout, n=n)
 
     @property
     def postmortem_path(self) -> Optional[str]:

@@ -69,8 +69,16 @@ admitted-concurrency ratio as an absolute floor
 budget, page-granular reservation must keep admitting >= 3x the
 dense leg's concurrent requests; a within-run A/B ratio gates on its
 own scale, like the fleet speedup) and the paged-vs-dense greedy
-token-parity verdict); all nine shapes are understood. Stdlib only —
-runnable from any CI step without the package installed.
+token-parity verdict); all nine shapes are understood.
+
+Two incident-autopilot gates ride along (skip-if-absent for rows
+predating the fields): the newest plain ``--serving`` row's
+``detail.incidents.count`` must be ZERO (detector warmup + hysteresis
+must keep a calm Poisson storm incident-free), and the newest
+``serve.py --chaos`` drill row (``detail.chaos_drill``) must show
+every fault class — slo / stall / crash — converted into >= 1
+classified incident bundle. Stdlib only — runnable from any CI step
+without the package installed.
 
 Usage::
 
@@ -303,6 +311,42 @@ def paged_token_parity(row: dict):
     if not detail.get("paged"):
         return None
     return detail.get("token_parity")
+
+
+#: fault classes the ``serve.py --chaos`` drill must each convert
+#: into exactly >= 1 correctly-classified incident bundle (the
+#: incident-autopilot acceptance bar)
+_CHAOS_REQUIRED_KINDS = ("slo", "stall", "crash")
+
+
+def calm_incident_count(row: dict):
+    """The calm serving row's incident count — a plain ``bench.py
+    --serving`` Poisson replay stamps ``detail.incidents`` with
+    ``calm: true``, and a healthy storm must record ZERO incidents
+    (warmup + hysteresis exist so ordinary load never trips the
+    detectors). None for rows predating the field and for
+    non-calm row shapes (the qos storm legitimately sheds)."""
+    inc = (row.get("detail") or {}).get("incidents")
+    if not isinstance(inc, dict) or not inc.get("calm"):
+        return None
+    c = inc.get("count")
+    return int(c) if c is not None else None
+
+
+def chaos_incident_kinds(row: dict):
+    """The chaos-drill row's per-kind incident counts (``serve.py
+    --chaos`` appends one ``serving_chaos_incidents`` row per drill),
+    or None for every other row shape and for rows predating the
+    field. Each fault class in ``_CHAOS_REQUIRED_KINDS`` must have
+    minted >= 1 bundle — a drill that stops converting faults into
+    classified incidents has lost its detection coverage."""
+    detail = row.get("detail") or {}
+    if not detail.get("chaos_drill"):
+        return None
+    inc = detail.get("incidents")
+    if not isinstance(inc, dict):
+        return None
+    return {k: int(v) for k, v in (inc.get("by_kind") or {}).items()}
 
 
 def signature(row: dict):
@@ -561,6 +605,39 @@ def main(argv=None) -> int:
         else:
             print(f"[perf-gate] ok: {verdict} under the absolute "
                   f"{ceiling} ceiling")
+    # incident autopilot, calm side: a plain Poisson replay must have
+    # recorded zero incidents — detector warmup + hysteresis exist
+    # precisely so ordinary load never trips them (skip-if-absent for
+    # rows predating the field)
+    cc = calm_incident_count(newest)
+    if cc is not None:
+        if cc > 0:
+            print(f"[perf-gate] FAIL: calm serving storm recorded "
+                  f"{cc} incident(s) for {newest.get('metric')} {span}"
+                  " — the anomaly detectors fire on healthy load")
+            failed = True
+        else:
+            print("[perf-gate] ok: calm serving storm recorded zero "
+                  "incidents")
+    # incident autopilot, chaos side: the newest drill row (no TTFT,
+    # so it lives outside the serving-row selection above) must show
+    # every fault class converted into >= 1 classified bundle
+    chaos_row = next((r for r in reversed(rows)
+                      if chaos_incident_kinds(r) is not None), None)
+    if chaos_row is not None:
+        kinds = chaos_incident_kinds(chaos_row)
+        cspan = f"[{chaos_row.get('ts', '?')}]"
+        for kind in _CHAOS_REQUIRED_KINDS:
+            n = kinds.get(kind, 0)
+            if n < 1:
+                print(f"[perf-gate] FAIL: chaos drill minted 0 "
+                      f"kind={kind} incidents {cspan} — the "
+                      f"{kind} fault class is no longer detected and "
+                      "captured")
+                failed = True
+            else:
+                print(f"[perf-gate] ok: chaos drill minted {n} "
+                      f"kind={kind} incident(s) {cspan}")
     return 1 if failed else 0
 
 

@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""Pretty-print a bigdl_tpu incident bundle.
+
+The serving engine's ``IncidentManager`` writes one JSON bundle per
+captured incident (``bigdl_tpu.observability.incidents``); this
+renders a saved bundle for a human: the trigger that fired, the
+phase-attributed slow-request exemplars, the windowed flight-recorder
+event slice, the memory/stats blocks, the surrounding trigger
+history, and the engine config digest.
+
+Usage:
+    python scripts/show_incident.py incident-inc-000001.json
+    python scripts/show_incident.py --events 50 --no-stats inc.json
+    python scripts/show_incident.py /var/incidents   # newest in dir
+
+Stdlib-only — runs anywhere the JSON file can be copied to, no jax or
+bigdl_tpu import required.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _hdr(title: str) -> str:
+    return f"\n=== {title} " + "=" * max(0, 60 - len(title))
+
+
+def _ms(v) -> str:
+    return f"{v * 1e3:8.1f}ms" if isinstance(v, (int, float)) else \
+        "       -"
+
+
+def render(inc: dict, events: int = 30, show_stats: bool = True) -> str:
+    out = []
+    out.append(f"incident {inc.get('id', '?')} "
+               f"[{inc.get('kind', '?')}] on "
+               f"{inc.get('service', '?')} "
+               f"written {inc.get('written_at', '?')} "
+               f"({inc.get('schema', '?')})")
+    out.append(f"reason: {inc.get('reason', '?')}")
+
+    trig = inc.get("trigger") or {}
+    out.append(_hdr("trigger"))
+    out.append(f"  detector={trig.get('detector', '?')} "
+               f"metric={trig.get('metric', '?')} "
+               f"value={trig.get('value')} score={trig.get('score')}")
+    if trig.get("alert"):
+        out.append("  alert: " + json.dumps(trig["alert"]))
+
+    err = inc.get("error")
+    if err:
+        out.append(_hdr("error"))
+        out.append(f"  {err.get('type')}: {err.get('message')}")
+
+    exs = inc.get("exemplars") or []
+    out.append(_hdr(f"slow-request exemplars ({len(exs)})"))
+    if exs:
+        out.append(f"  {'request':<12} {'phase':<16} {'outcome':<10} "
+                   f"{'total':>10} {'queue':>10} {'prefill':>10} "
+                   f"{'ttft':>10} {'decode':>10} tok")
+        for ex in exs:
+            flags = "".join(
+                f" [{f}]" for f in ("preempted", "page_waited")
+                if ex.get(f))
+            out.append(
+                f"  {str(ex.get('request_id', '?')):<12} "
+                f"{str(ex.get('phase', '?')):<16} "
+                f"{str(ex.get('outcome', '?')):<10} "
+                f"{_ms(ex.get('total_s'))} {_ms(ex.get('queue_wait_s'))} "
+                f"{_ms(ex.get('prefill_s'))} {_ms(ex.get('ttft_s'))} "
+                f"{_ms(ex.get('decode_s'))} "
+                f"{ex.get('tokens', '-')}{flags}")
+            if ex.get("trace_id"):
+                out.append(f"    trace={ex['trace_id']} "
+                           f"tenant={ex.get('tenant')} "
+                           f"priority={ex.get('priority')}")
+    else:
+        out.append("  (none — no finished requests in the window)"
+                   + ("  " + inc["exemplars_error"]
+                      if inc.get("exemplars_error") else ""))
+
+    hist = inc.get("trigger_history") or []
+    out.append(_hdr(f"trigger history ({len(hist)})"))
+    for h in hist[-12:]:
+        out.append(f"  {h.get('observed_ts_s', 0):.3f} "
+                   f"[{h.get('kind', '?'):<9}] "
+                   f"{h.get('detector', '?')}/{h.get('metric', '?')}: "
+                   f"{h.get('reason', '')}")
+
+    evs = inc.get("events") or []
+    out.append(_hdr(f"windowed events (showing "
+                    f"{min(events, len(evs))} of {len(evs)})"))
+    for e in evs[-events:]:
+        rid = e.get("request_id", "") or ""
+        attrs = {k: v for k, v in e.items()
+                 if k not in ("seq", "ts_s", "wall_s", "thread", "kind",
+                              "request_id")}
+        out.append(f"  #{e.get('seq', '?'):<6} {e.get('ts_s', 0):.6f} "
+                   f"[{e.get('thread', '?')}] "
+                   f"{e.get('kind', '?'):<24} {rid:<12} "
+                   f"{json.dumps(attrs) if attrs else ''}")
+    if inc.get("events_error"):
+        out.append("  events_error: " + inc["events_error"])
+
+    mem = inc.get("memory")
+    if mem:
+        out.append(_hdr("memory"))
+        for line in json.dumps(mem, indent=2,
+                               default=str).splitlines():
+            out.append("  " + line)
+
+    if show_stats and inc.get("stats"):
+        out.append(_hdr("stats"))
+        for line in json.dumps(inc["stats"], indent=2,
+                               default=str).splitlines():
+            out.append("  " + line)
+
+    dig = inc.get("config_digest")
+    if dig:
+        out.append(_hdr("config"))
+        out.append(f"  sha256={dig.get('sha256')}")
+        out.append("  " + json.dumps(dig.get("config"), sort_keys=True,
+                                     default=str))
+    return "\n".join(out) + "\n"
+
+
+def _resolve(path: str) -> str:
+    """A directory means "the newest bundle in the on-disk ring"."""
+    if not os.path.isdir(path):
+        return path
+    bundles = sorted(n for n in os.listdir(path)
+                     if n.startswith("incident-")
+                     and n.endswith(".json"))
+    if not bundles:
+        raise FileNotFoundError(f"no incident-*.json bundles in {path}")
+    return os.path.join(path, bundles[-1])
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Pretty-print a bigdl_tpu incident bundle JSON")
+    p.add_argument("path", help="bundle file (incident-inc-*.json) or "
+                                "an incident directory (newest bundle)")
+    p.add_argument("--events", type=int, default=30,
+                   help="how many trailing events to show (default 30)")
+    p.add_argument("--no-stats", action="store_true",
+                   help="skip the stats block")
+    args = p.parse_args(argv)
+    try:
+        with open(_resolve(args.path)) as f:
+            inc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"cannot read incident {args.path!r}: {e}",
+              file=sys.stderr)
+        return 1
+    sys.stdout.write(render(inc, events=args.events,
+                            show_stats=not args.no_stats))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
