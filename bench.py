@@ -580,10 +580,14 @@ def _serving_bench(args, dev):
     requests is the bar). value/vs_baseline is the affinity-vs-round-
     robin client TTFT p50 speedup (>1.0: content-aware routing lands
     first tokens sooner), and detail carries both legs' percentiles,
-    the fleet hit rates, the routing tallies, the drain block, and
-    the token-parity verdict against a single-replica reference.
-    perf_gate gates the speedup, the fleet hit rate, and the affinity
-    leg's p99 TTFT between comparable rows.
+    the fleet hit rates, the routing tallies, the drain block, the
+    token-parity verdict against a single-replica reference, plus the
+    affinity leg's capacity stamp (detail.capacity: fleet headroom,
+    replicas-needed, per-role device-wall split) and SLO error-budget
+    floor (detail.slo_budget.remaining_min). perf_gate gates the
+    speedup, the fleet hit rate, the affinity leg's p99 TTFT, the
+    capacity headroom band, and the calm-run budget floor between
+    comparable rows.
 
     `--serving --tp N`: the tensor-parallel A/B — the same Poisson
     workload through the engine SHARDED over an N-way model-axis

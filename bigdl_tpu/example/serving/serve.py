@@ -284,7 +284,8 @@ def main(argv=None):
                                   debug_requests=engine.debug_requests,
                                   debug_usage=engine.debug_usage,
                                   debug_timeseries=engine.debug_timeseries,
-                                  dashboard=engine.dashboard
+                                  dashboard=engine.dashboard,
+                                  debug_capacity=engine.debug_capacity
                                   ) as server:
         base = f"http://127.0.0.1:{server.port}"
         print(f"[engine]    live dashboard: {base}/debug/dashboard "
@@ -825,6 +826,32 @@ def _fleet_demo(args):
               f"{pc['hit_rate']:.0%} ({pc['hits']}/{pc['lookups']} "
               f"lookups), {pc['reused_tokens']} tokens served from "
               f"cache across {len(stats['replicas'])} replicas")
+
+        # the telemetry plane: every replica's sampler rings merged
+        # onto one clock-aligned timeline, and the capacity model's
+        # what-if answer for the load the demo just offered
+        ts = json.loads(urllib.request.urlopen(
+            f"{base}/debug/fleet/timeseries").read())
+        pts = sum(len(s["points"])
+                  for m in ts["metrics"].values()
+                  for s in m["replicas"].values())
+        print(f"[telemetry] /debug/fleet/timeseries: "
+              f"{len(ts['metrics'])} metrics x "
+              f"{len(ts['replicas'])} replicas, {pts} aligned points "
+              f"(dashboard: {base}/debug/fleet/dashboard)")
+        cap = json.loads(urllib.request.urlopen(
+            f"{base}/debug/fleet/capacity").read())
+        if cap.get("ready"):
+            print(f"[capacity]  sustainable "
+                  f"{cap['sustainable_rps']:.1f} req/s fleet-wide, "
+                  f"headroom {cap['headroom']:.0%}, "
+                  f"{cap['replicas_needed']} replica(s) needed at the "
+                  f"observed {cap['observed_rps']:.1f} req/s")
+            what_if = json.loads(urllib.request.urlopen(
+                f"{base}/debug/fleet/capacity?offered="
+                f"{2 * cap['observed_rps']:.4f}").read())
+            print(f"[capacity]  what-if 2x load -> "
+                  f"{what_if['replicas_needed']} replica(s) needed")
         body = urllib.request.urlopen(f"{base}/metrics").read().decode()
     shown = [ln for ln in body.splitlines()
              if ln.startswith("bigdl_fleet_routed_total")]

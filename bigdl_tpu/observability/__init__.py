@@ -59,7 +59,22 @@ equivalent for one-process-per-host JAX):
 - **Time series** (``timeseries``): a background ``TimeSeriesSampler``
   snapshotting gauges/derived rates into bounded rings behind
   ``GET /debug/timeseries``, rendered as a self-contained SVG-sparkline
-  dashboard at ``GET /debug/dashboard``.
+  dashboard at ``GET /debug/dashboard`` — plus the fleet merge
+  (``merge_fleet_timeseries``) folding every replica's rings onto one
+  clock-aligned timeline, rendered with per-replica overlays at the
+  front door's ``GET /debug/fleet/dashboard``.
+- **SLO error budgets** (``slo_budget``): ``SloBudgetTracker`` turning
+  the watchdog's objective snapshots into multi-window (fast/slow
+  burn) error-budget accounting — budget-remaining fraction,
+  exhaustion ETA at the current burn, per objective and per priority
+  class, with a chaos-drillable synthetic-spend path — behind
+  ``stats()["slo_budget"]`` and budget bars on both dashboards.
+- **Capacity model** (``capacity``): ``estimate_capacity`` combining
+  loop-phase fractions, roofline classes, and the usage ledger's
+  device-seconds-per-request into per-replica sustainable request
+  rate / tokens/s, headroom, replicas-needed what-ifs, and the
+  prefill-vs-decode disaggregation projection — behind
+  ``stats()["capacity"]`` and ``GET /debug/fleet/capacity``.
 - **Exporters** (``exporters``): Prometheus text rendering, a
   stdlib-only ``/metrics`` + ``/healthz`` HTTP endpoint with
   ``/debug/events`` + ``/debug/requests`` + ``/debug/trace`` +
@@ -125,7 +140,14 @@ from bigdl_tpu.observability.costmodel import (
     program_cost,
 )
 from bigdl_tpu.observability.timeseries import (
-    TimeSeriesSampler, render_dashboard,
+    TimeSeriesSampler, merge_fleet_timeseries, render_dashboard,
+    render_fleet_dashboard,
+)
+from bigdl_tpu.observability.slo_budget import (
+    DEFAULT_BURN_WINDOWS, SloBudgetTracker,
+)
+from bigdl_tpu.observability.capacity import (
+    aggregate_fleet_capacity, estimate_capacity, replicas_needed,
 )
 from bigdl_tpu.observability.memory import (
     DeviceMemoryMonitor, default_monitor, pool_sizes, register_pool,
@@ -174,7 +196,10 @@ __all__ = [
     "UsageLedger", "UsageRecord",
     "DispatchCostModel", "LoopPhaseAccumulator", "device_peaks",
     "peak_flops", "program_cost",
-    "TimeSeriesSampler", "render_dashboard",
+    "TimeSeriesSampler", "merge_fleet_timeseries", "render_dashboard",
+    "render_fleet_dashboard",
+    "DEFAULT_BURN_WINDOWS", "SloBudgetTracker",
+    "aggregate_fleet_capacity", "estimate_capacity", "replicas_needed",
     "DeviceMemoryMonitor", "default_monitor", "pool_sizes",
     "register_pool", "register_owned_pools", "static_pools",
     "tree_bytes", "tree_device_bytes", "unregister_pool",

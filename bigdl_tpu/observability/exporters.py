@@ -196,6 +196,9 @@ class MetricsHTTPServer:
       SVG sparklines, zero external assets) over the same rings plus
       the live roofline and loop-phase blocks; wire
       ``ContinuousBatchingEngine.dashboard`` here.
+    - ``GET /debug/capacity`` — the capacity/what-if estimate plus
+      the SLO error-budget ledger; wire
+      ``ContinuousBatchingEngine.debug_capacity`` here.
 
     ``recorder``/``tracer`` default to the process defaults, resolved
     per request (a swapped default redirects the endpoints too)."""
@@ -210,7 +213,8 @@ class MetricsHTTPServer:
                  profiler: Optional[Callable[[float], str]] = None,
                  debug_timeseries=None,
                  dashboard: Optional[Callable[[], str]] = None,
-                 debug_incidents=None):
+                 debug_incidents=None,
+                 debug_capacity: Optional[Callable[[], dict]] = None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         from bigdl_tpu.observability import events as _events
@@ -361,6 +365,17 @@ class MetricsHTTPServer:
                 elif path == "/debug/profile":
                     payload, status = run_profile(query)
                     self._send_json(payload, status=status)
+                elif path == "/debug/capacity":
+                    try:
+                        if debug_capacity is None:
+                            self._send_json(
+                                {"capacity": {"ready": False},
+                                 "note": "no capacity source attached "
+                                         "(pass debug_capacity=)"})
+                        else:
+                            self._send_json(debug_capacity())
+                    except Exception as e:
+                        self._send_json({"error": str(e)}, status=500)
                 elif path == "/debug/timeseries":
                     try:
                         if debug_timeseries is None:
@@ -459,7 +474,8 @@ def start_http_server(port: int = 0,
                       profiler: Optional[Callable[[float], str]] = None,
                       debug_timeseries=None,
                       dashboard: Optional[Callable[[], str]] = None,
-                      debug_incidents=None
+                      debug_incidents=None,
+                      debug_capacity: Optional[Callable[[], dict]] = None
                       ) -> MetricsHTTPServer:
     """Convenience wrapper: start and return a MetricsHTTPServer."""
     return MetricsHTTPServer(registry=registry, host=host, port=port,
@@ -471,7 +487,8 @@ def start_http_server(port: int = 0,
                              profiler=profiler,
                              debug_timeseries=debug_timeseries,
                              dashboard=dashboard,
-                             debug_incidents=debug_incidents)
+                             debug_incidents=debug_incidents,
+                             debug_capacity=debug_capacity)
 
 
 # -------------------------------------------------------- TensorBoard bridge

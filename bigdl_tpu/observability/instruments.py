@@ -627,6 +627,18 @@ def watchdog_instruments(registry: Optional[MetricRegistry] = None
             "Error-budget burn rate of the objective over its trailing "
             "window (1.0 = spending budget exactly as fast as the "
             "target allows)", labelnames=("objective", "service")),
+        budget_remaining=r.gauge(
+            "bigdl_slo_budget_remaining",
+            "Fraction of the objective's error budget left over the "
+            "trailing budget window (1.0 = untouched, 0.0 = "
+            "exhausted; chaos burn drills spend it synthetically)",
+            labelnames=("objective", "service")),
+        budget_burn_rate=r.gauge(
+            "bigdl_slo_budget_burn_rate",
+            "Multi-window burn rate of the objective (window='fast' / "
+            "'slow' Google-SRE pairing; 1.0 = spending budget exactly "
+            "as fast as the target allows)",
+            labelnames=("objective", "service", "window")),
     )
 
 
@@ -926,6 +938,17 @@ def fleet_instruments(fleet: str = "fleet",
             "supervisor (min-RTT ping estimate; added to replica "
             "timestamps when merging fleet traces)",
             labelnames=("fleet", "replica")),
+        capacity_headroom=r.gauge(
+            "bigdl_fleet_capacity_headroom",
+            "Fleet-wide headroom fraction from the capacity model: "
+            "1 - offered/sustainable request rate across live "
+            "replicas (0 = saturated, negative = overloaded)",
+            labelnames=lbl).labels(fleet),
+        capacity_replicas_needed=r.gauge(
+            "bigdl_fleet_capacity_replicas_needed",
+            "Replicas the capacity model estimates the current "
+            "offered load needs at each replica's measured "
+            "sustainable rate", labelnames=lbl).labels(fleet),
     )
 
 

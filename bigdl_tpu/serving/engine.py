@@ -338,6 +338,7 @@ class ContinuousBatchingEngine:
         from bigdl_tpu.observability.instruments import (
             incident_instruments, qos_instruments,
         )
+        from bigdl_tpu.observability.slo_budget import SloBudgetTracker
         from bigdl_tpu.observability.watchdog import (
             RecompileWatchdog, SloObjective, SloWatchdog,
         )
@@ -889,6 +890,13 @@ class ContinuousBatchingEngine:
         slo_children = {"ttft": self._ins.ttft_seconds,
                         "inter_token": self._ins.inter_token_seconds,
                         "queue_wait": self._ins.queue_wait_seconds}
+        # the error-budget ledger reads the SAME histogram children as
+        # the watchdog: the watchdog answers "burning now?", the
+        # tracker answers "how much budget is left / when does it run
+        # out" — and chaos burn drills spend it synthetically so the
+        # exhaustion path is exercisable
+        self._slo_budget = SloBudgetTracker(
+            service=service_name, registry=registry, recorder=self._rec)
         for obj in (slo_objectives or ()):
             if isinstance(obj, dict):
                 obj = SloObjective(**obj)
@@ -898,6 +906,7 @@ class ContinuousBatchingEngine:
                     f"metric {obj.metric!r}; expected one of "
                     f"{sorted(slo_children)}")
             self._slo_wd.watch(obj, slo_children[obj.metric])
+            self._slo_budget.watch(obj, slo_children[obj.metric])
         # stats() reports the DELTA since construction (the same
         # registry-façade convention as OccupancyStats): two engines
         # sharing a service_name share the series, so each instance
@@ -2031,6 +2040,9 @@ class ContinuousBatchingEngine:
         out["usage"] = self._usage.summary()
         out["cost"] = self._cost.summary()
         out["loop"] = self._loop_obs.summary()
+        out["slo_budget"] = self._slo_budget.state()
+        out["capacity"] = self._capacity_summary(
+            loop=out["loop"], cost=out["cost"], usage=out["usage"])
         out["qos"] = self._qos_summary()
         if self.paged:
             out["paging"] = self._paging_summary()
@@ -2267,12 +2279,34 @@ class ContinuousBatchingEngine:
                 "detectors": self._bank.states(),
                 "incidents": self._incidents.snapshot(n)}
 
+    def _capacity_summary(self, loop=None, cost=None,
+                          usage=None) -> dict:
+        """The ``stats()["capacity"]`` block: the what-if model over
+        this engine's measured loop / cost / usage summaries."""
+        from bigdl_tpu.observability.capacity import estimate_capacity
+
+        return estimate_capacity(
+            loop if loop is not None else self._loop_obs.summary(),
+            cost if cost is not None else self._cost.summary(),
+            usage if usage is not None else self._usage.summary(),
+            max_slots=self.max_slots, service=self.service_name)
+
+    def debug_capacity(self) -> dict:
+        """The ``GET /debug/capacity`` payload: the capacity/what-if
+        estimate plus the error-budget ledger — everything an
+        autoscaling policy (or an operator sizing a fleet) reads.
+        Snapshot semantics — safe from HTTP threads."""
+        return {"service": self.service_name,
+                "capacity": self._capacity_summary(),
+                "slo_budget": self._slo_budget.state()}
+
     def dashboard(self) -> str:
         """The ``GET /debug/dashboard`` page: one self-contained HTML
         document (inline CSS + SVG sparklines, zero external assets)
         over the sampler rings, plus the live cost/roofline, loop
         bubble, and alert blocks. Captured incidents and fired
-        triggers draw vertical markers on every sparkline."""
+        triggers draw vertical markers on every sparkline; watched
+        SLO objectives draw error-budget bars under the grid."""
         markers = [{"ts_s": t.get("ts_s"), "kind": "alert",
                     "label": t.get("detector")}
                    for t in self._incidents.history()]
@@ -2287,8 +2321,10 @@ class ContinuousBatchingEngine:
                    "incidents": (self._incidents.counts_by_kind()
                                  or None),
                    "cost": self._cost.summary(),
-                   "loop": self._loop_obs.summary()},
-            markers=markers)
+                   "loop": self._loop_obs.summary(),
+                   "capacity": self._capacity_summary()},
+            markers=markers,
+            budgets=self._slo_budget.budget_bars() or None)
 
     # ------------------------------------------------------- loop body
     def _loop(self):
@@ -2555,6 +2591,9 @@ class ContinuousBatchingEngine:
             self._sync_page_gauges()
         self._recompile_wd.sample()
         self._slo_wd.sample()
+        self._slo_budget.sample(
+            forced=self._chaos is not None
+            and self._chaos.burn_active())
         self._process_triggers(occupied, active)
         mfu_d, bw_d = self._cost.rates("decode")
         if mfu_d is not None:
@@ -3186,6 +3225,11 @@ class ContinuousBatchingEngine:
             # but their first token shipped long ago — observing a
             # second TTFT would double-count the request
             self._ins.ttft_seconds.observe(now - h.submitted_at)
+            # the histograms carry no priority label, so the budget
+            # ledger's per-class view is fed directly at the source
+            self._slo_budget.observe_class(
+                getattr(h, "priority", "normal") or "normal",
+                now - h.submitted_at)
             self._rec.record("request/first_token", h.request_id,
                              service=self.service_name, token=tok,
                              ttft_s=now - h.submitted_at)

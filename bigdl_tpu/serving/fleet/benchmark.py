@@ -117,6 +117,47 @@ def _fleet_replay(sup: ReplicaSupervisor, workload,
     return res
 
 
+def _capacity_stamp(cap: dict) -> dict:
+    """Compress ``fleet_capacity()`` into the bench-row block
+    ``perf_gate`` bands: fleet headroom/replicas-needed plus each
+    replica's role split (prefill vs decode device-wall fractions)."""
+    roles = {}
+    for rid, rc in (cap.get("replicas") or {}).items():
+        r = rc.get("roles") or {}
+        if r:
+            roles[rid] = {
+                "bound": r.get("bound"),
+                "prefill_fraction":
+                    (r.get("prefill") or {}).get("wall_fraction"),
+                "decode_fraction":
+                    (r.get("decode") or {}).get("wall_fraction"),
+                "disaggregation_speedup_bound":
+                    r.get("disaggregation_speedup_bound"),
+            }
+    return {
+        "ready": bool(cap.get("ready")),
+        "headroom": cap.get("headroom"),
+        "utilization": cap.get("utilization"),
+        "observed_rps": cap.get("observed_rps"),
+        "sustainable_rps": cap.get("sustainable_rps"),
+        "replicas_needed": cap.get("replicas_needed"),
+        "roles": roles or None,
+    }
+
+
+def _budget_stamp(budgets: dict) -> dict:
+    """Compress the per-replica SLO error-budget ledgers into the
+    bench-row block ``perf_gate`` floors: the fleet-worst remaining
+    fraction plus the per-replica minima."""
+    per = {rid: led.get("remaining_min")
+           for rid, led in budgets.items() if isinstance(led, dict)}
+    known = [v for v in per.values() if v is not None]
+    return {
+        "remaining_min": min(known) if known else None,
+        "per_replica": per or None,
+    }
+
+
 def _leg(workload, n_replicas, engine_cfg, seed, policy, chunk, log,
          label, drain_at: Optional[int] = None,
          rejoin_at: Optional[int] = None, victim: str = "r0") -> dict:
@@ -148,6 +189,12 @@ def _leg(workload, n_replicas, engine_cfg, seed, policy, chunk, log,
             sup, workload,
             on_submitted=trigger if drain_at is not None else None)
         stats = sup.stats()
+        # capacity + error-budget read must happen before the
+        # supervisor exits (workers are gone after teardown)
+        cap = sup.fleet_capacity()
+        budgets = cap.pop("slo_budget", None) or {}
+        res["capacity"] = _capacity_stamp(cap)
+        res["slo_budget"] = _budget_stamp(budgets)
         res["fleet"] = {
             "policy": policy,
             "replicas": n_replicas,
@@ -177,8 +224,12 @@ def run_fleet_comparison(n_replicas: int = 2, n_requests: int = 36,
     """The ``--serving --fleet N`` A/B. Returns the affinity and
     round-robin leg blocks (client TTFT / latency / inter-token
     percentiles, throughput, fleet hit rate, routing tallies), the
-    drain-drill block, the headline ratios, and the token-parity
-    verdict against a single-replica reference replay."""
+    drain-drill block, the headline ratios, the affinity leg's
+    capacity/what-if stamp (fleet headroom, replicas-needed, per-role
+    device-wall split) and SLO error-budget floor (worst
+    ``remaining_min`` across replicas — ``perf_gate`` gates calm runs
+    on it), and the token-parity verdict against a single-replica
+    reference replay."""
     if not 2 <= n_replicas <= 4:
         raise ValueError("the fleet bench runs 2-4 replicas")
     if n_templates is None:
@@ -211,7 +262,15 @@ def run_fleet_comparison(n_replicas: int = 2, n_requests: int = 36,
     share_rows = max(owned.values()) + 1
     engine_cfg = dict(max_slots=max_slots, prefill_chunk=prefill_chunk,
                       prefill_rows=prefill_rows,
-                      prefix_cache_rows=share_rows)
+                      prefix_cache_rows=share_rows,
+                      # generous TTFT objective: calm legs keep the
+                      # error budget ~full, so perf_gate can floor
+                      # detail.slo_budget.remaining_min; chaos drills
+                      # are what spend it
+                      slo_objectives=[dict(
+                          name="ttft", metric="ttft",
+                          threshold_s=5.0, target=0.9,
+                          window_s=60.0, min_count=3)])
 
     # single-replica reference on the same seed: the parity oracle for
     # every fleet leg (and the routing-never-changes-tokens contract)
@@ -259,6 +318,13 @@ def run_fleet_comparison(n_replicas: int = 2, n_requests: int = 36,
 
     for leg in (aff, rr):
         leg.pop("rows", None)  # ndarray-free JSON row
+    # the affinity leg is the headline: its capacity/what-if block and
+    # error-budget floor become the row's detail.capacity /
+    # detail.slo_budget (the control leg's copies add nothing)
+    capacity = aff.pop("capacity", None)
+    slo_budget = aff.pop("slo_budget", None)
+    rr.pop("capacity", None)
+    rr.pop("slo_budget", None)
 
     a50, r50 = aff["ttft"]["p50"], rr["ttft"]["p50"]
     ratios = {
@@ -273,6 +339,8 @@ def run_fleet_comparison(n_replicas: int = 2, n_requests: int = 36,
         "affinity": aff,
         "round_robin": rr,
         "drain": drain,
+        "capacity": capacity,
+        "slo_budget": slo_budget,
         **ratios,
         "token_parity": bool(aff_par and rr_par),
         "workload": {

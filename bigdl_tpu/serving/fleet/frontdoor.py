@@ -60,6 +60,17 @@ total. Two debug endpoints expose the merged view:
   ``incident_export`` RPC) stamped with ``replica=``, fleet-wide
   counts by kind, per-replica detector states, and the trace ids the
   exemplars reference — each resolvable in the merged fleet trace.
+- ``GET /debug/fleet/timeseries[?metric=&n=]`` — every replica's
+  sampler rings (the ``timeseries_export`` RPC) merged onto the
+  supervisor's clock-aligned timeline, keyed ``metric -> replica ->
+  ring``, with fleet-sum/mean derived series.
+- ``GET /debug/fleet/dashboard`` — one self-contained HTML page over
+  the merged timeline: per-metric rows with per-replica SVG
+  overlays, incident/drain markers, and SLO error-budget bars.
+- ``GET /debug/fleet/capacity[?offered=]`` — the fleet capacity /
+  what-if aggregate (sustainable rates, headroom, replicas-needed
+  for the observed or an explicit offered load) plus each replica's
+  error-budget ledger.
 """
 
 from __future__ import annotations
@@ -121,6 +132,15 @@ class FleetFrontDoor:
                 self.send_header("Content-Length", str(len(body)))
                 for k, v in (headers or {}).items():
                     self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_html(self, text: str, status: int = 200):
+                body = text.encode()
+                self.send_response(status)
+                self.send_header("Content-Type",
+                                 "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -393,6 +413,37 @@ class FleetFrontDoor:
                         n_raw = parse_qs(query).get("n", ["10"])[0]
                         self._send_json(
                             sup.fleet_incidents(int(n_raw)))
+                    except Exception as e:
+                        self._send_json({"error": str(e)}, 500)
+                elif path == "/debug/fleet/timeseries":
+                    # every replica's sampler rings merged onto the
+                    # supervisor's clock (points shifted by each
+                    # replica's ping-estimated offset)
+                    try:
+                        q = parse_qs(self.path.partition("?")[2])
+                        metric = q.get("metric", [None])[0]
+                        n_raw = q.get("n", [None])[0]
+                        n = int(n_raw) if n_raw is not None else None
+                        self._send_json(
+                            sup.fleet_timeseries(metric=metric, n=n))
+                    except Exception as e:
+                        self._send_json({"error": str(e)}, 500)
+                elif path == "/debug/fleet/dashboard":
+                    try:
+                        self._send_html(sup.fleet_dashboard())
+                    except Exception as e:
+                        self._send_html(
+                            "<!doctype html><html><body><pre>fleet "
+                            "dashboard error: %s</pre></body></html>"
+                            % str(e), status=500)
+                elif path == "/debug/fleet/capacity":
+                    try:
+                        q = parse_qs(self.path.partition("?")[2])
+                        offered = q.get("offered", [None])[0]
+                        self._send_json(sup.fleet_capacity(
+                            offered_rps=(float(offered)
+                                         if offered is not None
+                                         else None)))
                     except Exception as e:
                         self._send_json({"error": str(e)}, 500)
                 elif path == "/metrics":

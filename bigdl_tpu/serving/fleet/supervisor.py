@@ -41,6 +41,9 @@ from bigdl_tpu.observability.events import default_recorder
 from bigdl_tpu.observability.fleettrace import (
     merge_request_timelines,
 )
+from bigdl_tpu.observability.timeseries import (
+    merge_fleet_timeseries, render_fleet_dashboard,
+)
 from bigdl_tpu.serving.fleet.router import (
     NoLiveReplicas, PrefixAffinityRouter,
 )
@@ -114,6 +117,13 @@ class InProcessReplica:
         the worker RPC, so the supervisor's fleet merge treats both
         deployments identically."""
         return self.engine.debug_incidents(n)
+
+    def timeseries_export(self, metric: Optional[str] = None,
+                          n: Optional[int] = None) -> dict:
+        """The engine's ``debug_timeseries`` payload — same shape as
+        the worker RPC (an in-process replica shares the parent's
+        clock, so its offset is zero by construction)."""
+        return self.engine.debug_timeseries(metric=metric, n=n)
 
 
 class ReplicaSupervisor:
@@ -602,6 +612,144 @@ class ReplicaSupervisor:
                                "error": p.get("error")}
                          for rid, p in sorted(per.items())},
         }
+
+    def timeseries_exports(self, metric: Optional[str] = None,
+                           n: Optional[int] = None) -> List[dict]:
+        """Every replica's ``timeseries_export`` payload tagged with
+        its ping-estimated clock offset — the
+        ``merge_fleet_timeseries`` input (duck-typed, best-effort
+        like ``incident_exports``; a replica without the method or
+        with a dead pipe carries an ``error`` entry instead)."""
+        exports: List[dict] = []
+        with self._lock:
+            replicas = list(self._replicas.items())
+        for rid, rep in replicas:
+            export_fn = getattr(rep, "timeseries_export", None)
+            if export_fn is None:
+                continue
+            try:
+                payload = export_fn(metric=metric, n=n)
+            except Exception as e:
+                exports.append({"replica": rid, "error": repr(e)})
+                continue
+            exports.append({
+                "replica": rid,
+                "clock_offset_s": getattr(rep, "clock_offset_s",
+                                          None) or 0.0,
+                "clock_rtt_s": getattr(rep, "clock_rtt_s", None),
+                "export": payload,
+            })
+        return exports
+
+    def fleet_timeseries(self, metric: Optional[str] = None,
+                         n: Optional[int] = None) -> dict:
+        """The ``/debug/fleet/timeseries`` aggregate: every replica's
+        sampler rings merged onto the supervisor's clock (each
+        point shifted by that replica's measured offset), keyed
+        ``metric -> replica -> ring``, with fleet-sum/mean derived
+        series."""
+        return merge_fleet_timeseries(
+            self.timeseries_exports(metric=metric, n=n),
+            fleet=self.fleet_name)
+
+    def fleet_capacity(self, offered_rps: Optional[float] = None
+                       ) -> dict:
+        """The ``/debug/fleet/capacity`` aggregate: every replica's
+        ``stats()["capacity"]`` estimate folded into the fleet view
+        (summed sustainable rates, fleet headroom, replicas-needed
+        for the observed — or an explicit what-if — offered load),
+        exported as the ``bigdl_fleet_capacity_{headroom,
+        replicas_needed}`` gauges."""
+        from bigdl_tpu.observability.capacity import (
+            aggregate_fleet_capacity,
+        )
+
+        per: Dict[str, Optional[dict]] = {}
+        budgets: Dict[str, dict] = {}
+        with self._lock:
+            replicas = list(self._replicas.items())
+        for rid, rep in replicas:
+            try:
+                s = rep.stats()
+            except Exception:
+                per[rid] = None
+                continue
+            per[rid] = s.get("capacity")
+            if s.get("slo_budget"):
+                budgets[rid] = s["slo_budget"]
+        out = aggregate_fleet_capacity(per, offered_rps=offered_rps,
+                                       fleet=self.fleet_name)
+        out["slo_budget"] = budgets
+        if out.get("headroom") is not None:
+            self._ins.capacity_headroom.set(out["headroom"])
+        if out.get("replicas_needed") is not None:
+            self._ins.capacity_replicas_needed.set(
+                out["replicas_needed"])
+        return out
+
+    def fleet_markers(self, n: Optional[int] = None) -> List[dict]:
+        """Clock-aligned event markers for the fleet dashboard:
+        drain/rejoin events from the front-door recorder (offset 0 —
+        it IS the reference clock) plus every replica's captured
+        incidents shifted by that replica's offset."""
+        markers = []
+        for ev in self._rec.snapshot():
+            kind = ev.get("kind") or ""
+            if kind == "fleet/drain":
+                markers.append({"ts_s": ev.get("ts_s"),
+                                "kind": "drain",
+                                "label": "drain %s"
+                                % (ev.get("request_id") or "")})
+            elif kind == "fleet/rejoin":
+                markers.append({"ts_s": ev.get("ts_s"),
+                                "kind": "rejoin",
+                                "label": "rejoin %s"
+                                % (ev.get("request_id") or "")})
+        with self._lock:
+            replicas = list(self._replicas.items())
+        offsets = {rid: getattr(rep, "clock_offset_s", None) or 0.0
+                   for rid, rep in replicas}
+        fi = self.fleet_incidents(n)
+        for bundle in fi.get("incidents") or []:
+            ts = bundle.get("ts_s")
+            if ts is None:
+                continue
+            rid = bundle.get("replica")
+            markers.append({
+                "ts_s": ts + offsets.get(rid, 0.0),
+                "kind": "incident",
+                "label": "%s %s (%s)" % (rid, bundle.get("id"),
+                                         bundle.get("kind")),
+            })
+        markers.sort(key=lambda m: m.get("ts_s") or 0.0)
+        return markers
+
+    def fleet_dashboard(self) -> str:
+        """The ``/debug/fleet/dashboard`` page: one self-contained
+        HTML document over the merged fleet timeline — one row per
+        metric with per-replica overlays on the shared clock,
+        incident/drain markers, per-replica SLO budget bars, and the
+        fleet capacity block."""
+        cap = self.fleet_capacity()
+        budgets = []
+        for rid, ledger in sorted((cap.get("slo_budget") or {}
+                                   ).items()):
+            for obj in ledger.get("objectives") or []:
+                budgets.append({
+                    "replica": rid,
+                    "objective": obj.get("objective"),
+                    "budget_remaining": obj.get("budget_remaining"),
+                    "exhaustion_eta_s": obj.get("exhaustion_eta_s"),
+                })
+        return render_fleet_dashboard(
+            self.fleet_timeseries(),
+            title=self.fleet_name,
+            extra={"capacity": {k: v for k, v in cap.items()
+                                if k not in ("replicas",
+                                             "slo_budget")},
+                   "routing": self.router.snapshot()},
+            markers=self.fleet_markers(),
+            budgets=budgets or None)
 
     # ------------------------------------------------------ aggregates
     def loads(self) -> Dict[str, float]:

@@ -183,7 +183,8 @@ def _worker_main(conn, cfg: dict) -> None:
                   "payload": {"mono": time.monotonic(),
                               "wall": time.time()}})
         elif op in ("healthz", "stats", "trace_export",
-                    "metrics_export", "incident_export"):
+                    "metrics_export", "incident_export",
+                    "timeseries_export"):
             try:
                 if op == "healthz":
                     payload = eng.healthz()
@@ -199,6 +200,11 @@ def _worker_main(conn, cfg: dict) -> None:
                     }
                 elif op == "incident_export":
                     payload = eng.debug_incidents(msg.get("n"))
+                elif op == "timeseries_export":
+                    # raw monotonic ts — the PARENT shifts them by
+                    # its ping-estimated clock offset when merging
+                    payload = eng.debug_timeseries(
+                        metric=msg.get("metric"), n=msg.get("n"))
                 else:
                     payload = registry_snapshot(default_registry())
                 send({"ev": "reply", "seq": msg["seq"],
@@ -569,6 +575,16 @@ class WorkerReplica:
         merges these into ``/debug/fleet/incidents``."""
         return self._call("incident_export",
                           timeout=3 * self.rpc_timeout, n=n)
+
+    def timeseries_export(self, metric: Optional[str] = None,
+                          n: Optional[int] = None) -> dict:
+        """The worker engine's ``debug_timeseries`` payload (the
+        sampler's bounded rings, raw monotonic ``ts``) — the
+        supervisor shifts each point by ``clock_offset_s`` when
+        merging into ``/debug/fleet/timeseries``."""
+        return self._call("timeseries_export",
+                          timeout=3 * self.rpc_timeout,
+                          metric=metric, n=n)
 
     @property
     def postmortem_path(self) -> Optional[str]:

@@ -1,0 +1,230 @@
+#!/usr/bin/env python
+"""Offline renderer for saved fleet-telemetry dumps.
+
+Offline counterpart of the front door's ``GET /debug/fleet/
+timeseries`` + ``GET /debug/fleet/capacity``: save either payload to
+a file during (or after) an incident, copy it anywhere, and render it
+for a human — per-metric per-replica summaries over the clock-aligned
+timeline, the fleet capacity block, and the SLO error-budget table.
+Post-incident analysis works where jax isn't importable.
+
+Usage:
+    curl $DOOR/debug/fleet/timeseries > ts.json
+    curl $DOOR/debug/fleet/capacity > cap.json
+    python scripts/fleet_report.py ts.json --capacity cap.json
+    python scripts/fleet_report.py exports.json   # raw per-replica
+                                                  # exports: merged
+                                                  # offline first
+
+A raw exports file (the ``timeseries_exports()`` list, one
+``{"replica", "clock_offset_s", "export"}`` entry per replica) is
+merged offline through the same ``merge_fleet_timeseries`` core the
+live endpoint serves — loaded straight from the sibling source tree
+when ``bigdl_tpu`` (and its jax dependency) is not importable, the
+``trace_merge.py`` pattern.
+
+Stdlib-only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _load_timeseries_mod():
+    """Import the merge core — via the package when available, else
+    straight from source files so the CLI runs without jax."""
+    try:
+        from bigdl_tpu.observability import timeseries
+        return timeseries
+    except ImportError:
+        import importlib.util
+        import pathlib
+        import types
+
+        root = pathlib.Path(__file__).resolve().parent.parent
+        for pkg in ("bigdl_tpu", "bigdl_tpu.observability"):
+            if pkg not in sys.modules:
+                sys.modules[pkg] = types.ModuleType(pkg)
+        full = "bigdl_tpu.observability.timeseries"
+        spec = importlib.util.spec_from_file_location(
+            full, root / "bigdl_tpu" / "observability"
+            / "timeseries.py")
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[full] = mod
+        spec.loader.exec_module(mod)
+        return mod
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        if v != 0 and (abs(v) >= 1e5 or abs(v) < 1e-3):
+            return "%.3e" % v
+        return "%.4g" % v
+    return str(v)
+
+
+def _series_summary(points) -> dict:
+    vals = [p[1] for p in points if p[1] is not None]
+    if not vals:
+        return {"n": 0}
+    return {"n": len(vals), "last": vals[-1], "min": min(vals),
+            "max": max(vals),
+            "mean": sum(vals) / len(vals),
+            "span_s": (points[-1][0] - points[0][0]
+                       if len(points) > 1 else 0.0)}
+
+
+def render_timeseries(merged: dict) -> str:
+    """Per-metric per-replica summary table over the merged dump."""
+    out = []
+    replicas = merged.get("replicas") or []
+    out.append("fleet %r: %d replica(s) %s, interval %ss"
+               % (merged.get("fleet", "?"), len(replicas),
+                  replicas, merged.get("interval_s", "?")))
+    for rid, off in sorted((merged.get("clock") or {}).items()):
+        out.append("  clock %s: offset %+.6fs applied" % (rid, off))
+    for rid, err in sorted((merged.get("errors") or {}).items()):
+        out.append("  ERROR %s: %s" % (rid, err))
+    hdr = ("  %-22s %-12s %6s %10s %10s %10s %10s %8s"
+           % ("metric", "replica", "n", "last", "min", "max",
+              "mean", "span"))
+    out.append("")
+    out.append(hdr)
+    out.append("  " + "-" * (len(hdr) - 2))
+    for name in sorted(merged.get("metrics") or {}):
+        slot = merged["metrics"][name]
+        rows = [(rid, (slot.get("replicas") or {}).get(rid))
+                for rid in replicas]
+        rows.append(("fleet-mean",
+                     {"points": (slot.get("fleet") or {}
+                                 ).get("mean") or []}))
+        for rid, series in rows:
+            if not series:
+                continue
+            s = _series_summary(series.get("points") or [])
+            if not s["n"]:
+                out.append("  %-22s %-12s %6d" % (name, rid, 0))
+                continue
+            out.append("  %-22s %-12s %6d %10s %10s %10s %10s %7.1fs"
+                       % (name, rid, s["n"], _fmt(s["last"]),
+                          _fmt(s["min"]), _fmt(s["max"]),
+                          _fmt(s["mean"]), s["span_s"]))
+    return "\n".join(out) + "\n"
+
+
+def render_capacity(cap: dict) -> str:
+    """The fleet capacity block + the per-replica error-budget
+    table from a saved ``/debug/fleet/capacity`` payload."""
+    out = ["", "capacity (fleet %r):" % cap.get("fleet", "?")]
+    if not cap.get("ready"):
+        out.append("  not ready: no replica has measured traffic yet")
+    else:
+        out.append("  observed %.3f req/s of %.3f req/s sustainable "
+                   "(headroom %s)"
+                   % (cap.get("observed_rps") or 0.0,
+                      cap.get("sustainable_rps") or 0.0,
+                      _fmt(cap.get("headroom"))))
+        out.append("  replicas needed at offered %.3f req/s: %s "
+                   "(per-replica sustainable %s req/s, %s tok/s "
+                   "fleet-wide)"
+                   % (cap.get("offered_rps") or 0.0,
+                      cap.get("replicas_needed"),
+                      _fmt(cap.get("sustainable_rps_per_replica")),
+                      _fmt(cap.get("sustainable_tokens_per_s"))))
+    for rid, rc in sorted((cap.get("replicas") or {}).items()):
+        if not rc.get("ready"):
+            out.append("  %s: not ready (%s)"
+                       % (rid, rc.get("reason", "?")))
+            continue
+        roles = rc.get("roles") or {}
+        role_txt = ""
+        if roles:
+            role_txt = (" — %s-bound (prefill %s / decode %s of "
+                        "device wall, disagg x%s)"
+                        % (roles.get("bound", "?"),
+                           _fmt((roles.get("prefill") or {}
+                                 ).get("wall_fraction")),
+                           _fmt((roles.get("decode") or {}
+                                 ).get("wall_fraction")),
+                           _fmt(roles.get(
+                               "disaggregation_speedup_bound"))))
+        out.append("  %s: %s req/s sustainable, headroom %s%s"
+                   % (rid, _fmt(rc.get("sustainable_rps")),
+                      _fmt(rc.get("headroom")), role_txt))
+    budgets = cap.get("slo_budget") or {}
+    if budgets:
+        out.append("")
+        hdr = ("  %-10s %-14s %8s %10s %10s %10s %10s"
+               % ("replica", "objective", "target", "remaining",
+                  "fast-burn", "slow-burn", "eta"))
+        out.append("error budgets:")
+        out.append(hdr)
+        out.append("  " + "-" * (len(hdr) - 2))
+        for rid, ledger in sorted(budgets.items()):
+            for obj in ledger.get("objectives") or []:
+                wins = obj.get("windows") or {}
+                eta = obj.get("exhaustion_eta_s")
+                out.append(
+                    "  %-10s %-14s %7.1f%% %9.1f%% %10s %10s %10s%s"
+                    % (rid, obj.get("objective", "?"),
+                       100 * (obj.get("target") or 0.0),
+                       100 * (obj.get("budget_remaining") or 0.0),
+                       _fmt((wins.get("fast") or {}).get("burn_rate")),
+                       _fmt((wins.get("slow") or {}).get("burn_rate")),
+                       ("%.0fs" % eta) if eta is not None else "-",
+                       "  EXHAUSTED" if obj.get("exhausted") else ""))
+            for cls, led in sorted((ledger.get("classes") or {}
+                                    ).items()):
+                out.append(
+                    "  %-10s %-14s %8s %9.1f%% (%d obs, %d bad)"
+                    % (rid, "class:" + cls, "-",
+                       100 * (led.get("budget_remaining") or 0.0),
+                       led.get("observations") or 0,
+                       led.get("bad") or 0))
+    return "\n".join(out) + "\n"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Render saved fleet timeseries/capacity dumps")
+    p.add_argument("timeseries",
+                   help="saved /debug/fleet/timeseries payload, or a "
+                        "raw per-replica exports list (merged "
+                        "offline)")
+    p.add_argument("--capacity", default=None,
+                   help="saved /debug/fleet/capacity payload: adds "
+                        "the capacity block + error-budget table")
+    args = p.parse_args(argv)
+    try:
+        with open(args.timeseries) as f:
+            payload = json.load(f)
+    except (OSError, ValueError) as e:
+        print("cannot read %r: %s" % (args.timeseries, e),
+              file=sys.stderr)
+        return 1
+    if isinstance(payload, list) or "metrics" not in payload:
+        # raw exports: merge offline with the live endpoint's core
+        exports = payload if isinstance(payload, list) \
+            else payload.get("exports") or []
+        payload = _load_timeseries_mod().merge_fleet_timeseries(
+            exports)
+    sys.stdout.write(render_timeseries(payload))
+    if args.capacity:
+        try:
+            with open(args.capacity) as f:
+                cap = json.load(f)
+        except (OSError, ValueError) as e:
+            print("cannot read %r: %s" % (args.capacity, e),
+                  file=sys.stderr)
+            return 1
+        sys.stdout.write(render_capacity(cap))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

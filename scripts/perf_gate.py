@@ -36,7 +36,12 @@ mean per-request ``rpc_submit`` hop from the fleet-tracing
 decomposition — the pipe-RPC overhead must not creep — and the
 affinity-vs-round-robin TTFT p50 speedup as an absolute floor: the
 speedup is itself a within-run A/B ratio, so it must stay >= 1.0
-rather than within a band of the previous row's value), and
+rather than within a band of the previous row's value. Fleet rows
+also carry the telemetry-plane stamps: ``detail.capacity.headroom``
+bands run-to-run — the capacity model's sustainable-rate estimate
+must not silently collapse — and ``detail.slo_budget.remaining_min``
+floors absolutely at 0.5: a calm storm that spends half its SLO
+error budget has a latency tail, not noise), and
 ``bench.py --serving --quantized`` (``detail.quantized.*`` — the
 int8-KV/int8-weight engine's latencies gate run-to-run like any
 other leg; the fp leg rides along as ``detail.fp_baseline`` under a
@@ -219,6 +224,41 @@ def fleet_rpc_submit_mean(row: dict):
             ).get("hops") or {}
     v = hops.get("rpc_submit")
     return float(v) if v is not None else None
+
+
+def fleet_capacity_headroom(row: dict):
+    """The fleet A/B row's capacity-model headroom (1 - observed/
+    sustainable request rate on the affinity leg, fleet-wide), or
+    None for every other row shape and for rows predating the
+    ``detail.capacity`` stamp. Banded run-to-run, higher is better:
+    the same calm storm on the same hardware must keep the same
+    slack — a collapsing headroom means the sustainable-rate estimate
+    (device-seconds + host-seconds per request) regressed."""
+    cap = (row.get("detail") or {}).get("capacity")
+    if not isinstance(cap, dict) or not cap.get("ready"):
+        return None
+    hr = cap.get("headroom")
+    return float(hr) if hr is not None else None
+
+
+#: a calm fleet storm must keep at least half its SLO error budget —
+#: below this, the latency tail is real, not sampling noise
+_FLEET_BUDGET_REMAINING_FLOOR = 0.5
+
+
+def fleet_budget_remaining(row: dict):
+    """The fleet A/B row's worst per-replica SLO error-budget
+    remaining fraction (``detail.slo_budget.remaining_min`` — the
+    affinity leg's generous-threshold TTFT objective), or None for
+    every other row shape and for rows predating the field. Gated as
+    an absolute floor, not run-to-run: remaining is already a
+    normalized fraction of the budget window, and a calm storm should
+    sit near 1.0."""
+    sb = (row.get("detail") or {}).get("slo_budget")
+    if not isinstance(sb, dict):
+        return None
+    rm = sb.get("remaining_min")
+    return float(rm) if rm is not None else None
 
 
 def quantized_logit_div_rel(row: dict):
@@ -479,6 +519,11 @@ def main(argv=None) -> int:
         # the per-hop stamp: the pipe-RPC submit cost must not creep
         ("fleet rpc_submit mean", fleet_rpc_submit_mean, 1e3, "ms",
          False),
+        # the capacity-model stamp: the calm storm's fleet headroom
+        # must not collapse run-to-run (a shrinking sustainable-rate
+        # estimate is a capacity-model regression, not load)
+        ("fleet capacity headroom", fleet_capacity_headroom, 100.0,
+         "%", True),
     )
     for label, reader, scale, unit, higher_better in measures:
         new_v, old_v = reader(newest), reader(prev)
@@ -518,6 +563,21 @@ def main(argv=None) -> int:
             failed = True
         else:
             print(f"[perf-gate] ok: {verdict} clears the 1.0x floor")
+    # fleet A/B rows: the SLO error budget is a normalized fraction
+    # with its own meaningful scale (1.0 = untouched), so the calm
+    # storm gates as an absolute floor rather than run-to-run
+    br = fleet_budget_remaining(newest)
+    if br is not None:
+        verdict = (f"fleet SLO budget remaining {br:.3f} for "
+                   f"{newest.get('metric')} {span}")
+        if br < _FLEET_BUDGET_REMAINING_FLOOR:
+            print(f"[perf-gate] FAIL: {verdict} — the calm storm "
+                  f"spent past the {_FLEET_BUDGET_REMAINING_FLOOR} "
+                  "floor; the TTFT tail breaches the objective")
+            failed = True
+        else:
+            print(f"[perf-gate] ok: {verdict} clears the "
+                  f"{_FLEET_BUDGET_REMAINING_FLOOR} floor")
     # QoS storm rows: the p50 ratio is a within-run A/B with its own
     # meaningful scale, so it gates as an absolute ceiling; the
     # mechanism counts and conservation verdict are deterministic

@@ -8,10 +8,16 @@ phase-attributed slow-request exemplars, the windowed flight-recorder
 event slice, the memory/stats blocks, the surrounding trigger
 history, and the engine config digest.
 
+Both ring shapes render: a single bundle file, AND the fleet-merged
+payload saved from ``GET /debug/fleet/incidents`` (replica-stamped
+bundles, fleet-wide counts by kind, per-replica detector states and
+fetch errors) — one CLI covers the engine ring and the fleet ring.
+
 Usage:
     python scripts/show_incident.py incident-inc-000001.json
     python scripts/show_incident.py --events 50 --no-stats inc.json
     python scripts/show_incident.py /var/incidents   # newest in dir
+    python scripts/show_incident.py fleet_incidents.json  # fleet dump
 
 Stdlib-only — runs anywhere the JSON file can be copied to, no jax or
 bigdl_tpu import required.
@@ -128,6 +134,68 @@ def render(inc: dict, events: int = 30, show_stats: bool = True) -> str:
     return "\n".join(out) + "\n"
 
 
+def is_fleet_payload(payload: dict) -> bool:
+    """The ``/debug/fleet/incidents`` merge (or the engine's
+    ``/debug/incidents`` ring) rather than one bundle: an
+    ``incidents`` LIST plus merge-level tallies."""
+    return isinstance(payload.get("incidents"), list) \
+        and ("by_kind" in payload or "replicas" in payload)
+
+
+def render_fleet(payload: dict, events: int = 30,
+                 show_stats: bool = True) -> str:
+    """Render the fleet-merged (or engine-ring) incidents payload:
+    the fleet summary, per-replica detector states and fetch errors,
+    then every replica-stamped bundle through the single-bundle
+    renderer."""
+    out = []
+    name = payload.get("fleet") or payload.get("service") or "?"
+    by_kind = payload.get("by_kind") or {}
+    out.append(f"{name}: {payload.get('count', 0)} incident(s)"
+               + (" — " + ", ".join(f"{k}={v}" for k, v in
+                                    sorted(by_kind.items()))
+                  if by_kind else ""))
+
+    reps = payload.get("replicas") or {}
+    if reps:
+        out.append(_hdr(f"replicas ({len(reps)})"))
+        for rid, st in sorted(reps.items()):
+            if isinstance(st, dict):
+                err = st.get("error")
+                out.append(f"  {rid}: {st.get('count', 0)} bundle(s)"
+                           + (f"  FETCH ERROR: {err}" if err else ""))
+            else:
+                out.append(f"  {rid}: {st}")
+
+    dets = payload.get("detectors") or {}
+    if dets:
+        out.append(_hdr("detector states"))
+        # fleet shape nests {replica: {detector: state}}; the
+        # engine's own ring is flat {detector: state}
+        nested = all(isinstance(v, dict) for v in dets.values())
+        items = ([(f"{rid}/{d}", st)
+                  for rid, per in sorted(dets.items())
+                  for d, st in sorted((per or {}).items())]
+                 if nested else sorted(dets.items()))
+        for key, st in items:
+            marker = " <-- " if str(st) not in ("ok", "warmup") else ""
+            out.append(f"  {key:<40} {st}{marker}")
+
+    tids = payload.get("trace_ids") or []
+    if tids:
+        out.append(_hdr(f"referenced trace ids ({len(tids)})"))
+        for tid in tids[:12]:
+            out.append(f"  {tid}")
+
+    for bundle in payload.get("incidents") or []:
+        rid = bundle.get("replica")
+        out.append("\n" + "#" * 66)
+        out.append(f"## replica {rid}" if rid else "##")
+        out.append(render(bundle, events=events,
+                          show_stats=show_stats).rstrip("\n"))
+    return "\n".join(out) + "\n"
+
+
 def _resolve(path: str) -> str:
     """A directory means "the newest bundle in the on-disk ring"."""
     if not os.path.isdir(path):
@@ -143,8 +211,10 @@ def _resolve(path: str) -> str:
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="Pretty-print a bigdl_tpu incident bundle JSON")
-    p.add_argument("path", help="bundle file (incident-inc-*.json) or "
-                                "an incident directory (newest bundle)")
+    p.add_argument("path", help="bundle file (incident-inc-*.json), "
+                                "an incident directory (newest "
+                                "bundle), or a saved /debug/fleet/"
+                                "incidents payload")
     p.add_argument("--events", type=int, default=30,
                    help="how many trailing events to show (default 30)")
     p.add_argument("--no-stats", action="store_true",
@@ -157,8 +227,9 @@ def main(argv=None) -> int:
         print(f"cannot read incident {args.path!r}: {e}",
               file=sys.stderr)
         return 1
-    sys.stdout.write(render(inc, events=args.events,
-                            show_stats=not args.no_stats))
+    renderer = render_fleet if is_fleet_payload(inc) else render
+    sys.stdout.write(renderer(inc, events=args.events,
+                              show_stats=not args.no_stats))
     return 0
 
 

@@ -1,0 +1,346 @@
+"""Fleet telemetry plane: clock-aligned time-series merge, SLO error
+budgets, and the capacity/what-if model.
+
+The contracts under test: a rate source whose counter resets (worker
+respawn behind the same name) re-primes its baseline and never emits a
+negative-rate point — locally AND through the fleet merge; a reader
+that raises is counted in ``source_errors``, not propagated;
+``merge_fleet_timeseries`` shifts each replica's points by its
+measured clock offset onto one monotonic timeline and derives
+fleet-sum/mean series; ``SloBudgetTracker`` exhausts under a forced
+chaos burn and recovers once the spend ages out of the budget window;
+``estimate_capacity``/``aggregate_fleet_capacity`` answer the what-if
+in the right direction (double the offered load, the replicas-needed
+estimate never shrinks); and the front door serves
+``/debug/fleet/timeseries`` + ``/debug/fleet/capacity`` +
+``/debug/fleet/dashboard`` schema-stable over a hermetic in-process
+fleet."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import observability as obs
+from bigdl_tpu.observability import MetricRegistry
+from bigdl_tpu.observability.capacity import (
+    aggregate_fleet_capacity, estimate_capacity, replicas_needed,
+)
+from bigdl_tpu.observability.slo_budget import SloBudgetTracker
+from bigdl_tpu.observability.timeseries import (
+    TimeSeriesSampler, merge_fleet_timeseries, render_fleet_dashboard,
+)
+from bigdl_tpu.observability.watchdog import SloObjective
+from bigdl_tpu.serving import ContinuousBatchingEngine
+from bigdl_tpu.serving.fleet import (
+    FleetFrontDoor, InProcessReplica, ReplicaSupervisor,
+)
+
+VOCAB = 32
+
+
+@pytest.fixture()
+def reg():
+    r = MetricRegistry()
+    prev = obs.set_default_registry(r)
+    try:
+        yield r
+    finally:
+        obs.set_default_registry(prev)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils import random as rnd
+
+    rnd.set_seed(23)
+    m = TransformerLM(VOCAB, embed_dim=16, num_heads=4, num_kv_heads=2,
+                      num_layers=2, max_len=48, use_rope=True)
+    m.evaluate()
+    return m
+
+
+# ------------------------------------------------------ sampler guards
+def test_counter_reset_reprimes_and_never_goes_negative(reg):
+    s = TimeSeriesSampler(interval_s=1.0, registry=reg)
+    vals = iter([0.0, 10.0, 3.0, 8.0])  # 10 -> 3 is a reset
+    s.add_source("reqs_rate", lambda: next(vals), rate=True)
+    for t in (1.0, 2.0, 3.0, 4.0):
+        s.sample(now=t)
+    pts = s.snapshot()["metrics"]["reqs_rate"]["points"]
+    # first sample primes, the reset drops, the post-reset baseline
+    # re-primes so 3 -> 8 yields 5.0/s
+    assert pts == [[2.0, 10.0], [4.0, 5.0]]
+    assert s.counter_resets == 1
+    assert all(v >= 0.0 for _, v in pts)
+
+
+def test_counter_reset_no_negative_rate_fleet_side(reg):
+    s = TimeSeriesSampler(interval_s=1.0, registry=reg)
+    vals = iter([0.0, 10.0, 3.0, 8.0])
+    s.add_source("reqs_rate", lambda: next(vals), rate=True)
+    for t in (1.0, 2.0, 3.0, 4.0):
+        s.sample(now=t)
+    merged = merge_fleet_timeseries(
+        [{"replica": "r0", "clock_offset_s": 0.25,
+          "export": s.snapshot()}])
+    rep = merged["metrics"]["reqs_rate"]["replicas"]["r0"]
+    assert all(v >= 0.0 for _, v in rep["points"])
+    for series in merged["metrics"]["reqs_rate"]["fleet"].values():
+        assert all(v >= 0.0 for _, v in series)
+
+
+def test_broken_source_counted_not_propagated(reg):
+    s = TimeSeriesSampler(interval_s=1.0, registry=reg)
+
+    def boom():
+        raise RuntimeError("torn getter")
+
+    s.add_source("bad", boom).add_source("good", lambda: 1.0)
+    for t in (1.0, 2.0):
+        s.sample(now=t)  # must not raise
+    assert s.source_errors == 2
+    assert len(s.snapshot()["metrics"]["good"]["points"]) == 2
+    assert s.snapshot()["metrics"]["bad"]["points"] == []
+
+
+# ------------------------------------------------------- fleet merge
+def _export(points):
+    return {"interval_s": 1.0,
+            "metrics": {"queue_depth": {
+                "points": points,
+                "last": points[-1][1] if points else None}}}
+
+
+def test_merge_applies_clock_offsets_monotonic():
+    # r1's clock runs 0.5s behind the supervisor's: its raw stamps sit
+    # in the past and the offset shifts them forward onto the common
+    # timeline
+    merged = merge_fleet_timeseries([
+        {"replica": "r0", "clock_offset_s": 0.0,
+         "export": _export([[10.0, 2.0], [11.0, 4.0]])},
+        {"replica": "r1", "clock_offset_s": 0.5,
+         "export": _export([[9.5, 6.0], [10.5, 8.0]])},
+        {"replica": "r2", "error": "WorkerRPCTimeout('stats')"},
+    ])
+    assert merged["replicas"] == ["r0", "r1"]
+    assert "r2" in merged["errors"]
+    assert merged["clock"] == {"r0": 0.0, "r1": 0.5}
+    reps = merged["metrics"]["queue_depth"]["replicas"]
+    assert reps["r1"]["points"] == [[10.0, 6.0], [11.0, 8.0]]
+    for rid in ("r0", "r1"):
+        ts = [t for t, _ in reps[rid]["points"]]
+        assert ts == sorted(ts)
+    # aligned stamps land in shared bins: sum and mean are derived
+    fleet = merged["metrics"]["queue_depth"]["fleet"]
+    assert [v for _, v in fleet["sum"]] == [8.0, 12.0]
+    assert [v for _, v in fleet["mean"]] == [4.0, 6.0]
+
+
+def test_fleet_dashboard_renders_every_replica():
+    merged = merge_fleet_timeseries([
+        {"replica": "r0", "clock_offset_s": 0.0,
+         "export": _export([[10.0, 2.0], [11.0, 4.0]])},
+        {"replica": "r1", "clock_offset_s": 0.5,
+         "export": _export([[9.5, 6.0], [10.5, 8.0]])},
+    ])
+    html = render_fleet_dashboard(
+        merged, markers=[{"ts": 10.5, "kind": "drain", "label": "r1"}],
+        budgets=[{"objective": "ttft", "budget_remaining": 0.8}])
+    assert "<svg" in html and "queue_depth" in html
+    assert "r0" in html and "r1" in html
+    assert "SLO error budgets" in html
+
+
+# -------------------------------------------------------- slo budget
+def test_slo_budget_exhausts_under_forced_burn_and_recovers(reg):
+    hist = reg.histogram("t_ttft_seconds", "t",
+                         buckets=(0.01, 0.1, 1.0))
+    tr = SloBudgetTracker(service="t", budget_window_s=120.0,
+                          forced_burn_rate=12.0, registry=reg)
+    tr.watch(SloObjective("ttft", threshold_s=0.1, target=0.9,
+                          window_s=30.0, min_count=5, metric="ttft"),
+             hist._only())
+    t = 1000.0
+    tr.sample(now=t)
+    for _ in range(20):
+        hist.observe(0.02)  # calm: everything under threshold
+    tr.sample(now=t + 10)
+    st = tr.state()
+    assert st["objectives"][0]["budget_remaining"] == pytest.approx(1.0)
+    assert st["remaining_min"] == pytest.approx(1.0)
+    # forced chaos burn: spends budget_window/forced_burn_rate worth
+    # of budget per wall second -> exhausted well within 20 samples
+    for i in range(20):
+        hist.observe(0.02)
+        tr.sample(now=t + 11 + i, forced=True)
+    st = tr.state()
+    assert st["forced_burn_active"] is True
+    ob = st["objectives"][0]
+    assert ob["exhausted"] and ob["budget_remaining"] == 0.0
+    assert ob["windows"]["fast"]["burn_rate"] >= 12.0
+    assert reg.get("bigdl_slo_budget_remaining").labels(
+        "ttft", "t").get() == 0.0
+    # the synthetic spend ages out of the 120s budget window under
+    # calm traffic: the budget recovers without a reset
+    for i in range(10):
+        hist.observe(0.02)
+        tr.sample(now=t + 40 + (i + 1) * 30.0)
+    st = tr.state()
+    assert st["forced_burn_active"] is False
+    assert st["objectives"][0]["budget_remaining"] == pytest.approx(1.0)
+    assert st["objectives"][0]["exhausted"] is False
+
+
+def test_slo_budget_per_class_ledger(reg):
+    hist = reg.histogram("t2_ttft_seconds", "t2",
+                         buckets=(0.01, 0.1, 1.0))
+    tr = SloBudgetTracker(service="t2", budget_window_s=120.0,
+                          registry=reg)
+    tr.watch(SloObjective("ttft", threshold_s=0.1, target=0.9,
+                          window_s=30.0, min_count=5, metric="ttft"),
+             hist._only())
+    t = 2000.0
+    tr.sample(now=t)
+    for _ in range(20):
+        tr.observe_class("high", 0.02)   # all good
+        tr.observe_class("low", 0.5)     # all bad
+    tr.sample(now=t + 10)
+    cls = tr.state()["classes"]
+    assert cls["high"]["budget_remaining"] == pytest.approx(1.0)
+    assert cls["low"]["budget_remaining"] == 0.0
+    assert cls["low"]["bad"] == 20
+
+
+# ---------------------------------------------------------- capacity
+def _summaries(requests=20, wall_s=10.0, device_s=4.0, host_s=1.0):
+    loop = {"wall_s": wall_s, "device_busy_s": device_s,
+            "phases": {"sweep": host_s + device_s}}
+    cost = {"kinds": {
+        "prefill": {"wall_s": 3.0, "roofline": "compute-bound",
+                    "mfu": 0.4, "membw_util": 0.2},
+        "decode": {"wall_s": 1.0, "roofline": "memory-bound",
+                   "mfu": 0.05, "membw_util": 0.6}}}
+    usage = {"totals": {"requests": requests, "device_s": device_s,
+                        "prefill_tokens": 400, "decode_tokens": 100}}
+    return loop, cost, usage
+
+
+def test_estimate_capacity_prices_device_and_host_seconds():
+    loop, cost, usage = _summaries()
+    cap = estimate_capacity(loop, cost, usage, max_slots=4,
+                            service="t")
+    assert cap["ready"]
+    # 4s device + 1s non-overlapped host over 20 requests = 0.25s/req
+    assert cap["sustainable_rps"] == pytest.approx(4.0)
+    assert cap["observed_rps"] == pytest.approx(2.0)
+    assert cap["utilization"] == pytest.approx(0.5)
+    assert cap["headroom"] == pytest.approx(0.5)
+    assert cap["roles"]["bound"] == "prefill"
+    assert cap["roles"]["prefill"]["wall_fraction"] == \
+        pytest.approx(0.75)
+    # serializing 75% of device wall bounds disaggregation at 1/0.75
+    assert cap["roles"]["disaggregation_speedup_bound"] == \
+        pytest.approx(1.333, abs=1e-3)
+
+
+def test_capacity_not_ready_before_traffic():
+    cap = estimate_capacity({}, {}, {}, service="t")
+    assert cap["ready"] is False and "reason" in cap
+
+
+def test_replicas_needed_moves_with_offered_load():
+    loop, cost, usage = _summaries()
+    per = {"r0": estimate_capacity(loop, cost, usage),
+           "r1": estimate_capacity(loop, cost, usage)}
+    fleet = aggregate_fleet_capacity(per)
+    assert fleet["ready"] and fleet["replicas_ready"] == ["r0", "r1"]
+    assert fleet["sustainable_rps"] == pytest.approx(8.0)
+    base = fleet["replicas_needed"]
+    doubled = aggregate_fleet_capacity(
+        per, offered_rps=2 * fleet["observed_rps"])
+    assert doubled["replicas_needed"] >= base
+    # the what-if helper agrees with the aggregate
+    assert replicas_needed(fleet, 9.0) == 3
+    assert replicas_needed(fleet, 0.5) == 1
+
+
+def test_aggregate_skips_unready_replicas():
+    loop, cost, usage = _summaries()
+    fleet = aggregate_fleet_capacity(
+        {"r0": estimate_capacity(loop, cost, usage),
+         "r1": estimate_capacity({}, {}, {}),
+         "r2": None})
+    assert fleet["replicas_ready"] == ["r0"]
+    assert fleet["replicas"]["r1"]["ready"] is False
+    assert fleet["replicas"]["r2"]["ready"] is False
+    assert fleet["sustainable_rps"] == pytest.approx(4.0)
+
+
+# ------------------------------------------- hermetic fleet over HTTP
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=30) as r:
+        ctype = r.headers.get("Content-Type", "")
+        body = r.read().decode()
+    return ctype, body
+
+
+def test_front_door_serves_timeseries_capacity_dashboard(lm):
+    reps = [InProcessReplica(
+        f"r{i}", ContinuousBatchingEngine(
+            lm, max_slots=2, prefill_chunk=4,
+            timeseries_interval_s=0.05,
+            slo_objectives=[dict(name="ttft", metric="ttft",
+                                 threshold_s=5.0, target=0.9,
+                                 window_s=30.0, min_count=3)]))
+        for i in range(2)]
+    sup = ReplicaSupervisor(reps, chunk=4, poll_interval=0.05,
+                            registry=MetricRegistry())
+    r = np.random.RandomState(5)
+    with sup, FleetFrontDoor(sup) as door:
+        base = f"http://127.0.0.1:{door.port}"
+        routed = [sup.submit(r.randint(0, VOCAB, (6,)), 6)
+                  for _ in range(6)]
+        for rt in routed:
+            rt.handle.result(timeout=60)
+        time.sleep(0.3)  # a few sampler ticks past the last finish
+
+        ctype, body = _get(base, "/debug/fleet/timeseries")
+        assert ctype.startswith("application/json")
+        ts = json.loads(body)
+        assert sorted(ts["replicas"]) == ["r0", "r1"]
+        assert ts["errors"] == {}
+        assert set(ts["clock"]) == {"r0", "r1"}
+        assert ts["metrics"], "no sampler rings shipped"
+        for slot in ts["metrics"].values():
+            assert set(slot) == {"replicas", "fleet"}
+            for rep in slot["replicas"].values():
+                stamps = [t for t, _ in rep["points"]]
+                assert stamps == sorted(stamps)
+        # the metric filter narrows without changing the schema
+        one = json.loads(_get(
+            base, "/debug/fleet/timeseries?metric=queue_depth&n=4")[1])
+        assert set(one["metrics"]) <= {"queue_depth"}
+
+        ctype, body = _get(base, "/debug/fleet/capacity")
+        assert ctype.startswith("application/json")
+        cap = json.loads(body)
+        assert cap["ready"] and sorted(cap["replicas_ready"]) == ["r0", "r1"]
+        assert set(cap["replicas"]) == {"r0", "r1"}
+        assert cap["replicas_needed"] >= 1
+        assert set(cap["slo_budget"]) == {"r0", "r1"}
+        for ledger in cap["slo_budget"].values():
+            assert ledger["objectives"][0]["objective"] == "ttft"
+        # the what-if: double the offered load, never fewer replicas
+        what_if = json.loads(_get(
+            base, "/debug/fleet/capacity?offered="
+            f"{2 * cap['offered_rps']}")[1])
+        assert what_if["replicas_needed"] >= cap["replicas_needed"]
+
+        ctype, body = _get(base, "/debug/fleet/dashboard")
+        assert ctype.startswith("text/html")
+        assert "<svg" in body and "r0" in body and "r1" in body
+        assert "SLO error budgets" in body
